@@ -1,0 +1,54 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (reference surveyed in SURVEY.md), built on JAX/XLA/Pallas.
+
+Public namespace mirrors `paddle.*`: tensor creation + math at top level,
+paddle_tpu.nn, .optimizer, .amp, .jit, .static, .distributed, .vision, ...
+"""
+from __future__ import annotations
+
+import warnings as _warnings
+
+# Without jax_enable_x64, int64 requests silently execute as int32 (paddle's
+# default int dtype is int64; the semantics are preserved modulo width).
+_warnings.filterwarnings(
+    "ignore", message=".*requested in astype is not available.*")
+_warnings.filterwarnings(
+    "ignore", message=".*Explicitly requested dtype.*is not available.*")
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# float32 ops must be float32-accurate (the reference computes true fp32 unless
+# AMP is enabled). XLA's default runs f32 matmuls with bf16 passes on TPU;
+# force full precision for f32 — the AMP/bf16 path (paddle_tpu.amp) is the MXU
+# perf path and is unaffected by this setting.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+from .core import (  # noqa: F401
+    Tensor, Parameter, no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+    grad as _functional_grad, seed, get_rng_state, set_rng_state,
+    set_default_dtype, get_default_dtype,
+    set_flags, get_flags, set_device, get_device, device_count,
+    CPUPlace, CUDAPlace, TPUPlace, Place,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+    bool_ as bool8, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128,
+)
+from .core.dtypes import bool_  # noqa: F401
+
+from .ops import *  # noqa: F401,F403
+from .ops.dispatch import in_dygraph_mode, enable_static, disable_static  # noqa: F401
+from .ops import linalg  # noqa: F401
+
+# grad function (paddle.grad)
+grad = _functional_grad
+
+
+def is_grad_enabled_():
+    from .core import autograd_engine
+    return autograd_engine.is_grad_enabled()
+
+
+def disable_signal_handler():  # API parity no-op (reference: platform/init.cc:363)
+    return None

@@ -1,0 +1,21 @@
+"""Core runtime: dtypes, flags, errors, device, RNG, Tensor, autograd.
+
+TPU-native replacement for the reference's platform + memory + imperative C++
+layers (SURVEY §1 L0-L3). Device memory, streams and collectives are owned by
+the XLA runtime; this layer owns naming, dispatch policy, the tape, RNG state
+and configuration.
+"""
+from .dtypes import (bool_, uint8, int8, int16, int32, int64, float16,
+                     bfloat16, float32, float64, complex64, complex128,
+                     set_default_dtype, get_default_dtype, convert_dtype)
+from .flags import set_flags, get_flags, define_flag, flag_value
+from .errors import (EnforceNotMet, InvalidArgumentError, NotFoundError,
+                     UnimplementedError, enforce, throw)
+from .device import (Place, CPUPlace, CUDAPlace, TPUPlace, set_device,
+                     get_device, device_count, is_compiled_with_cuda,
+                     is_compiled_with_tpu, synchronize)
+from .generator import (Generator, seed, default_generator, get_rng_state,
+                        set_rng_state, get_rng_state_tracker)
+from .tensor import Tensor, Parameter
+from .autograd_engine import (no_grad, enable_grad, is_grad_enabled,
+                              set_grad_enabled, backward, grad)

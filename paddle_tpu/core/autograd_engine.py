@@ -1,0 +1,259 @@
+"""Tape autograd engine for eager (dygraph) mode.
+
+TPU-native equivalent of the reference imperative engine:
+- tape recording        (reference: paddle/fluid/imperative/tracer.cc:207 CreateGradOpNode)
+- reverse walk          (reference: imperative/basic_engine.cc:235 PrepareDeps, :305 Execute)
+- grad accumulation     (reference: imperative/gradient_accumulator.cc)
+
+Design difference from the reference: instead of per-op hand-written grad
+kernels selected via GradOpMaker, every eager op is executed through
+``jax.vjp`` of its (traceable) jnp implementation, so the backward of each op
+is an XLA-compiled computation and coverage is automatic for every op. When a
+whole forward is wrapped by ``jit.to_static`` the entire model becomes ONE tape
+node whose vjp is a single compiled HLO — the per-op tape is the debug path,
+exactly matching the reference's dygraph-slow / static-fast split (SURVEY §7).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from collections import defaultdict, deque
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_GRAD_ENABLED = [True]
+
+
+def is_grad_enabled() -> bool:
+    return _GRAD_ENABLED[0]
+
+
+def set_grad_enabled(mode: bool):
+    _GRAD_ENABLED[0] = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator disabling tape recording
+    (reference: python/paddle/fluid/dygraph/base.py no_grad_)."""
+
+    def __enter__(self):
+        self._prev = _GRAD_ENABLED[0]
+        _GRAD_ENABLED[0] = False
+        return self
+
+    def __exit__(self, *exc):
+        _GRAD_ENABLED[0] = self._prev
+        return False
+
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapper(*a, **k):
+            with no_grad():
+                return func(*a, **k)
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = _GRAD_ENABLED[0]
+        _GRAD_ENABLED[0] = True
+        return self
+
+
+class InputRef:
+    """Producer binding of one differentiable input, captured at record time.
+
+    The Python Tensor object is mutable (set_value/__setitem__ rebind its data
+    and node), so the tape must remember which GradNode produced the value
+    that was *consumed*, not whatever the object points at later. The version
+    snapshot detects in-place mutation of leaves needed for backward
+    (reference: framework/tensor.h:77 TensorInplaceVersion, checked in
+    basic_engine.cc)."""
+
+    __slots__ = ("tensor", "node", "idx", "version")
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+        entry = getattr(tensor, "_grad_node", None)
+        if entry is None:
+            self.node, self.idx = None, None
+        else:
+            self.node, self.idx = entry
+        self.version = tensor._inplace_version
+
+
+class GradNode:
+    """One recorded op on the tape. Holds the vjp closure (residuals live in
+    device memory until backward frees them) and the differentiable input
+    bindings (reference: imperative/op_base.h:182 GradOpNode)."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "accum", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn, inputs: List, out_avals: List):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = [InputRef(t) for t in inputs]
+        self.out_avals = out_avals    # [(shape, dtype)] for every output leaf
+        self.accum: dict = {}         # out leaf index -> accumulated cotangent
+
+    def seed(self, idx: int, g):
+        if idx in self.accum:
+            self.accum[idx] = self.accum[idx] + g
+        else:
+            self.accum[idx] = g
+
+    def cotangents(self):
+        """Materialize the full output-cotangent structure; zeros where no
+        gradient flowed (reference: basic_engine fills empty grads with zeros)."""
+        cots = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            if i in self.accum:
+                cots.append(self.accum[i])
+            elif np.issubdtype(np.dtype(dtype), np.inexact) or dtype == jnp.bfloat16:
+                cots.append(jnp.zeros(shape, dtype))
+            else:
+                # integer/bool outputs take symbolic zero cotangents
+                cots.append(np.zeros(shape, dtype=jax.dtypes.float0))
+        return cots
+
+
+def _node_of(t) -> Optional[Tuple[GradNode, int]]:
+    return getattr(t, "_grad_node", None)
+
+
+def _run_hooks(t, g):
+    hooks = getattr(t, "_backward_hooks", None)
+    if hooks:
+        for h in list(hooks.values()):
+            out = h(g)
+            if out is not None:
+                g = out if not hasattr(out, "_data") else out._data
+    return g
+
+
+def _execute(roots, retain_graph: bool = False, watched: Optional[dict] = None):
+    """Queue-driven reverse-topological tape walk over possibly multiple
+    seeded roots (reference: imperative/basic_engine.cc:305 Execute).
+
+    ``roots`` is a list of (tensor, grad-or-None). When ``watched`` is given
+    (a dict keyed by id(tensor)), cotangents arriving at those tensors are
+    accumulated there and leaf ``.grad`` fields are left untouched —
+    functional `paddle.grad` mode (reference: partial_grad_engine.cc).
+    """
+    root_nodes = []
+    for root, grad in roots:
+        entry = _node_of(root)
+        if entry is None:
+            continue  # leaf with no graph: nothing to do (matches dygraph)
+        root_node, root_idx = entry
+        if grad is None:
+            shape, dtype = root_node.out_avals[root_idx]
+            grad = jnp.ones(shape, dtype)
+        root_node.seed(root_idx, grad)
+        root_nodes.append(root_node)
+        if watched is not None and id(root) in watched:
+            watched[id(root)].append(grad)
+    if not root_nodes:
+        return
+
+    # PrepareDeps: BFS from the roots counting consumer edges per reachable
+    # node (reference: basic_engine.cc:235).
+    indeg = defaultdict(int)
+    seen = set()
+    stack = []
+    for rn in root_nodes:
+        if id(rn) not in seen:
+            seen.add(id(rn))
+            stack.append(rn)
+    while stack:
+        n = stack.pop()
+        for ref in n.inputs:
+            if ref.node is None:
+                continue
+            indeg[id(ref.node)] += 1
+            if id(ref.node) not in seen:
+                seen.add(id(ref.node))
+                stack.append(ref.node)
+
+    queue = deque(rn for rn in dict.fromkeys(root_nodes) if indeg[id(rn)] == 0)
+    while queue:
+        node = queue.popleft()
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through op '{node.name}' a second time; "
+                "set retain_graph=True to allow this.")
+        # apply() arranges every op's pure fn to return a flat tuple of output
+        # leaves, so the cotangent is always a tuple.
+        in_cots = node.vjp_fn(tuple(node.cotangents()))
+        if not retain_graph:
+            node.vjp_fn = None
+        node.accum = {}
+        for ref, g in zip(node.inputs, in_cots):
+            t = ref.tensor
+            g = _run_hooks(t, g)
+            if watched is not None and id(t) in watched and ref.version == t._inplace_version:
+                watched[id(t)].append(g)
+            if ref.node is not None:
+                ref.node.seed(ref.idx, g)
+                indeg[id(ref.node)] -= 1
+                if indeg[id(ref.node)] == 0:
+                    queue.append(ref.node)
+            elif watched is None and not t.stop_gradient:
+                if t._inplace_version != ref.version:
+                    raise RuntimeError(
+                        f"Tensor needed for the backward of op '{node.name}' "
+                        f"was modified in place (version {ref.version} -> "
+                        f"{t._inplace_version}); this would produce wrong "
+                        "gradients (reference: TensorInplaceVersion guard).")
+                t._accumulate_grad(g)
+
+
+def backward(root, grad=None, retain_graph: bool = False):
+    _execute([(root, grad)], retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """Functional ``paddle.grad`` (reference: imperative/partial_grad_engine.cc
+    via python/paddle/fluid/dygraph/base.py grad). ``create_graph`` (double
+    grad) is not yet supported on the eager tape; use jax.grad composition via
+    jit.to_static for higher-order gradients."""
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported yet; "
+            "wrap the computation with paddle_tpu.jit.to_static and use "
+            "nested vjp there.")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    grad_outputs = [g for g in (grad_outputs if isinstance(grad_outputs, (list, tuple))
+                                else [grad_outputs])]
+
+    # Collect mode: one multi-root walk; leaf .grad fields are untouched and
+    # intermediate (non-leaf) inputs get their cotangents too.
+    watched = {id(t): [] for t in inputs}
+    roots = [(o, None if g is None else (g._data if isinstance(g, Tensor) else g))
+             for o, g in zip(outputs, grad_outputs)]
+    _execute(roots, retain_graph=bool(retain_graph), watched=watched)
+
+    results = []
+    for t in inputs:
+        contribs = watched[id(t)]
+        if not contribs:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True to return None for it.")
+            results.append(None)
+        else:
+            total = contribs[0]
+            for c in contribs[1:]:
+                total = total + c
+            results.append(Tensor(total, stop_gradient=True))
+    return results

@@ -1,0 +1,87 @@
+"""Dtype model.
+
+TPU-native replacement for the reference's VarType dtype enum
+(reference: paddle/fluid/framework/framework.proto:106 `VarType.Type`) and the
+fp16/bf16 types (reference: paddle/fluid/platform/float16.h, bfloat16.h).
+On TPU, dtypes are just numpy/jax dtypes; bfloat16 is first-class (MXU native),
+float16 is supported but bf16 is preferred.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects (numpy dtype instances; jnp accepts them directly).
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16  # numpy extension dtype via ml_dtypes
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": np.dtype(bfloat16),
+    "bf16": np.dtype(bfloat16),
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalize a user dtype spec (str | np.dtype | jnp dtype | None) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _ALIASES:
+            return np.dtype(_ALIASES[key])
+        return np.dtype(key)
+    return np.dtype(dtype)
+
+
+def set_default_dtype(dtype):
+    """paddle.set_default_dtype parity (reference: python/paddle/framework/framework.py)."""
+    d = convert_dtype(dtype)
+    if d not in (np.dtype("float16"), np.dtype(bfloat16), float32, float64):
+        raise TypeError(
+            "set_default_dtype only supports float16/bfloat16/float32/float64, got %s" % d
+        )
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.floating) or d == np.dtype(bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype), np.integer)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name

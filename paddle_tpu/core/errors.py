@@ -1,0 +1,74 @@
+"""Structured error types + enforce helpers.
+
+TPU-native equivalent of the reference's PADDLE_ENFORCE / PADDLE_THROW machinery
+(reference: paddle/fluid/platform/enforce.h:427, errors.h, error_codes.proto).
+The reference attaches the op-creation Python stack to runtime errors
+(framework/op_call_stack.cc); here errors are raised directly from Python so the
+traceback is native.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base framework error (reference: enforce.h EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg, *args, exc=InvalidArgumentError):
+    """PADDLE_ENFORCE(cond, fmt, ...) parity (reference: enforce.h:427)."""
+    if not cond:
+        raise exc(msg % args if args else msg)
+
+
+def enforce_eq(a, b, msg="", exc=InvalidArgumentError):
+    if a != b:
+        raise exc(f"Expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_shape_match(shape_a, shape_b, msg=""):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(f"Shape mismatch: {tuple(shape_a)} vs {tuple(shape_b)}. {msg}")
+
+
+def throw(msg, *args, exc=EnforceNotMet):
+    """PADDLE_THROW parity (reference: enforce.h:415)."""
+    raise exc(msg % args if args else msg)

@@ -1,0 +1,100 @@
+"""Global flag registry with FLAGS_* environment override.
+
+TPU-native equivalent of the reference's gflags globals
+(reference: paddle/fluid/platform/flags.cc — 35 DEFINE_*;
+pybind/global_value_getter_setter.cc exposes them as paddle.set_flags/get_flags).
+We keep the FLAGS_<name> env contract: any registered flag can be preset via the
+environment at import time and changed at runtime with set_flags().
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "help", "caster", "on_change")
+
+    def __init__(self, name, default, help_str, caster, on_change=None):
+        self.name = name
+        self.default = default
+        self.help = help_str
+        self.caster = caster
+        self.on_change = on_change
+        env = os.environ.get("FLAGS_" + name)
+        self.value = caster(env) if env is not None else default
+
+
+def _cast_bool(v):
+    if isinstance(v, str):
+        return v.strip().lower() in ("1", "true", "yes", "on")
+    return bool(v)
+
+
+def define_flag(name: str, default: Any, help_str: str = "",
+                caster: Callable = None, on_change: Callable = None):
+    if caster is None:
+        if isinstance(default, bool):
+            caster = _cast_bool
+        elif isinstance(default, int):
+            caster = int
+        elif isinstance(default, float):
+            caster = float
+        else:
+            caster = str
+    _REGISTRY[name] = _Flag(name, default, help_str, caster, on_change)
+    return _REGISTRY[name]
+
+
+def get_flags(flags):
+    """paddle.get_flags parity. Accepts a name or list of names."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for n in flags:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError("Unknown flag: %s" % n)
+        out["FLAGS_" + key] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags parity."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError("Unknown flag: %s" % n)
+        f = _REGISTRY[key]
+        f.value = f.caster(v)
+        if f.on_change is not None:
+            f.on_change(f.value)
+
+
+def flag_value(name: str):
+    return _REGISTRY[name].value
+
+
+# ---------------------------------------------------------------------------
+# Core flags (subset of reference Appendix E relevant on TPU).
+define_flag("check_nan_inf", False,
+            "After every eager op, scan outputs for NaN/Inf and raise "
+            "(reference: platform/flags.cc FLAGS_check_nan_inf + "
+            "framework/details/nan_inf_utils_detail.cc:411).")
+define_flag("paddle_num_threads", 1, "Host-side intra-op threads (XLA-CPU).")
+define_flag("cudnn_deterministic", False,
+            "Deterministic kernels; on TPU maps to XLA deterministic reductions.")
+define_flag("selected_devices", "",
+            "Comma-separated local device ids (reference FLAGS_selected_gpus).")
+define_flag("benchmark", False, "Emit per-step benchmark logs.")
+define_flag("sort_sum_gradient", False,
+            "Deterministic gradient accumulation order in the tape engine "
+            "(reference: imperative/flags.cc).")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "Kept for API parity; HBM is managed by the XLA runtime.")
+define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity; GC is Python/XLA-owned.")
+define_flag("tpu_donate_buffers", True,
+            "Donate param/opt-state buffers in compiled train steps (in-place update).")
+define_flag("log_level", 0, "Framework VLOG-style verbosity (reference GLOG_v).")

@@ -1,0 +1,333 @@
+"""The eager Tensor: a mutable named holder over an immutable jax.Array.
+
+TPU-native equivalent of the reference's VarBase + Tensor
+(reference: paddle/fluid/imperative/layer.h:66 `VarBase`,
+framework/tensor.h:89 `Tensor`, framework/tensor.h:77 `TensorInplaceVersion`).
+
+Paddle semantics preserved:
+- ``stop_gradient`` defaults True for data, set False for parameters
+- ``t.grad`` accumulated by ``loss.backward()``; ``clear_grad()`` resets
+- in-place-looking APIs (``set_value``, ``__setitem__``) swap the underlying
+  immutable array and bump ``_inplace_version`` (the reference guards autograd
+  against in-place races the same way).
+
+Math/manipulation methods are attached by ``paddle_tpu.ops`` at import time
+(the reference attaches them via generated pybind ``core.ops``; here it is a
+method-patch table, see ops/__init__.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as _dtypes
+from .device import Place, _expected_place
+from . import autograd_engine as _ag
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "name",
+                 "persistable", "_inplace_version", "_backward_hooks",
+                 "_hook_counter", "trainable", "__weakref__", "is_distributed",
+                 "_sharding_spec")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None, persistable=False):
+        if isinstance(data, Tensor):
+            data = data._data
+        dtype = _dtypes.convert_dtype(dtype)
+        if isinstance(data, (jax.Array, jax.core.Tracer)):
+            self._data = data.astype(dtype) if (dtype is not None and data.dtype != dtype) else data
+        else:
+            arr = np.asarray(data)
+            if dtype is None and arr.dtype == np.float64:
+                dtype = _dtypes.get_default_dtype()  # paddle default-dtype convention
+            self._data = jnp.asarray(arr, dtype=dtype)
+        self.stop_gradient = bool(stop_gradient)
+        self._grad = None
+        self._grad_node = None
+        self.name = name
+        self.persistable = persistable
+        self._inplace_version = 0
+        self._backward_hooks = None
+        self._hook_counter = 0
+        self.trainable = not stop_gradient
+        self.is_distributed = False
+        self._sharding_spec = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self) -> Place:
+        d = getattr(self._data, "devices", None)
+        if d:
+            dev = next(iter(self._data.devices()))
+            return Place(dev.platform, dev.id)
+        return _expected_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        return Tensor(self._grad, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else (
+            value._data if isinstance(value, Tensor) else jnp.asarray(value))
+
+    def _accumulate_grad(self, g):
+        # reference: imperative/gradient_accumulator.cc (sum accumulation)
+        if g.dtype != self._data.dtype:
+            g = g.astype(self._data.dtype)
+        self._grad = g if self._grad is None else self._grad + g
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        g = None
+        if grad_tensor is not None:
+            g = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+        _ag.backward(self, g, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Gradient hook (reference: imperative/hooks.h); returns a removable
+        handle."""
+        if self._backward_hooks is None:
+            self._backward_hooks = {}
+        hid = self._hook_counter
+        self._hook_counter += 1
+        self._backward_hooks[hid] = hook
+        tensor = self
+
+        class _Handle:
+            def remove(self):
+                tensor._backward_hooks.pop(hid, None)
+        return _Handle()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def requires_grad_(self, value: bool = True):
+        self.stop_gradient = not value
+        return self
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from ..ops.dispatch import apply
+        d = _dtypes.convert_dtype(dtype)
+        return apply("cast", lambda x: x.astype(d), self)
+
+    cast = astype
+
+    def clone(self) -> "Tensor":
+        from ..ops.dispatch import apply
+        return apply("clone", lambda x: x + 0, self)
+
+    def cpu(self):
+        return Tensor(np.asarray(self._data), stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        return self
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    # -- mutation (in-place style) -----------------------------------------
+    def set_value(self, value):
+        """Replace contents in place (reference: VarBase SetValue); bumps the
+        inplace version like TensorInplaceVersion (tensor.h:77)."""
+        raw = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(raw.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {tuple(raw.shape)} vs {tuple(self._data.shape)}")
+        self._data = raw.astype(self._data.dtype)
+        self._inplace_version += 1
+        self._grad_node = None
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def _swap_payload(self, other: "Tensor"):
+        """Adopt another tensor's data + tape node (functional in-place).
+
+        Deliberately does NOT bump _inplace_version: this path is tape-recorded
+        (reshape_, relu_, __setitem__, increment), so downstream consumers get
+        correct gradients through the recorded node — the version guard is for
+        raw, untaped replacement (set_value)."""
+        self._data = other._data
+        self._grad_node = other._grad_node
+
+    def __setitem__(self, idx, value):
+        from ..ops.dispatch import apply
+        raw_idx = _unwrap_index(idx)
+
+        def _fit(x, v):
+            # jnp's .at[].set broadcasts but cannot drop dims; paddle/numpy
+            # allow assigning shape-(1,) to a scalar slot — squeeze leading 1s.
+            target = jax.eval_shape(lambda t: t[raw_idx], x).shape
+            while v.ndim > len(target) and v.shape[0] == 1:
+                v = v.reshape(v.shape[1:])
+            return x.at[raw_idx].set(v.astype(x.dtype))
+
+        if isinstance(value, Tensor):
+            out = apply("set_value", _fit, self, value)
+        else:
+            out = apply("set_value",
+                        lambda x: _fit(x, jnp.asarray(value)), self)
+        self._swap_payload(out)
+
+    def __getitem__(self, idx):
+        from ..ops.dispatch import apply
+        raw_idx = _unwrap_index(idx)
+        if _index_has_tensor(idx):
+            # advanced indexing with tensor indices participates in autograd
+            return apply("getitem", lambda x, *i: x[_rebuild_index(raw_idx, i)],
+                         self, *_index_tensors(idx))
+        return apply("getitem", lambda x: x[raw_idx], self)
+
+    # -- misc ---------------------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_s},\n"
+                f"       {np.asarray(self._data)!r})")
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.numpy().item(), spec)
+        return repr(self)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # jax pytree integration: Tensor flattens to its raw array
+    def __jax_array__(self):
+        return self._data
+
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+    return idx._data if isinstance(idx, Tensor) else idx
+
+
+def _index_has_tensor(idx):
+    if isinstance(idx, Tensor):
+        return True
+    if isinstance(idx, tuple):
+        return any(isinstance(i, Tensor) for i in idx)
+    return False
+
+
+def _index_tensors(idx):
+    if isinstance(idx, Tensor):
+        return (idx,)
+    return tuple(i for i in idx if isinstance(i, Tensor))
+
+
+def _rebuild_index(raw_idx, tensor_raws):
+    """Substitute traced index arrays back into the index structure."""
+    it = iter(tensor_raws)
+    if not isinstance(raw_idx, tuple):
+        return next(it) if isinstance(raw_idx, (jax.Array, jax.core.Tracer)) else raw_idx
+    out = []
+    for i in raw_idx:
+        out.append(next(it) if isinstance(i, (jax.Array, jax.core.Tracer)) else i)
+    return tuple(out)
+
+
+def _as_raw(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+# Parameter: a trainable Tensor (reference: python/paddle/fluid/framework.py:5400
+# ParamBase — a VarBase with trainable/regularizer attributes).
+class Parameter(Tensor):
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip",
+                 "is_distributed_param")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True, **kw):
+        super().__init__(data, dtype=dtype, name=name, stop_gradient=not trainable,
+                         persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = kw.get("regularizer")
+        self.do_model_average = kw.get("do_model_average")
+        self.need_clip = kw.get("need_clip", True)
+        self.is_distributed_param = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

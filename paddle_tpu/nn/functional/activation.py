@@ -1,0 +1,188 @@
+"""Functional activations (paddle.nn.functional parity).
+
+Reference: paddle/fluid/operators/activation_op.cc (FOR_EACH_ACTIVATION_OP
+macro family, SURVEY Appendix A) — the reference registers each as a C++/CUDA
+kernel pair; here each is one jnp expression XLA fuses into neighbours.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...core.tensor import Tensor
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "tanh", "softmax",
+    "log_softmax", "leaky_relu", "elu", "selu", "celu", "silu", "swish",
+    "mish", "hardswish", "hardsigmoid", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "softplus", "softsign", "prelu", "rrelu",
+    "maxout", "thresholded_relu", "log_sigmoid", "glu", "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    x._swap_payload(relu(x))
+    return x
+
+
+def relu6(x, name=None):
+    return apply("relu6", lambda a: jnp.clip(a, 0.0, 6.0), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, x)
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def impl(a):
+        if dtype is not None:
+            a = a.astype(np.dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply("softmax", impl, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def impl(a):
+        if dtype is not None:
+            a = a.astype(np.dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply("log_softmax", impl, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def mish(x, name=None):
+    return apply("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def hardswish(x, name=None):
+    return apply("hard_swish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hard_sigmoid", lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("brelu", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hard_shrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink",
+                 lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+def tanhshrink(x, name=None):
+    return apply("tanh_shrink", lambda a: a - jnp.tanh(a), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus",
+                 lambda a: jnp.where(a * beta > threshold, a,
+                                     jax.nn.softplus(a * beta) / beta), x)
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply("prelu", impl, x, weight)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    from ...core import generator as _gen
+    if training:
+        key = _gen.next_key()
+        return apply("rrelu",
+                     lambda a: jnp.where(
+                         a >= 0, a,
+                         a * jax.random.uniform(key, a.shape, a.dtype, lower, upper)), x)
+    mid = (lower + upper) / 2.0
+    return apply("rrelu", lambda a: jnp.where(a >= 0, a, a * mid), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(a):
+        s = list(a.shape)
+        c = s[axis]
+        new = s[:axis] + [c // groups, groups] + s[axis + 1:]
+        return jnp.max(a.reshape(new), axis=axis + 1)
+    return apply("maxout", impl, x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply("thresholded_relu", lambda a: jnp.where(a > threshold, a, 0.0), x)
+
+
+def log_sigmoid(x, name=None):
+    return apply("logsigmoid", jax.nn.log_sigmoid, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import generator as _gen
+    key = _gen.next_key()
+
+    def impl(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            # straight-through: forward=y_hard, backward=softmax
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply("gumbel_softmax", impl, x)

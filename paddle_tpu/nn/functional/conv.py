@@ -1,0 +1,158 @@
+"""Convolutions via jax.lax.conv_general_dilated.
+
+Parity targets: conv2d, conv3d, conv1d, depthwise_conv2d, conv2d_transpose,
+conv3d_transpose (reference: paddle/fluid/operators/conv_op.cc,
+conv_transpose_op.cc, + cudnn kernel variants). One lax primitive replaces the
+reference's per-backend kernel matrix; XLA tiles it onto the MXU.
+Data layout follows paddle's default NCHW / kernel OIHW.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import apply
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 2 * n:  # per-side pairs
+            return tuple(v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding_arg(padding, n, dilation, kernel):
+    """paddle padding: int, list, 'SAME', 'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)) and len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    p = _tuplize(padding, n)
+    return [(x, x) for x in p]
+
+
+def _dim_numbers(n, channel_last=False):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    dn = _dim_numbers(n, channel_last)
+    pad = _padding_arg(padding, n, dilation, None)
+
+    def impl(a, w, *b):
+        kernel = w
+        if channel_last:
+            # paddle stores kernels OIHW regardless; transpose for lax layout
+            perm = list(range(2, 2 + n)) + [1, 0]
+            kernel = jnp.transpose(w, perm)
+        out = lax.conv_general_dilated(
+            a, kernel, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(f"conv{n}d", impl, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_nd(1, x, weight, bias, stride, padding, dilation, groups, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(2, x, weight, bias, stride, padding, dilation, groups, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(3, x, weight, bias, stride, padding, dilation, groups, data_format)
+
+
+def _conv_transpose_nd(n, x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, output_size, data_format):
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    opad = _tuplize(output_padding, n)
+    dn = _dim_numbers(n, channel_last)
+    if isinstance(padding, str):
+        raise ValueError("string padding not supported for conv_transpose")
+    pads = _padding_arg(padding, n, dilation, None)
+
+    def impl(a, w, *b):
+        # paddle transpose-conv kernels are [in_c, out_c/groups, *k]
+        # grad-of-conv: lhs_dilation = stride, padding adjusted
+        k = w.shape[2:]
+        adj_pad = [
+            (dilation[i] * (k[i] - 1) - pads[i][0],
+             dilation[i] * (k[i] - 1) - pads[i][1] + opad[i])
+            for i in range(n)]
+        # flip spatial dims and swap i/o channels: OIHW with O=out
+        kernel = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            # [in_c, out_c/g, *k] -> [g, in_c/g, out_c/g, *k] -> [out_c, in_c/g, *k]
+            ic = kernel.shape[0]
+            kernel = kernel.reshape((groups, ic // groups) + kernel.shape[1:])
+            kernel = jnp.moveaxis(kernel, 2, 1)  # g, out/g, in/g, *k
+            kernel = kernel.reshape((kernel.shape[0] * kernel.shape[1],) + kernel.shape[2:])
+        else:
+            kernel = jnp.swapaxes(kernel, 0, 1)
+        if channel_last:
+            perm = list(range(2, 2 + n)) + [1, 0]
+            kernel = jnp.transpose(kernel, perm)
+        out = lax.conv_general_dilated(
+            a, kernel, window_strides=(1,) * n, padding=adj_pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[-1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(f"conv{n}d_transpose", impl, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose_nd(1, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, output_size, fmt)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(2, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, output_size,
+                              data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(3, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, output_size,
+                              data_format)

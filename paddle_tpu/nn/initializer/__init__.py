@@ -1,0 +1,209 @@
+"""Weight initializers (paddle.nn.initializer parity).
+
+Reference: python/paddle/fluid/initializer.py (ConstantInitializer,
+UniformInitializer, NormalInitializer, TruncatedNormalInitializer,
+XavierInitializer, MSRAInitializer, BilinearInitializer, NumpyArrayInitializer)
+and python/paddle/nn/initializer/. Initializers here are callables that
+produce a fresh jax array for a given shape/dtype using the global Generator.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dtypes as _dt
+from ...core import generator as _gen
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+    def _dtype(self, dtype):
+        d = _dt.convert_dtype(dtype)
+        return d if d is not None else _dt.get_default_dtype()
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(shape, self.value, self._dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        return jax.random.uniform(_gen.next_key(), shape, self._dtype(dtype),
+                                  self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        return (jax.random.normal(_gen.next_key(), shape, self._dtype(dtype))
+                * self.std + self.mean)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        return (jax.random.truncated_normal(_gen.next_key(), -2.0, 2.0, shape,
+                                            self._dtype(dtype))
+                * self.std + self.mean)
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c, *k] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierUniform(Initializer):
+    """reference: fluid/initializer.py XavierInitializer(uniform=True)."""
+
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_gen.next_key(), shape, self._dtype(dtype),
+                                  -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=None):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(_gen.next_key(), shape, self._dtype(dtype)) * std
+
+
+class KaimingUniform(Initializer):
+    """reference: fluid/initializer.py MSRAInitializer(uniform=True)."""
+
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        return math.sqrt(2.0) if self.nonlinearity == "relu" else 1.0
+
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        return jax.random.uniform(_gen.next_key(), shape, self._dtype(dtype),
+                                  -limit, limit)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype=None):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = self._gain() / math.sqrt(fi)
+        return jax.random.normal(_gen.next_key(), shape, self._dtype(dtype)) * std
+
+
+class Assign(Initializer):
+    """reference: NumpyArrayInitializer."""
+
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        arr = np.asarray(self.value._data if hasattr(self.value, "_data") else self.value)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(shape)
+        return jnp.asarray(arr, self._dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """reference: fluid/initializer.py BilinearInitializer (upsample deconv)."""
+
+    def __call__(self, shape, dtype=None):
+        weight = np.zeros(shape, np.float32)
+        f = math.ceil(shape[-1] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape[-2:]))):
+            x, y = i % shape[-1], i // shape[-1]
+            v = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            weight[..., y, x] = v
+        return jnp.asarray(weight, self._dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        rows, cols = shape[0], int(np.prod(shape[1:]))
+        n = max(rows, cols)
+        a = jax.random.normal(_gen.next_key(), (n, n), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diag(r))
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(self._dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        w = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        mins = min(oc // self.groups, ic)
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(mins):
+                w[(g * (oc // self.groups) + i, i) + tuple(centers)] = 1.0
+        return jnp.asarray(w, self._dtype(dtype))
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0
+
+
+# legacy fluid-style aliases (reference: fluid/initializer.py module tail)
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign

@@ -1,0 +1,127 @@
+"""Functional op library + Tensor method attachment.
+
+The reference generates per-op Python fast-path entry points at build time
+(pybind/op_function_generator.cc:496 → core.ops.*) and patches methods onto
+VarBase (python/paddle/fluid/dygraph/varbase_patch_methods.py). Here the ops
+are plain Python functions over traceable jnp implementations, and Tensor
+methods are attached from a table at import time.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import creation, math, manipulation, linalg, dispatch
+from .dispatch import (apply, apply_raw, OP_REGISTRY, in_dygraph_mode,
+                       enable_static, disable_static)
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+
+
+def _attach_methods():
+    m, mp, cr = math, manipulation, creation
+
+    methods = {
+        # math
+        "abs": m.abs, "exp": m.exp, "log": m.log, "log2": m.log2,
+        "log10": m.log10, "log1p": m.log1p, "sqrt": m.sqrt, "rsqrt": m.rsqrt,
+        "square": m.square, "sin": m.sin, "cos": m.cos, "tan": m.tan,
+        "tanh": m.tanh, "sigmoid": m.sigmoid, "floor": m.floor, "ceil": m.ceil,
+        "round": m.round, "trunc": m.trunc, "sign": m.sign,
+        "reciprocal": m.reciprocal, "erf": m.erf, "erfinv": m.erfinv,
+        "lgamma": m.lgamma, "digamma": m.digamma, "neg": m.neg,
+        "isnan": m.isnan, "isinf": m.isinf, "isfinite": m.isfinite,
+        "logical_not": m.logical_not, "bitwise_not": m.bitwise_not,
+        "add": m.add, "subtract": m.subtract, "multiply": m.multiply,
+        "divide": m.divide, "floor_divide": m.floor_divide, "mod": m.mod,
+        "remainder": m.remainder, "pow": m.pow, "maximum": m.maximum,
+        "minimum": m.minimum, "fmax": m.fmax, "fmin": m.fmin,
+        "atan2": m.atan2, "logical_and": m.logical_and,
+        "logical_or": m.logical_or, "logical_xor": m.logical_xor,
+        "bitwise_and": m.bitwise_and, "bitwise_or": m.bitwise_or,
+        "bitwise_xor": m.bitwise_xor, "equal": m.equal,
+        "not_equal": m.not_equal, "greater_than": m.greater_than,
+        "greater_equal": m.greater_equal, "less_than": m.less_than,
+        "less_equal": m.less_equal, "equal_all": m.equal_all,
+        "allclose": m.allclose, "isclose": m.isclose,
+        "matmul": m.matmul, "mm": m.mm, "bmm": m.bmm, "mv": m.mv,
+        "dot": m.dot, "inner": m.inner, "outer": m.outer, "kron": m.kron,
+        "cross": m.cross, "trace": m.trace, "scale": m.scale, "clip": m.clip,
+        "lerp": m.lerp, "nan_to_num": m.nan_to_num,
+        # reductions
+        "sum": m.sum, "mean": m.mean, "prod": m.prod, "max": m.max,
+        "min": m.min, "amax": m.amax, "amin": m.amin, "all": m.all,
+        "any": m.any, "std": m.std, "var": m.var, "median": m.median,
+        "nanmean": m.nanmean, "nansum": m.nansum, "quantile": m.quantile,
+        "logsumexp": m.logsumexp, "cumsum": m.cumsum, "cumprod": m.cumprod,
+        "count_nonzero": m.count_nonzero, "norm": m.norm, "dist": m.dist,
+        # search/sort
+        "argmax": m.argmax, "argmin": m.argmin, "argsort": m.argsort,
+        "sort": m.sort, "topk": m.topk, "kthvalue": m.kthvalue, "mode": m.mode,
+        "where": m.where, "nonzero": m.nonzero, "masked_select": m.masked_select,
+        "masked_fill": m.masked_fill, "index_select": m.index_select,
+        "index_sample": m.index_sample, "take_along_axis": m.take_along_axis,
+        "put_along_axis": m.put_along_axis, "gather": m.gather,
+        "gather_nd": m.gather_nd, "scatter": m.scatter,
+        "scatter_nd_add": m.scatter_nd_add, "bincount": m.bincount,
+        "histogram": m.histogram, "unique": m.unique,
+        "unique_consecutive": m.unique_consecutive,
+        "searchsorted": m.searchsorted,
+        # manipulation
+        "reshape": mp.reshape, "reshape_": mp.reshape_,
+        "transpose": mp.transpose, "moveaxis": mp.moveaxis,
+        "swapaxes": mp.swapaxes, "split": mp.split, "chunk": mp.chunk,
+        "squeeze": mp.squeeze, "squeeze_": mp.squeeze_,
+        "unsqueeze": mp.unsqueeze, "unsqueeze_": mp.unsqueeze_,
+        "flatten": mp.flatten, "tile": mp.tile, "expand": mp.expand,
+        "expand_as": mp.expand_as, "broadcast_to": mp.broadcast_to,
+        "flip": mp.flip, "roll": mp.roll, "unbind": mp.unbind,
+        "unstack": mp.unstack, "repeat_interleave": mp.repeat_interleave,
+        "slice": mp.slice, "strided_slice": mp.strided_slice,
+        "tolist": mp.tolist, "tensordot": mp.tensordot,
+        # linalg
+        "cholesky": linalg.cholesky, "inverse": linalg.inv,
+        "matrix_power": linalg.matrix_power,
+        # creation-ish
+        "fill_": creation.fill_, "zero_": creation.zero_,
+        "uniform_": creation.uniform_, "normal_": creation.normal_,
+    }
+    for name, fn in methods.items():
+        setattr(Tensor, name, fn)
+
+    # operator dunders
+    def _rsub(x, y):
+        return m.subtract(creation.to_tensor(y) if not isinstance(y, Tensor) else y, x)
+
+    def _rdiv(x, y):
+        return m.divide(creation.to_tensor(y) if not isinstance(y, Tensor) else y, x)
+
+    def _rpow(x, y):
+        return m.pow(creation.to_tensor(y) if not isinstance(y, Tensor) else y, x)
+
+    def _rmod(x, y):
+        return m.mod(creation.to_tensor(y) if not isinstance(y, Tensor) else y, x)
+
+    dunders = {
+        "__add__": m.add, "__radd__": m.add, "__sub__": m.subtract,
+        "__rsub__": _rsub, "__mul__": m.multiply, "__rmul__": m.multiply,
+        "__truediv__": m.divide, "__rtruediv__": _rdiv,
+        "__floordiv__": m.floor_divide, "__mod__": m.mod, "__rmod__": _rmod,
+        "__pow__": m.pow, "__rpow__": _rpow, "__matmul__": m.matmul,
+        "__neg__": m.neg, "__abs__": m.abs,
+        "__eq__": m.equal, "__ne__": m.not_equal, "__gt__": m.greater_than,
+        "__ge__": m.greater_equal, "__lt__": m.less_than,
+        "__le__": m.less_equal, "__invert__": m.logical_not,
+        "__and__": m.bitwise_and, "__or__": m.bitwise_or,
+        "__xor__": m.bitwise_xor,
+    }
+    for name, fn in dunders.items():
+        setattr(Tensor, name, fn)
+
+    @property
+    def T(self):
+        return mp.transpose(self, list(range(self.ndim))[::-1])
+    Tensor.T = T
+
+
+_attach_methods()

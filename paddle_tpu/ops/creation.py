@@ -1,0 +1,274 @@
+"""Tensor creation ops.
+
+Parity targets (reference op registrations, SURVEY Appendix A math/creation
+group): fill_constant, uniform_random, gaussian_random, randint, randperm,
+linspace, eye, tril_triu, assign, one_hot_v2, arange/range, bernoulli,
+multinomial, truncated_gaussian_random (paddle/fluid/operators/*).
+Random ops draw keys from the global Generator (core/generator.py) so
+``paddle.seed`` controls them, like the reference's seeded Generator
+(framework/generator.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from ..core import generator as _gen
+from .dispatch import apply, apply_raw
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._data if isinstance(s, Tensor) else s) for s in shape]
+
+
+def _dtype_or_default(dtype):
+    d = _dt.convert_dtype(dtype)
+    return d if d is not None else _dt.get_default_dtype()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    d = _dtype_or_default(dtype)
+    return apply("fill_constant", lambda: jnp.zeros(_shape_list(shape), d))
+
+
+def ones(shape, dtype=None, name=None):
+    d = _dtype_or_default(dtype)
+    return apply("fill_constant", lambda: jnp.ones(_shape_list(shape), d))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if d is None:
+        d = (np.dtype("bool") if isinstance(fill_value, bool)
+             else np.dtype("int64") if isinstance(fill_value, int)
+             else _dt.get_default_dtype())
+    return apply("fill_constant", lambda: jnp.full(_shape_list(shape), fill_value, d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply("fill_zeros_like", lambda a: jnp.zeros_like(a, dtype=_dt.convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply("fill_ones_like", lambda a: jnp.ones_like(a, dtype=_dt.convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply("fill_any_like",
+                 lambda a: jnp.full_like(a, fill_value, dtype=_dt.convert_dtype(dtype)), x)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    d = _dt.convert_dtype(dtype)
+    if d is None:
+        d = (np.dtype("int64") if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+             else _dt.get_default_dtype())
+    return apply("range", lambda: jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    d = _dtype_or_default(dtype)
+    return apply("linspace", lambda: jnp.linspace(start, stop, num, dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    d = _dtype_or_default(dtype)
+    return apply("eye", lambda: jnp.eye(num_rows, num_columns, dtype=d))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def impl(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base + jnp.diag(a, offset) - jnp.diag(jnp.full((a.shape[0],), padding_value, a.dtype), offset)
+        return jnp.diag(a, offset)
+    return apply("diag_v2", impl, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda a: jnp.diagflat(a, offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def impl(a):
+        flat = a.reshape(-1, a.shape[-1])
+        mats = jax.vmap(lambda v: jnp.diag(v, offset))(flat)
+        mats = mats.reshape(a.shape[:-1] + mats.shape[-2:])
+        if (dim1, dim2) != (-2, -1):
+            mats = jnp.moveaxis(mats, (-2, -1), (dim1, dim2))
+        return mats
+    return apply("diag_embed", impl, x)
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    return apply("meshgrid", lambda *xs: list(jnp.meshgrid(*xs, indexing="ij")), *tensors)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril_triu", lambda a: jnp.tril(a, diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("tril_triu", lambda a: jnp.triu(a, diagonal), x)
+
+
+def assign(x, output=None):
+    """reference: operators/assign_op.cc; copies input."""
+    src = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    out = apply("assign", lambda a: a + 0 if _dt.is_floating(a.dtype) else jnp.array(a), src)
+    if output is not None:
+        output._swap_payload(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return apply("size", lambda a: jnp.asarray(a.size, jnp.int64), x)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot_v2",
+                 lambda a: jax.nn.one_hot(a, num_classes, dtype=_dt.get_default_dtype()), x)
+
+
+# -- random ------------------------------------------------------------------
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _dtype_or_default(dtype)
+    key = _gen.next_key() if seed == 0 else jax.random.PRNGKey(seed)
+    return apply_raw("uniform_random",
+                     lambda: jax.random.uniform(key, _shape_list(shape), d, min, max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        shape = (mean.shape if isinstance(mean, Tensor) else std.shape)
+        key = _gen.next_key()
+        return apply_raw("gaussian_random",
+                         lambda m, s: jax.random.normal(key, _shape_list(shape),
+                                                        _dt.get_default_dtype()) * s + m,
+                         mean, std)
+    d = _dt.get_default_dtype()
+    key = _gen.next_key()
+    return apply_raw("gaussian_random",
+                     lambda: jax.random.normal(key, _shape_list(shape), d) * std + mean)
+
+
+def randn(shape, dtype=None, name=None):
+    d = _dtype_or_default(dtype)
+    key = _gen.next_key()
+    return apply_raw("gaussian_random", lambda: jax.random.normal(key, _shape_list(shape), d))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt.convert_dtype(dtype) or np.dtype("int64")
+    key = _gen.next_key()
+    return apply_raw("randint",
+                     lambda: jax.random.randint(key, _shape_list(shape), low, high, d))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _gen.next_key()
+    return apply_raw("randperm",
+                     lambda: jax.random.permutation(key, n).astype(_dt.convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = _gen.next_key()
+    return apply_raw("bernoulli",
+                     lambda p: jax.random.bernoulli(key, p).astype(p.dtype), x)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _gen.next_key()
+
+    def impl(probs):
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=(num_samples,) + probs.shape[:-1]).T \
+                if probs.ndim > 1 else jax.random.categorical(
+                    key, logits, shape=(num_samples,))
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(key, probs.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    out = apply_raw("multinomial", impl, x)
+    return out.astype("int64")
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    d = _dtype_or_default(dtype)
+    key = _gen.next_key()
+    return apply_raw(
+        "truncated_gaussian_random",
+        lambda: jax.random.truncated_normal(key, -2.0, 2.0, _shape_list(shape), d) * std + mean)
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0):
+    x.set_value(uniform(x.shape, x.dtype, min, max, seed))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0):
+    x.set_value(normal(mean, std, x.shape))
+    return x
+
+
+def zero_(x):
+    x.set_value(zeros(x.shape, x.dtype))
+    return x
+
+
+def fill_(x, value):
+    x.set_value(full(x.shape, value, x.dtype))
+    return x

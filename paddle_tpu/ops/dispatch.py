@@ -1,0 +1,172 @@
+"""Op dispatch: the single funnel every framework op goes through.
+
+TPU-native equivalent of the reference's Tracer::TraceOp + PreparedOp pipeline
+(reference: paddle/fluid/imperative/tracer.cc:133, prepared_operator.cc:87):
+where the reference looks up a per-(place,dtype) kernel and launches it, here
+every op has ONE traceable jnp implementation and dispatch decides:
+
+- eager (dygraph): run it now; if any differentiable input, run under
+  ``jax.vjp`` and record a GradNode on the tape (tracer.cc:207).
+- static mode: append an op record to the current Program instead of running
+  (the reference appends an OpDesc via LayerHelper).
+- AMP: an active autocast list may cast float inputs before execution
+  (reference: imperative/amp_auto_cast.cc AutoCastInputs).
+- FLAGS_check_nan_inf: scan eager outputs for NaN/Inf and abort with the op
+  name (reference: framework/details/nan_inf_utils_detail.cc:411).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from ..core.tensor import Tensor
+from ..core import autograd_engine as _ag
+from ..core.flags import flag_value
+
+# Registry of op name -> python impl, for introspection/tests/serialization
+# (reference: framework/op_info.h:131 OpInfoMap).
+OP_REGISTRY = {}
+
+# Hook installed by paddle_tpu.static to capture static-mode graph building.
+_STATIC_HANDLER = [None]
+_STATIC_MODE = [False]
+
+# Hook installed by paddle_tpu.amp for input autocasting: fn(op_name, tensors)->tensors
+_AMP_HANDLER = [None]
+
+
+def enable_static():
+    _STATIC_MODE[0] = True
+
+
+def disable_static():
+    _STATIC_MODE[0] = False
+
+
+def in_dygraph_mode() -> bool:
+    return not _STATIC_MODE[0]
+
+
+def register_static_handler(fn):
+    _STATIC_HANDLER[0] = fn
+
+
+def register_amp_handler(fn):
+    _AMP_HANDLER[0] = fn
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def apply(name: str, fn: Callable, *args, **attrs):
+    """Execute (or record) op ``name`` whose implementation is ``fn``.
+
+    ``args`` may contain Tensors at arbitrary pytree positions (e.g. concat
+    takes a list of tensors); ``attrs`` are static python attributes closed
+    over at trace time (the reference's OpDesc attrs).
+    """
+    leaves, treedef = tree_flatten(args, is_leaf=_is_tensor_leaf)
+
+    if _STATIC_MODE[0] and _STATIC_HANDLER[0] is not None:
+        return _STATIC_HANDLER[0](name, fn, args, attrs, leaves, treedef)
+
+    t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    tensors = [leaves[i] for i in t_idx]
+
+    if _AMP_HANDLER[0] is not None and tensors:
+        tensors = _AMP_HANDLER[0](name, tensors)
+        for i, t in zip(t_idx, tensors):
+            leaves[i] = t
+
+    need_grad = (_ag.is_grad_enabled()
+                 and any(not t.stop_gradient for t in tensors))
+
+    if need_grad:
+        # differentiate w.r.t. only the non-stop-gradient float inputs
+        diff_pos = [i for i, t in zip(t_idx, tensors)
+                    if not t.stop_gradient and _is_float(t._data.dtype)]
+    else:
+        diff_pos = []
+
+    out_meta = {}
+
+    def pure(*diff_raws):
+        ls = list(leaves)
+        for i in t_idx:
+            ls[i] = ls[i]._data
+        for p, r in zip(diff_pos, diff_raws):
+            ls[p] = r
+        call_args = tree_unflatten(treedef, ls)
+        out = fn(*call_args, **attrs)
+        out_leaves, out_td = tree_flatten(out)
+        out_meta["td"] = out_td
+        return tuple(out_leaves)
+
+    if diff_pos:
+        diff_tensors = [leaves[p] for p in diff_pos]
+        out_raw, vjp_fn = jax.vjp(pure, *[t._data for t in diff_tensors])
+        node = _ag.GradNode(
+            name, vjp_fn, diff_tensors,
+            [(tuple(o.shape), o.dtype) for o in out_raw])
+    else:
+        out_raw = pure()
+        node = None
+
+    if flag_value("check_nan_inf"):
+        _check_nan_inf(name, out_raw)
+
+    out_tensors = []
+    for i, o in enumerate(out_raw):
+        t = Tensor(o, stop_gradient=(node is None or not _is_float(o.dtype)))
+        if node is not None and _is_float(o.dtype):
+            t._grad_node = (node, i)
+        out_tensors.append(t)
+    result = tree_unflatten(out_meta["td"], out_tensors)
+    return result
+
+
+def apply_raw(name: str, fn: Callable, *args, **attrs):
+    """Run an op outside autograd entirely (optimizer updates, stats)."""
+    with _ag.no_grad():
+        return apply(name, fn, *args, **attrs)
+
+
+def _is_float(dtype) -> bool:
+    return (np.issubdtype(np.dtype(dtype), np.inexact)
+            or dtype == jnp.bfloat16)
+
+
+def _check_nan_inf(name, out_raw):
+    for o in out_raw:
+        if isinstance(o, jax.core.Tracer) or not _is_float(o.dtype):
+            continue
+        if not bool(jnp.all(jnp.isfinite(o))):
+            from ..core.errors import EnforceNotMet
+            raise EnforceNotMet(
+                f"Operator '{name}' produced NaN/Inf "
+                f"(FLAGS_check_nan_inf is on; reference: "
+                f"nan_inf_utils_detail.cc:411).")
+
+
+def register_op(name):
+    """Decorator registering a functional op under ``name``."""
+    def deco(fn):
+        OP_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def defop(name: str, impl: Callable):
+    """Define a standard op: a user-facing function that unwraps Tensors,
+    applies ``impl`` and wraps results."""
+    OP_REGISTRY[name] = impl
+
+    def op(*args, **kw):
+        return apply(name, impl, *args, **kw)
+    op.__name__ = name
+    return op

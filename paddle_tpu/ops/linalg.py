@@ -1,0 +1,129 @@
+"""Linear-algebra ops (paddle.linalg parity).
+
+Parity targets: cholesky, inverse, matrix_power, matrix_rank, svd, qr, eig,
+eigh, eigvals, det, slogdet, solve, triangular_solve, lstsq, pinv, lu, cond,
+multi_dot (reference: paddle/fluid/operators/cholesky_op.cc, inverse_op.cc,
+svd_op.cc-era additions). On TPU these lower to XLA's linalg custom calls.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import scipy as jsp
+
+from .dispatch import apply
+
+
+def cholesky(x, upper=False, name=None):
+    def impl(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return apply("cholesky", impl, x)
+
+
+def inv(x, name=None):
+    return apply("inverse", jnp.linalg.inv, x)
+
+
+inverse = inv
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, tol=tol), x)
+
+
+def det(x, name=None):
+    return apply("determinant", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def impl(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply("slogdeterminant", impl, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+
+
+def eig(x, name=None):
+    return apply("eig", lambda a: tuple(jnp.linalg.eig(a)), x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eigvals(x, name=None):
+    return apply("eigvals", jnp.linalg.eigvals, x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def impl(a, b):
+        return jsp.linalg.solve_triangular(a, b, lower=not upper,
+                                           trans=1 if transpose else 0,
+                                           unit_diagonal=unitriangular)
+    return apply("triangular_solve", impl, x, y)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def impl(b, l):
+        return jsp.linalg.cho_solve((l, not upper), b)
+    return apply("cholesky_solve", impl, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def impl(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return (sol, res, rank, sv)
+    return apply("lstsq", impl, x, y)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def impl(a):
+        lu_mat, piv = jsp.linalg.lu_factor(a)
+        return (lu_mat, piv.astype(jnp.int32))
+    return apply("lu", impl, x)
+
+
+def cond(x, p=None, name=None):
+    return apply("cond_linalg", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda xs: jnp.linalg.multi_dot(xs), list(x))
+
+
+def matrix_exp(x, name=None):
+    return apply("matrix_exp", jsp.linalg.expm, x)
+
+
+def householder_product(x, tau, name=None):
+    def impl(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ jnp.outer(v, v))
+        return q[:, :n]
+    return apply("householder_product", impl, x, tau)

@@ -1,0 +1,379 @@
+"""Shape / layout manipulation ops.
+
+Parity targets: reshape2, transpose2, concat, split, stack, unstack, unbind,
+squeeze2, unsqueeze2, flatten_contiguous_range, tile, expand_v2, flip, roll,
+slice, strided_slice, pad/pad3d, pixel_shuffle, shuffle_channel, unfold,
+space_to_depth, shard_index (reference: paddle/fluid/operators/*.cc per name).
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .dispatch import apply
+
+
+slice_builtin = builtins.slice
+
+
+def _int(v):
+    return int(v.item() if isinstance(v, Tensor) else v)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy().tolist()]
+    return [_int(s) for s in shape]
+
+
+def reshape(x, shape, name=None):
+    s = _shape_list(shape)
+    return apply("reshape2", lambda a: jnp.reshape(a, s), x)
+
+
+def reshape_(x, shape, name=None):
+    x._swap_payload(reshape(x, shape))
+    return x
+
+
+def transpose(x, perm, name=None):
+    p = [_int(i) for i in perm]
+    return apply("transpose2", lambda a: jnp.transpose(a, p), x)
+
+
+def t(x, name=None):
+    def impl(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+    return apply("t", impl, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def concat(x, axis=0, name=None):
+    ax = _int(axis)
+    return apply("concat", lambda xs: jnp.concatenate(xs, axis=ax), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", lambda xs: jnp.stack(xs, axis=axis), list(x))
+
+
+def hstack(x, name=None):
+    return apply("hstack", lambda xs: jnp.hstack(xs), list(x))
+
+
+def vstack(x, name=None):
+    return apply("vstack", lambda xs: jnp.vstack(xs), list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = _int(axis)
+
+    def impl(a):
+        if isinstance(num_or_sections, int):
+            return list(jnp.split(a, num_or_sections, axis=ax))
+        secs = [_int(s) if not isinstance(s, Tensor) else int(s.item())
+                for s in num_or_sections]
+        total = a.shape[ax]
+        if -1 in secs:
+            known = np.sum([s for s in secs if s != -1])
+            secs = [s if s != -1 else total - known for s in secs]
+        points = np.cumsum(secs)[:-1].tolist()
+        return list(jnp.split(a, points, axis=ax))
+    return apply("split", impl, x)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis, name)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    def impl(a):
+        n = a.shape[axis]
+        return [jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis)]
+    return apply("unstack", impl, x)
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def impl(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in (_int(v) for v in axes) if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axes) if axes else a
+    return apply("squeeze2", impl, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    x._swap_payload(squeeze(x, axis))
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    def impl(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = a
+        for ax in sorted(_int(v) for v in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply("unsqueeze2", impl, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    x._swap_payload(unsqueeze(x, axis))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply("flatten_contiguous_range", impl, x)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_list(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    s = _shape_list(shape)
+
+    def impl(a):
+        tgt = list(s)
+        # -1 means keep original dim (paddle semantics)
+        offset = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, tgt)
+    return apply("expand_v2", impl, x)
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as_v2", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape, name)
+
+
+def broadcast_tensors(input, name=None):
+    return apply("broadcast_tensors", lambda xs: list(jnp.broadcast_arrays(*xs)), list(input))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda a: jnp.flip(a, tuple(_int(v) for v in axes)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k, axes), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", lambda a: jnp.roll(a, shifts, axis), x)
+
+
+def slice(input, axes, starts, ends):
+    """reference: operators/slice_op.cc."""
+    axes = [_int(a) for a in axes]
+    starts = [_int(s) for s in starts]
+    ends = [_int(e) for e in ends]
+
+    def impl(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = slice_builtin(st, en)
+        return a[tuple(idx)]
+    return apply("slice", impl, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = [_int(a) for a in axes]
+    starts = [_int(s) for s in starts]
+    ends = [_int(e) for e in ends]
+    strides = [_int(s) for s in strides]
+
+    def impl(a):
+        idx = [slice_builtin(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice_builtin(st, en, sd)
+        return a[tuple(idx)]
+    return apply("strided_slice", impl, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _shape_list(shape)
+    offs = [0] * len(s) if offsets is None else [_int(o) for o in offsets]
+
+    def impl(a):
+        idx = tuple(slice_builtin(o, o + (d if d != -1 else a.shape[i] - o))
+                    for i, (o, d) in enumerate(zip(offs, s)))
+        return a[idx]
+    return apply("crop_tensor", impl, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics (reference: operators/pad3d_op.cc):
+    `pad` is [left, right, top, bottom, ...] over trailing spatial dims when
+    len(pad) < 2*ndim, else per-dim pairs."""
+    if isinstance(pad, Tensor):
+        pad = pad.numpy().tolist()
+    pad = [_int(p) for p in pad]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def impl(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            nspatial = len(pad) // 2
+            widths = [(0, 0)] * nd
+            # paddle packs trailing spatial dims in reverse (W first)
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            for i in range(nspatial):
+                dim = spatial[len(spatial) - 1 - i]
+                widths[dim] = (pad[2 * i], pad[2 * i + 1])
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply("pad3d", impl, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return apply("repeat_interleave",
+                     lambda a, r: jnp.repeat(a, r, axis=axis,
+                                             total_repeat_length=int(np.asarray(r._data if isinstance(r, Tensor) else r).sum())),
+                     x, repeats)
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def impl(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply("pixel_shuffle", impl, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def impl(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+    return apply("pixel_unshuffle", impl, x)
+
+
+def shuffle_channel(x, group):
+    def impl(a):
+        n, c, h, w = a.shape
+        return a.reshape(n, group, c // group, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+    return apply("shuffle_channel", impl, x)
+
+
+def space_to_depth(x, blocksize, name=None):
+    def impl(a):
+        n, c, h, w = a.shape
+        b = blocksize
+        a = a.reshape(n, c, h // b, b, w // b, b)
+        a = a.transpose(0, 3, 5, 1, 2, 4)
+        return a.reshape(n, c * b * b, h // b, w // b)
+    return apply("space_to_depth", impl, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op.cc)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+
+    def impl(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])])
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(a[:, :, di:di + oh * st[0]:st[0], dj:dj + ow * st[1]:st[1]])
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply("unfold", impl, x)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: operators/shard_index_op.cc (model-parallel embedding helper)."""
+    def impl(i):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        in_range = (i >= lo) & (i < hi)
+        return jnp.where(in_range, i - lo, ignore_value)
+    return apply("shard_index", impl, input)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def einsum(equation, *operands):
+    return apply("einsum", lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+
+def tolist(x):
+    return x.numpy().tolist()

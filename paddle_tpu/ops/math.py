@@ -1,0 +1,644 @@
+"""Math / elementwise / reduction / comparison / search ops.
+
+Parity targets: the reference "Math/elementwise/tensor" operator group
+(SURVEY Appendix A; paddle/fluid/operators/elementwise/, reduce_ops/,
+activation_op.cc FOR_EACH_ACTIVATION_OP). Each op here is one traceable jnp
+implementation registered through dispatch.apply — there is no per-device
+kernel matrix; XLA compiles/fuses per use site.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from .dispatch import apply, OP_REGISTRY
+
+_this = sys.modules[__name__]
+
+
+def _axis_arg(axis):
+    if isinstance(axis, Tensor):
+        a = axis.numpy().tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(x) for x in axis)
+    return axis
+
+
+# ---------------------------------------------------------------------------
+# Table-driven elementwise unary ops (reference: activation_op.cc and
+# per-op .cc files; one line each here).
+_UNARY = {
+    "abs": jnp.abs, "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log,
+    "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt, "rsqrt": jax.lax.rsqrt, "square": jnp.square,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+    "acos": jnp.arccos, "atan": jnp.arctan, "sinh": jnp.sinh,
+    "cosh": jnp.cosh, "tanh": jnp.tanh, "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "trunc": jnp.trunc, "sign": jnp.sign, "reciprocal": lambda x: 1.0 / x,
+    "erf": jax.lax.erf, "erfinv": jax.lax.erf_inv,
+    "lgamma": jax.lax.lgamma, "digamma": jax.lax.digamma,
+    "sigmoid": jax.nn.sigmoid, "logsigmoid": jax.nn.log_sigmoid,
+    "neg": jnp.negative, "conj": jnp.conj, "angle": jnp.angle,
+    "real": jnp.real, "imag": jnp.imag,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not, "bitwise_not": jnp.invert,
+    "frac": lambda x: x - jnp.trunc(x),
+}
+
+for _name, _fn in _UNARY.items():
+    def _make(nm, f):
+        def op(x, name=None):
+            return apply(nm, f, x)
+        op.__name__ = nm
+        return op
+    setattr(_this, _name, _make(_name, _fn))
+
+# ---------------------------------------------------------------------------
+# Binary elementwise (reference: operators/elementwise/elementwise_*_op.cc).
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow_t": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin,
+    "atan2": jnp.arctan2, "hypot": jnp.hypot,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "equal": lambda a, b: jnp.equal(a, b), "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "nextafter": jnp.nextafter, "copysign": jnp.copysign,
+    "heaviside": jnp.heaviside, "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "logaddexp": jnp.logaddexp,
+}
+
+for _name, _fn in _BINARY.items():
+    def _make2(nm, f):
+        def op(x, y, name=None):
+            return apply(nm, f, x, y)
+        op.__name__ = nm
+        return op
+    setattr(_this, _name, _make2(_name, _fn))
+
+
+def pow(x, y, name=None):
+    return apply("pow", jnp.power, x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """reference: operators/scale_op.cc."""
+    def impl(a, s):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    out = apply("scale", impl, x, scale)
+    if act is not None:
+        out = getattr(_this, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    def impl(a, *bounds):
+        it = iter(bounds)
+        lo = next(it) if isinstance(min, Tensor) else min
+        hi = next(it) if isinstance(max, Tensor) else max
+        return jnp.clip(a, lo, hi)
+    extra = [b for b in (min, max) if isinstance(b, Tensor)]
+    return apply("clip", impl, x, *extra)
+
+
+def lerp(x, y, weight, name=None):
+    return apply("lerp", lambda a, b, w: a + w * (b - a), x, y,
+                 weight if isinstance(weight, Tensor) else jnp.asarray(weight))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+# ---------------------------------------------------------------------------
+# Matrix ops (reference: operators/matmul_v2_op.cc, mul_op.cc, bmm_op.cc,
+# addmm_op.cc, mv_op.cc, dot_op.cc, kron_op.cc, cross_op.cc).
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def impl(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply("matmul_v2", impl, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, x, vec)
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, x, y)
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def impl(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply("cross", impl, x, y)
+
+
+def multiplex(inputs, index, name=None):
+    """reference: operators/multiplex_op.cc — row-wise select among inputs."""
+    return apply("multiplex",
+                 lambda idx, *xs: jnp.stack(xs, 0)[idx.reshape(-1),
+                                                   jnp.arange(xs[0].shape[0])],
+                 index, *inputs)
+
+
+# ---------------------------------------------------------------------------
+# Reductions (reference: operators/reduce_ops/).
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    d = _dt.convert_dtype(dtype)
+
+    def impl(a):
+        out = jnp.sum(a, axis=ax, keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+    return apply("reduce_sum", impl, x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_mean",
+                 lambda a: jnp.mean(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+
+    def impl(a):
+        out = jnp.prod(a, axis=_axis_arg(axis), keepdims=keepdim)
+        return out.astype(d) if d is not None else out
+    return apply("reduce_prod", impl, x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_max",
+                 lambda a: jnp.max(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_min",
+                 lambda a: jnp.min(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_all",
+                 lambda a: jnp.all(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_any",
+                 lambda a: jnp.any(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std", lambda a: jnp.std(a, axis=_axis_arg(axis),
+                                          ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var", lambda a: jnp.var(a, axis=_axis_arg(axis),
+                                          ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply("median", lambda a: jnp.median(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply("nanmedian", lambda a: jnp.nanmedian(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply("nansum", lambda a: jnp.nansum(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply("nanmean", lambda a: jnp.nanmean(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply("quantile", lambda a: jnp.quantile(a, jnp.asarray(q), axis=_axis_arg(axis),
+                                                    keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply("logsumexp",
+                 lambda a: jax.scipy.special.logsumexp(a, axis=_axis_arg(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+
+    def impl(a):
+        out = jnp.cumsum(a if axis is not None else a.reshape(-1), axis=axis if axis is not None else 0)
+        return out.astype(d) if d is not None else out
+    return apply("cumsum", impl, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+
+    def impl(a):
+        out = jnp.cumprod(a, axis=dim)
+        return out.astype(d) if d is not None else out
+    return apply("cumprod", impl, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def impl(a):
+        arr = a if axis is not None else a.reshape(-1)
+        ax = axis if axis is not None else 0
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax)
+        return vals
+    return apply("cummax", impl, x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply("count_nonzero",
+                 lambda a: jnp.count_nonzero(a, axis=_axis_arg(axis), keepdims=keepdim)
+                 .astype(jnp.int64), x)
+
+
+# ---------------------------------------------------------------------------
+# Search / sort (reference: operators/arg_max_op.cc, argsort_op.cc,
+# top_k_v2_op.cc, index_select_op.cc, masked_select_op.cc, where_op.cc...).
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply("arg_max",
+                 lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None else False)
+                 .astype(d), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    return apply("arg_min",
+                 lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None else False)
+                 .astype(d), x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def impl(a):
+        idx = jnp.argsort(a, axis=axis)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+    return apply("argsort", impl, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def impl(a):
+        out = jnp.sort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+    return apply("sort", impl, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(k.item() if isinstance(k, Tensor) else k)
+
+    def impl(a):
+        ax = axis if axis is not None else a.ndim - 1
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply("top_k_v2", impl, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def impl(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        vals = jnp.sort(moved, axis=-1)[..., k - 1]
+        idx = jnp.argsort(moved, axis=-1)[..., k - 1]
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+    return apply("kthvalue", impl, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def impl(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        s = jnp.sort(moved, axis=-1)
+        # mode = value with the longest run in the sorted array
+        n = s.shape[-1]
+        runs = jnp.concatenate([jnp.ones(s.shape[:-1] + (1,), jnp.int32),
+                                (s[..., 1:] != s[..., :-1]).astype(jnp.int32)], -1)
+        grp = jnp.cumsum(runs, -1)
+        counts = jax.vmap(lambda g: jnp.bincount(g.reshape(-1), length=n + 1),
+                          in_axes=0)(grp.reshape(-1, n)).reshape(grp.shape[:-1] + (n + 1,))
+        best_grp = jnp.argmax(counts, -1)
+        pos = jnp.argmax((grp == best_grp[..., None]).astype(jnp.int32), -1)
+        vals = jnp.take_along_axis(s, pos[..., None], -1)[..., 0]
+        idx = jnp.argmax((moved == vals[..., None]).astype(jnp.int32), -1)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+    return apply("mode", impl, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply("where", jnp.where, condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    """Data-dependent shape: materialized on host (reference where_index op is
+    likewise dynamic; under jit use masking instead)."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, jnp.int64)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), jnp.int64))
+
+
+def masked_select(x, mask, name=None):
+    """Data-dependent shape: host fallback (reference: masked_select_op.cc)."""
+    arr = np.asarray(x._data)
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(arr[m.astype(bool)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = value if not isinstance(value, Tensor) else value
+    if isinstance(val, Tensor):
+        return apply("masked_fill", lambda a, m, v: jnp.where(m, v.astype(a.dtype), a), x, mask, val)
+    return apply("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(val, a.dtype), a), x, mask)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+def index_sample(x, index):
+    """reference: operators/index_sample_op.cc — per-row gather."""
+    return apply("index_sample",
+                 lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply("take_along_axis",
+                 lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def impl(a, i, v):
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v.astype(a.dtype), axis=axis, inplace=False)
+        if reduce == "add":
+            return a.at[_along_axis_index(a, i, axis)].add(v.astype(a.dtype))
+        if reduce in ("mul", "multiply"):
+            return a.at[_along_axis_index(a, i, axis)].multiply(v.astype(a.dtype))
+        raise ValueError(reduce)
+    return apply("put_along_axis", impl, arr, indices,
+                 values if isinstance(values, Tensor) else Tensor(values))
+
+
+def _along_axis_index(a, i, axis):
+    idx = list(jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij"))
+    idx[axis] = i
+    return tuple(idx)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item() if isinstance(axis, Tensor) else axis)
+    return apply("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i,
+                                                 axis=ax), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def impl(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return apply("gather_nd", impl, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def impl(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u.astype(a.dtype))
+        z = a.at[i].set(jnp.zeros_like(u, a.dtype))
+        return z.at[i].add(u.astype(a.dtype))
+    return apply("scatter", impl, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def impl(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u.astype(a.dtype))
+    return apply("scatter_nd_add", impl, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def impl(i, u):
+        a = jnp.zeros(shape, u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return apply("scatter_nd", impl, index, updates)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._data)
+    w = None if weights is None else np.asarray(weights._data)
+    return Tensor(jnp.asarray(np.bincount(arr, w, minlength)))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def impl(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+    return apply("histogram", impl, input)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Data-dependent output shape — host computation (reference unique op is
+    CPU-only for the same reason)."""
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    """Data-dependent output shape — host computation, like `unique`."""
+    arr = np.asarray(x._data)
+    if axis is None:
+        flat = arr.reshape(-1)
+        if flat.size == 0:
+            keep = np.zeros(0, bool)
+        else:
+            keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        vals = flat[keep]
+        group = np.cumsum(keep) - 1
+        counts = np.bincount(group, minlength=len(vals)).astype(np.int64)
+        inverse = group.astype(np.int64)
+    else:
+        moved = np.moveaxis(arr, axis, 0)
+        flatrows = moved.reshape(moved.shape[0], -1)
+        if flatrows.shape[0] == 0:
+            keep = np.zeros(0, bool)
+        else:
+            keep = np.concatenate([[True], np.any(flatrows[1:] != flatrows[:-1], axis=1)])
+        vals = np.moveaxis(moved[keep], 0, axis)
+        group = np.cumsum(keep) - 1
+        counts = np.bincount(group, minlength=int(keep.sum())).astype(np.int64)
+        inverse = group.astype(np.int64)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inverse)))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("allclose",
+                 lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose",
+                 lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    return apply("searchsorted",
+                 lambda s, v: jnp.searchsorted(s, v, side="right" if right else "left")
+                 .astype(jnp.int32 if out_int32 else jnp.int64), sorted_sequence, values)
+
+
+# ---------------------------------------------------------------------------
+# Norms (reference: operators/p_norm_op.cc, frobenius_norm_op.cc,
+# squared_l2_norm_op.cc, clip_by_norm_op.cc, dist_op.cc).
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def impl(a):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(a * a))
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=_axis_arg(axis), keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(a), axis=_axis_arg(axis), keepdims=keepdim)
+        if p == -np.inf or p == "-inf":
+            return jnp.min(jnp.abs(a), axis=_axis_arg(axis), keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=_axis_arg(axis), keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=_axis_arg(axis),
+                                 keepdims=keepdim), 1.0 / p)
+    return apply("p_norm", impl, x)
+
+
+def dist(x, y, p=2, name=None):
+    def impl(a, b):
+        d = jnp.abs(a - b)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == np.inf:
+            return jnp.max(d)
+        if p == -np.inf:
+            return jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    return apply("dist", impl, x, y)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def impl(a):
+        n = jnp.sqrt(jnp.sum(a * a))
+        return jnp.where(n > max_norm, a * (max_norm / n), a)
+    return apply("clip_by_norm", impl, x)
+
+
+def squared_l2_norm(x):
+    return apply("squared_l2_norm", lambda a: jnp.sum(a * a), x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a: jnp.trace(a, offset, axis1, axis2), x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num",
+                 lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda a: a + value, x)
+    x._swap_payload(out)
+    return x

@@ -1,0 +1,20 @@
+"""Test configuration: run everything on XLA-CPU with 8 virtual devices so
+multi-chip sharding tests execute without TPU hardware (SURVEY §4 TPU
+equivalent: `XLA_FLAGS=--xla_force_host_platform_device_count=8`)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

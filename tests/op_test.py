@@ -1,0 +1,70 @@
+"""OpTest harness: numpy-reference op checking + numeric gradient checking.
+
+Port of the reference test discipline (reference:
+python/paddle/fluid/tests/unittests/op_test.py:270 OpTest,
+check_output_with_place :1076, check_grad :1405, get_numeric_gradient :110):
+every op test supplies numpy inputs and a numpy-computed expected output;
+gradients are validated against central differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import Tensor
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-4, rtol=1e-4, kwargs=None):
+    """Run `op_fn(*tensors, **kwargs)` and compare to `np_fn(*numpy_arrays)`."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    out = op_fn(*tensors, **kwargs)
+    expected = np_fn(*inputs)
+    _compare(out, expected, atol, rtol)
+
+
+def _compare(out, expected, atol, rtol):
+    if isinstance(out, (list, tuple)):
+        assert isinstance(expected, (list, tuple)), "output arity mismatch"
+        for o, e in zip(out, expected):
+            _compare(o, e, atol, rtol)
+        return
+    got = out.numpy() if isinstance(out, Tensor) else np.asarray(out)
+    expected = np.asarray(expected)
+    assert got.shape == expected.shape, f"shape {got.shape} vs {expected.shape}"
+    np.testing.assert_allclose(got.astype(np.float64) if got.dtype != bool else got,
+                               expected.astype(np.float64) if expected.dtype != bool else expected,
+                               atol=atol, rtol=rtol)
+
+
+def check_grad(op_fn, inputs, grad_idx=0, eps=1e-3, atol=1e-2, rtol=1e-2,
+               kwargs=None, reduce_to_scalar=True):
+    """Central-difference gradient check (reference: op_test.py
+    get_numeric_gradient :110): analytic grad from the tape vs numeric grad of
+    sum(op(x)) w.r.t. inputs[grad_idx]."""
+    kwargs = kwargs or {}
+    inputs = [np.asarray(a, np.float32) for a in inputs]
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    for t in tensors:
+        t.stop_gradient = False
+
+    out = op_fn(*tensors, **kwargs)
+    loss = out.sum() if reduce_to_scalar else out
+    loss.backward()
+    analytic = tensors[grad_idx].grad.numpy().astype(np.float64)
+
+    def f(x_flat):
+        args = [a.copy() for a in inputs]
+        args[grad_idx] = x_flat.reshape(inputs[grad_idx].shape).astype(np.float32)
+        ts = [paddle.to_tensor(a) for a in args]
+        o = op_fn(*ts, **kwargs)
+        return float(o.sum().numpy())
+
+    x0 = inputs[grad_idx].astype(np.float64).reshape(-1)
+    numeric = np.zeros_like(x0)
+    for i in range(x0.size):
+        xp = x0.copy(); xp[i] += eps
+        xm = x0.copy(); xm[i] -= eps
+        numeric[i] = (f(xp) - f(xm)) / (2 * eps)
+    numeric = numeric.reshape(inputs[grad_idx].shape)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)

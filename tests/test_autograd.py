@@ -1,0 +1,127 @@
+"""Tape autograd engine tests
+(pattern: reference unittests/test_imperative_basic.py + basic_engine.cc paths)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_fanout_accumulation(self):
+        # x used by two branches; grads must sum (gradient_accumulator.cc)
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        a = x * 2
+        b = x * 5
+        (a + b).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_diamond(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        a = x * x      # 4
+        b = a + x      # 6
+        c = a * b      # 24; dc/dx = da/dx*b + a*db/dx = 4*6+4*(4+1)=44
+        c.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [44.0])
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([1.0])  # stop_gradient=True
+        z = x * y
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * 3).detach()
+        z = y * x
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y._grad_node is None and y.stop_gradient
+
+    def test_backward_twice_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward(retain_graph=False)
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_grad_accumulate_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_non_scalar_backward_seed(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(np.asarray(g))
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        assert len(seen) == 1
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.array([[4.0, 1.0, 3.0]], np.float32),
+                             stop_gradient=False)
+        v, i = paddle.topk(x, 2)
+        v.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+
+
+class TestFunctionalGrad:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad([y], [x])
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad must not write .grad
+
+    def test_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        gx, gz = paddle.grad([y], [x, z], allow_unused=True)
+        np.testing.assert_allclose(gx.numpy(), [2.0])
+        assert gz is None
+
+
+class TestNanCheck:
+    def test_check_nan_inf_flag(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor([1.0])
+            with pytest.raises(Exception):
+                paddle.log(x - 2.0) * 1.0  # log(-1) = nan
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
