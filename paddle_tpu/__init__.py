@@ -14,6 +14,8 @@ _warnings.filterwarnings(
     "ignore", message=".*requested in astype is not available.*")
 _warnings.filterwarnings(
     "ignore", message=".*Explicitly requested dtype.*is not available.*")
+_warnings.filterwarnings(
+    "ignore", message=".*donated buffers were not usable.*")
 
 __version__ = "0.1.0"
 
@@ -43,6 +45,14 @@ from .ops import linalg  # noqa: F401
 
 # grad function (paddle.grad)
 grad = _functional_grad
+
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from .nn.layer_base import ParamAttr  # noqa: E402,F401
+from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa: E402,F401
+                      ClipGradByGlobalNorm)
+
 
 
 def is_grad_enabled_():

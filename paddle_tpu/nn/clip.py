@@ -1,0 +1,104 @@
+"""Gradient clipping.
+
+Reference: python/paddle/fluid/clip.py — ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm (the hybrid-parallel variant clips per mp-group via
+psum; here the global-norm sum is one fused computation and, under a mesh,
+XLA reduces across shards automatically).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """Functional form over [(param, grad Tensor)] pairs."""
+        params = [p for p, _ in params_grads]
+        grads = [g._data if isinstance(g, Tensor) else g for _, g in params_grads]
+        clipped = self._clip_raw(params, grads)
+        return [(p, Tensor(g)) for (p, _), g in zip(params_grads, clipped)]
+
+    def _clip_raw(self, params, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip_raw(self, params, grads):
+        return [jnp.clip(g, self.min, self.max) if _clips(p) else g
+                for p, g in zip(params, grads)]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_raw(self, params, grads):
+        out = []
+        for p, g in zip(params, grads):
+            if not _clips(p):
+                out.append(g)
+                continue
+            n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.where(n > self.clip_norm, self.clip_norm / n, 1.0)
+            out.append((g * scale.astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """reference: fluid/clip.py ClipGradByGlobalNorm — one global norm over
+    all grads, scale all by clip/max(global, clip)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip_raw(self, params, grads):
+        sq = [jnp.sum(g.astype(jnp.float32) ** 2)
+              for p, g in zip(params, grads) if _clips(p)]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [g * scale.astype(g.dtype) if _clips(p) else g
+                for p, g in zip(params, grads)]
+
+
+def _clips(p):
+    return getattr(p, "need_clip", True)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if not isinstance(parameters, (list, tuple)):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+                              for g in grads), 1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p._grad is not None:
+            p._grad = p._grad * scale.astype(p._grad.dtype)
+    return Tensor(total)
+
+
+class GradientClipByValue(ClipGradByValue):
+    pass
+
+
+class GradientClipByNorm(ClipGradByNorm):
+    pass
+
+
+class GradientClipByGlobalNorm(ClipGradByGlobalNorm):
+    pass

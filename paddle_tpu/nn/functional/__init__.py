@@ -1,0 +1,23 @@
+"""paddle.nn.functional parity namespace."""
+from .activation import *  # noqa: F401,F403
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,  # noqa: F401
+                   conv2d_transpose, conv3d_transpose)
+from .pooling import (max_pool1d, max_pool2d, max_pool3d, avg_pool1d,  # noqa: F401
+                      avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
+                      adaptive_avg_pool2d, adaptive_avg_pool3d,
+                      adaptive_max_pool1d, adaptive_max_pool2d,
+                      adaptive_max_pool3d)
+from .norm import (batch_norm, layer_norm, instance_norm, group_norm,  # noqa: F401
+                   local_response_norm, normalize)
+from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,  # noqa: F401
+                   mse_loss, l1_loss, square_error_cost, binary_cross_entropy,
+                   binary_cross_entropy_with_logits, sigmoid_focal_loss,
+                   kl_div, smooth_l1_loss, huber_loss, hinge_loss, log_loss,
+                   margin_ranking_loss, cosine_similarity,
+                   cosine_embedding_loss, triplet_margin_loss, label_smooth,
+                   ctc_loss)
+from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,  # noqa: F401
+                     embedding, one_hot, interpolate, upsample, grid_sample,
+                     affine_grid, bilinear, pad, temporal_shift,
+                     sequence_mask, diag_embed, unfold, npair_loss)
+from ...ops.manipulation import pixel_shuffle, pixel_unshuffle  # noqa: F401

@@ -1,0 +1,289 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+
+Parity targets: fc/matmul+bias (reference: operators/mul_op.cc + fc),
+dropout (dropout_op.cc), lookup_table_v2 (embedding), interp family
+(bilinear_interp_v2 etc.), grid_sample, affine_grid, one_hot, cosine ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...core.tensor import Tensor
+from ...core import generator as _gen
+from ...ops.manipulation import pad as _pad  # re-export target
+from .activation import *  # noqa: F401,F403 (paddle exposes these under F too)
+from ...ops.manipulation import unfold  # noqa: F401
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle weight layout [in, out]
+    (reference: python/paddle/nn/functional/common.py linear →  matmul_v2 +
+    elementwise_add)."""
+    if bias is not None:
+        return apply("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+    return apply("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """reference: operators/dropout_op.cc (two modes preserved)."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply("dropout", lambda a: a * (1.0 - p), x)
+        return x
+    key = _gen.next_key()
+
+    def impl(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply("dropout", impl, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return dropout(x, p, axis=[0, 1] if data_format == "NCHW" else [0, 3],
+                   training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return dropout(x, p, axis=[0, 1] if data_format == "NCDHW" else [0, 4],
+                   training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _gen.next_key()
+
+    def impl(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+    return apply("alpha_dropout", impl, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: operators/lookup_table_v2_op.cc. `sparse` selects
+    SelectedRows grads in the reference; XLA handles gather/scatter-add
+    fusion so it is accepted and ignored."""
+    def impl(w, i):
+        out = jnp.take(w, i.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply("lookup_table_v2", impl, weight, x)
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    """reference: operators/interpolate_v2_op.cc (nearest/bilinear/bicubic/
+    trilinear/area)."""
+    mode = mode.lower()
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC")
+
+    def out_shape(a):
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+        if size is not None:
+            s = size
+            if isinstance(s, Tensor):
+                s = s.numpy().tolist()
+            return tuple(int(v.item() if isinstance(v, Tensor) else v) for v in
+                         (s if isinstance(s, (list, tuple)) else [s]))
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(spatial)
+        return tuple(int(d * f) for d, f in zip(spatial, sf))
+
+    jax_method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def impl(a):
+        tgt = out_shape(a)
+        if channel_last:
+            full = (a.shape[0],) + tgt + (a.shape[-1],)
+        else:
+            full = a.shape[:2] + tgt
+        if mode == "nearest":
+            # paddle nearest uses floor on src index = i * scale
+            idx = []
+            spatial_off = 1 if channel_last else 2
+            out = a
+            for d, t in enumerate(tgt):
+                src = a.shape[spatial_off + d]
+                ii = jnp.floor(jnp.arange(t) * (src / t)).astype(jnp.int32)
+                out = jnp.take(out, ii, axis=spatial_off + d)
+            return out
+        if align_corners:
+            # jax.image.resize has no align_corners; do coordinate remap
+            spatial_off = 1 if channel_last else 2
+            out = a
+            for d, t in enumerate(tgt):
+                src = out.shape[spatial_off + d]
+                if t == 1 or src == 1:
+                    coords = jnp.zeros(t)
+                else:
+                    coords = jnp.linspace(0, src - 1, t)
+                i0 = jnp.floor(coords).astype(jnp.int32)
+                i1 = jnp.minimum(i0 + 1, src - 1)
+                w1 = (coords - i0).astype(a.dtype)
+                g0 = jnp.take(out, i0, axis=spatial_off + d)
+                g1 = jnp.take(out, i1, axis=spatial_off + d)
+                bshape = [1] * out.ndim
+                bshape[spatial_off + d] = t
+                w1 = w1.reshape(bshape)
+                out = g0 * (1 - w1) + g1 * w1
+            return out
+        return jax.image.resize(a, full, method=jax_method)
+    return apply("interpolate_v2", impl, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format, name)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference: operators/grid_sampler_op.cc. x: [N,C,H,W], grid: [N,Hg,Wg,2]."""
+    def impl(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * 0.5 * (w - 1)
+            fy = (gy + 1) * 0.5 * (h - 1)
+        else:
+            fx = ((gx + 1) * w - 1) * 0.5
+            fy = ((gy + 1) * h - 1) * 0.5
+
+        def sample(ix, iy):
+            inside = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            cx = jnp.clip(ix, 0, w - 1)
+            cy = jnp.clip(iy, 0, h - 1)
+            # batch gather: a[n, :, cy, cx]
+            bidx = jnp.arange(n).reshape(n, 1, 1)
+            vals = a[bidx, :, cy, cx]          # [N,Hg,Wg,C]
+            vals = jnp.moveaxis(vals, -1, 1)   # [N,C,Hg,Wg]
+            if padding_mode == "zeros":
+                vals = jnp.where(inside[:, None], vals, 0.0)
+            return vals
+
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        if mode == "nearest":
+            return sample(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0).astype(a.dtype)[:, None]
+        wy = (fy - y0).astype(a.dtype)[:, None]
+        v00 = sample(x0, y0)
+        v01 = sample(x1, y0)
+        v10 = sample(x0, y1)
+        v11 = sample(x1, y1)
+        return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+                + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return apply("grid_sampler", impl, x, grid)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference: operators/affine_grid_op.cc."""
+    if isinstance(out_shape, Tensor):
+        out_shape = out_shape.numpy().tolist()
+    n, c, h, w = [int(v) for v in out_shape]
+
+    def impl(th):
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+        gx, gy = jnp.meshgrid(xs, ys)  # [H,W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+        out = jnp.einsum("hwk,njk->nhwj", base, th)  # theta [N,2,3]
+        return out
+    return apply("affine_grid", impl, theta)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """reference: operators/bilinear_tensor_product_op.cc."""
+    def impl(a, b, w, *bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi:
+            out = out + bi[0]
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply("bilinear_tensor_product", impl, *args)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return _pad(x, pad, mode, value, data_format, name)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """reference: operators/temporal_shift_op.cc."""
+    def impl(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], 1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], 2).reshape(nt, c, h, w)
+    return apply("temporal_shift", impl, x)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def impl(a, p, y):
+        sim = jnp.matmul(a, p.T)
+        y = y.reshape(-1, 1)
+        tgt = (y == y.T).astype(sim.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) / 2
+        return ce + reg
+    return apply("npair_loss", impl, anchor, positive, labels)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: planned (PS-era op)")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: operators/sequence_ops/sequence_mask_op.cc."""
+    d = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+
+    def impl(lens):
+        m = maxlen
+        if m is None:
+            m = int(np.asarray(lens).max()) if not isinstance(lens, jax.core.Tracer) \
+                else lens.shape[-1]
+        rng = jnp.arange(m)
+        return (rng < lens[..., None]).astype(d)
+    return apply("sequence_mask", impl, x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    from ...ops.creation import diag_embed as _de
+    return _de(x, offset, dim1, dim2)

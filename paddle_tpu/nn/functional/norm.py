@@ -1,0 +1,161 @@
+"""Normalization functionals.
+
+Parity targets: batch_norm, sync_batch_norm, layer_norm, instance_norm,
+group_norm, lrn, spectral/weight norm helpers (reference:
+paddle/fluid/operators/batch_norm_op.cc, layer_norm_op.cc, group_norm_op.cc,
+instance_norm_op.cc, lrn_op.cc). On TPU sync_batch_norm == batch_norm with
+batch-stat psum over the data-parallel mesh axis (done by GSPMD when the batch
+is sharded) — no separate kernel needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...ops.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """reference: operators/batch_norm_op.cc (momentum convention:
+    running = momentum*running + (1-momentum)*batch)."""
+    channel_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def stat_shape(a):
+        s = [1] * a.ndim
+        s[channel_axis] = a.shape[channel_axis]
+        return s
+
+    if use_batch_stats:
+        def impl(a, w, b):
+            axes = tuple(i for i in range(a.ndim)
+                         if i != (channel_axis % a.ndim))
+            mean = jnp.mean(a, axis=axes)
+            var = jnp.var(a, axis=axes)
+            ss = stat_shape(a)
+            out = (a - mean.reshape(ss)) / jnp.sqrt(var.reshape(ss) + epsilon)
+            if w is not None:
+                out = out * w.reshape(ss)
+            if b is not None:
+                out = out + b.reshape(ss)
+            return out, mean, var
+        out, batch_mean, batch_var = apply(
+            "batch_norm", impl, x,
+            weight if weight is not None else None,
+            bias if bias is not None else None)
+        # running-stat update is state mutation, outside the tape
+        if running_mean is not None:
+            with _no_grad():
+                n = x.size / x.shape[channel_axis]
+                unbiased = batch_var * (n / max(n - 1, 1))
+                running_mean.set_value(momentum * running_mean
+                                       + (1.0 - momentum) * batch_mean.detach())
+                running_var.set_value(momentum * running_var
+                                      + (1.0 - momentum) * unbiased.detach())
+        return out
+
+    def impl_eval(a, m, v, w, b):
+        ss = stat_shape(a)
+        out = (a - m.reshape(ss)) / jnp.sqrt(v.reshape(ss) + epsilon)
+        if w is not None:
+            out = out * w.reshape(ss)
+        if b is not None:
+            out = out + b.reshape(ss)
+        return out
+    return apply("batch_norm", impl_eval, x, running_mean, running_var,
+                 weight, bias)
+
+
+def _no_grad():
+    from ...core.autograd_engine import no_grad
+    return no_grad()
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    """reference: operators/layer_norm_op.cc."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def impl(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        it = iter(wb)
+        if weight is not None:
+            out = out * next(it)
+        if bias is not None:
+            out = out + next(it)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("layer_norm", impl, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    """reference: operators/instance_norm_op.cc."""
+    def impl(a, *wb):
+        axes = tuple(range(2, a.ndim))  # per-sample per-channel stats
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        it = iter(wb)
+        ss = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        if weight is not None:
+            out = out * next(it).reshape(ss)
+        if bias is not None:
+            out = out + next(it).reshape(ss)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("instance_norm", impl, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    """reference: operators/group_norm_op.cc."""
+    def impl(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        g = a.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
+        it = iter(wb)
+        ss = [1, c] + [1] * (a.ndim - 2)
+        if weight is not None:
+            out = out * next(it).reshape(ss)
+        if bias is not None:
+            out = out + next(it).reshape(ss)
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply("group_norm", impl, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """reference: operators/lrn_op.cc."""
+    def impl(a):
+        sq = a * a
+        # sum over `size` adjacent channels
+        half = size // 2
+        pad = [(0, 0)] * a.ndim
+        pad[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + padded[:, i:i + a.shape[1]]
+        return a / jnp.power(k + alpha * acc, beta)
+    return apply("lrn", impl, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(a):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply("normalize", impl, x)

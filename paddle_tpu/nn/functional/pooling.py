@@ -1,0 +1,199 @@
+"""Pooling via lax.reduce_window.
+
+Parity targets: pool2d/pool3d (max/avg), max_pool2d_with_index, adaptive
+pools (reference: paddle/fluid/operators/pool_op.cc,
+max_pool2d_with_index_op). NCHW default layout.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import apply
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v if len(v) == n else [v[0]] * n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pool_nd(n, kind, x, kernel_size, stride, padding, ceil_mode,
+             count_include_pad=True, channel_last=False):
+    ks = _tuplize(kernel_size, n)
+    st = _tuplize(stride if stride is not None else kernel_size, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = padding
+        if isinstance(p, (list, tuple)) and len(p) == 2 * n:
+            pads = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+        else:
+            p = _tuplize(p, n)
+            pads = [(v, v) for v in p]
+        if ceil_mode:
+            pads = [(lo, hi + s - 1) for (lo, hi), s in zip(pads, st)]
+
+    def window_dims(a):
+        if channel_last:
+            return (1,) + ks + (1,), (1,) + st + (1,), \
+                ([(0, 0)] + pads + [(0, 0)]) if pads is not None else pad_mode
+        return (1, 1) + ks, (1, 1) + st, \
+            ([(0, 0), (0, 0)] + pads) if pads is not None else pad_mode
+
+    def impl(a):
+        wd, ws, pd = window_dims(a)
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, jnp.asarray(init, a.dtype), lax.max, wd, ws, pd)
+        s = lax.reduce_window(a, jnp.asarray(0.0, a.dtype), lax.add, wd, ws, pd)
+        all_zero = pads is not None and builtins.all(p == (0, 0) for p in pads)
+        if count_include_pad or pd == "VALID" or all_zero:
+            return s / np.prod(ks)
+        ones = jnp.ones_like(a)
+        cnt = lax.reduce_window(ones, jnp.asarray(0.0, a.dtype), lax.add, wd, ws, pd)
+        return s / cnt
+    return apply(f"pool{n}d_{kind}", impl, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool_nd(1, "max", x, kernel_size, stride, padding, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(2, "max", x, kernel_size, stride, padding, ceil_mode,
+                   channel_last=(data_format == "NHWC"))
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, ceil_mode)
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(3, "max", x, kernel_size, stride, padding, ceil_mode,
+                    channel_last=(data_format == "NDHWC"))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(1, "avg", x, kernel_size, stride, padding, ceil_mode,
+                    count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(2, "avg", x, kernel_size, stride, padding, ceil_mode,
+                    count_include_pad=not exclusive,
+                    channel_last=(data_format == "NHWC"))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(3, "avg", x, kernel_size, stride, padding, ceil_mode,
+                    count_include_pad=not exclusive,
+                    channel_last=(data_format == "NDHWC"))
+
+
+def _max_pool_indices(x, kernel_size, stride, padding, ceil_mode):
+    """Indices of maxima (flattened per-channel HW index), matching the
+    reference max_pool2d_with_index op."""
+    ks = _tuplize(kernel_size, 2)
+    st = _tuplize(stride if stride is not None else kernel_size, 2)
+    p = _tuplize(padding if not isinstance(padding, str) else 0, 2)
+
+    def impl(a):
+        n, c, h, w = a.shape
+        hw_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+        hw_idx = jnp.broadcast_to(hw_idx, a.shape)
+        pads = [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])]
+
+        def select(acc, cur):
+            acc_v, acc_i = acc
+            cur_v, cur_i = cur
+            take_cur = cur_v > acc_v
+            return (jnp.where(take_cur, cur_v, acc_v),
+                    jnp.where(take_cur, cur_i, acc_i))
+        init_v = jnp.asarray(-jnp.inf, a.dtype)
+        init_i = jnp.asarray(-1.0, jnp.float32)
+        v, i = lax.reduce_window((a, hw_idx), (init_v, init_i), select,
+                                 (1, 1) + ks, (1, 1) + st, pads)
+        return i.astype(jnp.int64)
+    return apply("max_pool2d_index", impl, x)
+
+
+def _adaptive_bounds(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = np.ceil((np.arange(out_size) + 1) * in_size / out_size).astype(int)
+    return starts, ends
+
+
+def _adaptive_pool_nd(n, kind, x, output_size, channel_last=False):
+    out_sz = _tuplize(output_size, n)
+
+    def impl(a):
+        spatial_off = (a.ndim - n - 1) if channel_last else (a.ndim - n)
+        out = a
+        # Uniform case: integer bins → plain strided pooling (fast path).
+        uniform = builtins.all(
+            a.shape[spatial_off + i] % out_sz[i] == 0 for i in range(n))
+        if uniform:
+            ks = tuple(a.shape[spatial_off + i] // out_sz[i] for i in range(n))
+            wd = [1] * a.ndim
+            st = [1] * a.ndim
+            for i in range(n):
+                wd[spatial_off + i] = ks[i]
+                st[spatial_off + i] = ks[i]
+            if kind == "max":
+                return lax.reduce_window(a, jnp.asarray(-jnp.inf, a.dtype),
+                                         lax.max, tuple(wd), tuple(st), "VALID")
+            s = lax.reduce_window(a, jnp.asarray(0.0, a.dtype), lax.add,
+                                  tuple(wd), tuple(st), "VALID")
+            return s / np.prod(ks)
+        # General case: gather per output bin along each dim.
+        for i in range(n):
+            dim = spatial_off + i
+            starts, ends = _adaptive_bounds(out.shape[dim], out_sz[i])
+            slices = []
+            for s0, e0 in zip(starts, ends):
+                sl = jnp.take(out, jnp.arange(s0, e0), axis=dim)
+                red = jnp.max(sl, axis=dim, keepdims=True) if kind == "max" \
+                    else jnp.mean(sl, axis=dim, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=dim)
+        return out
+    return apply(f"adaptive_pool{n}d_{kind}", impl, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(1, "avg", x, output_size)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool_nd(2, "avg", x, output_size,
+                             channel_last=(data_format == "NHWC"))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(3, "avg", x, output_size,
+                             channel_last=(data_format == "NDHWC"))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(1, "max", x, output_size)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(2, "max", x, output_size)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool_nd(3, "max", x, output_size)

@@ -1,0 +1,338 @@
+"""Layer: the module base class.
+
+TPU-native equivalent of the reference dygraph Layer
+(reference: python/paddle/fluid/dygraph/layers.py:875 `Layer.__call__` with
+pre/post forward hooks; create_parameter, sublayers/named_* walkers,
+state_dict/set_state_dict, train/eval, apply, to_static_state).
+
+Parameters are mutable Tensor holders, so a Layer works in both eager mode
+(ops see current values) and traced mode (jit.to_static swaps tracers in —
+see paddle_tpu/jit). Buffers mirror register_buffer semantics
+(layers.py register_buffer).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtypes as _dt
+from . import initializer as I
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class ParamAttr:
+    """reference: python/paddle/fluid/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        return ParamAttr()
+
+
+class Layer:
+    _global_counter: Dict[str, int] = collections.defaultdict(int)
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        cls = type(self).__name__.lower()
+        Layer._global_counter[cls] += 1
+        self._full_name = name_scope or f"{cls}_{Layer._global_counter[cls] - 1}"
+        self._dtype = _dt.convert_dtype(dtype)
+        self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
+        self._sub_layers: Dict[str, Optional["Layer"]] = collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self.training = True
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._casted_by_pure_fp16 = False
+
+    # -- parameter/buffer management ---------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """reference: layers.py create_parameter → LayerHelper.create_parameter."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = _dt.convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter)
+        self._parameters[name] = parameter
+        if parameter is not None:
+            object.__setattr__(self, name, parameter)
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        """reference: layers.py register_buffer."""
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        import jax.numpy as jnp
+        t = Tensor(jnp.zeros([], _dt.convert_dtype(dtype) or self._dtype))
+        return t
+
+    # -- attribute plumbing -------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            buffers.pop(name, None) if buffers else None
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            layers[name] = value
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                params[name] = None
+            if layers is not None and name in layers and value is None:
+                layers[name] = None
+            if buffers is not None and name in buffers:
+                buffers[name] = value
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' has no attribute '{name}'")
+
+    # -- walkers ------------------------------------------------------------
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True,
+                         include_self=True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True,
+                                           layers_set=layers_set)
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self):
+        return (l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return ((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- execution ----------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            out = hook(self, inputs, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    # -- state --------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        """reference: layers.py state_dict — params + persistable buffers."""
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, layer in self.named_sublayers(include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                full = f"{name}.{bname}" if name else bname
+                dest[structured_name_prefix + full] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """reference: layers.py set_state_dict (shape-checked copy)."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, t in own.items():
+            if k in state_dict:
+                v = state_dict[k]
+                raw = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                if tuple(raw.shape) != tuple(t.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: {raw.shape} vs {tuple(t.shape)}")
+                t.set_value(raw.astype(t.dtype))
+            else:
+                missing.append(k)
+        for k in state_dict:
+            if k not in own:
+                unexpected.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype casting ------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_to(_dt.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_to(_dt.convert_dtype(dtype))
+        return self
+
+    def _cast_to(self, dtype, floating_only=True):
+        for _, p in self.named_parameters():
+            if not floating_only or _dt.is_floating(p.dtype):
+                p._data = p._data.astype(dtype)
+        for _, b in self.named_buffers():
+            if not floating_only or _dt.is_floating(b.dtype):
+                b._data = b._data.astype(dtype)
+        for l in self.sublayers(include_self=True):
+            l._dtype = dtype
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{type(self).__name__}({extra}" if extra else f"{type(self).__name__}("]
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"  ({name}): " + "\n  ".join(sub_repr))
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else \
+            f"{type(self).__name__}({extra})"
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()

@@ -1,0 +1,313 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU + cells.
+
+Reference: python/paddle/nn/layer/rnn.py (RNNCellBase, LSTMCell :1038,
+GRUCell :1181, RNN :238, LSTM :1460, GRU :1616) and the cudnn_lstm_op.
+TPU design: the time loop is a `lax.scan` inside ONE traced op, so the whole
+sequence compiles to a single XLA while-loop with the cell body fused —
+replacing the reference's per-timestep kernel launches / cuDNN call.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layer_base import Layer
+from . import initializer as I
+from ..ops.dispatch import apply
+from ..ops import creation
+
+
+def _init_state(shape, dtype):
+    return jnp.zeros(shape, dtype)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return creation.full([b, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+
+        def impl(x, h, wi, wh, bi, bh):
+            z = x @ wi.T + bi + h @ wh.T + bh
+            h2 = jnp.tanh(z) if self.activation == "tanh" else jax.nn.relu(z)
+            return h2, h2
+        return apply("simple_rnn_cell", impl, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+
+
+class LSTMCell(RNNCellBase):
+    """reference: rnn.py:1038 (gate order i,f,g,o like paddle)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs, dtype=inputs.dtype)
+            states = (h, h)
+
+        def impl(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return h2, (h2, c2)
+        return apply("lstm_cell", impl, inputs, states[0], states[1],
+                     self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+
+class GRUCell(RNNCellBase):
+    """reference: rnn.py:1181 (paddle GRU formulation)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / np.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+
+        def impl(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1 - z) * n + z * h
+            return h2, h2
+        return apply("gru_cell", impl, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence scan (reference: rnn.py:238 RNN —
+    there a python loop / recurrent op; here lax.scan)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return _scan_rnn(self.cell, inputs, initial_states, sequence_length,
+                         self.is_reverse, self.time_major)
+
+
+def _cell_params(cell):
+    return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+
+
+def _scan_rnn(cell, inputs, initial_states, sequence_length, is_reverse,
+              time_major):
+    kind = ("lstm" if isinstance(cell, LSTMCell)
+            else "gru" if isinstance(cell, GRUCell) else "rnn")
+    act = getattr(cell, "activation", "tanh")
+    hidden = cell.hidden_size
+
+    def impl(x, wi, wh, bi, bh, *init):
+        if not time_major:
+            x = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+        if is_reverse:
+            x = jnp.flip(x, 0)
+        b = x.shape[1]
+        if init:
+            h0 = init[0]
+            c0 = init[1] if kind == "lstm" else None
+        else:
+            h0 = jnp.zeros((b, hidden), x.dtype)
+            c0 = jnp.zeros((b, hidden), x.dtype) if kind == "lstm" else None
+
+        def body(carry, xt):
+            if kind == "lstm":
+                h, c = carry
+                gates = xt @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+                return (h2, c2), h2
+            if kind == "gru":
+                h = carry
+                xg = xt @ wi.T + bi
+                hg = h @ wh.T + bh
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                h2 = (1 - z) * n + z * h
+                return h2, h2
+            h = carry
+            z = xt @ wi.T + bi + h @ wh.T + bh
+            h2 = jnp.tanh(z) if act == "tanh" else jax.nn.relu(z)
+            return h2, h2
+
+        carry0 = (h0, c0) if kind == "lstm" else h0
+        carryT, ys = jax.lax.scan(body, carry0, x)
+        if is_reverse:
+            ys = jnp.flip(ys, 0)
+        if not time_major:
+            ys = jnp.swapaxes(ys, 0, 1)
+        if kind == "lstm":
+            return ys, carryT[0], carryT[1]
+        return ys, carryT
+
+    args = [inputs] + _cell_params(cell)
+    if initial_states is not None:
+        if kind == "lstm":
+            args += [initial_states[0], initial_states[1]]
+        else:
+            args += [initial_states]
+    out = apply(f"rnn_scan_{kind}", impl, *args)
+    if kind == "lstm":
+        ys, h, c = out
+        return ys, (h, c)
+    ys, h = out
+    return ys, h
+
+
+class _MultiLayerRNN(Layer):
+    """Stacked (optionally bidirectional) recurrent network
+    (reference: rnn.py LSTM :1460 / GRU :1616 / SimpleRNN :1322)."""
+
+    MODE = "rnn"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        self.num_directions = num_dir
+
+        cell_cls = {"rnn": SimpleRNNCell, "lstm": LSTMCell, "gru": GRUCell}[self.MODE]
+        self._cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * num_dir
+            for d in range(num_dir):
+                kw = {}
+                if self.MODE == "rnn":
+                    kw["activation"] = activation
+                cell = cell_cls(in_sz, hidden_size, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr, **kw)
+                self.add_sublayer(f"cell_{layer}_{d}", cell)
+                self._cells.append(cell)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .functional import dropout as F_dropout
+        states_out = []
+        x = inputs
+        idx = 0
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.num_directions):
+                cell = self._cells[idx]
+                init = None
+                if initial_states is not None:
+                    if self.MODE == "lstm":
+                        init = (initial_states[0][idx], initial_states[1][idx])
+                    else:
+                        init = initial_states[idx]
+                ys, st = _scan_rnn(cell, x, init, sequence_length,
+                                   is_reverse=(d == 1), time_major=self.time_major)
+                outs.append(ys)
+                states_out.append(st)
+                idx += 1
+            if self.num_directions == 2:
+                from ..ops import manipulation
+                x = manipulation.concat(outs, axis=-1)
+            else:
+                x = outs[0]
+            if self.dropout and layer < self.num_layers - 1:
+                x = F_dropout(x, self.dropout, training=self.training)
+        from ..ops import manipulation as mp
+        if self.MODE == "lstm":
+            h = mp.stack([s[0] for s in states_out], 0)
+            c = mp.stack([s[1] for s in states_out], 0)
+            return x, (h, c)
+        h = mp.stack(states_out, 0)
+        return x, h
+
+
+class SimpleRNN(_MultiLayerRNN):
+    MODE = "rnn"
+
+
+class LSTM(_MultiLayerRNN):
+    MODE = "lstm"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class GRU(_MultiLayerRNN):
+    MODE = "gru"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)

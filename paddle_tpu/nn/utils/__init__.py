@@ -1,0 +1,16 @@
+"""paddle.nn.utils parity."""
+from ..utils_weight_norm import weight_norm, remove_weight_norm
+from ..utils_weight_norm import spectral_norm_fn as spectral_norm
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops import manipulation
+    return manipulation.concat([p.flatten() for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec[offset:offset + n].reshape(p.shape))
+        offset += n

@@ -1,0 +1,82 @@
+"""weight_norm / spectral_norm utilities.
+
+Reference: python/paddle/nn/utils/weight_norm_hook.py — reparameterize a
+layer's `weight` as g * v/||v|| via forward-pre-hook.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Parameter
+from ..ops import math as _math
+from ..ops.dispatch import apply
+
+
+def _norm_except_dim(w, dim):
+    import jax.numpy as jnp
+
+    def impl(a):
+        if dim is None or dim == -1:
+            return jnp.sqrt(jnp.sum(a * a))
+        axes = tuple(i for i in range(a.ndim) if i != dim)
+        return jnp.sqrt(jnp.sum(a * a, axis=axes))
+    return apply("norm_except_dim", impl, w)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    w = getattr(layer, name)
+    g = Parameter(_norm_except_dim(w, dim)._data)
+    v = Parameter(w._data)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        import jax.numpy as jnp
+
+        def impl(gg, vv):
+            if dim is None or dim == -1:
+                n = jnp.sqrt(jnp.sum(vv * vv))
+                return vv * (gg / jnp.maximum(n, 1e-12))
+            axes = tuple(i for i in range(vv.ndim) if i != dim)
+            n = jnp.sqrt(jnp.sum(vv * vv, axis=axes, keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv * (gg.reshape(shape) / jnp.maximum(n, 1e-12))
+        object.__setattr__(lyr, name, apply("weight_norm", impl, g, v))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    handle = getattr(layer, "_weight_norm_handle", None)
+    if handle is not None:
+        handle.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    w = Parameter(v._data)
+    layer.add_parameter(name, w)
+    return layer
+
+
+def spectral_norm_fn(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                     dim=None):
+    """nn.utils.spectral_norm parity via power iteration pre-hook."""
+    from .layers_common import SpectralNorm
+    w = getattr(layer, name)
+    sn = SpectralNorm(w.shape, dim=dim or 0, power_iters=n_power_iterations,
+                      eps=eps)
+    layer.add_sublayer("_spectral_norm", sn)
+    orig = layer._parameters[name]
+    layer._parameters[name + "_orig"] = orig
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name, sn(orig))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -1,0 +1,4 @@
+"""paddle.optimizer parity (reference: python/paddle/optimizer/__init__.py)."""
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+                        Adagrad, Adadelta, RMSProp, Lamb, LarsMomentum, Ftrl)
+from . import lr

@@ -1,0 +1,452 @@
+"""Optimizer base + the optimizer family, with a fused XLA update step.
+
+Reference: the reference implements optimizers as *graph ops*
+(paddle/fluid/operators/optimizers/{sgd,momentum,adam,adamax,adagrad,rmsprop,
+lamb,...}_op.cc) appended by python/paddle/fluid/optimizer.py:58 `Optimizer`.
+TPU design: each optimizer defines one pure `_update(param, grad, state, lr)`
+rule; `step()` applies it across the whole parameter pytree inside a single
+jitted computation with donated buffers (the analog of the reference's
+fuse_optimizer_ops_pass + coalesce_tensor fusion, SURVEY Appendix B).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtypes as _dt
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode (pass model.parameters())")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._state: Dict[int, dict] = {}
+        self._global_step = 0
+        self._jit_update = None
+        self._jit_key = None
+        self._accumulators_built = False
+        self.helper = None
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    # -- state --------------------------------------------------------------
+    def _ensure_state(self):
+        if self._accumulators_built:
+            return
+        for p in self._parameter_list:
+            self._state[id(p)] = self._init_state(p)
+        self._accumulators_built = True
+
+    def _init_state(self, p: Parameter) -> dict:
+        return {}
+
+    def state_dict(self):
+        """reference: python/paddle/optimizer/optimizer.py state_dict — moment
+        accumulators + global step + LR scheduler state."""
+        self._ensure_state()
+        out = {}
+        for i, p in enumerate(self._parameter_list):
+            for k, v in self._state[id(p)].items():
+                out[f"param_{i}.{k}"] = Tensor(v)
+        out["global_step"] = self._global_step
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._ensure_state()
+        for i, p in enumerate(self._parameter_list):
+            for k in self._state[id(p)]:
+                key = f"param_{i}.{k}"
+                if key in state:
+                    v = state[key]
+                    self._state[id(p)][k] = (
+                        v._data if isinstance(v, Tensor) else jnp.asarray(v))
+        self._global_step = int(state.get("global_step", self._global_step))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+    # -- update rule (override) ---------------------------------------------
+    def _update(self, param, grad, state, lr, step):
+        raise NotImplementedError
+
+    def _regularized_grad(self, p, g):
+        """Apply per-param L2 regularizer (reference: fluid/regularizer.py —
+        appended as grad += coeff * param)."""
+        reg = getattr(p, "regularizer", None)
+        wd = self._weight_decay
+        coeff = None
+        if reg is not None and getattr(reg, "_coeff", None):
+            coeff = reg._coeff
+        elif isinstance(wd, (int, float)) and not getattr(self, "_decoupled_wd", False):
+            coeff = float(wd)
+        elif wd is not None and hasattr(wd, "_coeff") and not getattr(self, "_decoupled_wd", False):
+            coeff = wd._coeff
+        return coeff
+
+    # -- step ---------------------------------------------------------------
+    @property
+    def _lr_dtype(self):
+        return jnp.float32
+
+    def step(self):
+        self._ensure_state()
+        params = [p for p in self._parameter_list if p._grad is not None
+                  and p.trainable]
+        if not params:
+            return
+        grads = [p._grad for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip._clip_raw(params, grads)
+        states = [self._state[id(p)] for p in params]
+        lr = jnp.asarray(self.get_lr(), self._lr_dtype)
+        step_no = jnp.asarray(self._global_step + 1, jnp.float32)
+
+        key = tuple((tuple(p.shape), str(p.dtype)) for p in params)
+        if self._jit_update is None or self._jit_key != key:
+            reg_coeffs = [self._regularized_grad(p, None) for p in params]
+
+            def fused(params_raw, grads_raw, states_raw, lr_, step_):
+                new_p, new_s = [], []
+                for pr, gr, st, rc in zip(params_raw, grads_raw, states_raw,
+                                          reg_coeffs):
+                    if rc is not None:
+                        gr = gr + rc * pr
+                    p2, s2 = self._update(pr, gr.astype(pr.dtype), st, lr_, step_)
+                    new_p.append(p2)
+                    new_s.append(s2)
+                return new_p, new_s
+            self._jit_update = jax.jit(fused, donate_argnums=(0, 2))
+            self._jit_key = key
+
+        new_params, new_states = self._jit_update(
+            [p._data for p in params], grads, states, lr, step_no)
+        for p, np_, ns in zip(params, new_params, new_states):
+            p._data = np_
+            p._inplace_version += 1
+            self._state[id(p)] = ns
+        self._global_step += 1
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Dygraph minimize = backward + step (reference:
+        fluid/optimizer.py minimize)."""
+        loss.backward()
+        self.step()
+        return None, None
+
+    def backward(self, loss, **kw):
+        loss.backward()
+
+    def apply_gradients(self, params_grads):
+        for p, g in params_grads:
+            p._grad = g._data if isinstance(g, Tensor) else g
+        self.step()
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op.cc."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update(self, p, g, s, lr, step):
+        return p - lr.astype(p.dtype) * g, s
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op.cc (use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._data.shape, p._data.dtype)}
+
+    def _update(self, p, g, s, lr, step):
+        lr = lr.astype(p.dtype)
+        v = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            p2 = p - lr * (g + self._momentum * v)
+        else:
+            p2 = p - lr * v
+        return p2, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op.cc (bias-corrected)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._data.dtype
+        st = {"moment1": jnp.zeros(p._data.shape, dt),
+              "moment2": jnp.zeros(p._data.shape, dt)}
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            st["master"] = p._data.astype(jnp.float32)
+        return st
+
+    def _update(self, p, g, s, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        master = s.get("master")
+        work = master if master is not None else p
+        gf = g.astype(work.dtype)
+        m = b1 * s["moment1"] + (1 - b1) * gf
+        v = b2 * s["moment2"] + (1 - b2) * gf * gf
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        new_work = work - lr.astype(work.dtype) * mhat / (jnp.sqrt(vhat) + eps)
+        ns = {"moment1": m, "moment2": v}
+        if master is not None:
+            ns["master"] = new_work
+            return new_work.astype(p.dtype), ns
+        return new_work, ns
+
+
+class AdamW(Adam):
+    """reference: python/paddle/optimizer/adamw.py (decoupled weight decay)."""
+
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
+            else weight_decay
+
+    def step(self):
+        # mark which params decay (by name predicate) before the fused update
+        self._decay_mask = {}
+        for p in self._parameter_list:
+            decay = True
+            if self._apply_decay_param_fun is not None:
+                decay = self._apply_decay_param_fun(p.name or "")
+            self._decay_mask[id(p)] = decay
+        super().step()
+
+    def _update(self, p, g, s, lr, step):
+        # decoupled decay first: p *= (1 - lr*coeff)
+        coeff = self._coeff if isinstance(self._coeff, float) else 0.01
+        master = s.get("master")
+        work = master if master is not None else p
+        decayed = work * (1.0 - lr.astype(work.dtype) * coeff)
+        if master is not None:
+            s = dict(s, master=decayed)
+            out, ns = super()._update(p, g, s, lr, step)
+            return out, ns
+        return super()._update(decayed, g, s, lr, step)
+
+
+class Adamax(Optimizer):
+    """reference: operators/optimizers/adamax_op.cc."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p._data.shape, p._data.dtype),
+                "inf_norm": jnp.zeros(p._data.shape, p._data.dtype)}
+
+    def _update(self, p, g, s, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * s["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * s["inf_norm"], jnp.abs(g))
+        p2 = p - (lr.astype(p.dtype) / (1 - b1 ** step)) * m / (u + eps)
+        return p2, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    """reference: operators/optimizers/adagrad_op.cc."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._data.shape, self._init_acc, p._data.dtype)}
+
+    def _update(self, p, g, s, lr, step):
+        m = s["moment"] + g * g
+        p2 = p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self._epsilon)
+        return p2, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    """reference: operators/optimizers/adadelta_op.cc."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_sq_grad": jnp.zeros(p._data.shape, p._data.dtype),
+                "avg_sq_update": jnp.zeros(p._data.shape, p._data.dtype)}
+
+    def _update(self, p, g, s, lr, step):
+        rho, eps = self._rho, self._epsilon
+        ag = rho * s["avg_sq_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(s["avg_sq_update"] + eps) / jnp.sqrt(ag + eps)
+        au = rho * s["avg_sq_update"] + (1 - rho) * upd * upd
+        return p - lr.astype(p.dtype) * upd, {"avg_sq_grad": ag, "avg_sq_update": au}
+
+
+class RMSProp(Optimizer):
+    """reference: operators/optimizers/rmsprop_op.cc (centered variant)."""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros(p._data.shape, p._data.dtype),
+              "momentum": jnp.zeros(p._data.shape, p._data.dtype)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(p._data.shape, p._data.dtype)
+        return st
+
+    def _update(self, p, g, s, lr, step):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * s["mean_square"] + (1 - rho) * g * g
+        if self._centered:
+            mg = rho * s["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * s["momentum"] + lr.astype(p.dtype) * g / denom
+        ns = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            ns["mean_grad"] = mg
+        return p - mom, ns
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.cc (layerwise adaptive)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._data.shape, p._data.dtype),
+                "moment2": jnp.zeros(p._data.shape, p._data.dtype)}
+
+    def _update(self, p, g, s, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * s["moment1"] + (1 - b1) * g
+        v = b2 * s["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        r = mhat / (jnp.sqrt(vhat) + eps) + self._lamb_wd * p
+        w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+        r_norm = jnp.sqrt(jnp.sum(r.astype(jnp.float32) ** 2))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - (lr * trust).astype(p.dtype) * r, {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """reference: operators/optimizers/lars_momentum_op.cc."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._data.shape, p._data.dtype)}
+
+    def _update(self, p, g, s, lr, step):
+        w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+        g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm),
+            lr)
+        v = self._momentum * s["velocity"] + local_lr.astype(p.dtype) * (
+            g + self._lars_wd * p)
+        return p - v, {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    """reference: operators/optimizers/ftrl_op.cc."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _init_state(self, p):
+        return {"squared": jnp.zeros(p._data.shape, p._data.dtype),
+                "linear": jnp.zeros(p._data.shape, p._data.dtype)}
+
+    def _update(self, p, g, s, lr, step):
+        lp = self._lr_power
+        new_sq = s["squared"] + g * g
+        sigma = (jnp.power(new_sq, -lp) - jnp.power(s["squared"] + 1e-30, -lp)) / lr
+        lin = s["linear"] + g - sigma * p
+        quad = jnp.power(new_sq, -lp) / lr + 2 * self._l2
+        pre = jnp.clip(lin, -self._l1, self._l1) - lin
+        p2 = pre / quad
+        return p2, {"squared": new_sq, "linear": lin}

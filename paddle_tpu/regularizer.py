@@ -1,0 +1,27 @@
+"""Weight regularizers (reference: python/paddle/fluid/regularizer.py —
+appended to grads as `grad += coeff * param` ops; here picked up by the fused
+optimizer update)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L2Decay(WeightDecayRegularizer):
+    """reference: fluid/regularizer.py L2DecayRegularizer."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """reference: fluid/regularizer.py L1DecayRegularizer. The fused update
+    applies sign(p)*coeff for L1."""
+    _l1 = True
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

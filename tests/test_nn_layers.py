@@ -1,0 +1,346 @@
+"""nn layer tests with torch/numpy cross-checks
+(pattern: reference unittests/test_layers.py + per-op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLinearConv:
+    def test_linear_math(self):
+        lin = nn.Linear(3, 2)
+        w = np.arange(6).reshape(3, 2).astype(np.float32)
+        b = np.array([1.0, -1.0], np.float32)
+        lin.weight.set_value(w)
+        lin.bias.set_value(b)
+        x = np.array([[1.0, 2.0, 3.0]], np.float32)
+        out = lin(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_conv2d_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+        w = np.random.rand(5, 3, 3, 3).astype(np.float32)
+        b = np.random.rand(5).astype(np.float32)
+        ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        paddle.to_tensor(b), stride=2, padding=1).numpy()
+        theirs = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), torch.tensor(b),
+            stride=2, padding=1).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+    def test_conv2d_groups_dilation(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(1, 4, 10, 10).astype(np.float32)
+        w = np.random.rand(8, 2, 3, 3).astype(np.float32)
+        ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        groups=2, dilation=2, padding=2).numpy()
+        theirs = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), groups=2, dilation=2,
+            padding=2).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+    def test_depthwise(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(1, 6, 8, 8).astype(np.float32)
+        w = np.random.rand(6, 1, 3, 3).astype(np.float32)
+        ours = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                        groups=6, padding=1).numpy()
+        theirs = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(w), groups=6, padding=1).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+    def test_conv2d_transpose(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(1, 4, 5, 5).astype(np.float32)
+        w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+        ours = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  stride=2, padding=1).numpy()
+        theirs = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4, rtol=1e-4)
+
+
+class TestPool:
+    def test_max_avg_pool(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+        ours = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        theirs = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-6)
+        ours = F.avg_pool2d(paddle.to_tensor(x), 3, 2, 1).numpy()
+        theirs = torch.nn.functional.avg_pool2d(
+            torch.tensor(x), 3, 2, 1, count_include_pad=False).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+    def test_adaptive_pool(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(2, 3, 7, 9).astype(np.float32)
+        ours = F.adaptive_avg_pool2d(paddle.to_tensor(x), [3, 4]).numpy()
+        theirs = torch.nn.functional.adaptive_avg_pool2d(
+            torch.tensor(x), (3, 4)).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+class TestNorm:
+    def test_batch_norm_train_eval(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+        ours_bn = nn.BatchNorm2D(3, momentum=0.9)
+        theirs_bn = torch.nn.BatchNorm2d(3, momentum=0.1)  # torch: new*0.1
+        out1 = ours_bn(paddle.to_tensor(x)).numpy()
+        out2 = theirs_bn(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(out1, out2, atol=1e-4)
+        np.testing.assert_allclose(ours_bn._mean.numpy(),
+                                   theirs_bn.running_mean.numpy(), atol=1e-5)
+        np.testing.assert_allclose(ours_bn._variance.numpy(),
+                                   theirs_bn.running_var.numpy(), atol=1e-5)
+        ours_bn.eval()
+        theirs_bn.eval()
+        np.testing.assert_allclose(
+            ours_bn(paddle.to_tensor(x)).numpy(),
+            theirs_bn(torch.tensor(x)).detach().numpy(), atol=1e-4)
+
+    def test_layer_norm(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(2, 5, 8).astype(np.float32)
+        ours = nn.LayerNorm(8)
+        theirs = torch.nn.LayerNorm(8)
+        np.testing.assert_allclose(
+            ours(paddle.to_tensor(x)).numpy(),
+            theirs(torch.tensor(x)).detach().numpy(), atol=1e-5)
+
+    def test_group_norm(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.rand(2, 6, 4, 4).astype(np.float32)
+        ours = nn.GroupNorm(3, 6)
+        theirs = torch.nn.GroupNorm(3, 6)
+        np.testing.assert_allclose(
+            ours(paddle.to_tensor(x)).numpy(),
+            theirs(torch.tensor(x)).detach().numpy(), atol=1e-5)
+
+
+class TestLosses:
+    def test_cross_entropy_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        logits = np.random.rand(4, 7).astype(np.float32)
+        labels = np.array([0, 3, 6, 2])
+        ours = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels)).numpy()
+        theirs = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels)).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+    def test_cross_entropy_ignore_index(self):
+        torch = pytest.importorskip("torch")
+        logits = np.random.rand(4, 7).astype(np.float32)
+        labels = np.array([0, -100, 6, -100])
+        ours = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100).numpy()
+        theirs = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels), ignore_index=-100).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+    def test_bce_with_logits(self):
+        torch = pytest.importorskip("torch")
+        z = np.random.randn(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        ours = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(z), paddle.to_tensor(y)).numpy()
+        theirs = torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(z), torch.tensor(y)).numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+    def test_kl_smooth_l1(self):
+        torch = pytest.importorskip("torch")
+        a = np.log(np.random.rand(3, 4).astype(np.float32) + 0.1)
+        b = np.random.rand(3, 4).astype(np.float32)
+        ours = F.kl_div(paddle.to_tensor(a), paddle.to_tensor(b),
+                        reduction="sum").numpy()
+        theirs = torch.nn.functional.kl_div(
+            torch.tensor(a), torch.tensor(b), reduction="sum").numpy()
+        np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+class TestRNN:
+    def test_lstm_vs_torch(self):
+        torch = pytest.importorskip("torch")
+        paddle.seed(1)
+        ours = nn.LSTM(4, 6)
+        theirs = torch.nn.LSTM(4, 6, batch_first=True)
+        # copy our weights into torch
+        sd = {k: v.numpy() for k, v in ours.state_dict().items()}
+        with torch.no_grad():
+            theirs.weight_ih_l0.copy_(torch.tensor(sd["cell_0_0.weight_ih"]))
+            theirs.weight_hh_l0.copy_(torch.tensor(sd["cell_0_0.weight_hh"]))
+            theirs.bias_ih_l0.copy_(torch.tensor(sd["cell_0_0.bias_ih"]))
+            theirs.bias_hh_l0.copy_(torch.tensor(sd["cell_0_0.bias_hh"]))
+        x = np.random.rand(2, 5, 4).astype(np.float32)
+        out_o, (h_o, c_o) = ours(paddle.to_tensor(x))
+        out_t, (h_t, c_t) = theirs(torch.tensor(x))
+        np.testing.assert_allclose(out_o.numpy(), out_t.detach().numpy(), atol=1e-4)
+        np.testing.assert_allclose(h_o.numpy(), h_t.detach().numpy(), atol=1e-4)
+
+    def test_gru_shapes(self):
+        gru = nn.GRU(3, 5, num_layers=2)
+        out, h = gru(paddle.randn([2, 7, 3]))
+        assert out.shape == [2, 7, 5]
+        assert h.shape == [2, 2, 5]
+
+
+class TestTransformer:
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_mask(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        x = paddle.randn([1, 4, 8])
+        mask = paddle.tril(paddle.ones([4, 4], "bool"))
+        out = mha(x, attn_mask=mask)
+        assert out.shape == [1, 4, 8]
+
+    def test_encoder_decoder(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        src = paddle.randn([2, 6, 16])
+        tgt = paddle.randn([2, 4, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 4, 16]
+
+
+class TestLayerMechanics:
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h1 = lin.register_forward_pre_hook(lambda l, i: calls.append("pre"))
+        h2 = lin.register_forward_post_hook(lambda l, i, o: calls.append("post"))
+        lin(paddle.randn([1, 2]))
+        assert calls == ["pre", "post"]
+        h1.remove()
+        h2.remove()
+        lin(paddle.randn([1, 2]))
+        assert calls == ["pre", "post"]
+
+    def test_state_dict_roundtrip(self):
+        a = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        b = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        b.set_state_dict(a.state_dict())
+        x = paddle.randn([2, 3])
+        np.testing.assert_allclose(a(x).numpy(), b(x).numpy())
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        x = paddle.ones([4, 2])
+        np.testing.assert_allclose(m[1](x).numpy(), x.numpy())
+
+    def test_parameters_dedup(self):
+        shared = nn.Linear(2, 2)
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = shared
+                self.b = shared
+            def forward(self, x):
+                return self.b(self.a(x))
+        assert len(M().parameters()) == 2  # weight+bias counted once
+
+
+class TestOptimizers:
+    def _quadratic(self, opt_fn, steps=120, tol=1e-2):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([5.0, -3.0], np.float32), stop_gradient=False)
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(w._data)
+        opt = opt_fn([p])
+        for _ in range(steps):
+            loss = ((p - paddle.to_tensor([1.0, 2.0])) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        np.testing.assert_allclose(p.numpy(), [1.0, 2.0], atol=0.15)
+
+    def test_sgd(self):
+        import paddle_tpu.optimizer as optim
+        self._quadratic(lambda ps: optim.SGD(0.1, parameters=ps))
+
+    def test_momentum(self):
+        import paddle_tpu.optimizer as optim
+        self._quadratic(lambda ps: optim.Momentum(0.05, 0.9, parameters=ps))
+
+    def test_adam(self):
+        import paddle_tpu.optimizer as optim
+        self._quadratic(lambda ps: optim.Adam(0.3, parameters=ps))
+
+    def test_adamw(self):
+        import paddle_tpu.optimizer as optim
+        self._quadratic(lambda ps: optim.AdamW(0.3, parameters=ps,
+                                               weight_decay=0.0))
+
+    def test_rmsprop_lamb(self):
+        import paddle_tpu.optimizer as optim
+        self._quadratic(lambda ps: optim.RMSProp(0.1, parameters=ps))
+        self._quadratic(lambda ps: optim.Lamb(0.3, lamb_weight_decay=0.0,
+                                              parameters=ps), steps=200)
+
+    def test_adam_vs_torch_trajectory(self):
+        torch = pytest.importorskip("torch")
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.core.tensor import Parameter
+        w0 = np.array([1.5, -2.0], np.float32)
+        p = Parameter(w0.copy())
+        opt = optim.Adam(0.1, parameters=[p])
+        tp = torch.nn.Parameter(torch.tensor(w0.copy()))
+        topt = torch.optim.Adam([tp], lr=0.1)
+        for _ in range(10):
+            (p * p).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            tloss = (tp * tp).sum()
+            topt.zero_grad()
+            tloss.backward()
+            topt.step()
+        np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), atol=1e-4)
+
+    def test_grad_clip_global_norm(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.array([1.0], np.float32))
+        clip = paddle.ClipGradByGlobalNorm(0.5)
+        opt = optim.SGD(1.0, parameters=[p], grad_clip=clip)
+        (p * 100.0).sum().backward()
+        opt.step()
+        # grad 100 clipped to 0.5 -> p = 1 - 0.5
+        np.testing.assert_allclose(p.numpy(), [0.5], atol=1e-5)
+
+    def test_lr_scheduler(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.optimizer import lr as lr_mod
+        from paddle_tpu.core.tensor import Parameter
+        sched = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        p = Parameter(np.array([1.0], np.float32))
+        opt = optim.SGD(sched, parameters=[p])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step(); sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_optimizer_state_roundtrip(self):
+        import paddle_tpu.optimizer as optim
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.array([1.0, 2.0], np.float32))
+        opt = optim.Adam(0.1, parameters=[p])
+        (p * p).sum().backward()
+        opt.step(); opt.clear_grad()
+        state = opt.state_dict()
+        p2 = Parameter(np.array([1.0, 2.0], np.float32))
+        opt2 = optim.Adam(0.1, parameters=[p2])
+        opt2.set_state_dict(state)
+        np.testing.assert_allclose(
+            np.asarray(opt2._state[id(p2)]["moment1"]),
+            np.asarray(opt._state[id(p)]["moment1"]))
