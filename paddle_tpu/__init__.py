@@ -52,6 +52,9 @@ from . import regularizer  # noqa: E402,F401
 from .nn.layer_base import ParamAttr  # noqa: E402,F401
 from .nn.clip import (ClipGradByValue, ClipGradByNorm,  # noqa: E402,F401
                       ClipGradByGlobalNorm)
+from . import jit  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from .framework_io import save, load  # noqa: E402,F401
 
 
 

@@ -50,6 +50,11 @@ class Generator:
 _DEFAULT = Generator(0)
 _NUMPY_SEEDED = [False]
 
+# While a functionalization trace is active (paddle_tpu/jit/functionalize.py),
+# key draws are rerouted through the trace's key argument so compiled programs
+# get fresh randomness per call instead of a baked-in constant key.
+_TRACE_HOOK = [None]
+
 
 def default_generator() -> Generator:
     return _DEFAULT
@@ -65,6 +70,8 @@ def seed(value: int) -> Generator:
 
 
 def next_key():
+    if _TRACE_HOOK[0] is not None:
+        return _TRACE_HOOK[0]()
     return _DEFAULT.split(1)
 
 

@@ -178,8 +178,26 @@ class Tensor:
     # -- mutation (in-place style) -----------------------------------------
     def set_value(self, value):
         """Replace contents in place (reference: VarBase SetValue); bumps the
-        inplace version like TensorInplaceVersion (tensor.h:77)."""
+        inplace version like TensorInplaceVersion (tensor.h:77).
+
+        Under a functionalization trace (jit.to_static) a traced value is
+        captured as a state effect instead of mutating the holder; under
+        static-graph mode a symbolic Variable value is registered with the
+        Program the same way."""
+        if type(value).__name__ == "Variable" and hasattr(value, "_program"):
+            value._program.record_state_effect(self, value)
+            return
         raw = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if isinstance(raw, jax.core.Tracer):
+            from ..jit.functionalize import active_trace
+            ctx = active_trace()
+            if ctx is not None:
+                if tuple(raw.shape) != tuple(self._data.shape):
+                    raise ValueError(
+                        f"set_value shape mismatch under trace: "
+                        f"{tuple(raw.shape)} vs {tuple(self._data.shape)}")
+                ctx.record_effect(self, raw.astype(self._data.dtype))
+                return
         if tuple(raw.shape) != tuple(self._data.shape):
             raise ValueError(
                 f"set_value shape mismatch: {tuple(raw.shape)} vs {tuple(self._data.shape)}")

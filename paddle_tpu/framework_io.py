@@ -1,0 +1,60 @@
+"""paddle.save / paddle.load: pickle-based checkpoint of state dicts.
+
+Reference: python/paddle/framework/io.py:494 save / :688 load — pickle of
+numpy-ified tensors with >4GB protocol handling. Here tensors are converted
+to numpy; nested dicts/lists (layer state_dict, optimizer state_dict) are
+traversed. For sharded/async checkpoints of distributed training, see
+paddle_tpu.incubate.checkpoint (orbax-style).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__paddle_tensor__": True, "data": np.asarray(obj._data),
+            "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape") and not isinstance(
+            obj, np.ndarray) and type(obj).__module__.startswith("jax"):
+        return np.asarray(obj)
+    return obj
+
+
+def _from_saved(obj, return_tensor=True):
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tensor__"):
+            if return_tensor:
+                t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True),
+                           name=obj.get("name"))
+                return t
+            return obj["data"]
+        return {k: _from_saved(v, return_tensor) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_saved(v, return_tensor) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    return _from_saved(blob, return_tensor=not return_numpy)

@@ -1,0 +1,313 @@
+"""paddle.jit: dynamic-to-static compilation + save/load.
+
+TPU-native replacement for the reference @to_static stack
+(reference: python/paddle/fluid/dygraph/jit.py:161 declarative,
+dygraph_to_static/program_translator.py:233 StaticFunction, :689 ProgramCache,
+partial_program.py:109 PartialProgramLayer).
+
+Design difference: the reference REWRITES the Python AST (if→cond ops,
+for→while_loop ops) then runs the rewritten code under a static Program.
+Here the original Python executes under a jax trace (functionalize.py) and the
+whole forward becomes ONE XLA computation; its vjp is the compiled backward.
+Python control flow on tensor values must use lax-style ops
+(paddle_tpu.ops.cond/while_loop) — data-dependent `if` raises a tracer error
+with guidance, matching XLA's compilation model instead of hiding it.
+
+The cache is keyed by input signature exactly like ProgramCache
+(program_translator.py:689): (shapes, dtypes, training-mode, param dtypes).
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtypes as _dt
+from ..core import generator as _gen
+from ..ops.dispatch import apply
+from ..core import autograd_engine as _ag
+from .functionalize import build_pure
+
+
+class InputSpec:
+    """reference: python/paddle/static/input_spec.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = _dt.convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+
+def _is_float(dtype) -> bool:
+    return (np.issubdtype(np.dtype(dtype), np.inexact)
+            or dtype == jnp.bfloat16)
+
+
+def _sig_of(args) -> Tuple:
+    leaves, td = jax.tree_util.tree_flatten(
+        args, is_leaf=lambda x: isinstance(x, Tensor))
+    sig = []
+    for l in leaves:
+        if isinstance(l, Tensor):
+            sig.append(("T", tuple(l.shape), str(l.dtype)))
+        else:
+            sig.append(("C", repr(l)))
+    return tuple(sig), td
+
+
+class StaticFunction:
+    """A callable wrapping `fn` (a function or a Layer.forward) that executes
+    as one compiled XLA program per input signature
+    (reference: program_translator.py:233)."""
+
+    def __init__(self, fn: Callable, layer=None, input_spec=None,
+                 build_strategy=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Tuple, Any] = {}
+        functools.update_wrapper(self, fn)
+
+    @property
+    def concrete_programs(self):
+        return list(self._cache.values())
+
+    def _params_and_buffers(self) -> List[Tensor]:
+        if self._layer is None:
+            return []
+        ps = [p for _, p in self._layer.named_parameters()]
+        bs = [b for _, b in self._layer.named_buffers()]
+        return ps + bs
+
+    def __call__(self, *args, **kwargs):
+        state = self._params_and_buffers()
+        mode_key = (self._layer.training if self._layer is not None else None)
+        sig, _ = _sig_of(args)
+        pkey = tuple(str(p.dtype) for p in state)
+        key = (sig, mode_key, pkey, tuple(sorted(kwargs.items())) if kwargs else ())
+
+        in_leaves, in_td = jax.tree_util.tree_flatten(
+            args, is_leaf=lambda x: isinstance(x, Tensor))
+        state_raws = [p._data for p in state]
+        in_raws = [l._data if isinstance(l, Tensor) else l for l in in_leaves]
+        diff_s = [i for i, p in enumerate(state)
+                  if not p.stop_gradient and _is_float(p.dtype)]
+        diff_i = [i for i, l in enumerate(in_leaves)
+                  if isinstance(l, Tensor) and not l.stop_gradient
+                  and _is_float(l.dtype)]
+
+        entry = self._cache.get(key)
+        if entry is None:
+            pure, meta = build_pure(self._fn, state)
+
+            # fwd: one compiled XLA program (params, inputs, key) -> outs+effects
+            def fwd(s_raws, i_raws, k, skw):
+                i_tree = jax.tree_util.tree_unflatten(in_td, list(i_raws))
+                return pure(list(s_raws), i_tree, k, skw)
+            fwd_jit = jax.jit(fwd, static_argnums=(3,))
+
+            # bwd: separate compiled program, recomputes fwd internally
+            # (XLA fuses fwd+bwd into one program; the zero-recompute path is
+            # the fully-fused train step used by hapi/static Executor).
+            def bwd(sd_raws, id_raws, s_all, i_all, k, skw, cots):
+                def f(sd, idf):
+                    s_full = list(s_all)
+                    for pos, r in zip(diff_s, sd):
+                        s_full[pos] = r
+                    i_full = list(i_all)
+                    for pos, r in zip(diff_i, idf):
+                        i_full[pos] = r
+                    i_tree = jax.tree_util.tree_unflatten(in_td, i_full)
+                    return pure(s_full, i_tree, k, skw)
+                _, vjp = jax.vjp(f, list(sd_raws), list(id_raws))
+                gs, gi = vjp(tuple(cots))
+                return list(gs) + list(gi)
+            bwd_jit = jax.jit(bwd, static_argnums=(5,))
+            entry = {"fwd": fwd_jit, "bwd": bwd_jit, "meta": meta}
+            self._cache[key] = entry
+        meta = entry["meta"]
+
+        call_key = _gen.next_key()
+        skw = _HashableKwargs(kwargs) if kwargs else None
+        out_raws = entry["fwd"](state_raws, in_raws, call_key, skw)
+
+        need_grad = _ag.is_grad_enabled() and (diff_s or diff_i)
+        node = None
+        if need_grad:
+            diff_tensors = [state[i] for i in diff_s] + [in_leaves[i] for i in diff_i]
+            bwd_jit = entry["bwd"]
+            sd = [state_raws[i] for i in diff_s]
+            idr = [in_raws[i] for i in diff_i]
+
+            def vjp_fn(cots):
+                return bwd_jit(sd, idr, state_raws, in_raws, call_key, skw,
+                               tuple(cots))
+            node = _ag.GradNode(
+                f"to_static:{getattr(self._fn, '__name__', 'fn')}",
+                vjp_fn, diff_tensors,
+                [(tuple(o.shape), o.dtype) for o in out_raws])
+
+        n_out = meta["n_out"]
+        outs = []
+        for i, o in enumerate(out_raws[:n_out]):
+            t = Tensor(o, stop_gradient=(node is None or not _is_float(o.dtype)))
+            if node is not None and _is_float(o.dtype):
+                t._grad_node = (node, i)
+            outs.append(t)
+        for holder, val in zip(meta["effect_holders"], out_raws[n_out:]):
+            holder._data = val
+            holder._inplace_version += 1
+        return jax.tree_util.tree_unflatten(meta["out_treedef"], outs)
+
+    def rollback(self):
+        return self._fn
+
+
+class _HashableKwargs:
+    """kwargs passed as a static argument to jit (must hash)."""
+
+    def __init__(self, kw):
+        self._kw = dict(kw)
+
+    def __hash__(self):
+        return hash(tuple(sorted((k, repr(v)) for k, v in self._kw.items())))
+
+    def __eq__(self, other):
+        return isinstance(other, _HashableKwargs) and self._kw == other._kw
+
+    def keys(self):
+        return self._kw.keys()
+
+    def __getitem__(self, k):
+        return self._kw[k]
+
+    def items(self):
+        return self._kw.items()
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    """@paddle.jit.to_static parity (reference: jit/__init__.py:22)."""
+    from ..nn.layer_base import Layer
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            orig_forward = layer.forward  # bound method, captured BEFORE rebind
+            sf = StaticFunction(orig_forward, layer=layer, input_spec=input_spec)
+            layer.forward = sf
+            return layer
+        # plain function or bound method of a Layer
+        layer = getattr(fn, "__self__", None)
+        if layer is not None and not isinstance(layer, Layer):
+            layer = None
+        return StaticFunction(fn, layer=layer, input_spec=input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# save / load: serialize a compiled inference program via jax.export
+# (reference: fluid/dygraph/jit.py:508 jit.save → save_inference_model;
+# the saved artifact here is StableHLO + params, loadable without Python
+# model code — the same deployment property as the reference's ProgramDesc.)
+
+def save(layer, path, input_spec=None, **config):
+    from ..nn.layer_base import Layer
+    from jax import export as jax_export
+
+    if isinstance(layer, StaticFunction):
+        fn, owner = layer._fn, layer._layer
+    elif isinstance(layer, Layer):
+        owner = layer
+        fwd = layer.forward
+        fn = fwd._fn if isinstance(fwd, StaticFunction) else fwd
+    else:
+        raise TypeError("jit.save expects a Layer or StaticFunction")
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on first save")
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in input_spec]
+
+    if owner is not None:
+        owner.eval()
+    state = ([p for _, p in owner.named_parameters()] if owner else []) + \
+        ([b for _, b in owner.named_buffers()] if owner else [])
+    pure, meta = build_pure(fn, state)
+
+    key = jax.random.PRNGKey(0)
+
+    def infer_fn(param_raws, *input_raws):
+        return pure(list(param_raws), list(input_raws), key, None)
+
+    avals = [jax.ShapeDtypeStruct(
+        tuple(d if d is not None else 1 for d in s.shape), s.dtype)
+        for s in specs]
+    param_avals = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype) for p in state]
+    exported = jax_export.export(jax.jit(infer_fn))(param_avals, *avals)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    params_np = [np.asarray(p._data) for p in state]
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"params": params_np,
+                     "n_out": meta.get("n_out"),
+                     "out_treedef_children": None}, f, protocol=4)
+
+
+class TranslatedLayer:
+    """Loaded inference program (reference: fluid/dygraph/io.py
+    TranslatedLayer). Callable like a Layer, backed by deserialized StableHLO."""
+
+    def __init__(self, exported, params, n_out):
+        self._exported = exported
+        self._params = params
+        self._n_out = n_out
+        self.training = False
+
+    def __call__(self, *inputs):
+        raws = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        out = self._exported.call(self._params, *raws)
+        outs = [Tensor(o) for o in out[:self._n_out or len(out)]]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def eval(self):
+        return self
+
+    def forward(self, *inputs):
+        return self(*inputs)
+
+
+def load(path, **config):
+    from jax import export as jax_export
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        blob = pickle.load(f)
+    params = [jnp.asarray(p) for p in blob["params"]]
+    return TranslatedLayer(exported, params, blob.get("n_out"))

@@ -1,0 +1,110 @@
+"""Functionalization: run stateful dygraph Python (Layers with mutable
+Parameters, RNG draws, buffer updates) as a PURE jax-traceable function.
+
+This is the TPU replacement for the reference's dygraph→static machinery
+(reference: fluid/dygraph/dygraph_to_static/program_translator.py:582
+ConcreteProgram — there, an AST-rewritten function is re-run under a static
+Program; here the SAME Python runs under a jax trace with:
+ - Parameters/buffers temporarily rebound to tracers (param swap)
+ - RNG draws routed through a per-call key argument (so dropout masks differ
+   across calls of the compiled function; the reference threads seed attrs)
+ - buffer mutations (BN running stats) captured as extra outputs
+   ("state effects"), applied after execution — the reference mutates
+   variables in the scope directly.)
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import generator as _gen
+from ..core import autograd_engine as _ag
+
+
+class TraceContext:
+    """Active while a stateful function is being traced to a pure one."""
+
+    def __init__(self, key):
+        self.key = key
+        self.key_counter = 0
+        self.state_effects: List[Tuple[Tensor, Any]] = []  # (holder, traced raw)
+
+    def next_key(self):
+        k = jax.random.fold_in(self.key, self.key_counter)
+        self.key_counter += 1
+        return k
+
+    def record_effect(self, holder: Tensor, raw):
+        # last write wins per holder
+        for i, (h, _) in enumerate(self.state_effects):
+            if h is holder:
+                self.state_effects[i] = (holder, raw)
+                return
+        self.state_effects.append((holder, raw))
+
+
+_ACTIVE: List[TraceContext] = []
+
+
+def active_trace() -> Optional[TraceContext]:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextlib.contextmanager
+def trace_context(key):
+    ctx = TraceContext(key)
+    _ACTIVE.append(ctx)
+    # route the global generator through the trace key supply
+    prev_hook = _gen._TRACE_HOOK[0]
+    _gen._TRACE_HOOK[0] = ctx.next_key
+    try:
+        yield ctx
+    finally:
+        _gen._TRACE_HOOK[0] = prev_hook
+        _ACTIVE.pop()
+
+
+@contextlib.contextmanager
+def swap_params(params: List[Tensor], raws):
+    """Temporarily rebind Parameter/buffer payloads to traced values."""
+    saved = [(p, p._data, p._grad_node) for p in params]
+    try:
+        for p, r in zip(params, raws):
+            p._data = r
+            p._grad_node = None
+        yield
+    finally:
+        for p, d, n in saved:
+            p._data = d
+            p._grad_node = n
+
+
+def build_pure(fn: Callable, params: List[Tensor], n_outputs_hint=None):
+    """Return pure(param_raws, input_raws, key) -> (out_leaves, out_treedef,
+    effect_raws) executing `fn` statefully but capturing all state."""
+
+    meta = {}
+
+    def pure(param_raws, input_raws, key, static_kwargs):
+        with trace_context(key) as ctx:
+            with swap_params(params, param_raws):
+                with _ag.no_grad():
+                    in_tensors = jax.tree_util.tree_map(
+                        lambda r: Tensor(r, stop_gradient=True), input_raws)
+                    out = fn(*in_tensors, **(static_kwargs or {}))
+            out_leaves, out_td = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            out_raws = [o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                        for o in out_leaves]
+            # meta is filled at trace time and read after the traced call
+            meta["out_treedef"] = out_td
+            meta["n_out"] = len(out_raws)
+            meta["effect_holders"] = [h for h, _ in ctx.state_effects]
+            effect_raws = [r for _, r in ctx.state_effects]
+        return tuple(out_raws) + tuple(effect_raws)
+
+    return pure, meta

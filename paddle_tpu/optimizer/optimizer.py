@@ -25,9 +25,13 @@ from .lr import LRScheduler
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
+        from ..ops.dispatch import in_dygraph_mode
         if parameters is None:
-            raise ValueError(
-                "parameters is required in dygraph mode (pass model.parameters())")
+            if in_dygraph_mode():
+                raise ValueError(
+                    "parameters is required in dygraph mode "
+                    "(pass model.parameters())")
+            parameters = []  # static mode: params come from the Program
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -156,8 +160,25 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        """Dygraph minimize = backward + step (reference:
-        fluid/optimizer.py minimize)."""
+        """Dygraph: backward + step. Static mode: register this optimizer with
+        the Program — the Executor compiles backward+update into the step
+        (reference: fluid/optimizer.py minimize appends optimizer ops)."""
+        from ..ops.dispatch import in_dygraph_mode
+        if not in_dygraph_mode() and hasattr(loss, "_program"):
+            from ..static.graph import Variable
+            prog = loss._program
+            prog._loss = loss
+            prog._optimizer = self
+            params_grads = []
+            for i, p in enumerate(prog.all_parameters()):
+                if p.stop_gradient:
+                    continue
+                gname = (p.name or f"param_{i}") + "@GRAD"
+                gv = Variable(prog, p.shape, p.dtype, name=gname)
+                prog.add_var(gv)
+                prog._grad_map[gname] = p
+                params_grads.append((p, gv))
+            return None, params_grads
         loss.backward()
         self.step()
         return None, None

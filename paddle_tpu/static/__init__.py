@@ -1,0 +1,155 @@
+"""paddle.static parity: declarative Program mode.
+
+Reference: python/paddle/static/__init__.py — Program, program_guard, data,
+Executor, append_backward, save/load_inference_model, CompiledProgram.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .graph import (Program, Variable, program_guard, data,
+                    default_main_program, default_startup_program,
+                    static_handler)
+from .executor import Executor, global_scope
+from ..ops import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+# install the graph-recording handler into the op dispatch funnel
+_dispatch.register_static_handler(static_handler)
+
+
+from ..jit import InputSpec  # noqa: E402
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """reference: fluid/backward.py:1363 — there, a compile-time transpiler
+    appending grad OpDescs; here, marks the loss so the Executor compiles
+    jax.grad over the recorded graph. Returns (param, grad_var) pairs."""
+    prog = loss._program
+    prog._loss = loss
+    params_grads = []
+    plist = parameter_list if parameter_list is not None else prog.all_parameters()
+    for i, p in enumerate(plist):
+        if getattr(p, "stop_gradient", True):
+            continue
+        gname = (p.name or f"param_{i}") + "@GRAD"
+        gv = Variable(prog, p.shape, p.dtype, name=gname)
+        prog.add_var(gv)
+        prog._grad_map[gname] = p
+        params_grads.append((p, gv))
+    return params_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: fluid/backward.py:1958."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    return [g for _, g in append_backward(t, parameter_list=list(inputs))]
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py:88 — multi-device compilation wrapper.
+    On TPU the Executor already compiles whole programs; data parallelism is
+    mesh sharding (paddle_tpu.distributed), so this is a thin pass-through
+    kept for API compatibility."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+class BuildStrategy:
+    """reference: details/build_strategy.h:54 — fusion/memory knobs. XLA owns
+    these decisions; fields accepted and recorded for compatibility."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.reduce_strategy = None
+        self.gradient_scale_strategy = None
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    """reference: fluid/io.py:1199 — prunes to feed/fetch and serializes.
+    Here: pickle the param arrays + record the program replay closure is not
+    serializable, so we re-trace via jax.export like jit.save."""
+    program = program or default_main_program()
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from .executor import _replay
+
+    params = program.all_parameters()
+    feed_list = list(feed_vars)
+    fetch_list = list(fetch_vars)
+
+    def infer(param_raws, *feed_raws):
+        env = {id(v): r for v, r in zip(feed_list, feed_raws)}
+        param_env = {id(p): r for p, r in zip(params, param_raws)}
+        _replay(program, env, param_env)
+        return [env[id(f)] for f in fetch_list]
+
+    param_avals = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype) for p in params]
+    feed_avals = [jax.ShapeDtypeStruct(
+        tuple(1 if (s is None or s == -1) else s for s in v.shape), v.dtype)
+        for v in feed_list]
+    exported = jax_export.export(jax.jit(infer))(param_avals, *feed_avals)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"params": [np.asarray(p._data) for p in params],
+                     "n_out": len(fetch_list)}, f, protocol=4)
+
+
+def load_inference_model(path_prefix, executor=None):
+    from ..jit import load as jit_load
+    tl = jit_load(path_prefix)
+    return tl, None, None
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ns():
+        yield
+    return _ns()
+
+
+# static.nn: op-style wrappers (reference: fluid/layers/nn.py via
+# paddle.static.nn — each call creates fresh parameters, like the reference's
+# LayerHelper.create_parameter per call site)
+class nn:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from ..nn import functional as F
+        from ..nn.layers_common import Linear
+        lay = Linear(int(x.shape[-1]), size)
+        out = F.linear(x, lay.weight, lay.bias)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def embedding(input, size, param_attr=None, dtype="float32"):
+        from ..nn.layers_common import Embedding
+        lay = Embedding(size[0], size[1], weight_attr=param_attr)
+        return lay(input)

@@ -1,0 +1,302 @@
+"""Static-graph Program IR: symbolic Variables + recorded ops.
+
+TPU-native equivalent of the reference Program model
+(reference: framework/framework.proto ProgramDesc :202 / OpDesc :43,
+python/paddle/fluid/framework.py Program :3974, Block :2479, Variable :799).
+
+Design difference: the reference serializes protobuf op descriptions executed
+op-by-op by a C++ interpreter (executor.cc:166). Here a Program records each
+op's traceable implementation + argument structure; the Executor compiles the
+whole op list (plus backward + optimizer update) into ONE jitted XLA program
+per feed signature — replacing the interpreter hot loop with a single HLO
+(SURVEY §7 decision 1).
+
+Dynamic dims: `data(shape=[None, ...])` keeps None; recorded output shapes are
+inferred with a two-placeholder eval_shape trick (dims that vary with the
+placeholder are reported as -1, like the reference's -1 convention).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtypes as _dt
+
+
+class Variable:
+    """Symbolic graph variable (reference: framework.py:799)."""
+
+    _counter = [0]
+
+    def __init__(self, program, shape, dtype, name=None, is_data=False,
+                 stop_gradient=True, persistable=False):
+        self._program = program
+        self.shape = list(shape)
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        if name is None:
+            Variable._counter[0] += 1
+            name = f"_generated_var_{Variable._counter[0]}"
+        self.name = name
+        self.is_data = is_data
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.op = None          # producing OpRecord
+        self.out_index = None   # leaf index in producing op's outputs
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        if any(s in (None, -1) for s in self.shape):
+            return -1
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def astype(self, dtype):
+        from ..ops.dispatch import apply
+        d = _dt.convert_dtype(dtype)
+        return apply("cast", lambda x: x.astype(d), self)
+
+    cast = astype
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+    def __hash__(self):
+        return id(self)
+
+    # numpy conversion is not available pre-execution (matches reference)
+    def numpy(self):
+        raise RuntimeError(
+            "Variable has no data in static-graph mode; fetch it via "
+            "Executor.run(fetch_list=[...]).")
+
+
+class OpRecord:
+    """One recorded op (reference: framework.proto OpDesc :43)."""
+
+    __slots__ = ("type", "fn", "arg_leaves", "arg_treedef", "attrs",
+                 "out_vars", "out_treedef", "idx")
+
+    def __init__(self, type_, fn, arg_leaves, arg_treedef, attrs, out_vars,
+                 out_treedef, idx):
+        self.type = type_
+        self.fn = fn
+        self.arg_leaves = arg_leaves      # Variable | Tensor(param ref) | const
+        self.arg_treedef = arg_treedef
+        self.attrs = attrs
+        self.out_vars = out_vars
+        self.out_treedef = out_treedef
+        self.idx = idx
+
+
+class Program:
+    """reference: framework.py:3974 Program (single-block equivalent)."""
+
+    def __init__(self):
+        self.ops: List[OpRecord] = []
+        self.vars: Dict[str, Variable] = {}
+        self._params: List[Tensor] = []       # concrete Parameters touched
+        self._state_effects: List[Tuple[Tensor, Variable]] = []
+        self._loss: Optional[Variable] = None
+        self._optimizer = None
+        self._grad_map: Dict[str, Any] = {}   # grad var name -> param/input var
+        self.random_seed = None
+        self._version = 0
+
+    # -- var/param bookkeeping ---------------------------------------------
+    def add_var(self, var: Variable):
+        self.vars[var.name] = var
+        return var
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return list(self._params)
+
+    def touch_param(self, p: Tensor):
+        if all(p is not q for q in self._params):
+            self._params.append(p)
+
+    def record_state_effect(self, holder: Tensor, value: Variable):
+        for i, (h, _) in enumerate(self._state_effects):
+            if h is holder:
+                self._state_effects[i] = (holder, value)
+                return
+        self._state_effects.append((holder, value))
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def clone(self, for_test=False):
+        import copy
+        p = Program.__new__(Program)
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        p._params = list(self._params)
+        p._state_effects = [] if for_test else list(self._state_effects)
+        p._loss = self._loss
+        p._optimizer = None if for_test else self._optimizer
+        p._grad_map = dict(self._grad_map)
+        p.random_seed = self.random_seed
+        p._version = self._version
+        return p
+
+    def __repr__(self):
+        lines = [f"Program({len(self.ops)} ops, {len(self.vars)} vars)"]
+        for op in self.ops:
+            ins = [getattr(l, "name", "<const>") for l in op.arg_leaves]
+            outs = [v.name for v in op.out_vars]
+            lines.append(f"  {{{op.type}}} inputs={ins} -> outputs={outs}")
+        return "\n".join(lines)
+
+    to_string = __repr__
+
+
+# -- global program state (reference: framework.py default_main_program) ----
+_main_program = [Program()]
+_startup_program = [Program()]
+
+
+def default_main_program() -> Program:
+    return _main_program[0]
+
+
+def default_startup_program() -> Program:
+    return _startup_program[0]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_m, prev_s = _main_program[0], _startup_program[0]
+    _main_program[0] = main_program
+    if startup_program is not None:
+        _startup_program[0] = startup_program
+    try:
+        yield
+    finally:
+        _main_program[0] = prev_m
+        _startup_program[0] = prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """reference: python/paddle/static/input.py data — a feed slot."""
+    prog = default_main_program()
+    var = Variable(prog, shape, _dt.convert_dtype(dtype), name=name,
+                   is_data=True, stop_gradient=True)
+    return prog.add_var(var)
+
+
+# -- shape inference --------------------------------------------------------
+
+_PLACEHOLDERS = (2, 3)
+
+
+def _avals_for(leaves, placeholder):
+    avals = []
+    for l in leaves:
+        if isinstance(l, Variable):
+            shape = tuple(placeholder if (s is None or s == -1) else int(s)
+                          for s in l.shape)
+            avals.append(jax.ShapeDtypeStruct(shape, l.dtype or np.float32))
+        elif isinstance(l, Tensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(l.shape), l.dtype))
+        else:
+            avals.append(l)
+    return avals
+
+
+def infer_out_structure(fn, leaves, treedef, attrs):
+    """Two-placeholder eval_shape: dims that track the placeholder are
+    dynamic (-1)."""
+    results = []
+    for ph in _PLACEHOLDERS:
+        avals = _avals_for(leaves, ph)
+
+        def call(*dyn):
+            it = iter(dyn)
+            full = [next(it) if isinstance(l, (Variable, Tensor)) else l
+                    for l in leaves]
+            args = jax.tree_util.tree_unflatten(treedef, full)
+            return fn(*args, **attrs)
+        dyn_avals = [a for a, l in zip(avals, leaves)
+                     if isinstance(l, (Variable, Tensor))]
+        results.append(jax.eval_shape(call, *dyn_avals))
+        if not _has_dynamic(leaves):
+            results.append(results[0])
+            break
+    s1, s2 = results[0], results[1]
+    l1, td = jax.tree_util.tree_flatten(s1)
+    l2, _ = jax.tree_util.tree_flatten(s2)
+    out_shapes = []
+    for a, b in zip(l1, l2):
+        shape = [da if da == db else -1 for da, db in zip(a.shape, b.shape)]
+        out_shapes.append((shape, a.dtype))
+    return out_shapes, td
+
+
+def _has_dynamic(leaves):
+    return any(isinstance(l, Variable)
+               and any(s in (None, -1) for s in l.shape) for l in leaves)
+
+
+# -- the static dispatch handler -------------------------------------------
+
+def static_handler(name, fn, args, attrs, leaves, treedef):
+    """Installed into ops.dispatch: append an OpRecord instead of executing
+    (the reference appends an OpDesc via LayerHelper.append_op)."""
+    prog = default_main_program()
+    # params referenced by the graph
+    for l in leaves:
+        if isinstance(l, Tensor):
+            prog.touch_param(l)
+    out_shapes, out_td = infer_out_structure(fn, leaves, attrs=attrs,
+                                             treedef=treedef)
+    out_vars = []
+    for shape, dtype in out_shapes:
+        v = Variable(prog, shape, dtype,
+                     stop_gradient=all(
+                         getattr(l, "stop_gradient", True)
+                         for l in leaves if isinstance(l, (Variable, Tensor))))
+        prog.add_var(v)
+        out_vars.append(v)
+    rec = OpRecord(name, fn, list(leaves), treedef, dict(attrs), out_vars,
+                   out_td, len(prog.ops))
+    prog.ops.append(rec)
+    prog._version += 1
+    for i, v in enumerate(out_vars):
+        v.op = rec
+        v.out_index = i
+    result = jax.tree_util.tree_unflatten(out_td, out_vars)
+    return result
+
+
+def _attach_variable_methods():
+    """Give Variable the same op-method surface as Tensor (the methods call
+    ops functions, which route back through dispatch → static_handler)."""
+    from ..core.tensor import Tensor as _T
+    skip = {"numpy", "item", "set_value", "astype", "backward", "detach",
+            "__repr__", "__hash__", "__init__"}
+    for attr in dir(_T):
+        if attr in skip or (attr.startswith("__") and attr not in (
+                "__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+                "__rmul__", "__truediv__", "__rtruediv__", "__floordiv__",
+                "__mod__", "__rmod__", "__pow__", "__rpow__", "__matmul__",
+                "__neg__", "__abs__", "__eq__", "__ne__", "__gt__", "__ge__",
+                "__lt__", "__le__", "__getitem__", "__invert__", "__and__",
+                "__or__", "__xor__")):
+            continue
+        val = _T.__dict__.get(attr)
+        if callable(val) and not hasattr(Variable, attr):
+            setattr(Variable, attr, val)
+
+
+_attach_variable_methods()

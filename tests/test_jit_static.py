@@ -1,0 +1,194 @@
+"""to_static + static Program/Executor tests
+(pattern: reference unittests/dygraph_to_static/ mode-equivalence suite +
+book/ static-graph chapter tests)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as optim
+from paddle_tpu.jit import to_static, InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(8, 32)
+        self.bn = nn.BatchNorm1D(32)
+        self.drop = nn.Dropout(0.3)
+        self.l2 = nn.Linear(32, 2)
+
+    def forward(self, x):
+        return self.l2(self.drop(F.relu(self.bn(self.l1(x)))))
+
+
+class TestToStatic:
+    def test_eager_equivalence(self):
+        m = SmallNet()
+        m.eval()
+        x = paddle.randn([16, 8])
+        eager = m.forward(x).numpy()  # direct call, no compile
+        sm = to_static(m)
+        np.testing.assert_allclose(eager, sm(x).numpy(), atol=1e-5)
+
+    def test_training_through_compiled(self):
+        paddle.seed(0)
+        m = to_static(SmallNet())
+        m.train()
+        opt = optim.Adam(1e-2, parameters=m.parameters())
+        x = paddle.randn([16, 8])
+        y = paddle.to_tensor(np.random.randint(0, 2, 16))
+        prev_mean = m.bn._mean.numpy().copy()
+        losses = []
+        for _ in range(25):
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7
+        # BN running stats updated through state-effect capture
+        assert not np.allclose(prev_mean, m.bn._mean.numpy())
+
+    def test_rng_varies_across_calls(self):
+        m = to_static(SmallNet())
+        m.train()
+        x = paddle.randn([8, 8])
+        a, b = m(x).numpy(), m(x).numpy()
+        assert not np.allclose(a, b)
+
+    def test_cache_per_shape(self):
+        m = to_static(SmallNet())
+        m.eval()
+        m(paddle.randn([4, 8]))
+        m(paddle.randn([6, 8]))
+        assert len(m.forward._cache) == 2
+        m(paddle.randn([4, 8]))
+        assert len(m.forward._cache) == 2
+
+    def test_function_decorator(self):
+        @to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1.0
+        x = paddle.randn([3, 4])
+        y = paddle.randn([4, 5])
+        np.testing.assert_allclose(
+            f(x, y).numpy(), (paddle.matmul(x, y) + 1.0).numpy(), atol=1e-5)
+
+    def test_grad_matches_eager(self):
+        m1 = SmallNet()
+        m2 = SmallNet()
+        m2.set_state_dict(m1.state_dict())
+        m1.eval(); m2.eval()
+        sm2 = to_static(m2)
+        x = paddle.randn([4, 8])
+        y = paddle.to_tensor([0, 1, 0, 1])
+        l1 = F.cross_entropy(m1.forward(x), y)
+        l1.backward()
+        l2 = F.cross_entropy(sm2(x), y)
+        l2.backward()
+        for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                      m2.named_parameters()):
+            assert p2.grad is not None, n2
+            np.testing.assert_allclose(p1.grad.numpy(), p2.grad.numpy(),
+                                       atol=1e-4, err_msg=n1)
+
+    def test_jit_save_load(self, tmp_path):
+        m = to_static(SmallNet())
+        m.eval()
+        x = paddle.randn([4, 8])
+        expected = m(x).numpy()
+        path = str(tmp_path / "net")
+        paddle.jit.save(m, path, input_spec=[InputSpec([4, 8], "float32")])
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), expected, atol=1e-5)
+
+
+class TestStaticMode:
+    def _build(self):
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 4], "float32")
+            y = paddle.static.data("y", [None, 1], "float32")
+            lin = nn.Linear(4, 1)
+            pred = lin(x)
+            loss = paddle.mean((pred - y) ** 2)
+        return main, startup, x, y, pred, loss, lin
+
+    def test_static_train_converges(self):
+        paddle.enable_static()
+        try:
+            main, startup, x, y, pred, loss, lin = self._build()
+            with paddle.static.program_guard(main, startup):
+                opt = optim.SGD(0.1)
+                opt.minimize(loss)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            X = rng.rand(64, 4).astype(np.float32)
+            W = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+            Y = X @ W
+            for _ in range(300):
+                out, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            assert out < 1e-3
+            np.testing.assert_allclose(lin.weight.numpy(), W, atol=0.2)
+        finally:
+            paddle.disable_static()
+
+    def test_static_infer_only(self):
+        paddle.enable_static()
+        try:
+            main, startup, x, y, pred, loss, lin = self._build()
+            exe = paddle.static.Executor()
+            X = np.random.rand(5, 4).astype(np.float32)
+            Y = np.zeros((5, 1), np.float32)
+            p, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+            expected = X @ lin.weight.numpy() + lin.bias.numpy()
+            np.testing.assert_allclose(p, expected, atol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_append_backward_fetch_grads(self):
+        paddle.enable_static()
+        try:
+            main, startup, x, y, pred, loss, lin = self._build()
+            with paddle.static.program_guard(main, startup):
+                pgs = paddle.static.append_backward(loss)
+            exe = paddle.static.Executor()
+            X = np.ones((2, 4), np.float32)
+            Y = np.zeros((2, 1), np.float32)
+            grad_vars = [g for _, g in pgs]
+            outs = exe.run(main, feed={"x": X, "y": Y},
+                           fetch_list=[loss] + grad_vars)
+            assert len(outs) == 3  # loss + w grad + b grad
+            assert outs[1].shape == (4, 1)
+        finally:
+            paddle.disable_static()
+
+    def test_dynamic_batch_dim(self):
+        paddle.enable_static()
+        try:
+            main, startup, x, y, pred, loss, lin = self._build()
+            exe = paddle.static.Executor()
+            for bs in (3, 7):
+                X = np.random.rand(bs, 4).astype(np.float32)
+                Y = np.zeros((bs, 1), np.float32)
+                p, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+                assert p.shape == (bs, 1)
+        finally:
+            paddle.disable_static()
+
+    def test_program_repr_and_clone(self):
+        paddle.enable_static()
+        try:
+            main, startup, *_ , loss, lin = self._build()
+            s = str(main)
+            assert "linear" in s and "reduce_mean" in s
+            test_prog = main.clone(for_test=True)
+            assert len(test_prog.ops) == len(main.ops)
+        finally:
+            paddle.disable_static()
