@@ -65,3 +65,9 @@ def is_grad_enabled_():
 
 def disable_signal_handler():  # API parity no-op (reference: platform/init.cc:363)
     return None
+from . import io  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from .hapi import Model, summary  # noqa: E402,F401
+from .io import DataLoader  # noqa: E402,F401

@@ -1,0 +1,226 @@
+"""paddle.amp: automatic mixed precision.
+
+Reference: python/paddle/amp/auto_cast.py:20, grad_scaler.py:20 →
+fluid/dygraph/amp/auto_cast.py:91 amp_guard + loss_scaler.py:27 AmpScaler,
+C++ white/black lists imperative/amp_auto_cast.h:31, and the AMP ops
+check_finite_and_unscale / update_loss_scaling (operators/amp/).
+
+TPU design: the preferred low dtype is bfloat16 (MXU native, same exponent
+range as fp32 ⇒ loss scaling is a no-op kept for API parity); float16 is
+supported with real dynamic loss scaling for parity with ported scripts. The
+autocast hook lives in the op-dispatch funnel (ops/dispatch.py), exactly
+where the reference tracer casts inputs (imperative/tracer.cc:162).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtypes as _dt
+from ..ops.dispatch import register_amp_handler, apply_raw
+
+# reference: imperative/amp_auto_cast.cc default lists
+WHITE_LIST = {
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "matmul_v2", "bmm", "mm", "mv", "linear", "mul",
+    "einsum", "addmm",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "reduce_mean",
+    "reduce_sum", "logsumexp", "mean", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "bce_loss", "nll_loss",
+    "cross_entropy", "p_norm", "dist", "squared_l2_norm", "cumsum",
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "norm",
+    "mse_loss", "l1_loss", "kldiv_loss", "softmax", "log_softmax",
+}
+
+_STATE = {"enabled": False, "dtype": None, "level": "O1",
+          "custom_white": set(), "custom_black": set()}
+
+
+def _amp_hook(op_name: str, tensors: List[Tensor]) -> List[Tensor]:
+    if not _STATE["enabled"]:
+        return tensors
+    low = _STATE["dtype"]
+    white = (WHITE_LIST | _STATE["custom_white"]) - _STATE["custom_black"]
+    black = BLACK_LIST | _STATE["custom_black"]
+    if _STATE["level"] == "O2":
+        cast_low = op_name not in black
+    else:
+        cast_low = op_name in white
+    out = []
+    for t in tensors:
+        if _dt.is_floating(t.dtype):
+            if cast_low and t.dtype != low and t.dtype != np.dtype("float64"):
+                out.append(_cast_keep_graph(t, low))
+                continue
+            if (not cast_low and op_name in black
+                    and t.dtype == np.dtype(low)):
+                out.append(_cast_keep_graph(t, np.float32))
+                continue
+        out.append(t)
+    return out
+
+
+def _cast_keep_graph(t: Tensor, dtype):
+    # cast through the dispatch funnel so grads flow (cast has a vjp)
+    d = np.dtype(dtype)
+    from ..ops.dispatch import apply
+    prev = _STATE["enabled"]
+    _STATE["enabled"] = False  # avoid recursive autocast of the cast op
+    try:
+        return apply("amp_cast", lambda x: x.astype(d), t)
+    finally:
+        _STATE["enabled"] = prev
+
+
+register_amp_handler(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """reference: amp/auto_cast.py:20 (dtype default here is bf16 — the TPU
+    native low precision; pass 'float16' for parity experiments)."""
+    prev = dict(_STATE)
+    _STATE["enabled"] = bool(enable)
+    _STATE["dtype"] = _dt.convert_dtype(dtype)
+    _STATE["level"] = level
+    _STATE["custom_white"] = set(custom_white_list or ())
+    _STATE["custom_black"] = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        _STATE.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def is_auto_cast_enabled():
+    return _STATE["enabled"]
+
+
+def get_amp_dtype():
+    return _STATE["dtype"]
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """reference: amp/auto_cast.py decorate (O2 casts model params to the low
+    dtype; optimizers keep fp32 master weights via multi_precision)."""
+    low = _dt.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._cast_to(low)
+            m._casted_by_pure_fp16 = True
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py:20 →
+    fluid/dygraph/amp/loss_scaler.py:27 AmpScaler; kernels
+    check_finite_and_unscale + update_loss_scaling as one fused check here).
+
+    With bf16 (TPU default) scaling is mathematically unnecessary; the class
+    still tracks found_inf so ported fp16 scripts behave identically."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p._grad is None:
+                continue
+            g = p._grad * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found = True
+            p._grad = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler

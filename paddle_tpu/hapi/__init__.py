@@ -1,0 +1,3 @@
+"""hapi high-level API (reference: python/paddle/hapi/)."""
+from .model import Model, summary
+from . import callbacks

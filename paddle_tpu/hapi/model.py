@@ -1,0 +1,347 @@
+"""paddle.Model: the Keras-like high-level API.
+
+Reference: python/paddle/hapi/model.py:876 Model (fit :1519, evaluate,
+predict, save/load, summary; DynamicGraphAdapter :659 / StaticGraphAdapter
+:250). TPU design: one adapter — the train step is functionalized and
+jit-compiled whole (forward + loss + backward + optimizer update in a single
+XLA program, buffers donated), which is the role the StaticGraphAdapter's
+compiled Program served, with the dygraph API surface.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import generator as _gen
+from ..core import autograd_engine as _ag
+from ..nn.layer_base import Layer
+from ..metric import Metric
+from ..io import DataLoader, Dataset
+from ..jit.functionalize import trace_context, swap_params
+from .callbacks import config_callbacks
+from .. import framework_io
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+        self._train_step_fn = None
+        self._train_sig = None
+        self._eval_fn = None
+        self._eval_sig = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+
+    # ------------------------------------------------------------------
+    def _state(self):
+        ps = [p for _, p in self.network.named_parameters()]
+        bs = [b for _, b in self.network.named_buffers()]
+        return ps, bs
+
+    def _build_train_step(self, sig):
+        """Compile (params, opt_state, x, y, key, lr, step) -> (loss, preds,
+        new_params, new_state, effects) — one XLA program per signature."""
+        params, buffers = self._state()
+        state = params + buffers
+        trainable = [p for p in params if not p.stop_gradient]
+        t_pos = [i for i, p in enumerate(state) if not p.stop_gradient
+                 and i < len(params)]
+        fixed_pos = [i for i in range(len(state)) if i not in set(t_pos)]
+        opt = self._optimizer
+        loss_fn = self._loss
+        net = self.network
+        reg_coeffs = [opt._regularized_grad(p, None) for p in trainable]
+        clip = opt._grad_clip
+
+        meta = {}
+
+        def fwd_loss(train_raws, fixed_raws, x_raws, y_raws, key):
+            full = [None] * len(state)
+            for pos, r in zip(fixed_pos, fixed_raws):
+                full[pos] = r
+            for pos, r in zip(t_pos, train_raws):
+                full[pos] = r
+            with trace_context(key) as ctx:
+                with swap_params(state, full):
+                    with _ag.no_grad():
+                        xs = [Tensor(r) for r in x_raws]
+                        ys = [Tensor(r) for r in y_raws]
+                        preds = net.forward(*xs)
+                        preds_t = preds if isinstance(preds, (list, tuple)) \
+                            else [preds]
+                        loss = loss_fn(*preds_t, *ys)
+                effects = [r for _, r in ctx.state_effects]
+                meta["effect_holders"] = [h for h, _ in ctx.state_effects]
+            loss_raw = loss._data if isinstance(loss, Tensor) else loss
+            return loss_raw, ([p._data for p in preds_t], effects)
+
+        def step(train_raws, fixed_raws, opt_states, x_raws, y_raws, key, lr,
+                 step_no):
+            (loss, (preds, effects)), grads = jax.value_and_grad(
+                fwd_loss, has_aux=True)(train_raws, fixed_raws, x_raws,
+                                        y_raws, key)
+            grads = list(grads)
+            for i, rc in enumerate(reg_coeffs):
+                if rc is not None:
+                    grads[i] = grads[i] + rc * train_raws[i]
+            if clip is not None:
+                grads = clip._clip_raw(trainable, grads)
+            new_p, new_s = [], []
+            for pr, g, st in zip(train_raws, grads, opt_states):
+                p2, s2 = opt._update(pr, g.astype(pr.dtype), st, lr, step_no)
+                new_p.append(p2)
+                new_s.append(s2)
+            return loss, preds, new_p, new_s, effects
+
+        jitted = jax.jit(step, donate_argnums=(0, 2))
+        return {"fn": jitted, "meta": meta, "state": state,
+                "trainable": trainable, "t_pos": t_pos,
+                "fixed_pos": fixed_pos}
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One fused train step (reference: model.py train_batch)."""
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        x_raws = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        y_raws = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                  for l in labels]
+        sig = tuple((tuple(r.shape), str(r.dtype)) for r in x_raws + y_raws)
+        if self._train_step_fn is None or self._train_sig != sig:
+            self.network.train()
+            self._train_step_fn = self._build_train_step(sig)
+            self._train_sig = sig
+        ts = self._train_step_fn
+        opt = self._optimizer
+        for p in ts["trainable"]:
+            if id(p) not in opt._state:
+                opt._state[id(p)] = opt._init_state(p)
+        opt._accumulators_built = True
+        opt_states = [opt._state[id(p)] for p in ts["trainable"]]
+        train_raws = [p._data for p in ts["trainable"]]
+        fixed_raws = [ts["state"][i]._data for i in ts["fixed_pos"]]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_no = jnp.asarray(opt._global_step + 1, jnp.float32)
+        key = _gen.next_key()
+        loss, preds, new_p, new_s, effects = ts["fn"](
+            train_raws, fixed_raws, opt_states, x_raws, y_raws, key, lr, step_no)
+        for p, npr, ns in zip(ts["trainable"], new_p, new_s):
+            p._data = npr
+            p._inplace_version += 1
+            opt._state[id(p)] = ns
+        opt._global_step += 1
+        for h, v in zip(ts["meta"].get("effect_holders", []), effects):
+            h._data = v
+            h._inplace_version += 1
+        metrics = self._update_metrics(preds, labels)
+        return float(loss), metrics
+
+    def _update_metrics(self, preds, labels):
+        out = []
+        for m in self._metrics:
+            pt = [Tensor(p) for p in preds]
+            r = m.compute(*pt, *labels)
+            r = m.update(r if not isinstance(r, tuple) else r[0])
+            out.append(r)
+        return out
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        self.network.eval()
+        with _ag.no_grad():
+            preds = self.network(*inputs)
+        preds_t = preds if isinstance(preds, (list, tuple)) else [preds]
+        loss = None
+        if self._loss is not None and labels:
+            loss = float(self._loss(*preds_t, *labels))
+        metrics = self._update_metrics([p._data for p in preds_t], labels)
+        return loss, metrics
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        with _ag.no_grad():
+            out = self.network(*inputs)
+        return out
+
+    # ------------------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # assume iterable of batches
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return [batch[0]], []
+        return [batch], []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        """reference: hapi/model.py:1519."""
+        loader = self._as_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                xs, ys = self._split_batch(batch)
+                loss, metrics = self.train_batch(xs, ys)
+                logs = {"loss": loss}
+                for m, r in zip(self._metrics, metrics):
+                    logs[m.name() if isinstance(m.name(), str) else
+                         m.name()[0]] = r
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=verbose,
+                              callbacks=cbks.callbacks, _inner=True)
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None, _inner=False):
+        loader = self._as_loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            xs, ys = self._split_batch(batch)
+            loss, _ = self.eval_batch(xs, ys)
+            if loss is not None:
+                losses.append(loss)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            logs[name if isinstance(name, str) else name[0]] = m.accumulate()
+        if callbacks is not None and _inner:
+            from .callbacks import CallbackList
+            CallbackList(callbacks).on_eval_end(logs)
+        elif verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._as_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            xs, _ = self._split_batch(batch)
+            out = self.predict_batch(xs)
+            outputs.append(out.numpy() if isinstance(out, Tensor)
+                           else [o.numpy() for o in out])
+        if stack_outputs and outputs and isinstance(outputs[0], np.ndarray):
+            return [np.concatenate(outputs, 0)]
+        return outputs
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework_io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = framework_io.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        # retire any compiled step referencing old param objects' values
+        self._train_step_fn = None
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(framework_io.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtype)
+
+
+def summary(net: Layer, input_size=None, dtypes=None):
+    """reference: hapi/model_summary.py — layer table + param counts."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = 0
+        for _, p in layer._parameters.items():
+            if p is not None:
+                n_params += p.size
+        for _, b in layer._buffers.items():
+            if b is not None:
+                n_params += b.size
+        if name == "":
+            continue
+        rows.append((name, type(layer).__name__, n_params))
+    seen = set()
+    for _, p in net.named_parameters():
+        if id(p) in seen:
+            continue
+        seen.add(id(p))
+        total += p.size
+        if p.trainable:
+            trainable += p.size
+    for _, b in net.named_buffers():
+        if id(b) not in seen:
+            total += b.size
+            seen.add(id(b))
+    print("-" * 64)
+    print(f"{'Layer':<36}{'Type':<18}{'Params':>10}")
+    print("=" * 64)
+    for name, kind, n in rows:
+        print(f"{name:<36}{kind:<18}{n:>10}")
+    print("=" * 64)
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    print(f"Non-trainable params: {total - trainable}")
+    print("-" * 64)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,467 @@
+"""paddle.io parity: Dataset / Sampler / DataLoader.
+
+Reference: python/paddle/fluid/dataloader/ (dataset.py, batch_sampler.py,
+dataloader_iter.py:100 single-proc / :251 multi-proc with shared-memory
+LoDTensor transport) and fluid/reader.py:149 DataLoader.
+
+TPU design: worker processes produce numpy batches over a multiprocessing
+queue; a background prefetch thread moves batches to device ahead of the
+consumer (the role of the reference's BufferedReader double-buffer
+(operators/reader/buffered_reader.h:36) — host→HBM copies overlap compute).
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import math
+import os
+import queue as _queue
+import threading
+import traceback
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import generator as _gen
+
+
+class Dataset:
+    """reference: fluid/dataloader/dataset.py Dataset (map-style)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (list, tuple)) else [item])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(itertools.accumulate(len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = 0 if ds_idx == 0 else self.cumulative_sizes[ds_idx - 1]
+        return self.datasets[ds_idx][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    n = len(dataset)
+    if sum(lengths) != n:
+        raise ValueError("sum of lengths must equal dataset size")
+    perm = np.random.permutation(n)
+    out, off = [], 0
+    for ln in lengths:
+        out.append(Subset(dataset, perm[off:off + ln].tolist()))
+        off += ln
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """reference: fluid/dataloader/batch_sampler.py."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """reference: fluid/dataloader/batch_sampler.py DistributedBatchSampler —
+    shards the index space across ranks (on TPU: across data-parallel mesh
+    coordinates / processes)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            from ..distributed import get_world_size, get_rank
+            num_replicas = num_replicas or get_world_size()
+            rank = rank if rank is not None else get_rank()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[: self.total_size - len(indices)]
+        indices = indices[self.local_rank:self.total_size:self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+# -- collate ---------------------------------------------------------------
+
+def default_collate_fn(batch):
+    """reference: fluid/dataloader/collate.py default_collate_fn."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch, 0)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._data) for s in batch], 0)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn(list(items)) for items in zip(*batch)]
+    return list(batch)
+
+
+def default_convert_fn(batch):
+    return batch
+
+
+class _WorkerInfo:
+    def __init__(self, wid, num_workers, dataset, seed):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = [None]
+
+
+def get_worker_info():
+    return _worker_info[0]
+
+
+def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
+                 num_workers, seed, iterable_mode):
+    """Worker process body (reference: dataloader_iter.py _worker_loop)."""
+    np.random.seed((seed + wid) & 0xFFFFFFFF)
+    _worker_info[0] = _WorkerInfo(wid, num_workers, dataset, seed)
+    try:
+        if iterable_mode:
+            it = iter(dataset)
+            while True:
+                msg = index_queue.get()
+                if msg is None:
+                    break
+                order, batch_size = msg
+                batch = list(itertools.islice(it, batch_size))
+                if not batch:
+                    out_queue.put((order, "END", None))
+                    continue
+                out_queue.put((order, "OK", collate_fn(batch)))
+        else:
+            while True:
+                msg = index_queue.get()
+                if msg is None:
+                    break
+                order, indices = msg
+                try:
+                    batch = [dataset[i] for i in indices]
+                    out_queue.put((order, "OK", collate_fn(batch)))
+                except Exception:
+                    out_queue.put((order, "ERR", traceback.format_exc()))
+    except KeyboardInterrupt:
+        pass
+
+
+class DataLoader:
+    """reference: fluid/reader.py:149 DataLoader (return_list=True mode)."""
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=120, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.return_list = return_list
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            self.batch_size = batch_size
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return iter(self)
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            return self._single_process_iter()
+        return self._multi_process_iter()
+
+    def _to_tensors(self, batch):
+        if isinstance(batch, (list, tuple)):
+            return [Tensor(b) if isinstance(b, np.ndarray) else b for b in batch]
+        if isinstance(batch, np.ndarray):
+            return [Tensor(batch)]
+        if isinstance(batch, dict):
+            return {k: Tensor(v) if isinstance(v, np.ndarray) else v
+                    for k, v in batch.items()}
+        return batch
+
+    def _single_process_iter(self):
+        if self._iterable:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch or (self.drop_last and len(batch) < self.batch_size):
+                    return
+                yield self._to_tensors(self.collate_fn(batch))
+        else:
+            for indices in self.batch_sampler:
+                batch = [self.dataset[i] for i in indices]
+                yield self._to_tensors(self.collate_fn(batch))
+
+    def _multi_process_iter(self):
+        """Worker pool + in-order delivery + host prefetch
+        (reference: dataloader_iter.py:251 _DataLoaderIterMultiProcess)."""
+        import multiprocessing as mp
+        ctx = mp.get_context("fork" if os.name == "posix" else "spawn")
+        index_queues = []
+        out_queue = ctx.Queue()
+        workers = []
+        seed = int(np.random.randint(0, 2 ** 31))
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, iq, out_queue, self.collate_fn, wid,
+                      self.num_workers, seed, self._iterable),
+                daemon=True)
+            w.start()
+            index_queues.append(iq)
+            workers.append(w)
+
+        try:
+            if self._iterable:
+                yield from self._mp_iterable(index_queues, out_queue)
+            else:
+                yield from self._mp_map(index_queues, out_queue)
+        finally:
+            for iq in index_queues:
+                try:
+                    iq.put(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()
+
+    def _mp_map(self, index_queues, out_queue):
+        batches = list(self.batch_sampler)
+        n = len(batches)
+        inflight = 0
+        next_send = 0
+        next_recv = 0
+        hold = {}
+        max_inflight = self.num_workers * self.prefetch_factor
+        while next_recv < n:
+            while next_send < n and inflight < max_inflight:
+                index_queues[next_send % self.num_workers].put(
+                    (next_send, batches[next_send]))
+                next_send += 1
+                inflight += 1
+            order, status, payload = out_queue.get(timeout=self.timeout)
+            inflight -= 1
+            if status == "ERR":
+                raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            hold[order] = payload
+            while next_recv in hold:
+                yield self._to_tensors(hold.pop(next_recv))
+                next_recv += 1
+
+    def _mp_iterable(self, index_queues, out_queue):
+        # each worker consumes its own iterator copy; messages tagged by wid
+        live = set(range(self.num_workers))
+        for wid in live:
+            index_queues[wid].put((wid, self.batch_size))
+        while live:
+            wid, status, payload = out_queue.get(timeout=self.timeout)
+            if status == "END":
+                live.discard(wid)
+                continue
+            if status == "ERR":
+                raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            if wid in live:
+                index_queues[wid].put((wid, self.batch_size))
+            yield self._to_tensors(payload)

@@ -1,0 +1,211 @@
+"""DataLoader / AMP / metric / hapi Model tests
+(pattern: reference unittests/test_dataloader_*, test_amp_*, paddle/tests/test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class RangeDS(paddle.io.Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((3,), i, np.float32), np.int64(i % 2)
+
+
+class TestDataLoader:
+    def test_single_process(self):
+        dl = paddle.DataLoader(RangeDS(), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 5
+        assert batches[0][0].shape == [4, 3]
+        np.testing.assert_allclose(batches[0][0].numpy()[:, 0], [0, 1, 2, 3])
+
+    def test_shuffle_and_drop_last(self):
+        dl = paddle.DataLoader(RangeDS(18), batch_size=4, shuffle=True,
+                               drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 4
+        seen = sorted(int(v) for b in batches for v in b[0].numpy()[:, 0])
+        assert len(set(seen)) == 16
+
+    def test_multiprocess_order(self):
+        dl = paddle.DataLoader(RangeDS(), batch_size=4, num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 5
+        # in-order delivery despite parallel workers
+        np.testing.assert_allclose(batches[1][0].numpy()[:, 0], [4, 5, 6, 7])
+
+    def test_worker_exception_propagates(self):
+        class Bad(paddle.io.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                raise ValueError("boom")
+        with pytest.raises(RuntimeError, match="boom"):
+            list(paddle.DataLoader(Bad(), batch_size=2, num_workers=1))
+
+    def test_iterable_dataset(self):
+        class It(paddle.io.IterableDataset):
+            def __iter__(self):
+                for i in range(10):
+                    yield np.float32(i)
+        dl = paddle.DataLoader(It(), batch_size=4)
+        batches = list(dl)
+        assert [len(b[0]) for b in batches] == [4, 4, 2]
+
+    def test_samplers(self):
+        ds = RangeDS(10)
+        bs = paddle.io.BatchSampler(ds, batch_size=3)
+        assert len(bs) == 4
+        dbs = paddle.io.DistributedBatchSampler(ds, batch_size=2,
+                                                num_replicas=2, rank=0)
+        idx = [i for b in dbs for i in b]
+        assert all(i % 2 == 0 for i in idx)  # rank0 gets even indices
+
+    def test_tensor_dataset_and_split(self):
+        xs = paddle.randn([10, 4])
+        ys = paddle.arange(10)
+        tds = paddle.io.TensorDataset([xs, ys])
+        assert len(tds) == 10
+        a, b = paddle.io.random_split(tds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+
+
+class TestAMP:
+    def test_autocast_white_black(self):
+        with paddle.amp.auto_cast():
+            a = paddle.randn([4, 4])
+            c = paddle.matmul(a, a)
+            assert str(c.dtype) == "bfloat16"
+            m = paddle.mean(c)
+            assert m.dtype == np.dtype("float32")
+        c2 = paddle.matmul(a, a)
+        assert c2.dtype == np.dtype("float32")
+
+    def test_autocast_grads_flow(self):
+        lin = nn.Linear(4, 4)
+        x = paddle.randn([2, 4])
+        with paddle.amp.auto_cast():
+            loss = lin(x).mean()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert lin.weight.grad.dtype == np.dtype("float32")
+
+    def test_grad_scaler_skips_inf(self):
+        from paddle_tpu.core.tensor import Parameter
+        p = Parameter(np.array([1.0], np.float32))
+        opt = paddle.optimizer.SGD(0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (p * np.float32(np.inf)).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # update skipped
+        assert scaler._scale < 4.0  # scale decreased
+
+    def test_o2_decorate(self):
+        m = nn.Linear(4, 4)
+        paddle.amp.decorate(m, level="O2")
+        assert str(m.weight.dtype) == "bfloat16"
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        acc = paddle.metric.Accuracy(topk=(1, 2))
+        pred = paddle.to_tensor(np.array([[0.1, 0.5, 0.4],
+                                          [0.6, 0.3, 0.1]], np.float32))
+        lab = paddle.to_tensor(np.array([2, 0]))
+        acc.update(acc.compute(pred, lab))
+        top1, top2 = acc.accumulate()
+        assert top1 == 0.5 and top2 == 1.0
+
+    def test_precision_recall(self):
+        p = paddle.metric.Precision()
+        r = paddle.metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect(self):
+        auc = paddle.metric.Auc()
+        auc.update(np.array([0.9, 0.8, 0.1, 0.2]), np.array([1, 1, 0, 0]))
+        assert auc.accumulate() > 0.99
+
+
+class SepDS(paddle.io.Dataset):
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.y = (np.arange(n) % 2).astype(np.int64)
+        self.x = (rng.rand(n, 3).astype(np.float32) + self.y[:, None] * 2.0)
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class TestHapiModel:
+    def _model(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = nn.Linear(3, 16)
+                self.l2 = nn.Linear(16, 2)
+
+            def forward(self, x):
+                return self.l2(F.relu(self.l1(x)))
+        model = paddle.Model(Net())
+        model.prepare(
+            paddle.optimizer.Adam(0.05, parameters=model.parameters()),
+            nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+        return model
+
+    def test_fit_evaluate_predict(self):
+        paddle.seed(0)
+        model = self._model()
+        model.fit(SepDS(), epochs=10, batch_size=16, verbose=0)
+        res = model.evaluate(SepDS(seed=1), batch_size=16, verbose=0)
+        assert res["acc"] > 0.9, res
+        preds = model.predict(SepDS(seed=2), batch_size=16, stack_outputs=True)
+        assert preds[0].shape == (64, 2)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = self._model()
+        model.fit(SepDS(), epochs=2, batch_size=16, verbose=0)
+        path = str(tmp_path / "ckpt")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        model2 = self._model()
+        model2.load(path)
+        x = paddle.randn([4, 3])
+        np.testing.assert_allclose(model.predict_batch([x]).numpy(),
+                                   model2.predict_batch([x]).numpy(),
+                                   atol=1e-6)
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+        model = self._model()
+        es = EarlyStopping(monitor="acc", mode="max", patience=0)
+        model.fit(SepDS(), eval_data=SepDS(seed=1), epochs=50, batch_size=16,
+                  verbose=0, callbacks=[es])
+        assert model.stop_training  # stopped before 50 epochs
+
+    def test_summary(self, capsys):
+        model = self._model()
+        info = model.summary()
+        assert info["total_params"] == 3 * 16 + 16 + 16 * 2 + 2
