@@ -1,0 +1,104 @@
+"""Process/device environment for distributed training.
+
+TPU-native replacement for the reference's env-var handshake + NCCL bootstrap
+(reference: python/paddle/distributed/parallel.py:60 init_parallel_env →
+imperative/nccl_context.cc:53 NCCLParallelContext::Init — TCP-broadcast of
+ncclUniqueId + ncclCommInitRank; platform/gen_comm_id_helper.cc).
+
+On TPU the transport is XLA's ICI/DCN: `jax.distributed.initialize`
+(coordinator address ≈ PADDLE_TRAINER_ENDPOINTS[0]) wires every host into one
+global runtime; there are no ring ids or comm streams to manage. The
+reference's env contract (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+PADDLE_TRAINER_ENDPOINTS) is honored so launcher scripts port unchanged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+_INITIALIZED = [False]
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py:70 ParallelEnv."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self._device_id = int(os.environ.get("FLAGS_selected_devices",
+                                             os.environ.get("FLAGS_selected_gpus", "0"))
+                              .split(",")[0] or 0)
+
+    @property
+    def rank(self):
+        if _INITIALIZED[0]:
+            return jax.process_index()
+        return self._rank
+
+    local_rank = rank
+
+    @property
+    def world_size(self):
+        if _INITIALIZED[0]:
+            return jax.process_count()
+        return self._world_size
+
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints
+
+
+def init_parallel_env():
+    """reference: distributed/parallel.py:60. Multi-host: initialize the JAX
+    distributed runtime from the PADDLE_* env contract. Single-host: no-op —
+    all local devices are already visible."""
+    env = ParallelEnv()
+    if _INITIALIZED[0]:
+        return env
+    if env._world_size > 1 and not _INITIALIZED[0]:
+        coordinator = env._endpoints[0] if env._endpoints[0] else None
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=env._world_size,
+            process_id=env._rank)
+    _INITIALIZED[0] = True
+    return env
+
+
+def get_rank(group=None):
+    if _INITIALIZED[0] or int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+        return ParallelEnv().rank
+    return 0
+
+
+def get_world_size(group=None):
+    if _INITIALIZED[0] or int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+        return ParallelEnv().world_size
+    return 1
+
+
+def is_initialized():
+    return _INITIALIZED[0]
+
+
+def device_count():
+    return len(jax.devices())
