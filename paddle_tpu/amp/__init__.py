@@ -146,6 +146,10 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer UNSCALED state (reference: grad_scaler.py caches an
+        # OptState per optimizer) so multi-optimizer recipes can't
+        # double-unscale or step with still-scaled grads
+        self._unscaled_ids = set()
 
     def scale(self, var):
         if not self._enable:
@@ -155,6 +159,10 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if id(optimizer) in self._unscaled_ids:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer since "
+                "the last update()")
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -165,20 +173,22 @@ class GradScaler:
             if not finite:
                 found = True
             p._grad = g
-        self._found_inf = found
+        self._found_inf = self._found_inf or found
+        self._unscaled_ids.add(id(optimizer))
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if not getattr(self, "_unscaled", False):
+        if id(optimizer) not in self._unscaled_ids:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self._unscaled = False
 
     def update(self):
+        self._unscaled_ids.clear()
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1
@@ -192,6 +202,7 @@ class GradScaler:
             if self._good_steps >= self._incr_every_n:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        self._found_inf = False
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()

@@ -70,6 +70,7 @@ class Model:
         net = self.network
         reg_coeffs = [opt._regularized_grad(p, None) for p in trainable]
         clip = opt._grad_clip
+        ctxs = opt._param_update_ctx(trainable)
 
         meta = {}
 
@@ -99,14 +100,16 @@ class Model:
                 fwd_loss, has_aux=True)(train_raws, fixed_raws, x_raws,
                                         y_raws, key)
             grads = list(grads)
+            # clip first, then regularize — same order as Optimizer.step
+            if clip is not None:
+                grads = clip._clip_raw(trainable, grads)
             for i, rc in enumerate(reg_coeffs):
                 if rc is not None:
                     grads[i] = grads[i] + rc * train_raws[i]
-            if clip is not None:
-                grads = clip._clip_raw(trainable, grads)
             new_p, new_s = [], []
-            for pr, g, st in zip(train_raws, grads, opt_states):
-                p2, s2 = opt._update(pr, g.astype(pr.dtype), st, lr, step_no)
+            for pr, g, st, ctx in zip(train_raws, grads, opt_states, ctxs):
+                p2, s2 = opt._update(pr, g.astype(pr.dtype), st, lr, step_no,
+                                     ctx)
                 new_p.append(p2)
                 new_s.append(s2)
             return loss, preds, new_p, new_s, effects

@@ -49,12 +49,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # running-stat update is state mutation, outside the tape
         if running_mean is not None:
             with _no_grad():
-                n = x.size / x.shape[channel_axis]
-                unbiased = batch_var * (n / max(n - 1, 1))
+                # biased batch variance, matching the reference convention
+                # (batch_norm_op.cc:397 uses the plain batch var, no n/(n-1))
                 running_mean.set_value(momentum * running_mean
                                        + (1.0 - momentum) * batch_mean.detach())
                 running_var.set_value(momentum * running_var
-                                      + (1.0 - momentum) * unbiased.detach())
+                                      + (1.0 - momentum) * batch_var.detach())
         return out
 
     def impl_eval(a, m, v, w, b):

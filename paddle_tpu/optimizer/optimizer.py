@@ -92,7 +92,7 @@ class Optimizer:
             self._learning_rate.set_state_dict(state["LR_Scheduler"])
 
     # -- update rule (override) ---------------------------------------------
-    def _update(self, param, grad, state, lr, step):
+    def _update(self, param, grad, state, lr, step, ctx=None):
         raise NotImplementedError
 
     def _regularized_grad(self, p, g):
@@ -114,6 +114,14 @@ class Optimizer:
     def _lr_dtype(self):
         return jnp.float32
 
+    def _param_update_ctx(self, params):
+        """Per-param static context threaded into the fused update (hashable;
+        part of the jit key). Subclasses override — e.g. AdamW returns
+        (decay_coeff, lr_ratio) per param so apply_decay_param_fun-excluded
+        params skip decoupled decay (reference: optimizer/adamw.py
+        _append_decoupled_weight_decay's per-param skip)."""
+        return [None] * len(params)
+
     def step(self):
         self._ensure_state()
         params = [p for p in self._parameter_list if p._grad is not None
@@ -127,17 +135,20 @@ class Optimizer:
         lr = jnp.asarray(self.get_lr(), self._lr_dtype)
         step_no = jnp.asarray(self._global_step + 1, jnp.float32)
 
-        key = tuple((tuple(p.shape), str(p.dtype)) for p in params)
+        ctxs = self._param_update_ctx(params)
+        key = (tuple((tuple(p.shape), str(p.dtype)) for p in params),
+               tuple(ctxs))
         if self._jit_update is None or self._jit_key != key:
             reg_coeffs = [self._regularized_grad(p, None) for p in params]
 
             def fused(params_raw, grads_raw, states_raw, lr_, step_):
                 new_p, new_s = [], []
-                for pr, gr, st, rc in zip(params_raw, grads_raw, states_raw,
-                                          reg_coeffs):
+                for pr, gr, st, rc, ctx in zip(params_raw, grads_raw,
+                                               states_raw, reg_coeffs, ctxs):
                     if rc is not None:
                         gr = gr + rc * pr
-                    p2, s2 = self._update(pr, gr.astype(pr.dtype), st, lr_, step_)
+                    p2, s2 = self._update(pr, gr.astype(pr.dtype), st, lr_,
+                                          step_, ctx)
                     new_p.append(p2)
                     new_s.append(s2)
                 return new_p, new_s
@@ -199,7 +210,7 @@ class SGD(Optimizer):
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         return p - lr.astype(p.dtype) * g, s
 
 
@@ -216,7 +227,7 @@ class Momentum(Optimizer):
     def _init_state(self, p):
         return {"velocity": jnp.zeros(p._data.shape, p._data.dtype)}
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         lr = lr.astype(p.dtype)
         v = self._momentum * s["velocity"] + g
         if self._nesterov:
@@ -247,7 +258,7 @@ class Adam(Optimizer):
             st["master"] = p._data.astype(jnp.float32)
         return st
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         master = s.get("master")
         work = master if master is not None else p
@@ -277,22 +288,33 @@ class AdamW(Adam):
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          name)
         self._apply_decay_param_fun = apply_decay_param_fun
-        self._coeff = float(weight_decay) if isinstance(weight_decay, (int, float)) \
-            else weight_decay
+        self._lr_ratio = lr_ratio
+        if isinstance(weight_decay, (int, float)):
+            self._coeff = float(weight_decay)
+        elif isinstance(weight_decay, Tensor):
+            self._coeff = float(weight_decay.numpy())
+        else:
+            raise TypeError(
+                f"AdamW weight_decay must be a float or Tensor, got "
+                f"{type(weight_decay).__name__}")
 
-    def step(self):
-        # mark which params decay (by name predicate) before the fused update
-        self._decay_mask = {}
-        for p in self._parameter_list:
+    def _param_update_ctx(self, params):
+        ctxs = []
+        for p in params:
             decay = True
             if self._apply_decay_param_fun is not None:
-                decay = self._apply_decay_param_fun(p.name or "")
-            self._decay_mask[id(p)] = decay
-        super().step()
+                decay = bool(self._apply_decay_param_fun(p.name or ""))
+            ratio = 1.0
+            if self._lr_ratio is not None:
+                ratio = float(self._lr_ratio(p))
+            ctxs.append((self._coeff if decay else 0.0, ratio))
+        return ctxs
 
-    def _update(self, p, g, s, lr, step):
-        # decoupled decay first: p *= (1 - lr*coeff)
-        coeff = self._coeff if isinstance(self._coeff, float) else 0.01
+    def _update(self, p, g, s, lr, step, ctx=None):
+        # decoupled decay first: p *= (1 - lr*ratio*coeff); excluded params
+        # (biases/LayerNorm via apply_decay_param_fun) get coeff 0.
+        coeff, ratio = ctx
+        lr = lr * ratio
         master = s.get("master")
         work = master if master is not None else p
         decayed = work * (1.0 - lr.astype(work.dtype) * coeff)
@@ -316,7 +338,7 @@ class Adamax(Optimizer):
         return {"moment": jnp.zeros(p._data.shape, p._data.dtype),
                 "inf_norm": jnp.zeros(p._data.shape, p._data.dtype)}
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         m = b1 * s["moment"] + (1 - b1) * g
         u = jnp.maximum(b2 * s["inf_norm"], jnp.abs(g))
@@ -337,7 +359,7 @@ class Adagrad(Optimizer):
     def _init_state(self, p):
         return {"moment": jnp.full(p._data.shape, self._init_acc, p._data.dtype)}
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         m = s["moment"] + g * g
         p2 = p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self._epsilon)
         return p2, {"moment": m}
@@ -355,7 +377,7 @@ class Adadelta(Optimizer):
         return {"avg_sq_grad": jnp.zeros(p._data.shape, p._data.dtype),
                 "avg_sq_update": jnp.zeros(p._data.shape, p._data.dtype)}
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         rho, eps = self._rho, self._epsilon
         ag = rho * s["avg_sq_grad"] + (1 - rho) * g * g
         upd = g * jnp.sqrt(s["avg_sq_update"] + eps) / jnp.sqrt(ag + eps)
@@ -380,7 +402,7 @@ class RMSProp(Optimizer):
             st["mean_grad"] = jnp.zeros(p._data.shape, p._data.dtype)
         return st
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         rho, eps = self._rho, self._epsilon
         ms = rho * s["mean_square"] + (1 - rho) * g * g
         if self._centered:
@@ -411,7 +433,7 @@ class Lamb(Optimizer):
         return {"moment1": jnp.zeros(p._data.shape, p._data.dtype),
                 "moment2": jnp.zeros(p._data.shape, p._data.dtype)}
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         m = b1 * s["moment1"] + (1 - b1) * g
         v = b2 * s["moment2"] + (1 - b2) * g * g
@@ -438,7 +460,7 @@ class LarsMomentum(Optimizer):
     def _init_state(self, p):
         return {"velocity": jnp.zeros(p._data.shape, p._data.dtype)}
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
         g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
         local_lr = jnp.where(
@@ -462,7 +484,7 @@ class Ftrl(Optimizer):
         return {"squared": jnp.zeros(p._data.shape, p._data.dtype),
                 "linear": jnp.zeros(p._data.shape, p._data.dtype)}
 
-    def _update(self, p, g, s, lr, step):
+    def _update(self, p, g, s, lr, step, ctx=None):
         lp = self._lr_power
         new_sq = s["squared"] + g * g
         sigma = (jnp.power(new_sq, -lp) - jnp.power(s["squared"] + 1e-30, -lp)) / lr

@@ -209,17 +209,21 @@ class Executor:
         else:
             clip = None
 
+        ctxs = optimizer._param_update_ctx(trainable)
+
         def train_step(param_raws, opt_states, feed_raws, lr, step_no):
             t_raws = [r for p, r in zip(params, param_raws)
                       if not p.stop_gradient]
             (loss, (env, param_env)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(t_raws, param_raws, feed_raws)
             grads = list(grads)
+            # clip first, then L2-regularize — same order as dygraph
+            # Optimizer.step (reference apply_gradients: clip → regularize)
+            if clip is not None:
+                grads = clip._clip_raw(trainable, grads)
             for i, rc in enumerate(reg_coeffs):
                 if rc is not None:
                     grads[i] = grads[i] + rc * t_raws[i]
-            if clip is not None:
-                grads = clip._clip_raw(trainable, grads)
             new_params, new_states = [], []
             gi = 0
             for p, pr, st in zip(params, param_raws, opt_states):
@@ -228,7 +232,7 @@ class Executor:
                     new_states.append(st)
                     continue
                 p2, s2 = optimizer._update(pr, grads[gi].astype(pr.dtype), st,
-                                           lr, step_no)
+                                           lr, step_no, ctxs[gi])
                 new_params.append(p2)
                 new_states.append(s2)
                 gi += 1

@@ -1,0 +1,172 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+1. GradScaler.step must not re-unscale after a manual unscale_().
+2. AdamW honors apply_decay_param_fun (excluded params get no decay).
+3. batch_norm running_var uses the *biased* batch variance
+   (reference: operators/batch_norm_op.cc:397).
+4. static-mode train step clips grads first, then L2-regularizes —
+   same order as dygraph Optimizer.step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.amp import GradScaler
+
+
+class TestGradScalerUnscaleOnce:
+    def test_manual_unscale_then_step_divides_once(self):
+        p = paddle.Parameter(np.zeros((3,), np.float32))
+        opt = optim.SGD(learning_rate=1.0, parameters=[p])
+        scaler = GradScaler(init_loss_scaling=1024.0)
+        # simulate backward of a scaled loss: grad = scale * true_grad
+        true_grad = np.array([1.0, 2.0, 3.0], np.float32)
+        p._grad = paddle.to_tensor(true_grad * 1024.0)._data
+        scaler.unscale_(opt)
+        np.testing.assert_allclose(np.asarray(p._grad), true_grad, rtol=1e-6)
+        scaler.step(opt)  # must NOT divide by the scale again
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), -true_grad, rtol=1e-5)
+
+    def test_two_optimizers_one_scaler(self):
+        pa = paddle.Parameter(np.zeros((2,), np.float32))
+        pb = paddle.Parameter(np.zeros((2,), np.float32))
+        oa = optim.SGD(learning_rate=1.0, parameters=[pa])
+        ob = optim.SGD(learning_rate=1.0, parameters=[pb])
+        scaler = GradScaler(init_loss_scaling=4.0)
+        pa._grad = paddle.to_tensor(np.array([4.0, 4.0], np.float32))._data
+        pb._grad = paddle.to_tensor(np.array([8.0, 8.0], np.float32))._data
+        scaler.unscale_(oa)
+        scaler.unscale_(ob)
+        scaler.step(oa)
+        scaler.step(ob)  # must not re-unscale ob's grads
+        scaler.update()
+        np.testing.assert_allclose(pa.numpy(), [-1.0, -1.0])
+        np.testing.assert_allclose(pb.numpy(), [-2.0, -2.0])
+
+    def test_double_unscale_raises(self):
+        p = paddle.Parameter(np.zeros((2,), np.float32))
+        opt = optim.SGD(learning_rate=1.0, parameters=[p])
+        scaler = GradScaler(init_loss_scaling=4.0)
+        p._grad = paddle.to_tensor(np.array([4.0, 4.0], np.float32))._data
+        scaler.unscale_(opt)
+        with pytest.raises(RuntimeError):
+            scaler.unscale_(opt)
+
+    def test_step_without_manual_unscale_still_unscales(self):
+        p = paddle.Parameter(np.zeros((2,), np.float32))
+        opt = optim.SGD(learning_rate=1.0, parameters=[p])
+        scaler = GradScaler(init_loss_scaling=8.0)
+        p._grad = paddle.to_tensor(np.array([8.0, 16.0], np.float32))._data
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [-1.0, -2.0], rtol=1e-6)
+
+
+class TestAdamWDecayMask:
+    def test_apply_decay_param_fun_excludes(self):
+        w = paddle.Parameter(np.full((4,), 2.0, np.float32))
+        b = paddle.Parameter(np.full((4,), 2.0, np.float32))
+        w.name, b.name = "linear_w", "linear_b"
+        opt = optim.AdamW(learning_rate=0.1, parameters=[w, b],
+                          weight_decay=0.5,
+                          apply_decay_param_fun=lambda n: n.endswith("_w"))
+        g = np.full((4,), 0.01, np.float32)
+        w._grad = paddle.to_tensor(g)._data
+        b._grad = paddle.to_tensor(g)._data
+        opt.step()
+        # identical grads, identical init: only the decayed param shrinks more
+        assert float(w.numpy()[0]) < float(b.numpy()[0])
+        # the excluded param must match plain Adam exactly
+        b2 = paddle.Parameter(np.full((4,), 2.0, np.float32))
+        adam = optim.Adam(learning_rate=0.1, parameters=[b2])
+        b2._grad = paddle.to_tensor(g)._data
+        adam.step()
+        np.testing.assert_allclose(b.numpy(), b2.numpy(), rtol=1e-6)
+
+    def test_lr_ratio_scales_update(self):
+        p1 = paddle.Parameter(np.full((2,), 1.0, np.float32))
+        p2 = paddle.Parameter(np.full((2,), 1.0, np.float32))
+        p1.name, p2.name = "a", "b"
+        opt = optim.AdamW(learning_rate=0.1, parameters=[p1, p2],
+                          weight_decay=0.0,
+                          lr_ratio=lambda p: 0.5 if p.name == "b" else 1.0)
+        g = np.full((2,), 1.0, np.float32)
+        p1._grad = paddle.to_tensor(g)._data
+        p2._grad = paddle.to_tensor(g)._data
+        opt.step()
+        d1 = 1.0 - float(p1.numpy()[0])
+        d2 = 1.0 - float(p2.numpy()[0])
+        np.testing.assert_allclose(d2, d1 * 0.5, rtol=1e-5)
+
+    def test_non_float_weight_decay_raises(self):
+        p = paddle.Parameter(np.zeros((2,), np.float32))
+        with pytest.raises(TypeError):
+            optim.AdamW(parameters=[p], weight_decay="0.01")
+
+
+class TestBatchNormRunningVarBiased:
+    def test_running_var_uses_biased_batch_var(self):
+        bn = nn.BatchNorm1D(3, momentum=0.9)
+        x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        bn.train()
+        bn(paddle.to_tensor(x))
+        biased_var = x.var(axis=0)  # ddof=0
+        expected = 0.9 * np.ones(3, np.float32) + 0.1 * biased_var
+        np.testing.assert_allclose(bn._variance.numpy(), expected,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestStaticClipOrderParity:
+    def test_static_matches_dygraph_with_clip_and_decay(self):
+        rng = np.random.RandomState(1)
+        W0 = rng.randn(4, 2).astype(np.float32)
+        b0 = np.zeros(2, np.float32)
+        X = rng.randn(16, 4).astype(np.float32)
+        Y = rng.randn(16, 2).astype(np.float32)
+
+        def make_opt(params):
+            return optim.Momentum(
+                learning_rate=0.1, momentum=0.9, parameters=params,
+                weight_decay=0.1,
+                grad_clip=paddle.ClipGradByGlobalNorm(0.05))
+
+        # dygraph
+        lin_d = nn.Linear(4, 2)
+        lin_d.weight.set_value(W0)
+        lin_d.bias.set_value(b0)
+        opt_d = make_opt(lin_d.parameters())
+        for _ in range(3):
+            loss = paddle.mean((lin_d(paddle.to_tensor(X))
+                                - paddle.to_tensor(Y)) ** 2)
+            loss.backward()
+            opt_d.step()
+            opt_d.clear_grad()
+
+        # static
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [None, 4], "float32")
+                y = paddle.static.data("y", [None, 2], "float32")
+                lin_s = nn.Linear(4, 2)
+                loss = paddle.mean((lin_s(x) - y) ** 2)
+                opt_s = make_opt([])
+                opt_s._parameter_list = lin_s.parameters()
+                opt_s.minimize(loss)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            lin_s.weight.set_value(W0)
+            lin_s.bias.set_value(b0)
+            for _ in range(3):
+                exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        finally:
+            paddle.disable_static()
+
+        np.testing.assert_allclose(lin_s.weight.numpy(), lin_d.weight.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(lin_s.bias.numpy(), lin_d.bias.numpy(),
+                                   rtol=1e-4, atol=1e-5)

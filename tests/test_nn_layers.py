@@ -95,10 +95,16 @@ class TestNorm:
         np.testing.assert_allclose(out1, out2, atol=1e-4)
         np.testing.assert_allclose(ours_bn._mean.numpy(),
                                    theirs_bn.running_mean.numpy(), atol=1e-5)
+        # running_var follows the reference's *biased* batch-var convention
+        # (batch_norm_op.cc:397), unlike torch's unbiased one.
+        biased_var = x.var(axis=(0, 2, 3))
         np.testing.assert_allclose(ours_bn._variance.numpy(),
-                                   theirs_bn.running_var.numpy(), atol=1e-5)
+                                   0.9 * np.ones(3) + 0.1 * biased_var,
+                                   atol=1e-5)
         ours_bn.eval()
         theirs_bn.eval()
+        # align running stats before comparing eval outputs
+        theirs_bn.running_var.data = torch.tensor(ours_bn._variance.numpy())
         np.testing.assert_allclose(
             ours_bn(paddle.to_tensor(x)).numpy(),
             theirs_bn(torch.tensor(x)).detach().numpy(), atol=1e-4)
