@@ -1,0 +1,36 @@
+"""paddle_tpu.distributed: collectives, data parallel, topology, launch.
+
+Public surface mirrors `paddle.distributed` (reference:
+python/paddle/distributed/__init__.py): functional collectives, ParallelEnv /
+init_parallel_env, DataParallel, new_group, spawn, launch; plus the TPU-native
+mesh utilities that replace ring ids (see mesh.py docstring).
+"""
+from .env import (  # noqa: F401
+    ParallelEnv, init_parallel_env, is_initialized, device_count,
+)
+# group-aware rank/world-size (fall back to env for the global group)
+from .collective import get_rank, get_world_size  # noqa: F401
+from .mesh import (  # noqa: F401
+    build_mesh, set_mesh, get_mesh, ensure_mesh, shard_tensor,
+    replicate_tensor, constrain, sharding_for,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, destroy_process_group,
+    all_reduce, reduce, broadcast, all_gather, all_gather_object, scatter,
+    reduce_scatter, alltoall, send, recv, p2p_exchange, barrier, wait,
+)
+from .parallel import (  # noqa: F401
+    DataParallel, sync_params_buffers, shard_batch, build_global_batch,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    set_hybrid_communicate_group, get_hybrid_communicate_group,
+)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py:333 — multiprocessing launch of
+    ``func(*args)`` per process with the PADDLE_* env contract set."""
+    from .spawn_impl import spawn as _spawn
+    return _spawn(func, args=args, nprocs=nprocs, join=join, daemon=daemon,
+                  **options)

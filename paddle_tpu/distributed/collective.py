@@ -1,0 +1,541 @@
+"""Collective functional API over mesh axes.
+
+TPU-native equivalent of the reference's collective surface
+(reference: python/paddle/distributed/collective.py — all_reduce :410,
+broadcast :343, all_gather :585, reduce :491, scatter :663, alltoall :1315,
+send/recv :1386/:1436, new_group :205, barrier :165; backed by the C++ comm
+ops in operators/collective/ and NCCLCommContext ring registry).
+
+Design (SURVEY §5.8 TPU mapping): a communicator ring becomes a *mesh axis*.
+Three execution contexts:
+
+1. **Inside a mapped trace** (shard_map/pjit body — the perf path): lowers to
+   ``lax.psum``/``all_gather``/``psum_scatter``/``all_to_all``/``ppermute``
+   on the group's axis; XLA schedules them on ICI. Calls go through the op
+   funnel, so they are tape-recorded and differentiable (psum's transpose
+   is the same allreduce the reference's grad ops insert).
+2. **Eager, single process**: the group spans only this process ⇒ identity
+   (matches a world_size-1 reference run). Intra-host multi-device work is
+   expressed by sharding, not by eager collectives.
+3. **Eager, multi-process** (one process per host via launcher +
+   jax.distributed): implemented with a host-local all-gather
+   (``multihost_utils.process_allgather``) + local reduction — the paddle
+   process-level semantics, with ICI/DCN transport picked by XLA.
+
+Groups: a group that IS a mesh axis (dp/mp/pp from the hybrid topology) needs
+no rank masks — ``psum(x, axis)`` already reduces within each slice of the
+other axes. ``new_group(ranks)`` over arbitrary ranks uses masked full-axis
+collectives (members contribute, non-members pass through), because this JAX
+version does not support ``axis_index_groups`` under shard_map.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply
+from . import mesh as _mesh
+
+
+class ReduceOp:
+    """reference: distributed/collective.py:38 ReduceOp."""
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = a mesh-axis subset or an explicit rank list
+    (reference: collective.py:76 Group; ring_id ≈ axis name here)."""
+
+    _next_id = [1]
+
+    def __init__(self, ranks: Optional[Sequence[int]] = None,
+                 axis: Union[str, Tuple[str, ...], None] = None,
+                 gid: Optional[int] = None, name: Optional[str] = None):
+        self.ranks = list(ranks) if ranks is not None else None
+        self.axis = axis
+        if gid is None:
+            gid = Group._next_id[0]
+            Group._next_id[0] += 1
+        self.id = gid
+        self.name = name or f"group_{gid}"
+
+    @property
+    def nranks(self):
+        if self.ranks is not None:
+            return len(self.ranks)
+        axes = _resolve_axes(self)
+        if axes:
+            return _mesh.mesh_axis_size(axes)
+        return jax.process_count()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        if self.ranks is None:
+            return rank
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return True
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis}, ranks={self.ranks})"
+
+
+_GLOBAL_GROUP = Group(gid=0, name="global")
+_GROUP_MAP = {0: _GLOBAL_GROUP}
+
+
+def _get_group(group) -> Group:
+    if group is None:
+        return _GLOBAL_GROUP
+    if isinstance(group, Group):
+        return group
+    return _GROUP_MAP[int(group)]
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend=None,
+              timeout=None, axis=None) -> Group:
+    """reference: collective.py:205 new_group. ``axis=`` creates a mesh-axis
+    group (the hybrid-topology fast path); ``ranks=`` an arbitrary subset."""
+    g = Group(ranks=ranks, axis=axis)
+    _GROUP_MAP[g.id] = g
+    return g
+
+
+def get_group(gid: int) -> Group:
+    return _GROUP_MAP[gid]
+
+
+def is_initialized() -> bool:
+    from .env import is_initialized as _i
+    return _i()
+
+
+def destroy_process_group(group=None):
+    if group is not None:
+        _GROUP_MAP.pop(_get_group(group).id, None)
+
+
+# -- mapped-context detection -------------------------------------------------
+
+def _axes_in_scope() -> Tuple[str, ...]:
+    """Mesh axes bound in the current (shard_map) trace."""
+    m = _mesh.get_mesh()
+    if m is None:
+        return ()
+    found = []
+    for name in m.axis_names:
+        try:
+            lax.axis_index(name)
+            found.append(name)
+        except (NameError, Exception):
+            # jax raises NameError for unbound axis names; anything else
+            # equally means "not usable here"
+            pass
+    return tuple(found)
+
+
+def _resolve_axes(group: Group) -> Tuple[str, ...]:
+    scope = _axes_in_scope()
+    if group.axis is not None:
+        want = (group.axis,) if isinstance(group.axis, str) else tuple(group.axis)
+        return tuple(a for a in want if a in scope)
+    return scope
+
+
+def _linear_index(axes: Tuple[str, ...]):
+    """Flat rank index over the given axes (row-major in axis order)."""
+    m = _mesh.get_mesh()
+    idx = jnp.zeros((), jnp.int32)
+    for a in axes:
+        idx = idx * m.shape[a] + lax.axis_index(a)
+    return idx
+
+
+def _member_mask(group: Group, axes: Tuple[str, ...]):
+    if group.ranks is None:
+        return None
+    idx = _linear_index(axes)
+    return jnp.isin(idx, jnp.asarray(np.array(group.ranks, np.int32)))
+
+
+# -- raw implementations (jax arrays; usable inside shard_map directly) -------
+
+_REDUCERS = {
+    ReduceOp.SUM: (lax.psum, jnp.zeros_like),
+    ReduceOp.AVG: (lax.pmean, jnp.zeros_like),
+    ReduceOp.MAX: (lax.pmax, lambda x: jnp.full_like(x, -jnp.inf)
+                   if jnp.issubdtype(x.dtype, jnp.floating)
+                   else jnp.full_like(x, jnp.iinfo(x.dtype).min)),
+    ReduceOp.MIN: (lax.pmin, lambda x: jnp.full_like(x, jnp.inf)
+                   if jnp.issubdtype(x.dtype, jnp.floating)
+                   else jnp.full_like(x, jnp.iinfo(x.dtype).max)),
+}
+
+
+def _raw_allreduce(x, op, group: Group, axes: Tuple[str, ...]):
+    mask = _member_mask(group, axes)
+    if op == ReduceOp.PROD:
+        # no pprod primitive: psum of logs would lose sign — use
+        # exp(psum(log|x|)) * sign product via psum of sign bits
+        contrib = x if mask is None else jnp.where(mask, x, jnp.ones_like(x))
+        neg = (contrib < 0).astype(jnp.int32)
+        total_neg = lax.psum(neg, axes)
+        mag = lax.psum(jnp.log(jnp.abs(contrib) + 1e-30), axes)
+        out = jnp.exp(mag) * jnp.where(total_neg % 2 == 1, -1.0, 1.0).astype(x.dtype)
+        return out if mask is None else jnp.where(mask, out, x)
+    fn, neutral = _REDUCERS[op]
+    if mask is None:
+        return fn(x, axes)
+    contrib = jnp.where(mask, x, neutral(x))
+    if op == ReduceOp.AVG:
+        total = lax.psum(contrib, axes)
+        out = total / float(len(group.ranks))
+    else:
+        out = fn(contrib, axes)
+    return jnp.where(mask, out, x)
+
+
+def _raw_broadcast(x, src_in_group, group: Group, axes: Tuple[str, ...]):
+    idx = _linear_index(axes)
+    if group.ranks is not None:
+        src_global = group.ranks[src_in_group]
+        mask = _member_mask(group, axes)
+    else:
+        src_global = src_in_group
+        mask = None
+    contrib = jnp.where(idx == src_global, x, jnp.zeros_like(x))
+    out = lax.psum(contrib, axes)
+    if mask is not None:
+        return jnp.where(mask, out, x)
+    return out
+
+
+def _raw_allgather(x, group: Group, axes: Tuple[str, ...]):
+    if len(axes) == 1:
+        full = lax.all_gather(x, axes[0])         # [axis_size, ...]
+    else:
+        full = x
+        for a in reversed(axes):
+            full = lax.all_gather(full, a)
+        full = full.reshape((-1,) + x.shape)
+    if group.ranks is not None:
+        full = full[jnp.asarray(np.array(group.ranks, np.int32))]
+    return full
+
+
+def _raw_reduce_scatter(x, op, group: Group, axes: Tuple[str, ...]):
+    if group.ranks is not None:
+        raise NotImplementedError(
+            "reduce_scatter over an arbitrary rank group; use a mesh-axis "
+            "group")
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise NotImplementedError("reduce_scatter supports SUM/AVG")
+    out = lax.psum_scatter(x, axes, tiled=True)
+    if op == ReduceOp.AVG:
+        out = out / _mesh.mesh_axis_size(axes)
+    return out
+
+
+def _raw_alltoall(x, group: Group, axes: Tuple[str, ...]):
+    if group.ranks is not None:
+        raise NotImplementedError(
+            "alltoall over an arbitrary rank group; use a mesh-axis group")
+    if len(axes) != 1:
+        raise NotImplementedError("alltoall needs a single mesh axis")
+    return lax.all_to_all(x, axes[0], split_axis=0, concat_axis=0, tiled=True)
+
+
+def _raw_p2p(x, src, dst, axes: Tuple[str, ...]):
+    """Move ``x`` from rank src to rank dst (others keep their value)."""
+    if len(axes) != 1:
+        raise NotImplementedError("send/recv needs a single mesh axis")
+    moved = lax.ppermute(x, axes[0], perm=[(src, dst)])
+    idx = lax.axis_index(axes[0])
+    return jnp.where(idx == dst, moved, x)
+
+
+# -- public functional API ----------------------------------------------------
+
+def _run(name, tensor, raw_fn, inplace=True):
+    """Dispatch a collective through the op funnel (differentiable, visible
+    to AMP/nan-check), honoring paddle's mutate-in-place convention."""
+    if isinstance(tensor, Tensor):
+        out = apply(name, raw_fn, tensor)
+        if inplace:
+            tensor._swap_payload(out)
+            return tensor
+        return out
+    return raw_fn(tensor)
+
+
+def _eager_multiprocess_reduce(arr, op):
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(arr)  # [nproc, ...]
+    if op == ReduceOp.SUM:
+        return gathered.sum(axis=0)
+    if op == ReduceOp.AVG:
+        return gathered.mean(axis=0)
+    if op == ReduceOp.MAX:
+        return gathered.max(axis=0)
+    if op == ReduceOp.MIN:
+        return gathered.min(axis=0)
+    if op == ReduceOp.PROD:
+        return gathered.prod(axis=0)
+    raise ValueError(f"bad ReduceOp {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=None):
+    """reference: distributed/collective.py:410 (c_allreduce_* kernels,
+    c_allreduce_op.h:253)."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if axes:
+        return _run("c_allreduce", tensor,
+                    lambda x: _raw_allreduce(x, op, g, axes))
+    if jax.process_count() > 1:
+        return _run("c_allreduce", tensor,
+                    lambda x: _eager_multiprocess_reduce(x, op))
+    return tensor  # world of one
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    """reference: collective.py:491. SPMD form: every rank computes the
+    reduction, only dst keeps it (others keep their input)."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if not axes:
+        return all_reduce(tensor, op, group, sync_op)
+
+    def impl(x):
+        red = _raw_allreduce(x, op, g, axes)
+        idx = _linear_index(axes)
+        dst_global = g.ranks[dst] if g.ranks is not None else dst
+        return jnp.where(idx == dst_global, red, x)
+    return _run("c_reduce", tensor, impl)
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    """reference: collective.py:343 (c_broadcast op)."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if axes:
+        src_in_group = g.get_group_rank(src) if g.ranks is not None else src
+        return _run("c_broadcast", tensor,
+                    lambda x: _raw_broadcast(x, src_in_group, g, axes))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return _run("c_broadcast", tensor,
+                    lambda x: multihost_utils.broadcast_one_to_all(x))
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """reference: collective.py:585. Fills ``tensor_list`` with every rank's
+    tensor; also returns the stacked result."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if axes:
+        stacked = _run("c_allgather", tensor,
+                       lambda x: _raw_allgather(x, g, axes), inplace=False)
+    elif jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        raw = tensor._data if isinstance(tensor, Tensor) else tensor
+        out = multihost_utils.process_allgather(raw)
+        stacked = Tensor(out) if isinstance(tensor, Tensor) else out
+    else:
+        stacked = (Tensor(tensor._data[None]) if isinstance(tensor, Tensor)
+                   else tensor[None])
+    if tensor_list is not None:
+        n = stacked.shape[0]
+        for i in range(int(n)):
+            tensor_list.append(stacked[i])
+    return stacked
+
+
+def all_gather_object(object_list, obj, group=None):
+    """reference: collective.py all_gather_object (pickle transport)."""
+    import pickle
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        # pad to a common max size
+        n = int(multihost_utils.process_allgather(
+            jnp.asarray([data.size])).max())
+        buf = np.zeros(n + 8, np.uint8)
+        buf[:8] = np.frombuffer(np.int64(data.size).tobytes(), np.uint8)
+        buf[8:8 + data.size] = data
+        rows = multihost_utils.process_allgather(jnp.asarray(buf))
+        for row in np.asarray(rows):
+            size = int(np.frombuffer(row[:8].tobytes(), np.int64)[0])
+            object_list.append(pickle.loads(row[8:8 + size].tobytes()))
+    else:
+        object_list.append(obj)
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """reference: collective.py:663 — src holds a list of per-rank tensors;
+    each rank receives its slice."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if not axes:
+        if tensor_list:
+            rank = g.get_group_rank(get_rank()) if g.ranks is not None else get_rank()
+            pick = tensor_list[max(rank, 0)]
+            if isinstance(tensor, Tensor):
+                tensor._swap_payload(pick if isinstance(pick, Tensor)
+                                     else Tensor(pick))
+                return tensor
+            return pick
+        return tensor
+
+    def impl(x, stack):
+        idx = _linear_index(axes)
+        src_global = g.ranks[src] if g.ranks is not None else src
+        full = _raw_broadcast(stack, src, g, axes)
+        my = jnp.take(full, idx, axis=0)
+        del src_global
+        return my
+    stack_raw = jnp.stack([t._data if isinstance(t, Tensor) else jnp.asarray(t)
+                           for t in (tensor_list or [])])
+    if isinstance(tensor, Tensor):
+        out = apply("c_scatter", impl, tensor, Tensor(stack_raw))
+        tensor._swap_payload(out)
+        return tensor
+    return impl(tensor, stack_raw)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """reference: operators/collective/c_reducescatter_op.cc."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = concat_tensors(src)
+    if not axes:
+        if isinstance(tensor, Tensor) and isinstance(src, Tensor):
+            tensor._swap_payload(src)
+            return tensor
+        return src
+    if isinstance(src, Tensor):
+        out = apply("c_reducescatter",
+                    lambda x: _raw_reduce_scatter(x, op, g, axes), src)
+        if isinstance(tensor, Tensor):
+            tensor._swap_payload(out)
+            return tensor
+        return out
+    return _raw_reduce_scatter(src, op, g, axes)
+
+
+def concat_tensors(ts):
+    from ..ops import concat as _concat
+    return _concat(list(ts), axis=0)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """reference: collective.py:1315 (alltoall op)."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    xs = in_tensor_list
+    single = not isinstance(xs, (list, tuple))
+    stacked = xs if single else concat_tensors(
+        [x.unsqueeze(0) if isinstance(x, Tensor) else x[None] for x in xs])
+    if not axes:
+        result = stacked
+    else:
+        result = _run("alltoall", stacked,
+                      lambda x: _raw_alltoall(x, g, axes), inplace=False)
+    if out_tensor_list is not None and not single:
+        for i in range(result.shape[0]):
+            out_tensor_list.append(result[i])
+    return result
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """reference: collective.py:1386 (send_v2). SPMD pair with recv: both
+    ranks run the same program; see _raw_p2p."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if not axes:
+        return tensor
+    src = _static_rank_hint()
+    return _run("send_v2", tensor,
+                lambda x: _raw_p2p(x, src if src is not None else 0, dst, axes))
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    """reference: collective.py:1436 (recv_v2)."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if not axes:
+        return tensor
+    dst = _static_rank_hint()
+    return _run("recv_v2", tensor,
+                lambda x: _raw_p2p(x, src, dst if dst is not None else 0, axes))
+
+
+def p2p_exchange(tensor, src, dst, group=None):
+    """Explicit SPMD point-to-point: value of rank ``src`` lands on rank
+    ``dst``; every other rank keeps its own (the shard_map-native form of
+    send_v2/recv_v2 used by the pipeline schedule)."""
+    g = _get_group(group)
+    axes = _resolve_axes(g)
+    if not axes:
+        return tensor
+    return _run("p2p", tensor, lambda x: _raw_p2p(x, src, dst, axes))
+
+
+_STATIC_RANK = [None]
+
+
+def _static_rank_hint():
+    return _STATIC_RANK[0]
+
+
+def barrier(group=None):
+    """reference: collective.py:165 (barrier op). Eager multi-process: a tiny
+    allreduce is the barrier; in SPMD traces XLA orders collectives, no-op."""
+    if jax.process_count() > 1 and not _axes_in_scope():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: collective.py:276. XLA owns stream ordering; block the host
+    until the value is ready (the closest observable semantics)."""
+    if isinstance(tensor, Tensor):
+        tensor.block_until_ready()
+    return tensor
+
+
+def get_rank(group=None):
+    from .env import get_rank as _r
+    g = _get_group(group)
+    r = _r()
+    if g.ranks is not None:
+        return g.get_group_rank(r)
+    return r
+
+
+def get_world_size(group=None):
+    g = _get_group(group)
+    if g is _GLOBAL_GROUP:
+        from .env import get_world_size as _w
+        return _w()
+    return g.nranks

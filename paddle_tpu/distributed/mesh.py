@@ -1,0 +1,102 @@
+"""Device-mesh management: the TPU-native replacement for ring ids.
+
+Where the reference keys every communicator by an integer ``ring_id``
+(reference: paddle/fluid/platform/collective_helper.h:68 NCCLCommContext —
+ring_id → NCCLComm; rings built by c_comm_init ops), the TPU design names
+communication *axes* of one global ``jax.sharding.Mesh``. A "ring" becomes a
+mesh axis; a hybrid dp×mp×pp topology (reference: fleet/base/topology.py:111
+HybridCommunicateGroup) becomes a 3-axis mesh, and every collective rides the
+ICI links of its axis — XLA plans the routing, no ring bookkeeping.
+
+A process-global default mesh is kept here; ``init_parallel_env`` installs a
+1-D "dp" mesh over all visible devices, ``fleet.init`` with a hybrid strategy
+installs a multi-axis one.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_GLOBAL_MESH: list = [None]
+
+# canonical axis order for hybrid parallelism (reference topology order
+# fleet/base/topology.py hybrid_configs: dp, pp, sharding, mp — here:
+# dp outermost/DCN-most, then pp, then sp, then mp innermost/ICI-most so
+# tensor-parallel collectives ride the fastest links)
+HYBRID_AXES = ("dp", "pp", "sharding", "sp", "mp")
+
+
+def build_mesh(axes: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from an {axis_name: size} dict (order preserved).
+
+    ``axes=None`` gives a 1-D data-parallel mesh over all devices — the
+    equivalent of the reference's single global NCCL ring (ring_id 0).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if not axes:
+        axes = {"dp": len(devs)}
+    names = tuple(axes.keys())
+    sizes = tuple(int(s) for s in axes.values())
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        raise ValueError(
+            f"mesh {dict(axes)} needs {total} devices, have {len(devs)}")
+    return Mesh(np.array(devs).reshape(sizes), names)
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    _GLOBAL_MESH[0] = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH[0]
+
+
+def ensure_mesh() -> Mesh:
+    """Return the global mesh, creating the default 1-D dp mesh on first use."""
+    if _GLOBAL_MESH[0] is None:
+        _GLOBAL_MESH[0] = build_mesh()
+    return _GLOBAL_MESH[0]
+
+
+def mesh_axis_size(axis, mesh: Optional[Mesh] = None) -> int:
+    m = mesh or get_mesh()
+    if m is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        return int(np.prod([m.shape[a] for a in axis]))
+    return int(m.shape[axis])
+
+
+def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    return NamedSharding(mesh or ensure_mesh(), spec)
+
+
+def constrain(raw, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """Attach a sharding to a raw array: ``with_sharding_constraint`` under a
+    trace, ``device_put`` (a real reshard) in eager mode. This is the analog
+    of the reference inserting c_split/c_identity ops around TP blocks."""
+    sh = sharding_for(spec, mesh)
+    if isinstance(raw, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(raw, sh)
+    return jax.device_put(raw, sh)
+
+
+def shard_tensor(tensor, spec: PartitionSpec, mesh: Optional[Mesh] = None):
+    """Reshard a Tensor in place onto ``spec`` (eager) and remember the spec
+    so jitted paths can re-apply it."""
+    from ..core.tensor import Tensor
+    if isinstance(tensor, Tensor):
+        tensor._data = constrain(tensor._data, spec, mesh)
+        tensor._sharding_spec = spec
+        tensor.is_distributed = True
+        return tensor
+    return constrain(tensor, spec, mesh)
+
+
+def replicate_tensor(tensor, mesh: Optional[Mesh] = None):
+    return shard_tensor(tensor, PartitionSpec(), mesh)

@@ -1,0 +1,157 @@
+"""Data-parallel training.
+
+TPU-native equivalent of the reference's dygraph DataParallel + C++ Reducer
+(reference: python/paddle/fluid/dygraph/parallel.py:380 DataParallel,
+paddle/fluid/imperative/reducer.cc:289/:624/:798 — gradient bucketing with
+overlapped fused NCCL allreduce).
+
+Design: the Reducer exists because the reference runs one process per GPU and
+must merge replica gradients by hand, overlapping comm with the backward
+walk. On TPU the same math is expressed as SPMD sharding: the *global* batch
+is sharded over the mesh's "dp" axis, parameters are replicated, and XLA
+inserts the gradient all-reduce (and overlaps it with compute) when it
+partitions the backward pass. So:
+
+- forward: pin inputs to PartitionSpec("dp", ...) and parameters to
+  replicated — the entire Reducer machinery (buckets, comm streams, unused
+  -variable scan: reducer.cc:527 PrepareForBackward) has no residue.
+- ``loss.backward()`` then yields gradients that are already the global
+  (sum over shards) gradients of the global-mean loss == the reference's
+  allreduce-averaged replica gradients.
+- multi-process launches (one process per host) additionally broadcast the
+  initial parameters from rank 0 (reference: parallel.py sync_params_buffers)
+  and expose ``apply_collective_grads`` as the eager fallback path.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..ops.dispatch import apply
+from . import mesh as _mesh
+from . import collective as C
+from .env import ParallelEnv, init_parallel_env, get_rank, get_world_size
+
+
+def _dp_axis_size() -> int:
+    m = _mesh.get_mesh()
+    if m is None or "dp" not in m.axis_names:
+        return 1
+    return int(m.shape["dp"])
+
+
+def sync_params_buffers(model: Layer, comm_group=None, src_rank=0,
+                        is_model_parallel=False):
+    """reference: fluid/dygraph/parallel.py sync_params_buffers — broadcast
+    params+buffers from src so every replica starts identical."""
+    if jax.process_count() <= 1:
+        return
+    for _, p in model.named_parameters():
+        C.broadcast(p, src_rank, group=comm_group)
+    for _, b in model.named_buffers():
+        C.broadcast(b, src_rank, group=comm_group)
+
+
+class DataParallel(Layer):
+    """reference: fluid/dygraph/parallel.py:380.
+
+    ``comm_buffer_size``/``last_comm_buffer_size`` are accepted for API
+    parity; bucketing is XLA's job here. ``find_unused_parameters`` is
+    likewise moot: there is one global computation, so no replica can
+    disagree about which parameters were used (the hazard reducer.cc:860
+    ProcessUnusedDenseVars guards against)."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self._mesh = _mesh.ensure_mesh()
+        self.find_unused_parameters = find_unused_parameters
+        # replicate parameters/buffers across the mesh (BCastParamsToDevices,
+        # parallel_executor.cc:687) and sync across processes
+        for _, p in layers.named_parameters():
+            _mesh.replicate_tensor(p, self._mesh)
+        for _, b in layers.named_buffers():
+            _mesh.replicate_tensor(b, self._mesh)
+        sync_params_buffers(layers, comm_group=group)
+
+    def _shard_input(self, x):
+        if not isinstance(x, Tensor) or x.ndim == 0:
+            return x
+        n = _dp_axis_size()
+        if n <= 1 or x.shape[0] % n != 0:
+            return x
+        spec = P(*(("dp",) + (None,) * (x.ndim - 1)))
+        return apply("shard_batch",
+                     lambda r: _mesh.constrain(r, spec, self._mesh), x)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(x) for x in inputs)
+        kwargs = {k: self._shard_input(v) for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """reference: parallel.py:586 — identity in sync mode (the global
+        mean over the sharded batch already carries the 1/nranks)."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Eager multi-process fallback (reference: parallel.py:595): average
+        gradients across processes."""
+        if jax.process_count() <= 1:
+            return
+        for p in self._layers.parameters():
+            if p._grad is None:
+                continue
+            g = Tensor(p._grad)
+            C.all_reduce(g, op=C.ReduceOp.AVG, group=self._group)
+            p._grad = g._data
+
+    # delegate everything stateful to the wrapped layer
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    load_dict = set_state_dict
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+
+def shard_batch(tensor, mesh=None, axis="dp"):
+    """Pin a global-batch tensor onto the dp axis (helper for hand-written
+    training loops; DataParallel.forward does this automatically)."""
+    m = mesh or _mesh.ensure_mesh()
+    if axis not in m.axis_names:
+        return tensor
+    nd = tensor.ndim if isinstance(tensor, Tensor) else np.ndim(tensor)
+    spec = P(*((axis,) + (None,) * (nd - 1)))
+    return _mesh.shard_tensor(tensor, spec, m)
+
+
+def build_global_batch(local_np, mesh=None, axis="dp"):
+    """Multi-process: assemble each process's local batch into one global
+    sharded array (reference analog: each trainer feeds its own shard).
+    Single-process: just shard the given array."""
+    m = mesh or _mesh.ensure_mesh()
+    arr = np.asarray(local_np)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        spec = P(*((axis,) + (None,) * (arr.ndim - 1)))
+        global_arr = multihost_utils.host_local_array_to_global_array(
+            arr, m, spec)
+        return Tensor(global_arr)
+    return shard_batch(Tensor(arr), m, axis)

@@ -1,0 +1,9 @@
+"""paddle_tpu.models: flagship model families beyond paddle.vision.
+
+The reference ships its NLP models through PaddleNLP (ERNIE/BERT/GPT built on
+python/paddle/nn/layer/transformer.py); this package provides the same model
+families natively so BASELINE configs 3 and 5 (BERT finetune, GPT hybrid
+parallel) are expressible inside the framework.
+"""
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion  # noqa: F401
+from .bert import BertConfig, BertModel, BertForSequenceClassification  # noqa: F401

@@ -1,0 +1,102 @@
+"""BERT encoder family (BASELINE config 3: ERNIE-base / BERT-base finetune).
+
+Built on the framework transformer layers the same way the reference
+ecosystem does (reference: python/paddle/nn/layer/transformer.py:431
+TransformerEncoderLayer; ERNIE/BERT definitions live in PaddleNLP on top of
+them). Post-norm blocks, learned token/position/type embeddings, pooler.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import ops
+from ..nn.layer_base import Layer
+from ..nn import (Embedding, LayerNorm, Linear, Dropout, Tanh,
+                  TransformerEncoder, TransformerEncoderLayer)
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size, c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.expand(
+                ops.unsqueeze(ops.arange(0, seq_len, dtype="int32"), 0),
+                [input_ids.shape[0], seq_len])
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.dense = Linear(c.hidden_size, c.hidden_size)
+        self.activation = Tanh()
+
+    def forward(self, h):
+        return self.activation(self.dense(h[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.embeddings = BertEmbeddings(c)
+        layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation="gelu",
+            attn_dropout=c.attention_dropout_prob, normalize_before=False)
+        self.encoder = TransformerEncoder(layer, c.num_layers)
+        self.pooler = BertPooler(c)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None:
+            # [B, L] 1/0 -> additive [B, 1, 1, L]
+            m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - m.astype(h.dtype)) * -1e4
+        seq = self.encoder(h, src_mask=attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertForSequenceClassification(Layer):
+    """reference analog: PaddleNLP BertForSequenceClassification (GLUE)."""
+
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -1,0 +1,137 @@
+"""GPT: decoder-only causal language model — the flagship training workload
+(BASELINE config 5: "Fleet hybrid-parallel GPT-3 1.3B pp+dp").
+
+Built from the framework's own transformer layers (the reference builds GPT
+the same way on python/paddle/nn/layer/transformer.py MultiHeadAttention /
+TransformerEncoder; the 1.3B fleet example lives in the PaddleNLP repo, its
+parallel form in fleet/meta_parallel/parallel_layers/mp_layers.py).
+
+TPU notes:
+- pre-norm (normalize_before=True) transformer blocks, bf16-friendly.
+- the causal mask is a static additive mask folded into attention — XLA fuses
+  it; no dynamic masking code path.
+- `tp_partition_specs()` returns the tensor-parallel PartitionSpec plan for
+  every parameter (Megatron-style column/row split over the "mp" mesh axis:
+  reference mp_layers.py:96 ColumnParallelLinear / :169 RowParallelLinear /
+  :29 VocabParallelEmbedding) — consumed by fleet's planner and the
+  multi-chip dryrun.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .. import ops
+from ..nn.layer_base import Layer
+from ..nn import (Embedding, LayerNorm, Linear, Dropout, TransformerEncoder,
+                  TransformerEncoderLayer)
+from ..nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+
+    @property
+    def ffn_size(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+class GPTModel(Layer):
+    """Token + position embedding → pre-norm decoder stack → final norm."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        from ..nn.layer_base import ParamAttr
+        from ..nn import initializer as I
+        emb_attr = ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.word_embeddings = Embedding(c.vocab_size, c.hidden_size,
+                                         weight_attr=emb_attr)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size,
+                                             weight_attr=ParamAttr(
+                                                 initializer=I.Normal(0.0, 0.02)))
+        self.embedding_dropout = Dropout(c.hidden_dropout_prob)
+        layer = TransformerEncoderLayer(
+            c.hidden_size, c.num_heads, c.ffn_size,
+            dropout=c.hidden_dropout_prob, activation="gelu",
+            attn_dropout=c.attention_dropout_prob, normalize_before=True)
+        self.decoder = TransformerEncoder(layer, c.num_layers,
+                                          norm=LayerNorm(c.hidden_size))
+
+    def forward(self, input_ids, position_ids=None):
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(0, seq_len, dtype="int32")
+            position_ids = ops.expand(ops.unsqueeze(position_ids, 0),
+                                      [input_ids.shape[0], seq_len])
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids))
+        h = self.embedding_dropout(h)
+        # additive causal mask, broadcast over [B, H, L, L]
+        mask = ops.triu(ops.full([seq_len, seq_len], -1e4, h.dtype), 1)
+        mask = ops.unsqueeze(ops.unsqueeze(mask, 0), 0)
+        return self.decoder(h, src_mask=mask)
+
+
+class GPTForCausalLM(Layer):
+    """LM head tied to the word embedding (reference GPT convention)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        # logits = h @ E^T with the tied embedding matrix
+        return ops.matmul(h, self.gpt.word_embeddings.weight, transpose_y=True)
+
+
+class GPTPretrainingCriterion(Layer):
+    """Shifted next-token cross entropy."""
+
+    def forward(self, logits, labels):
+        v = logits.shape[-1]
+        flat = ops.reshape(logits[:, :-1, :], [-1, v])
+        tgt = ops.reshape(labels[:, 1:], [-1])
+        return F.cross_entropy(flat, tgt)
+
+
+# -- tensor-parallel plan -----------------------------------------------------
+
+_TP_RULES = (
+    # Megatron split: qkv + ffn-in are column-parallel (shard output dim),
+    # attn-out + ffn-out are row-parallel (shard input dim), embeddings are
+    # vocab/position-sharded on the table dim.
+    (r"\.(q_proj|k_proj|v_proj|linear1)\.weight$", (None, "mp")),
+    (r"\.(q_proj|k_proj|v_proj|linear1)\.bias$", ("mp",)),
+    (r"\.(out_proj|linear2)\.weight$", ("mp", None)),
+    (r"word_embeddings\.weight$", ("mp", None)),
+)
+
+
+def tp_partition_specs(model: Layer) -> Dict[str, tuple]:
+    """Per-parameter PartitionSpec axes (as tuples; () = replicated) for
+    tensor parallelism over the "mp" mesh axis."""
+    specs = {}
+    for name, p in model.named_parameters():
+        spec = ()
+        for pat, s in _TP_RULES:
+            if re.search(pat, name):
+                spec = s
+                break
+        specs[name] = spec
+    return specs

@@ -1,0 +1,241 @@
+"""Collective + DataParallel tests on the 8-virtual-device CPU mesh
+(conftest sets XLA_FLAGS=--xla_force_host_platform_device_count=8; SURVEY §4
+"multi-process-on-one-host" tests become multi-device single-process here).
+
+Numerics mirror the reference's collective tests
+(reference: python/paddle/fluid/tests/unittests/test_collective_base.py:212
+check_with_place — run a collective, compare against numpy).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+import paddle_tpu.distributed as dist
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    dist.set_mesh(dist.build_mesh({"dp": 8}))
+    yield
+    dist.set_mesh(None)
+
+
+def spmd(fn, in_specs, out_specs):
+    """Run fn under shard_map on the global mesh."""
+    return jax.shard_map(fn, mesh=dist.get_mesh(),
+                         in_specs=in_specs, out_specs=out_specs)
+
+
+class TestCollectives:
+    def test_all_reduce_sum(self):
+        x = np.arange(8.0, dtype=np.float32)
+        out = spmd(lambda v: dist.all_reduce(v), P("dp"), P("dp"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()))
+
+    def test_all_reduce_ops(self):
+        x = np.array([3, -1, 4, 1, -5, 9, 2, 6], np.float32)
+        for op, ref in [(dist.ReduceOp.MAX, x.max()),
+                        (dist.ReduceOp.MIN, x.min()),
+                        (dist.ReduceOp.AVG, x.mean())]:
+            out = spmd(lambda v, op=op: dist.all_reduce(v, op=op),
+                       P("dp"), P("dp"))(jnp.asarray(x))
+            np.testing.assert_allclose(np.asarray(out), np.full(8, ref),
+                                       rtol=1e-6)
+
+    def test_all_reduce_prod(self):
+        x = np.array([1, 2, -1, 1, 1, 3, 1, 1], np.float32)
+        out = spmd(lambda v: dist.all_reduce(v, op=dist.ReduceOp.PROD),
+                   P("dp"), P("dp"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, x.prod()),
+                                   rtol=1e-4)
+
+    def test_all_reduce_subgroup(self):
+        g = dist.new_group(ranks=[0, 1, 2, 3])
+        x = np.arange(8.0, dtype=np.float32)
+        out = spmd(lambda v: dist.all_reduce(v, group=g),
+                   P("dp"), P("dp"))(jnp.asarray(x))
+        expected = np.array([6, 6, 6, 6, 4, 5, 6, 7], np.float32)
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_broadcast(self):
+        x = np.arange(8.0, dtype=np.float32)
+        out = spmd(lambda v: dist.broadcast(v, src=3),
+                   P("dp"), P("dp"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 3.0))
+
+    def test_broadcast_subgroup(self):
+        g = dist.new_group(ranks=[4, 5, 6, 7])
+        x = np.arange(8.0, dtype=np.float32)
+        # src is the global rank of the group's 1st member
+        out = spmd(lambda v: dist.broadcast(v, src=4, group=g),
+                   P("dp"), P("dp"))(jnp.asarray(x))
+        expected = np.array([0, 1, 2, 3, 4, 4, 4, 4], np.float32)
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_reduce_to_dst(self):
+        x = np.arange(8.0, dtype=np.float32)
+        out = spmd(lambda v: dist.reduce(v, dst=2),
+                   P("dp"), P("dp"))(jnp.asarray(x))
+        expected = x.copy()
+        expected[2] = x.sum()
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_all_gather(self):
+        x = np.arange(16.0, dtype=np.float32).reshape(8, 2)
+
+        def fn(v):
+            return dist.all_gather(None, v)
+        out = spmd(fn, P("dp", None), P(None, "dp", None))(jnp.asarray(x))
+        # each rank gathers all 8 rows: [8, 1, 2] per rank
+        np.testing.assert_allclose(np.asarray(out)[:, 0, :], x)
+
+    def test_reduce_scatter(self):
+        x = np.tile(np.arange(8.0, dtype=np.float32), (8, 1))  # every rank same
+
+        def fn(v):
+            return dist.reduce_scatter(None, v)
+        out = spmd(fn, P("dp"), P("dp"))(jnp.asarray(x.reshape(64)))
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 8)
+
+    def test_alltoall(self):
+        # rank r holds row r: [r*8 .. r*8+7]; after alltoall rank r holds col r
+        x = np.arange(64.0, dtype=np.float32).reshape(64)
+
+        def fn(v):
+            return dist.alltoall(v)
+        out = spmd(fn, P("dp"), P("dp"))(jnp.asarray(x))
+        expected = np.arange(64.0).reshape(8, 8).T.reshape(64)
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_p2p_exchange(self):
+        x = np.arange(8.0, dtype=np.float32)
+        out = spmd(lambda v: dist.p2p_exchange(v, src=1, dst=5),
+                   P("dp"), P("dp"))(jnp.asarray(x))
+        expected = x.copy()
+        expected[5] = 1.0
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_all_reduce_grad(self):
+        # psum is differentiable: d/dx of sum-over-ranks distributes back
+        def loss(x):
+            def per(v):
+                return jax.lax.pmean(
+                    jnp.sum(dist.all_reduce(v) ** 2), "dp")
+            return spmd(per, P("dp"), P())(x)
+        x = jnp.arange(8.0)
+        g = jax.grad(loss)(x)
+        # all_reduce output = 28 on every rank; loss = 8 * 28^2 / 8 (pmean)
+        # dloss/dx_i = 2 * 28 * 8 / 8 ... verify against numeric grad
+        eps = 1e-3
+        num = np.zeros(8)
+        for i in range(8):
+            xp = np.arange(8.0); xp[i] += eps
+            xm = np.arange(8.0); xm[i] -= eps
+            num[i] = (float(loss(jnp.asarray(xp))) -
+                      float(loss(jnp.asarray(xm)))) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(g), num, rtol=1e-3)
+
+    def test_eager_world_of_one_identity(self):
+        t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = dist.all_reduce(t)
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+        dist.barrier()
+        dist.wait(t)
+
+    def test_get_rank_world_size(self):
+        assert dist.get_rank() == 0
+        assert dist.get_world_size() == 1
+        g = dist.new_group(ranks=[0, 1, 2])
+        assert dist.get_world_size(g) == 3
+
+
+class TestTopology:
+    def test_communicate_topology(self):
+        topo = dist.CommunicateTopology(["data", "pipe", "model"], [2, 2, 2])
+        assert topo.world_size() == 8
+        assert topo.get_rank(data=1, pipe=0, model=1) == 5
+        assert topo.get_coord(5) == (1, 0, 1)
+        assert topo.get_axis_list("data", 0) == [0, 1, 2, 3]
+        comm = topo.get_comm_list("model")
+        assert [0, 1] in comm and [6, 7] in comm and len(comm) == 4
+
+    def test_hybrid_communicate_group(self):
+        hcg = dist.HybridCommunicateGroup(dp_degree=2, mp_degree=4)
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 4
+        assert hcg.get_data_parallel_rank() == 0
+        m = dist.get_mesh()
+        assert m is not None and dict(m.shape) == {"dp": 2, "mp": 4}
+        # mp-axis psum reduces within each dp slice independently
+        x = np.arange(8.0, dtype=np.float32)
+        out = jax.shard_map(
+            lambda v: dist.all_reduce(v, group=hcg.get_model_parallel_group()),
+            mesh=m, in_specs=P(("dp", "mp")), out_specs=P(("dp", "mp")))(
+                jnp.asarray(x))
+        expected = np.array([6, 6, 6, 6, 22, 22, 22, 22], np.float32)
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+
+class TestDataParallel:
+    def _train(self, use_dp, steps=5):
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        if use_dp:
+            net = paddle.DataParallel(net)
+        opt = optim.Momentum(learning_rate=0.05, momentum=0.9,
+                             parameters=net.parameters())
+        rng = np.random.RandomState(3)
+        X = rng.randn(32, 16).astype(np.float32)
+        Y = rng.randn(32, 4).astype(np.float32)
+        losses = []
+        for _ in range(steps):
+            pred = net(paddle.to_tensor(X))
+            loss = paddle.mean((pred - paddle.to_tensor(Y)) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses, net
+
+    def test_dp_matches_single_device(self):
+        ref_losses, _ = self._train(use_dp=False)
+        dp_losses, dp_net = self._train(use_dp=True)
+        np.testing.assert_allclose(dp_losses, ref_losses, rtol=1e-5)
+        assert dp_losses[-1] < dp_losses[0]
+
+    def test_dp_input_actually_sharded(self):
+        net = paddle.DataParallel(nn.Linear(4, 2))
+        x = paddle.to_tensor(np.ones((16, 4), np.float32))
+        captured = {}
+        orig_forward = net._layers.forward
+
+        def probe(inp):
+            captured["sharding"] = inp._data.sharding
+            return orig_forward(inp)
+        net._layers.forward = probe
+        net(x)
+        spec = captured["sharding"].spec
+        assert spec[0] == "dp"
+
+    def test_dp_state_dict_roundtrip(self):
+        net = paddle.DataParallel(nn.Linear(4, 2))
+        sd = net.state_dict()
+        assert "weight" in sd and "bias" in sd
+        net.set_state_dict({k: v.numpy() * 0 for k, v in sd.items()})
+        np.testing.assert_allclose(net._layers.weight.numpy(), 0)
+
+    def test_scale_loss_identity(self):
+        net = paddle.DataParallel(nn.Linear(4, 2))
+        loss = paddle.to_tensor(np.float32(3.0))
+        assert float(net.scale_loss(loss).numpy()) == 3.0
+
+    def test_shard_batch_helper(self):
+        t = dist.shard_batch(paddle.to_tensor(np.ones((8, 3), np.float32)))
+        assert t._data.sharding.spec[0] == "dp"
+        g = paddle.mean(t * 2.0)
+        assert abs(float(g.numpy()) - 2.0) < 1e-6

@@ -1,0 +1,38 @@
+"""Pin the driver contracts: entry() compile-check + dryrun_multichip(8)
+(VERDICT round-1 item 2: these must exist and pass)."""
+import sys
+import numpy as np
+import jax
+import pytest
+
+
+sys.path.insert(0, "/root/repo")
+
+
+def test_entry_jittable():
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (4, 32, 256)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+    ge.dryrun_multichip(8)  # raises on failure
+
+
+def test_models_import():
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM, BertConfig,
+                                   BertModel)
+    from paddle_tpu.models.gpt import tp_partition_specs
+    m = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                                 num_heads=2, max_position_embeddings=16))
+    specs = tp_partition_specs(m)
+    # the Megatron plan must mark col/row splits
+    col = [k for k, v in specs.items() if v == (None, "mp")]
+    row = [k for k, v in specs.items() if v == ("mp", None)]
+    assert any("q_proj.weight" in k for k in col)
+    assert any("linear1.weight" in k for k in col)
+    assert any("out_proj.weight" in k for k in row)
+    assert any("word_embeddings.weight" in k for k in row)
