@@ -66,6 +66,8 @@ def is_grad_enabled_():
 def disable_signal_handler():  # API parity no-op (reference: platform/init.cc:363)
     return None
 from . import distributed  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import models  # noqa: E402,F401
 from .distributed import DataParallel  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import amp  # noqa: E402,F401

@@ -50,14 +50,17 @@ def _pool_nd(n, kind, x, kernel_size, stride, padding, ceil_mode,
     def impl(a):
         wd, ws, pd = window_dims(a)
         if kind == "max":
-            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
-            return lax.reduce_window(a, jnp.asarray(init, a.dtype), lax.max, wd, ws, pd)
-        s = lax.reduce_window(a, jnp.asarray(0.0, a.dtype), lax.add, wd, ws, pd)
+            # scalar init (not an array) keeps reduce_window on the monoid
+            # primitive, which is the reverse-differentiable path under jit
+            init = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                    else int(jnp.iinfo(a.dtype).min))
+            return lax.reduce_window(a, init, lax.max, wd, ws, pd)
+        s = lax.reduce_window(a, 0.0, lax.add, wd, ws, pd)
         all_zero = pads is not None and builtins.all(p == (0, 0) for p in pads)
         if count_include_pad or pd == "VALID" or all_zero:
             return s / np.prod(ks)
         ones = jnp.ones_like(a)
-        cnt = lax.reduce_window(ones, jnp.asarray(0.0, a.dtype), lax.add, wd, ws, pd)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, wd, ws, pd)
         return s / cnt
     return apply(f"pool{n}d_{kind}", impl, x)
 
@@ -153,9 +156,9 @@ def _adaptive_pool_nd(n, kind, x, output_size, channel_last=False):
                 wd[spatial_off + i] = ks[i]
                 st[spatial_off + i] = ks[i]
             if kind == "max":
-                return lax.reduce_window(a, jnp.asarray(-jnp.inf, a.dtype),
+                return lax.reduce_window(a, -jnp.inf,
                                          lax.max, tuple(wd), tuple(st), "VALID")
-            s = lax.reduce_window(a, jnp.asarray(0.0, a.dtype), lax.add,
+            s = lax.reduce_window(a, 0.0, lax.add,
                                   tuple(wd), tuple(st), "VALID")
             return s / np.prod(ks)
         # General case: gather per output bin along each dim.

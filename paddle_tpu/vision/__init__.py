@@ -1,0 +1,21 @@
+"""paddle.vision parity namespace (reference: python/paddle/vision/)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    VGG, vgg11, vgg13, vgg16, vgg19,
+    MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
+    mobilenet_v3_large, mobilenet_v3_small,
+)
+
+
+def set_image_backend(backend):
+    """reference: vision/image.py set_image_backend — numpy is the only
+    backend here (cv2/PIL both feed numpy arrays)."""
+    if backend not in ("pil", "cv2", "numpy", "tensor"):
+        raise ValueError(f"unknown image backend {backend}")
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,214 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — mnist.py,
+cifar.py, folder.py). Zero-egress environment: ``download=True`` raises with
+instructions instead of fetching; file parsing matches the reference formats
+(IDX for MNIST, pickled batches for CIFAR, class-dirs for DatasetFolder).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ...io import Dataset
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network egress); "
+        f"pass the local file path(s) explicitly")
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py MNIST (IDX ubyte files)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path is None or label_path is None:
+            _no_download(type(self).__name__)
+        self.images = self._parse_images(image_path)
+        self.labels = self._parse_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _parse_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad MNIST image magic {magic} in {path}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _parse_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad MNIST label magic {magic} in {path}")
+            return np.frombuffer(f.read(n), dtype=np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img[None].astype(np.float32) / 255.0
+        return img, np.array([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    """reference: vision/datasets/mnist.py FashionMNIST (same format)."""
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """reference: vision/datasets/cifar.py Cifar10 (python-pickle batches in
+    a tar.gz)."""
+
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data, self.labels = self._load(data_file)
+
+    def _label_key(self):
+        return b"labels"
+
+    def _load(self, data_file):
+        wanted = (self._train_members if self.mode == "train"
+                  else self._test_members)
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in wanted:
+                    batch = pickle.load(tf.extractfile(member),
+                                        encoding="bytes")
+                    images.append(batch[b"data"])
+                    labels.extend(batch[self._label_key()])
+        if not images:
+            raise ValueError(f"no {self.mode} batches found in {data_file}")
+        data = np.concatenate(images).reshape(-1, 3, 32, 32)
+        return data.transpose(0, 2, 3, 1), np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, np.array([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    """reference: vision/datasets/cifar.py Cifar100."""
+    _train_members = ["train"]
+    _test_members = ["test"]
+
+    def _label_key(self):
+        return b"fine_labels"
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        with open(path, "rb") as f:
+            return np.asarray(Image.open(f).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(
+            f"loading {path} needs PIL; save images as .npy arrays or "
+            f"provide a custom loader") from e
+
+
+class DatasetFolder(Dataset):
+    """reference: vision/datasets/folder.py DatasetFolder (class-per-dir)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise ValueError(f"no class directories found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            for dirpath, _, files in sorted(os.walk(os.path.join(root, c))):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file is not None
+                          else fname.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """reference: vision/datasets/folder.py ImageFolder (flat, no labels)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file is not None
+                      else fname.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,9 @@
+"""Model zoo (reference: python/paddle/vision/models/__init__.py)."""
+from .lenet import LeNet  # noqa: F401
+from .resnet import (ResNet, BasicBlock, BottleneckBlock,  # noqa: F401
+                     resnet18, resnet34, resnet50, resnet101, resnet152,
+                     wide_resnet50_2, wide_resnet101_2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (MobileNetV1, MobileNetV2, MobileNetV3Large,  # noqa: F401
+                        MobileNetV3Small, mobilenet_v1, mobilenet_v2,
+                        mobilenet_v3_large, mobilenet_v3_small)

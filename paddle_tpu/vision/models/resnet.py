@@ -1,0 +1,176 @@
+"""ResNet family (reference: python/paddle/vision/models/resnet.py — same
+depth table and block structure, NCHW layout).
+
+TPU notes: plain Conv2D+BatchNorm2D composition — XLA fuses conv+bn+relu;
+bf16 under amp.auto_cast hits the MXU at full tile width. No manual fusion.
+"""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn import (Conv2D, BatchNorm2D, ReLU, MaxPool2D, AdaptiveAvgPool2D,
+                   Linear, Sequential)
+from ... import ops
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64, dilation=1, norm_layer=None):
+        super().__init__()
+        norm_layer = norm_layer or BatchNorm2D
+        if groups != 1 or base_width != 64:
+            raise ValueError("BasicBlock only supports groups=1, base_width=64")
+        self.conv1 = Conv2D(inplanes, planes, 3, padding=1, stride=stride,
+                            bias_attr=False)
+        self.bn1 = norm_layer(planes)
+        self.relu = ReLU()
+        self.conv2 = Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.bn2 = norm_layer(planes)
+        self.downsample = downsample
+        self.stride = stride
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64, dilation=1, norm_layer=None):
+        super().__init__()
+        norm_layer = norm_layer or BatchNorm2D
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = norm_layer(width)
+        self.conv2 = Conv2D(width, width, 3, padding=dilation, stride=stride,
+                            groups=groups, dilation=dilation, bias_attr=False)
+        self.bn2 = norm_layer(width)
+        self.conv3 = Conv2D(width, planes * self.expansion, 1, bias_attr=False)
+        self.bn3 = norm_layer(planes * self.expansion)
+        self.relu = ReLU()
+        self.downsample = downsample
+        self.stride = stride
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(Layer):
+    """reference: vision/models/resnet.py ResNet (depth table :261)."""
+
+    _depth_cfg = {
+        18: (BasicBlock, [2, 2, 2, 2]),
+        34: (BasicBlock, [3, 4, 6, 3]),
+        50: (BottleneckBlock, [3, 4, 6, 3]),
+        101: (BottleneckBlock, [3, 4, 23, 3]),
+        152: (BottleneckBlock, [3, 8, 36, 3]),
+    }
+
+    def __init__(self, block=None, depth=50, width=64, num_classes=1000,
+                 with_pool=True, groups=1):
+        super().__init__()
+        if block is None:
+            block, layer_cfg = self._depth_cfg[depth]
+        else:
+            layer_cfg = self._depth_cfg[depth][1]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.groups = groups
+        self.base_width = width
+        self.inplanes = 64
+        self.dilation = 1
+
+        self.conv1 = Conv2D(3, self.inplanes, 7, stride=2, padding=3,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(self.inplanes)
+        self.relu = ReLU()
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, layer_cfg[0])
+        self.layer2 = self._make_layer(block, 128, layer_cfg[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, layer_cfg[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, layer_cfg[3], stride=2)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = Sequential(
+                Conv2D(self.inplanes, planes * block.expansion, 1,
+                       stride=stride, bias_attr=False),
+                BatchNorm2D(planes * block.expansion))
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        self.groups, self.base_width)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes,
+                                groups=self.groups, base_width=self.base_width))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.layer3(x)
+        x = self.layer4(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def _resnet(depth, pretrained=False, **kwargs):
+    model = ResNet(depth=depth, **kwargs)
+    if pretrained:
+        raise RuntimeError(
+            "pretrained weights require network access; load a local "
+            "state_dict with model.set_state_dict instead")
+    return model
+
+
+def resnet18(pretrained=False, **kwargs):
+    return _resnet(18, pretrained, **kwargs)
+
+
+def resnet34(pretrained=False, **kwargs):
+    return _resnet(34, pretrained, **kwargs)
+
+
+def resnet50(pretrained=False, **kwargs):
+    return _resnet(50, pretrained, **kwargs)
+
+
+def resnet101(pretrained=False, **kwargs):
+    return _resnet(101, pretrained, **kwargs)
+
+
+def resnet152(pretrained=False, **kwargs):
+    return _resnet(152, pretrained, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    kwargs["width"] = 128
+    return _resnet(50, pretrained, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    kwargs["width"] = 128
+    return _resnet(101, pretrained, **kwargs)

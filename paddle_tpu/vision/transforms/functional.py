@@ -1,0 +1,203 @@
+"""Functional image transforms on numpy HWC arrays (PIL accepted when
+installed). reference: python/paddle/vision/transforms/functional.py (+ the
+cv2/pil backend split there — here the single backend is numpy/jax, which
+keeps the data pipeline dependency-free and feeds device transfer directly).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def _to_numpy(img):
+    if isinstance(img, np.ndarray):
+        return img
+    # PIL.Image duck-type
+    if hasattr(img, "convert") and hasattr(img, "size"):
+        return np.asarray(img)
+    from ...core.tensor import Tensor
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """uint8 HWC [0,255] -> float32 CHW [0,1] (reference: functional.py
+    to_tensor — uint8 input is always rescaled, float input passes through)."""
+    arr = _to_numpy(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    was_uint8 = arr.dtype == np.uint8
+    arr = arr.astype(np.float32)
+    if was_uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    from ...core.tensor import Tensor
+    return Tensor(np.ascontiguousarray(arr))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = _to_numpy(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def _interp_resize(arr, h, w):
+    """Bilinear resize via jax.image (maps to XLA gather/dot — fast enough
+    for host-side preprocessing, exact for tests)."""
+    import jax
+    import jax.numpy as jnp
+    src = jnp.asarray(arr.astype(np.float32))
+    out = jax.image.resize(src, (h, w) + arr.shape[2:], method="bilinear")
+    res = np.asarray(out)
+    if arr.dtype == np.uint8:
+        res = np.clip(np.round(res), 0, 255).astype(np.uint8)
+    return res
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h <= w:
+            nh, nw = size, int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), size
+    else:
+        nh, nw = size
+    return _interp_resize(arr, nh, nw)
+
+
+def crop(img, top, left, height, width):
+    arr = _to_numpy(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1]
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_numpy(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)  # l, t, r, b
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    pads = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, pads, mode=mode, **kw)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotation by inverse-mapping with nearest sampling; ``expand=True``
+    grows the canvas to hold the whole rotated image (reference:
+    functional.py rotate)."""
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        # output canvas = bounding box of the rotated input rectangle
+        oh = int(np.ceil(abs(h * cos) + abs(w * sin) - 1e-9))
+        ow = int(np.ceil(abs(w * cos) + abs(h * sin) - 1e-9))
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow, ocy, ocx = h, w, cy, cx
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    ys = (yy - ocy) * cos - (xx - ocx) * sin + cy
+    xs = (yy - ocy) * sin + (xx - ocx) * cos + cx
+    yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+    out = arr[yi, xi]
+    invalid = (ys < 0) | (ys > h - 1) | (xs < 0) | (xs > w - 1)
+    out[invalid] = fill
+    return out
+
+
+def adjust_brightness(img, factor):
+    arr = _to_numpy(img).astype(np.float32) * factor
+    return _clip_like(arr, img)
+
+
+def adjust_contrast(img, factor):
+    arr = _to_numpy(img).astype(np.float32)
+    mean = arr.mean()
+    return _clip_like(mean + factor * (arr - mean), img)
+
+
+def adjust_saturation(img, factor):
+    arr = _to_numpy(img).astype(np.float32)
+    gray = arr.mean(axis=-1, keepdims=True)
+    return _clip_like(gray + factor * (arr - gray), img)
+
+
+def adjust_hue(img, factor):
+    """factor in [-0.5, 0.5]: rotate hue channel in HSV space."""
+    arr = _to_numpy(img)
+    scale = 255.0 if arr.dtype == np.uint8 else 1.0
+    x = arr.astype(np.float32) / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b)[m] / diff[m]) % 6
+    m = mx == g
+    h[m] = (b - r)[m] / diff[m] + 2
+    m = mx == b
+    h[m] = (r - g)[m] / diff[m] + 4
+    h = (h / 6.0 + factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    out = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q])], axis=-1)
+    return _clip_like(out * scale, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_numpy(img).astype(np.float32)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2])
+    gray = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _clip_like(gray, img)
+
+
+def _clip_like(arr, ref):
+    ref_arr = _to_numpy(ref)
+    if ref_arr.dtype == np.uint8:
+        return np.clip(np.round(arr), 0, 255).astype(np.uint8)
+    return arr.astype(ref_arr.dtype)

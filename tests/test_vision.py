@@ -1,0 +1,246 @@
+"""Vision package tests: model forward/backward shapes, torch parity for
+ResNet-50 architecture (param count), transforms numerics, dataset parsing
+(reference test analogs: python/paddle/tests/test_vision_models.py,
+test_transforms.py, test_datasets.py)."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+from paddle_tpu.vision import models, transforms, datasets
+from paddle_tpu.vision.transforms import functional as TF
+
+
+def _n_params(model):
+    return sum(int(np.prod(p.shape)) for p in model.parameters())
+
+
+class TestModels:
+    def test_lenet_forward_backward(self):
+        m = models.LeNet()
+        x = paddle.to_tensor(np.random.rand(2, 1, 28, 28).astype(np.float32))
+        out = m(x)
+        assert out.shape == [2, 10]
+        loss = paddle.mean(out ** 2)
+        loss.backward()
+        assert m.features[0].weight.grad is not None
+
+    def test_resnet18_forward(self):
+        m = models.resnet18(num_classes=7)
+        m.eval()
+        x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype(np.float32))
+        assert m(x).shape == [2, 7]
+
+    def test_resnet50_param_count_matches_torchvision(self):
+        # canonical ResNet-50 ImageNet param count
+        m = models.resnet50()
+        assert _n_params(m) == 25_557_032
+
+    def test_resnet50_forward_backward(self):
+        m = models.resnet50(num_classes=10)
+        x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype(np.float32))
+        out = m(x)
+        assert out.shape == [2, 10]
+        loss = paddle.mean(out ** 2)
+        loss.backward()
+        assert m.conv1.weight.grad is not None
+
+    def test_vgg11_forward(self):
+        m = models.vgg11(num_classes=5)
+        m.eval()
+        x = paddle.to_tensor(np.random.rand(1, 3, 224, 224).astype(np.float32))
+        assert m(x).shape == [1, 5]
+
+    def test_mobilenet_v1_v2_forward(self):
+        for ctor in (models.mobilenet_v1, models.mobilenet_v2):
+            m = ctor(num_classes=4)
+            m.eval()
+            x = paddle.to_tensor(
+                np.random.rand(1, 3, 96, 96).astype(np.float32))
+            assert m(x).shape == [1, 4]
+
+    def test_mobilenet_v3_forward(self):
+        m = models.mobilenet_v3_small(num_classes=4)
+        m.eval()
+        x = paddle.to_tensor(np.random.rand(1, 3, 96, 96).astype(np.float32))
+        assert m(x).shape == [1, 4]
+
+    def test_resnet18_short_convergence(self):
+        paddle.seed(1)
+        m = models.resnet18(num_classes=4)
+        opt = optim.Momentum(learning_rate=0.01, momentum=0.9,
+                             parameters=m.parameters())
+        rng = np.random.RandomState(0)
+        X = rng.rand(8, 3, 32, 32).astype(np.float32)
+        Y = rng.randint(0, 4, (8,)).astype(np.int64)
+        ce = paddle.nn.CrossEntropyLoss()
+        losses = []
+        for _ in range(10):
+            loss = ce(m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestTransforms:
+    def test_to_tensor_normalize(self):
+        img = (np.random.rand(8, 6, 3) * 255).astype(np.uint8)
+        t = TF.to_tensor(img)
+        assert t.shape == [3, 8, 6]
+        assert float(paddle.max(t).numpy()) <= 1.0
+        n = TF.normalize(np.transpose(img, (2, 0, 1)).astype(np.float32),
+                         mean=[127.5] * 3, std=[127.5] * 3)
+        np.testing.assert_allclose(
+            n, (np.transpose(img, (2, 0, 1)) - 127.5) / 127.5, rtol=1e-6)
+
+    def test_resize(self):
+        img = (np.random.rand(16, 8, 3) * 255).astype(np.uint8)
+        out = TF.resize(img, (4, 4))
+        assert out.shape == (4, 4, 3)
+        out2 = TF.resize(img, 8)  # short side to 8
+        assert out2.shape == (16, 8, 3)
+
+    def test_crops_flips(self):
+        img = np.arange(5 * 4 * 3, dtype=np.uint8).reshape(5, 4, 3)
+        assert TF.center_crop(img, 2).shape == (2, 2, 3)
+        np.testing.assert_array_equal(TF.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(TF.vflip(img), img[::-1])
+        assert TF.crop(img, 1, 1, 3, 2).shape == (3, 2, 3)
+
+    def test_pad(self):
+        img = np.ones((2, 2, 3), np.uint8)
+        out = TF.pad(img, 1)
+        assert out.shape == (4, 4, 3)
+        assert out[0, 0, 0] == 0
+
+    def test_adjusts(self):
+        img = (np.random.rand(4, 4, 3) * 255).astype(np.uint8)
+        assert TF.adjust_brightness(img, 1.0).dtype == np.uint8
+        np.testing.assert_array_equal(TF.adjust_brightness(img, 1.0), img)
+        np.testing.assert_array_equal(TF.adjust_contrast(img, 1.0), img)
+        np.testing.assert_allclose(TF.adjust_hue(img, 0.0).astype(int), img,
+                                   atol=2)
+        gray = TF.to_grayscale(img, 3)
+        assert gray.shape == img.shape
+        assert np.all(gray[..., 0] == gray[..., 1])
+
+    def test_compose_pipeline(self):
+        tf = transforms.Compose([
+            transforms.Resize(10),
+            transforms.RandomCrop(8),
+            transforms.RandomHorizontalFlip(0.5),
+            transforms.ColorJitter(0.1, 0.1, 0.1, 0.1),
+            transforms.ToTensor(),
+            transforms.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        img = (np.random.rand(12, 12, 3) * 255).astype(np.uint8)
+        out = tf(img)
+        assert out.shape == (3, 8, 8)
+
+    def test_random_erasing(self):
+        img = np.ones((10, 10, 3), np.uint8) * 7
+        out = transforms.RandomErasing(prob=1.0)(img)
+        assert (out == 0).any()
+
+
+def _write_mnist(tmp_path, n=20):
+    imgs = (np.random.rand(n, 28, 28) * 255).astype(np.uint8)
+    labels = np.random.randint(0, 10, n).astype(np.uint8)
+    ip = os.path.join(tmp_path, "imgs.gz")
+    lp = os.path.join(tmp_path, "labels.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return ip, lp, imgs, labels
+
+
+class TestDatasets:
+    def test_mnist(self, tmp_path):
+        ip, lp, imgs, labels = _write_mnist(str(tmp_path))
+        ds = datasets.MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 20
+        x, y = ds[3]
+        assert x.shape == (1, 28, 28)
+        assert int(y[0]) == labels[3]
+        np.testing.assert_allclose(x[0], imgs[3] / 255.0, rtol=1e-6)
+
+    def test_mnist_with_dataloader(self, tmp_path):
+        ip, lp, _, _ = _write_mnist(str(tmp_path))
+        ds = datasets.MNIST(image_path=ip, label_path=lp)
+        loader = paddle.io.DataLoader(ds, batch_size=8, shuffle=True)
+        xb, yb = next(iter(loader))
+        assert list(xb.shape) == [8, 1, 28, 28]
+
+    def test_cifar10(self, tmp_path):
+        data = (np.random.rand(10, 3072) * 255).astype(np.uint8)
+        labels = list(range(10))
+        path = os.path.join(str(tmp_path), "cifar-10.tar.gz")
+        batch_file = os.path.join(str(tmp_path), "data_batch_1")
+        with open(batch_file, "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+        test_file = os.path.join(str(tmp_path), "test_batch")
+        with open(test_file, "wb") as f:
+            pickle.dump({b"data": data[:4], b"labels": labels[:4]}, f)
+        with tarfile.open(path, "w:gz") as tf:
+            tf.add(batch_file, arcname="cifar-10-batches-py/data_batch_1")
+            tf.add(test_file, arcname="cifar-10-batches-py/test_batch")
+        ds = datasets.Cifar10(data_file=path, mode="train")
+        assert len(ds) == 10
+        x, y = ds[0]
+        assert x.shape == (3, 32, 32)
+        ds_test = datasets.Cifar10(data_file=path, mode="test")
+        assert len(ds_test) == 4
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = os.path.join(str(tmp_path), cls)
+            os.makedirs(d)
+            for i in range(3):
+                np.save(os.path.join(d, f"{i}.npy"),
+                        (np.random.rand(8, 8, 3) * 255).astype(np.uint8))
+        ds = datasets.DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        x, y = ds[0]
+        assert y == 0
+        flat = datasets.ImageFolder(str(tmp_path))
+        assert len(flat) == 6
+
+    def test_download_raises(self):
+        with pytest.raises(RuntimeError, match="download"):
+            datasets.MNIST()
+
+
+class TestReviewRegressions:
+    def test_random_crop_pad_if_needed_width(self):
+        img = np.ones((32, 20, 3), np.uint8)
+        out = transforms.RandomCrop(32, pad_if_needed=True)(img)
+        assert out.shape == (32, 32, 3)
+
+    def test_rotate_expand(self):
+        img = np.ones((10, 20, 3), np.uint8) * 255
+        out = TF.rotate(img, 45, expand=True)
+        assert out.shape[0] > 10 and out.shape[1] > 20
+        # area preserved up to half-pixel boundary losses (nearest sampling)
+        assert (out > 0).sum() >= (img > 0).sum() * 0.85
+        # 90 degrees swaps the canvas dims exactly
+        out90 = TF.rotate(img, 90, expand=True)
+        assert out90.shape == (20, 10, 3)
+
+    def test_to_tensor_dark_uint8(self):
+        img = np.full((2, 2, 3), 1, np.uint8)
+        t = TF.to_tensor(img)
+        np.testing.assert_allclose(np.asarray(t._data), 1 / 255.0, rtol=1e-5)
+        f = np.full((2, 2, 3), 0.5, np.float32)
+        np.testing.assert_allclose(np.asarray(TF.to_tensor(f)._data), 0.5)
