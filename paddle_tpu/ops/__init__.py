@@ -16,6 +16,9 @@ from .dispatch import (apply, apply_raw, OP_REGISTRY, in_dygraph_mode,
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
+from .control_flow import (cond, while_loop, case, switch_case,  # noqa: F401
+                           increment, create_array, array_write, array_read,
+                           array_length)
 
 
 def _attach_methods():

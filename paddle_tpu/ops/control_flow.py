@@ -1,0 +1,363 @@
+"""Control flow ops: cond / while_loop / case / switch_case.
+
+TPU-native equivalent of the reference's sub-block control flow
+(reference: paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc — ops that re-entrantly run sub-Blocks through the Executor;
+Python surface python/paddle/fluid/layers/control_flow.py cond :2334,
+while_loop :1076, case :2788, switch_case :3099).
+
+Three execution contexts:
+1. **Eager with concrete predicate**: plain Python dispatch — the branch taken
+   is tape-recorded, so autograd works exactly like any eager code.
+2. **Traced (to_static / inside jit)**: predicates are tracers; lowers to
+   ``lax.cond`` / ``lax.while_loop`` over the flattened raw leaves. cond is
+   reverse-differentiable; while_loop is forward-only under reverse-mode AD
+   (XLA's model) — loops that need training gradients should be expressed
+   with lax.scan-style RNN layers or run in eager mode.
+3. **Static Program**: the branch builders are traced into sub-Programs
+   (the analog of the reference's sub-Blocks) and recorded as ONE composite
+   op whose implementation replays the sub-Programs under lax.cond /
+   lax.while_loop; external variables/parameters become the op's inputs.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.tree_util import tree_flatten, tree_unflatten
+
+from ..core.tensor import Tensor
+from .dispatch import apply, in_dygraph_mode
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "increment",
+           "array_write", "array_read", "array_length", "create_array"]
+
+
+def _is_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _flatten_out(out):
+    leaves, td = tree_flatten(out, is_leaf=_is_leaf)
+    raws = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+            for l in leaves]
+    return raws, td
+
+
+def _is_tracer(x):
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _static_var(x):
+    from ..static.graph import Variable
+    return isinstance(x, Variable)
+
+
+# -- cond ---------------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference: fluid/layers/control_flow.py:2334 cond."""
+    if _static_var(pred):
+        return _static_cond(pred, true_fn, false_fn)
+    raw = pred._data if isinstance(pred, Tensor) else pred
+    if not isinstance(raw, jax.core.Tracer):
+        take_true = bool(np.asarray(raw))
+        if take_true:
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+    # traced: run both branches under lax.cond
+    meta = {}
+
+    def t_branch(_):
+        raws, td = _flatten_out(true_fn())
+        meta["td"] = td
+        return tuple(raws)
+
+    def f_branch(_):
+        raws, td = _flatten_out(false_fn())
+        meta.setdefault("td", td)
+        return tuple(raws)
+
+    out_raws = lax.cond(raw.astype(bool).reshape(()), t_branch, f_branch, 0)
+    outs = [Tensor(r) for r in out_raws]
+    return tree_unflatten(meta["td"], outs)
+
+
+def _sub_capture(fn, args=()):
+    """Trace a branch builder into a fresh sub-Program (the reference's
+    sub-Block: conditional_block_op.cc)."""
+    from ..static.graph import Program, program_guard, Variable
+    sub = Program()
+    with program_guard(sub):
+        out = fn(*args)
+    leaves, td = tree_flatten(out, is_leaf=lambda x: isinstance(x, (Tensor,)))
+    return sub, leaves, td
+
+
+def _external_leaves(sub) -> List[Any]:
+    """Variables from the outer program + parameter Tensors used by sub."""
+    from ..static.graph import Variable
+    seen, ext = set(), []
+    for op in sub.ops:
+        for leaf in op.arg_leaves:
+            if isinstance(leaf, Variable) and leaf._program is not sub:
+                if id(leaf) not in seen:
+                    seen.add(id(leaf))
+                    ext.append(leaf)
+            elif isinstance(leaf, Tensor):
+                if id(leaf) not in seen:
+                    seen.add(id(leaf))
+                    ext.append(leaf)
+    return ext
+
+
+def _replay_sub(sub, ext, ext_raws, extra_env=None, extra_penv=None):
+    from ..static.graph import Variable
+    from ..static.executor import _replay
+    env, penv = dict(extra_env or {}), dict(extra_penv or {})
+    for leaf, rawv in zip(ext, ext_raws):
+        if isinstance(leaf, Variable):
+            env[id(leaf)] = rawv
+        else:
+            penv[id(leaf)] = rawv
+    _replay(sub, env, penv)
+    return env, penv
+
+
+def _out_raws(env, penv, leaves):
+    from ..static.graph import Variable
+    out = []
+    for l in leaves:
+        if isinstance(l, Variable):
+            out.append(env[id(l)])
+        elif isinstance(l, Tensor):
+            out.append(penv.get(id(l), l._data))
+        else:
+            out.append(l)
+    return out
+
+
+def _outer_out_leaves(sub, leaves):
+    """Output leaves that are passthrough captures (outer Variables / param
+    Tensors returned unchanged) — they must be bound as inputs too."""
+    from ..static.graph import Variable
+    outer = []
+    for l in leaves:
+        if isinstance(l, Variable) and l._program is not sub:
+            outer.append(l)
+        elif isinstance(l, Tensor):
+            outer.append(l)
+    return outer
+
+
+def _static_cond(pred, true_fn, false_fn):
+    sub_t, out_t, td_t = _sub_capture(true_fn)
+    sub_f, out_f, td_f = _sub_capture(false_fn)
+    ext = []
+    seen = set()
+    for e in (_external_leaves(sub_t) + _external_leaves(sub_f)
+              + _outer_out_leaves(sub_t, out_t)
+              + _outer_out_leaves(sub_f, out_f)):
+        if id(e) not in seen:
+            seen.add(id(e))
+            ext.append(e)
+
+    def composite(pred_raw, *ext_raws):
+        def tb(_):
+            env, penv = _replay_sub(sub_t, ext, ext_raws)
+            return tuple(_out_raws(env, penv, out_t))
+
+        def fb(_):
+            env, penv = _replay_sub(sub_f, ext, ext_raws)
+            return tuple(_out_raws(env, penv, out_f))
+        return lax.cond(pred_raw.astype(bool).reshape(()), tb, fb, 0)
+
+    res = apply("cond", composite, pred, *ext)
+    leaves = list(res) if isinstance(res, (list, tuple)) else [res]
+    return tree_unflatten(td_t, leaves)
+
+
+# -- while_loop ---------------------------------------------------------------
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """reference: fluid/layers/control_flow.py:1076 while_loop."""
+    loop_vars = list(loop_vars)
+    if any(_static_var(v) for v in tree_flatten(
+            loop_vars, is_leaf=_is_leaf)[0]):
+        return _static_while(cond_fn, body_fn, loop_vars)
+
+    leaves, td = tree_flatten(loop_vars, is_leaf=_is_leaf)
+    if not any(_is_tracer(l) for l in leaves):
+        # eager: a real Python loop, fully tape-recorded
+        state = loop_vars
+        while bool(np.asarray(_as_scalar(cond_fn(*state)))):
+            out = body_fn(*state)
+            state = list(out) if isinstance(out, (list, tuple)) else [out]
+        return state
+    # traced: lax.while_loop over raw leaves
+    raws = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+            for l in leaves]
+
+    def wrap(raw_state):
+        ts = [Tensor(r) for r in raw_state]
+        return tree_unflatten(td, ts)
+
+    def c(raw_state):
+        out = cond_fn(*wrap(raw_state))
+        return _as_raw_scalar(out)
+
+    def b(raw_state):
+        out = body_fn(*wrap(raw_state))
+        out_list = list(out) if isinstance(out, (list, tuple)) else [out]
+        new_leaves, _ = tree_flatten(out_list, is_leaf=_is_leaf)
+        return tuple(l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                     for l in new_leaves)
+
+    final = lax.while_loop(c, b, tuple(raws))
+    return tree_unflatten(td, [Tensor(r) for r in final])
+
+
+def _as_scalar(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _as_raw_scalar(x):
+    r = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return r.astype(bool).reshape(())
+
+
+def _static_while(cond_fn, body_fn, loop_vars):
+    from ..static.graph import Variable
+    lv_leaves, td = tree_flatten(loop_vars, is_leaf=_is_leaf)
+    sub_c, out_c, _ = _sub_capture(cond_fn, loop_vars)
+    sub_b, out_b, td_b = _sub_capture(body_fn, loop_vars)
+    lv_ids = {id(l) for l in lv_leaves}
+    ext = []
+    seen = set()
+    for e in (_external_leaves(sub_c) + _external_leaves(sub_b)
+              + _outer_out_leaves(sub_c, out_c)
+              + _outer_out_leaves(sub_b, out_b)):
+        if id(e) not in seen and id(e) not in lv_ids:
+            seen.add(id(e))
+            ext.append(e)
+
+    def composite(*all_raws):
+        n = len(lv_leaves)
+        lv_raws, ext_raws = all_raws[:n], all_raws[n:]
+
+        def lv_envs(state):
+            # loop vars may be graph Variables or concrete Tensors (a
+            # counter mixed with an eager accumulator) — bind each in the
+            # environment _replay resolves it from
+            env = {id(v): r for v, r in zip(lv_leaves, state)
+                   if isinstance(v, Variable)}
+            penv = {id(v): r for v, r in zip(lv_leaves, state)
+                    if isinstance(v, Tensor)}
+            return env, penv
+
+        def c(state):
+            e0, p0 = lv_envs(state)
+            env, penv = _replay_sub(sub_c, ext, ext_raws, e0, p0)
+            return _out_raws(env, penv, out_c)[0].astype(bool).reshape(())
+
+        def b(state):
+            e0, p0 = lv_envs(state)
+            env, penv = _replay_sub(sub_b, ext, ext_raws, e0, p0)
+            outs = _out_raws(env, penv, out_b)
+            return tuple(o.astype(s.dtype) if hasattr(o, "astype") else o
+                         for o, s in zip(outs, state))
+
+        return lax.while_loop(c, b, tuple(lv_raws))
+
+    res = apply("while_loop", composite, *(lv_leaves + ext))
+    leaves = list(res) if isinstance(res, (list, tuple)) else [res]
+    return tree_unflatten(td_b, leaves)
+
+
+# -- case / switch_case -------------------------------------------------------
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: control_flow.py:2788 — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: control_flow.py:3099."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = list(enumerate(branch_fns)) if not isinstance(
+            branch_fns[0], (tuple, list)) else [tuple(p) for p in branch_fns]
+    idx_raw = branch_index._data if isinstance(branch_index, Tensor) \
+        else branch_index
+    if not isinstance(idx_raw, jax.core.Tracer) and not _static_var(
+            branch_index):
+        i = int(np.asarray(idx_raw))
+        for k, fn in pairs:
+            if k == i:
+                return fn()
+        if default is not None:
+            return default()
+        return pairs[-1][1]()  # reference: last branch is the fallback
+    # traced: nest conds
+    def build(remaining):
+        (k, fn) = remaining[0]
+        if len(remaining) == 1:
+            if default is not None:
+                return cond(branch_index == k, fn, default)
+            return fn()
+        return cond(branch_index == k, fn, lambda: build(remaining[1:]))
+    return build(pairs)
+
+
+# -- tensor-array helpers (reference: controlflow/write_to_array etc.) -------
+
+def create_array(dtype="float32", initialized_list=None):
+    """reference: fluid/layers/control_flow.py create_array — a Python list
+    plays the LoDTensorArray role (static shapes make a real tensor-array op
+    unnecessary on XLA; loops that build arrays should use lax.scan RNNs)."""
+    return list(initialized_list or [])
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = int(np.asarray(_as_scalar(i)))
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(np.asarray(_as_scalar(i)))]
+
+
+def array_length(array):
+    from . import creation
+    return creation.to_tensor(np.int64(len(array)))
+
+
+def increment(x, value=1.0):
+    """reference: operators/increment_op — in-place add on a 1-element
+    tensor."""
+    from .dispatch import apply as _apply
+    out = _apply("increment", lambda a: a + np.asarray(value, a.dtype), x)
+    if isinstance(x, Tensor):
+        x._swap_payload(out)
+        return x
+    return out

@@ -138,6 +138,14 @@ def name_scope(prefix=None):
 # paddle.static.nn — each call creates fresh parameters, like the reference's
 # LayerHelper.create_parameter per call site)
 class nn:
+    # control flow (reference: fluid/layers/control_flow.py cond/While)
+    from ..ops.control_flow import (cond, while_loop, case,  # noqa: F401
+                                    switch_case)
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
+
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):
         from ..nn import functional as F

@@ -295,7 +295,9 @@ def _attach_variable_methods():
                 "__or__", "__xor__")):
             continue
         val = _T.__dict__.get(attr)
-        if callable(val) and not hasattr(Variable, attr):
+        # check Variable.__dict__, not hasattr: object supplies default rich
+        # comparisons (__eq__/__gt__/...) which must be overridden here
+        if callable(val) and attr not in Variable.__dict__:
             setattr(Variable, attr, val)
 
 

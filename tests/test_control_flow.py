@@ -1,0 +1,235 @@
+"""Control flow tests across all three modes (reference analogs:
+unittests/test_cond.py, test_while_loop_op.py, test_case.py,
+test_switch_case.py; dygraph_to_static/test_ifelse.py, test_loop.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu import ops
+from paddle_tpu.jit import to_static, InputSpec
+
+
+class TestEagerCond:
+    def test_concrete_pred_dispatch(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        out = ops.cond(paddle.mean(x) > 1.0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [4.0])
+        out = ops.cond(paddle.mean(x) > 3.0, lambda: x * 2, lambda: x - 1)
+        np.testing.assert_allclose(out.numpy(), [1.0])
+
+    def test_grad_through_taken_branch(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        out = ops.cond(x.sum() > 0, lambda: x * x, lambda: x)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_case_and_switch_case(self):
+        x = paddle.to_tensor(np.float32(5.0))
+        out = ops.case([(x > 10.0, lambda: x * 0),
+                        (x > 3.0, lambda: x * 2)],
+                       default=lambda: x)
+        assert float(out.numpy()) == 10.0
+        idx = paddle.to_tensor(np.int32(1))
+        out = ops.switch_case(idx, {0: lambda: x + 1, 1: lambda: x + 2},
+                              default=lambda: x)
+        assert float(out.numpy()) == 7.0
+
+
+class TestEagerWhile:
+    def test_while_accumulate(self):
+        i = paddle.to_tensor(np.float32(0.0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i2, s2 = ops.while_loop(lambda i, s: i < 5.0,
+                                lambda i, s: [i + 1.0, s + i],
+                                [i, s])
+        assert float(i2.numpy()) == 5.0
+        assert float(s2.numpy()) == 10.0  # 0+1+2+3+4
+
+    def test_while_grad_through_tape(self):
+        w = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+        x = paddle.to_tensor(np.float32(1.0))
+        cnt = paddle.to_tensor(np.float32(0.0))
+
+        def body(c, v):
+            return [c + 1.0, v * w]
+
+        _, y = ops.while_loop(lambda c, v: c < 3.0, body, [cnt, x])
+        y.backward()  # y = w^3, dy/dw = 3 w^2
+        np.testing.assert_allclose(float(w.grad.numpy()), 3 * 1.5 ** 2,
+                                   rtol=1e-5)
+
+
+class TestToStaticControlFlow:
+    def test_cond_in_to_static(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return ops.cond(paddle.mean(h) > 0,
+                                lambda: h * 2.0, lambda: -h)
+
+        net = Net()
+        st = to_static(Net())
+        st.set_state_dict(net.state_dict())
+        for scale in (3.0, -3.0):
+            x = paddle.to_tensor(
+                np.full((2, 4), scale, np.float32))
+            eager = net(x).numpy()
+            static = st(x).numpy()
+            np.testing.assert_allclose(static, eager, atol=1e-5)
+
+    def test_while_in_to_static(self):
+        def fn(x):
+            i = paddle.to_tensor(np.float32(0.0))
+
+            def body(i, v):
+                return [i + 1.0, v * 2.0]
+            _, out = ops.while_loop(lambda i, v: i < 4.0, body, [i, x])
+            return out
+
+        st = to_static(fn)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(st(x).numpy(), [16.0, 32.0])
+
+    def test_cond_train_step(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 1)
+
+            def forward(self, x):
+                h = self.fc(x)
+                return ops.cond(paddle.mean(h) > 100.0,
+                                lambda: h * 0.0, lambda: h)
+
+        net = to_static(Net())
+        opt = optim.SGD(learning_rate=0.1,
+                        parameters=net.parameters())
+        X = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        loss0 = None
+        for _ in range(5):
+            loss = paddle.mean(net(paddle.to_tensor(X)) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            loss0 = loss0 if loss0 is not None else float(loss.numpy())
+        assert float(loss.numpy()) < loss0
+
+
+class TestStaticProgramControlFlow:
+    def test_static_cond(self):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [3], "float32")
+                out = paddle.static.nn.cond(
+                    paddle.mean(x) > 0.0, lambda: x * 2.0, lambda: x - 1.0)
+            exe = paddle.static.Executor()
+            pos, = exe.run(main, feed={"x": np.array([1, 2, 3], np.float32)},
+                           fetch_list=[out])
+            np.testing.assert_allclose(pos, [2, 4, 6])
+            neg, = exe.run(main, feed={"x": -np.array([1, 2, 3], np.float32)},
+                           fetch_list=[out])
+            np.testing.assert_allclose(neg, [-2, -3, -4])
+        finally:
+            paddle.disable_static()
+
+    def test_static_while(self):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [2], "float32")
+                i = paddle.zeros([], "float32")
+                i2, out = paddle.static.nn.while_loop(
+                    lambda i, v: i < 3.0,
+                    lambda i, v: [i + 1.0, v * 2.0],
+                    [i, x])
+            exe = paddle.static.Executor()
+            res, = exe.run(main, feed={"x": np.array([1, 5], np.float32)},
+                           fetch_list=[out])
+            np.testing.assert_allclose(res, [8.0, 40.0])
+        finally:
+            paddle.disable_static()
+
+    def test_static_cond_with_params(self):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [None, 4], "float32")
+                lin = nn.Linear(4, 2)
+                h = lin(x)
+                out = paddle.static.nn.cond(
+                    paddle.mean(h) > 1e6, lambda: h * 0.0, lambda: h + 1.0)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            X = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+            res, = exe.run(main, feed={"x": X}, fetch_list=[out])
+            expected = X @ lin.weight.numpy() + lin.bias.numpy() + 1.0
+            np.testing.assert_allclose(res, expected, atol=1e-5)
+        finally:
+            paddle.disable_static()
+
+
+class TestArrayOps:
+    def test_array_write_read(self):
+        arr = ops.create_array()
+        x = paddle.to_tensor(np.float32(3.0))
+        i = paddle.to_tensor(np.int64(0))
+        ops.array_write(x, i, arr)
+        got = ops.array_read(arr, i)
+        assert float(got.numpy()) == 3.0
+        assert int(ops.array_length(arr).numpy()) == 1
+
+    def test_increment(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        ops.increment(x, 2.0)
+        np.testing.assert_allclose(x.numpy(), [3.0])
+
+    def test_static_cond_passthrough_branch(self):
+        # select between two existing tensors (review regression)
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [1], "float32")
+                y = paddle.static.data("y", [1], "float32")
+                out = paddle.static.nn.cond(x[0] < y[0],
+                                            lambda: x, lambda: y)
+            exe = paddle.static.Executor()
+            res, = exe.run(main, feed={"x": np.array([1.0], np.float32),
+                                       "y": np.array([5.0], np.float32)},
+                           fetch_list=[out])
+            np.testing.assert_allclose(res, [1.0])
+        finally:
+            paddle.disable_static()
+
+    def test_static_while_with_tensor_loop_var(self):
+        # graph counter + eager Tensor accumulator (review regression)
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                i = paddle.zeros([1], "float32")
+                acc = paddle.to_tensor(np.array([0.0], np.float32))
+                i2, acc2 = paddle.static.nn.while_loop(
+                    lambda i, a: i[0] < 3.0,
+                    lambda i, a: [i + 1.0, a + 2.0],
+                    [i, acc])
+            exe = paddle.static.Executor()
+            res, = exe.run(main, feed={}, fetch_list=[acc2])
+            np.testing.assert_allclose(res, [6.0])
+        finally:
+            paddle.disable_static()
