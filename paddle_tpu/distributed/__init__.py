@@ -26,6 +26,9 @@ from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,
     set_hybrid_communicate_group, get_hybrid_communicate_group,
 )
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -1,0 +1,165 @@
+"""paddle.distributed.fleet parity: the unified distributed-training entry.
+
+TPU-native equivalent of the reference Fleet facade
+(reference: python/paddle/distributed/fleet/base/fleet_base.py:72 Fleet —
+init :139, distributed_optimizer :744, distributed_model, minimize :1244;
+meta-optimizer selection fleet_base.py:1325 + strategy_compiler.py).
+
+Where the reference's meta-optimizers rewrite Programs, `fleet.init`
+compiles the DistributedStrategy into the global Mesh + hybrid topology;
+`distributed_model` applies the parallel wrappers (DataParallel for dp,
+PipelineParallel for pp — TP layers are already mesh-annotated);
+`distributed_optimizer` applies strategy levers (sharding, LARS/LAMB swap,
+gradient merge) to the optimizer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .strategy import DistributedStrategy
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,
+                        RowParallelLinear, ParallelCrossEntropy,
+                        get_rng_state_tracker, model_parallel_random_seed)
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        set_hybrid_communicate_group,
+                        get_hybrid_communicate_group)
+from .. import mesh as _mesh
+from ..env import get_rank, get_world_size, init_parallel_env
+from . import utils  # noqa: F401 (recompute lives here)
+
+
+class _FleetState:
+    def __init__(self):
+        self.strategy: Optional[DistributedStrategy] = None
+        self.hcg: Optional[HybridCommunicateGroup] = None
+        self.initialized = False
+
+
+_F = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    """reference: fleet_base.py:139."""
+    if strategy is None:
+        strategy = DistributedStrategy()
+    _F.strategy = strategy
+    init_parallel_env()
+    hc = strategy.hybrid_configs
+    _F.hcg = HybridCommunicateGroup(
+        dp_degree=int(hc.get("dp_degree", 1)),
+        mp_degree=int(hc.get("mp_degree", 1)),
+        pp_degree=int(hc.get("pp_degree", 1)),
+        sharding_degree=int(hc.get("sharding_degree", 1)),
+        sep_degree=int(hc.get("sep_degree", 1)))
+    set_hybrid_communicate_group(_F.hcg)
+    _F.initialized = True
+    return _F
+
+
+def get_hybrid_communicate_group_():
+    return _F.hcg
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+def distributed_model(model):
+    """reference: fleet_base.py distributed_model — wrap per parallel mode."""
+    hcg = _F.hcg
+    if hcg is None:
+        init()
+        hcg = _F.hcg
+    mode = hcg.get_parallel_mode()
+    if mode == "pipeline":
+        from .pipeline_parallel import PipelineParallel
+        return PipelineParallel(model, hcg, _F.strategy)
+    if mode == "data":
+        from ..parallel import DataParallel
+        return DataParallel(model)
+    # model/tensor parallel: layers are already mesh-annotated; replicate the
+    # rest (reference broadcasts non-mp params across the mp ring)
+    for _, p in model.named_parameters():
+        if p._sharding_spec is None:
+            _mesh.replicate_tensor(p)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet_base.py:744 + the meta-optimizer stack. Applies the
+    strategy levers that live optimizer-side."""
+    st = strategy or _F.strategy or DistributedStrategy()
+    _F.strategy = st
+    if st.sharding:
+        from ..sharding import shard_optimizer_states
+        shard_optimizer_states(optimizer)
+    if st.lars or st.lamb:
+        optimizer = _swap_optimizer(optimizer, st)
+    if st.gradient_merge:
+        from .utils import GradientMergeOptimizer
+        optimizer = GradientMergeOptimizer(
+            optimizer, k_steps=int(st.gradient_merge_configs["k_steps"]),
+            avg=bool(st.gradient_merge_configs["avg"]))
+    return optimizer
+
+
+def _swap_optimizer(optimizer, st):
+    """LARS/LAMB meta-optimizers (reference: meta_optimizers/lars_optimizer
+    .py / lamb_optimizer.py) — swap the update rule, keep params/lr."""
+    from ... import optimizer as optim
+    params = optimizer._parameter_list
+    lr = optimizer._learning_rate
+    if st.lamb:
+        cfg = st.lamb_configs
+        return optim.Lamb(learning_rate=lr, parameters=params,
+                          lamb_weight_decay=float(cfg["lamb_weight_decay"]))
+    cfg = st.lars_configs
+    return optim.LarsMomentum(
+        learning_rate=lr, parameters=params,
+        momentum=getattr(optimizer, "_momentum", 0.9),
+        lars_coeff=float(cfg["lars_coeff"]),
+        lars_weight_decay=float(cfg["lars_weight_decay"]))
+
+
+def minimize(loss, startup_program=None, parameter_list=None,
+             no_grad_set=None):
+    """reference: fleet_base.py:1244 — static-mode minimize delegates to the
+    program optimizer; dygraph users call optimizer.step() directly."""
+    opt = getattr(loss, "_program", None)
+    if opt is not None and loss._program._optimizer is not None:
+        return loss._program._optimizer.minimize(loss)
+    raise RuntimeError("fleet.minimize requires a static-mode loss with an "
+                       "optimizer; in dygraph call optimizer.step()")
+
+
+from .pipeline_parallel import (PipelineLayer, PipelineParallel,  # noqa: E402
+                                LayerDesc, SharedLayerDesc)
+from . import pipeline_engine  # noqa: E402,F401
+
+
+# meta_parallel namespace (reference: fleet.meta_parallel)
+class meta_parallel:
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    ParallelCrossEntropy = ParallelCrossEntropy
+    PipelineLayer = PipelineLayer
+    PipelineParallel = PipelineParallel
+    LayerDesc = LayerDesc
+    SharedLayerDesc = SharedLayerDesc
+    get_rng_state_tracker = staticmethod(get_rng_state_tracker)

@@ -1,0 +1,163 @@
+"""Tensor-parallel layers over the "mp" mesh axis.
+
+TPU-native equivalent of the reference's Megatron-style parallel layers
+(reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+mp_layers.py — VocabParallelEmbedding :29, ColumnParallelLinear :96,
+RowParallelLinear :169; collective helpers c_identity/_mp_allreduce/c_concat
+from operators/collective/).
+
+Design: the reference materializes PER-RANK weight shards and inserts
+explicit collectives. Here each layer owns the *global* weight annotated
+with a PartitionSpec over "mp"; forward pins activations with sharding
+constraints and XLA's SPMD partitioner derives the same compute/collective
+pattern (identity forward + allreduce backward for column, allreduce
+forward for row) — provably the same math, with the partitioner free to
+fuse/overlap the collectives on ICI.
+
+gather_output / input_is_parallel keep their reference meanings, expressed
+as the sharding of the returned/accepted activation:
+- ColumnParallelLinear(gather_output=False) returns y pinned to
+  P(..., "mp") (each mp rank holds its output columns);
+- RowParallelLinear(input_is_parallel=True) accepts x pinned to
+  P(..., "mp") and returns the replicated (allreduced) result.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...ops.dispatch import apply
+from .. import mesh as _mesh
+
+
+def _mp_size() -> int:
+    m = _mesh.get_mesh()
+    if m is None or "mp" not in m.axis_names:
+        return 1
+    return int(m.shape["mp"])
+
+
+def _pin(x, *spec_axes):
+    """Sharding-constrain a Tensor (no-op without an mp axis)."""
+    if _mp_size() <= 1:
+        return x
+    spec = P(*spec_axes)
+    return apply("c_identity",
+                 lambda r: _mesh.constrain(r, spec), x)
+
+
+def _shard_param(p: Tensor, spec_axes):
+    if _mp_size() > 1:
+        _mesh.shard_tensor(p, P(*spec_axes))
+    return p
+
+
+class VocabParallelEmbedding(Layer):
+    """reference: mp_layers.py:29 — embedding table sharded on the vocab dim."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        # gathered result is replicated (reference: c_allreduce after the
+        # masked local lookup)
+        return _pin(out, *((None,) * (len(out.shape) - 1) + (None,)))
+
+
+class ColumnParallelLinear(Layer):
+    """reference: mp_layers.py:96 — weight split along the output dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        if out_features % max(_mp_size(), 1) != 0:
+            raise ValueError(
+                f"out_features {out_features} not divisible by mp degree "
+                f"{_mp_size()}")
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, (None, "mp"))
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+        if self.bias is not None:
+            _shard_param(self.bias, ("mp",))
+
+    def forward(self, x):
+        # input must be replicated (c_identity in the reference = identity
+        # fwd, allreduce bwd — exactly what the partitioner derives)
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return _pin(y, *((None,) * len(y.shape)))
+        return _pin(y, *((None,) * (len(y.shape) - 1) + ("mp",)))
+
+
+class RowParallelLinear(Layer):
+    """reference: mp_layers.py:169 — weight split along the input dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        if in_features % max(_mp_size(), 1) != 0:
+            raise ValueError(
+                f"in_features {in_features} not divisible by mp degree "
+                f"{_mp_size()}")
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, ("mp", None))
+        # bias is applied after the reduction, kept replicated (reference
+        # adds it post-allreduce)
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _pin(x, *((None,) * (len(x.shape) - 1) + ("mp",)))
+        y = F.linear(x, self.weight, None)
+        y = _pin(y, *((None,) * len(y.shape)))  # replicated ⇒ psum inserted
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """reference: mp_layers.py ParallelCrossEntropy — softmax CE over
+    mp-sharded logits. With global-weight semantics the plain CE is already
+    correct; the constraint keeps the logits sharded through the loss."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, logits, labels):
+        logits = _pin(logits, *((None,) * (len(logits.shape) - 1) + ("mp",)))
+        return F.cross_entropy(logits, labels)
+
+
+# named RNG streams for parallel dropout — the core generator already
+# implements the reference's RNGStatesTracker (parallel_layers/random.py:30)
+from ...core.generator import get_rng_state_tracker  # noqa: E402,F401
+
+
+def model_parallel_random_seed(seed):
+    """reference: parallel_layers/random.py model_parallel_random_seed."""
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("model_parallel_rng", int(seed))

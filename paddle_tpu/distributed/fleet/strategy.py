@@ -1,0 +1,109 @@
+"""DistributedStrategy: the serializable knob tree for distributed training.
+
+TPU-native equivalent of the reference's proto-backed strategy
+(reference: paddle/fluid/framework/distributed_strategy.proto:147,
+python/paddle/distributed/fleet/base/distributed_strategy.py:104 — there the
+strategy selects meta-optimizers that REWRITE the Program; here it compiles
+to a Mesh + per-parameter/optimizer-state PartitionSpecs + train-step
+options (recompute/gradient merge), and XLA does the rewriting).
+
+Serialization is JSON (save_to_prototxt/load_from_prototxt keep their names
+for API parity and read/write the JSON file).
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict
+
+
+_DEFAULTS: Dict[str, Any] = {
+    "amp": False,
+    "amp_configs": {"init_loss_scaling": 32768.0, "use_pure_fp16": False,
+                    "custom_white_list": [], "custom_black_list": []},
+    "recompute": False,
+    "recompute_configs": {"checkpoints": []},
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "sharding": False,
+    "sharding_configs": {"sharding_degree": 8, "stage": 1},
+    "lamb": False,
+    "lamb_configs": {"lamb_weight_decay": 0.01, "exclude_from_weight_decay": []},
+    "lars": False,
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "localsgd": False,
+    "dgc": False,
+    "pipeline": False,
+    "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1},
+    "tensor_parallel": False,
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1},
+    "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                       "sharding_degree": 1, "sep_degree": 1},
+    "nccl_comm_num": 1,
+    "find_unused_parameters": False,
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._d = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        d = object.__getattribute__(self, "_d")
+        if name in d:
+            return d[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name == "_d":
+            object.__setattr__(self, name, value)
+            return
+        if name not in self._d:
+            raise AttributeError(f"unknown strategy field {name!r}")
+        if name.endswith("_configs"):
+            merged = dict(self._d[name])
+            merged.update(value)
+            self._d[name] = merged
+        else:
+            self._d[name] = value
+
+    # -- serialization (JSON; names kept for reference parity) --------------
+    def to_json(self) -> str:
+        return json.dumps(self._d, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "DistributedStrategy":
+        st = cls()
+        data = json.loads(s)
+        for k, v in data.items():
+            if k in st._d:
+                st._d[k] = v
+        return st
+
+    def save_to_prototxt(self, path):
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    def load_from_prototxt(self, path):
+        with open(path) as f:
+            self._d = DistributedStrategy.from_json(f.read())._d
+
+    def __repr__(self):
+        return f"DistributedStrategy({json.dumps(self._d, sort_keys=True)})"
+
+    def __eq__(self, other):
+        return isinstance(other, DistributedStrategy) and self._d == other._d
+
+    def mesh_axes(self) -> Dict[str, int]:
+        """Compile the hybrid config to mesh axes (only degrees > 1)."""
+        hc = self.hybrid_configs
+        axes = {}
+        for key, axis in (("dp_degree", "dp"), ("pp_degree", "pp"),
+                          ("sharding_degree", "sharding"),
+                          ("sep_degree", "sp"), ("mp_degree", "mp")):
+            if int(hc.get(key, 1)) > 1:
+                axes[axis] = int(hc[key])
+        return axes
