@@ -21,6 +21,20 @@ __version__ = "0.1.0"
 
 import jax as _jax
 
+# Under a launcher/spawn (PADDLE_TRAINERS_NUM > 1) the distributed runtime
+# must come up before the first XLA-backend touch below. Inline (not via
+# paddle_tpu.distributed) because that package import already pulls in
+# backend-touching modules.
+import os as _os
+if (int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
+        and not _os.environ.get("_PADDLE_TPU_DIST_INITIALIZED")):
+    _eps = _os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+    _jax.distributed.initialize(
+        coordinator_address=(_eps[0] or None) if _eps else None,
+        num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+        process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _os.environ["_PADDLE_TPU_DIST_INITIALIZED"] = "1"
+
 # float32 ops must be float32-accurate (the reference computes true fp32 unless
 # AMP is enabled). XLA's default runs f32 matmuls with bf16 passes on TPU;
 # force full precision for f32 — the AMP/bf16 path (paddle_tpu.amp) is the MXU

@@ -19,7 +19,9 @@ from typing import Optional
 import jax
 
 
-_INITIALIZED = [False]
+# paddle_tpu/__init__ performs the pre-backend bootstrap and leaves this
+# sentinel (see there); pick it up so init_parallel_env is a no-op after it
+_INITIALIZED = [bool(os.environ.get("_PADDLE_TPU_DIST_INITIALIZED"))]
 
 
 class ParallelEnv:
@@ -69,12 +71,15 @@ class ParallelEnv:
 
 def init_parallel_env():
     """reference: distributed/parallel.py:60. Multi-host: initialize the JAX
-    distributed runtime from the PADDLE_* env contract. Single-host: no-op —
-    all local devices are already visible."""
+    distributed runtime from the PADDLE_* env contract (normally already
+    done by the pre-backend bootstrap in paddle_tpu/__init__ — jax requires
+    initialize() before the first backend touch, the same
+    before-any-kernel constraint as the reference's
+    NCCLParallelContext::Init, nccl_context.cc:53). Single-host: no-op."""
     env = ParallelEnv()
     if _INITIALIZED[0]:
         return env
-    if env._world_size > 1 and not _INITIALIZED[0]:
+    if env._world_size > 1:
         coordinator = env._endpoints[0] if env._endpoints[0] else None
         jax.distributed.initialize(
             coordinator_address=coordinator,

@@ -1,0 +1,151 @@
+"""Distributed launcher CLI: ``python -m paddle_tpu.distributed.launch``.
+
+TPU-native equivalent of the reference launcher
+(reference: python/paddle/distributed/fleet/launch.py:364 launch /
+:217 launch_collective; launch_utils.py:267 get_cluster, :452
+start_local_trainers, :559 watch_local_trainers, :308
+terminate_local_procs).
+
+The env contract is preserved verbatim (PADDLE_TRAINER_ID,
+PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS) so
+reference launch scripts port unchanged; ``init_parallel_env`` turns it into
+``jax.distributed.initialize`` (endpoint[0] = coordinator). On TPU pods the
+standard layout is ONE process per host (XLA owns all local chips), so
+``--nproc_per_node`` defaults to 1; multi-chip-per-process parallelism is
+mesh sharding, not process fan-out.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a distributed training job")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips (reference: --ips)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (1 per TPU host is standard)")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--start_port", type=int,
+                   default=int(os.environ.get("FLAGS_START_PORT", "6070")))
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="per-rank log files (reference: launch_utils.py:544)")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--devices", "--gpus", "--selected_devices", type=str,
+                   default=None, dest="devices")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster(ips: List[str], nproc_per_node: int, start_port: int):
+    """All (ip, port) endpoints, rank-major (reference: get_cluster)."""
+    endpoints = []
+    for ip in ips:
+        for i in range(nproc_per_node):
+            endpoints.append(f"{ip}:{start_port + i}")
+    return endpoints
+
+
+def start_local_trainers(endpoints: List[str], node_ips: List[str],
+                         node_rank: int, nproc_per_node: int,
+                         script: str, script_args: List[str],
+                         log_dir: Optional[str] = None,
+                         extra_env: Optional[dict] = None):
+    """Spawn this node's trainer processes with the PADDLE_* contract
+    (reference: launch_utils.py:452)."""
+    procs = []
+    base_rank = node_rank * nproc_per_node
+    for local_rank in range(nproc_per_node):
+        rank = base_rank + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "FLAGS_selected_devices": str(local_rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+        })
+        if extra_env:
+            env.update(extra_env)
+        cmd = [sys.executable, "-u", script] + list(script_args)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+            proc = subprocess.Popen(cmd, env=env, stdout=log_f, stderr=log_f)
+            proc._log_file = log_f
+        else:
+            proc = subprocess.Popen(cmd, env=env)
+        proc._rank = rank
+        procs.append(proc)
+    return procs
+
+
+def terminate_local_procs(procs):
+    """SIGTERM then SIGKILL (reference: launch_utils.py:308)."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + 5
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for p in procs:
+        f = getattr(p, "_log_file", None)
+        if f:
+            f.close()
+
+
+def watch_local_trainers(procs) -> int:
+    """Poll children; any nonzero exit tears the job down
+    (reference: launch_utils.py:559)."""
+    alive = list(procs)
+    while alive:
+        time.sleep(0.2)
+        for p in list(alive):
+            ret = p.poll()
+            if ret is None:
+                continue
+            alive.remove(p)
+            if ret != 0:
+                sys.stderr.write(
+                    f"trainer rank {p._rank} exited with code {ret}; "
+                    f"terminating the job\n")
+                terminate_local_procs(alive)
+                return ret
+    return 0
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv)
+    ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
+    endpoints = get_cluster(ips, args.nproc_per_node, args.start_port)
+    procs = start_local_trainers(
+        endpoints, ips, args.node_rank, args.nproc_per_node,
+        args.training_script, args.training_script_args, args.log_dir)
+
+    def _sig(_signum, _frame):
+        terminate_local_procs(procs)
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    rc = watch_local_trainers(procs)
+    terminate_local_procs(procs)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,82 @@
+"""dist.spawn: in-Python multi-process launch.
+
+TPU-native equivalent of reference spawn
+(reference: python/paddle/distributed/spawn.py:333 spawn — multiprocessing
+with the PADDLE_* env handshake per child; :230 _func_wrapper).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import sys
+import traceback
+from typing import Optional
+
+
+def _worker(func, args, rank, nprocs, endpoints, error_queue, env_updates):
+    try:
+        os.environ.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        })
+        if env_updates:
+            os.environ.update(env_updates)
+        func(*args)
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        error_queue.put(traceback.format_exc())
+        sys.exit(1)
+
+
+class MultiprocessContext:
+    """reference: spawn.py MultiprocessContext (join + error surfacing)."""
+
+    def __init__(self, processes, error_queues):
+        self.processes = processes
+        self.error_queues = error_queues
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        for rank, (p, q) in enumerate(zip(self.processes,
+                                          self.error_queues)):
+            if p.exitcode not in (0, None):
+                msg = q.get() if not q.empty() else f"exitcode {p.exitcode}"
+                for other in self.processes:
+                    if other.is_alive():
+                        other.terminate()
+                raise RuntimeError(
+                    f"spawned rank {rank} failed:\n{msg}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py:333."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if nprocs <= 1:
+            nprocs = 1
+    start_port = int(options.get("start_port",
+                                 os.environ.get("FLAGS_START_PORT", "6170")))
+    ips = options.get("ips", "127.0.0.1")
+    endpoints = [f"{ips}:{start_port + i}" for i in range(nprocs)]
+    env_updates = options.get("env", None)
+
+    ctx = multiprocessing.get_context("spawn")
+    processes, queues = [], []
+    for rank in range(nprocs):
+        q = ctx.SimpleQueue()
+        p = ctx.Process(target=_worker,
+                        args=(func, args, rank, nprocs, endpoints, q,
+                              env_updates),
+                        daemon=daemon)
+        p.start()
+        processes.append(p)
+        queues.append(q)
+    mp_ctx = MultiprocessContext(processes, queues)
+    if join:
+        mp_ctx.join()
+    return mp_ctx
