@@ -19,6 +19,8 @@ from .manipulation import *  # noqa: F401,F403
 from .control_flow import (cond, while_loop, case, switch_case,  # noqa: F401
                            increment, create_array, array_write, array_read,
                            array_length)
+from .detection import (yolo_box, yolov3_loss, multiclass_nms,  # noqa: F401
+                        prior_box, box_coder, iou_similarity, box_clip)
 
 
 def _attach_methods():
