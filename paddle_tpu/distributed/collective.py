@@ -395,7 +395,9 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if not axes:
         if tensor_list:
             rank = g.get_group_rank(get_rank()) if g.ranks is not None else get_rank()
-            pick = tensor_list[max(rank, 0)]
+            if rank < 0:  # not a member of this group: keep input
+                return tensor
+            pick = tensor_list[rank]
             if isinstance(tensor, Tensor):
                 tensor._swap_payload(pick if isinstance(pick, Tensor)
                                      else Tensor(pick))
@@ -405,11 +407,19 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
     def impl(x, stack):
         idx = _linear_index(axes)
-        src_global = g.ranks[src] if g.ranks is not None else src
-        full = _raw_broadcast(stack, src, g, axes)
-        my = jnp.take(full, idx, axis=0)
-        del src_global
-        return my
+        src_in_group = g.get_group_rank(src) if g.ranks is not None else src
+        if src_in_group < 0:  # reference collective.py:663 asserts gsrc >= 0
+            raise ValueError(
+                f"scatter src={src} is not a member of group ranks "
+                f"{g.ranks}")
+        full = _raw_broadcast(stack, src_in_group, g, axes)
+        if g.ranks is not None:
+            # each member picks its slot by *group* rank; non-members keep x
+            ranks = jnp.asarray(np.array(g.ranks, np.int32))
+            matches = ranks == idx
+            my = jnp.take(full, jnp.argmax(matches), axis=0)
+            return jnp.where(matches.any(), my, x)
+        return jnp.take(full, idx, axis=0)
     stack_raw = jnp.stack([t._data if isinstance(t, Tensor) else jnp.asarray(t)
                            for t in (tensor_list or [])])
     if isinstance(tensor, Tensor):
@@ -466,27 +476,38 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return result
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    """reference: collective.py:1386 (send_v2). SPMD pair with recv: both
-    ranks run the same program; see _raw_p2p."""
+def send(tensor, dst=0, group=None, sync_op=True, src=None):
+    """reference: collective.py:1386 (send_v2).
+
+    In an SPMD trace every rank runs the same program, so the sending rank
+    cannot be inferred from "who called send" the way the reference's
+    per-process send_v2 kernel does — it must be stated. Pass ``src=``
+    (or use :func:`p2p_exchange`) to name the sender; otherwise this
+    raises rather than silently routing from rank 0.
+    """
     g = _get_group(group)
     axes = _resolve_axes(g)
     if not axes:
         return tensor
-    src = _static_rank_hint()
-    return _run("send_v2", tensor,
-                lambda x: _raw_p2p(x, src if src is not None else 0, dst, axes))
+    if src is None:
+        raise NotImplementedError(
+            "send() inside an SPMD trace cannot infer the sending rank; "
+            "pass src= explicitly or use p2p_exchange(tensor, src, dst)")
+    return p2p_exchange(tensor, src, dst, group)
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
-    """reference: collective.py:1436 (recv_v2)."""
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """reference: collective.py:1436 (recv_v2). See :func:`send` — the
+    receiving rank must be stated (``dst=``) inside an SPMD trace."""
     g = _get_group(group)
     axes = _resolve_axes(g)
     if not axes:
         return tensor
-    dst = _static_rank_hint()
-    return _run("recv_v2", tensor,
-                lambda x: _raw_p2p(x, src, dst if dst is not None else 0, axes))
+    if dst is None:
+        raise NotImplementedError(
+            "recv() inside an SPMD trace cannot infer the receiving rank; "
+            "pass dst= explicitly or use p2p_exchange(tensor, src, dst)")
+    return p2p_exchange(tensor, src, dst, group)
 
 
 def p2p_exchange(tensor, src, dst, group=None):
@@ -498,13 +519,6 @@ def p2p_exchange(tensor, src, dst, group=None):
     if not axes:
         return tensor
     return _run("p2p", tensor, lambda x: _raw_p2p(x, src, dst, axes))
-
-
-_STATIC_RANK = [None]
-
-
-def _static_rank_hint():
-    return _STATIC_RANK[0]
 
 
 def barrier(group=None):

@@ -117,8 +117,22 @@ class HybridCommunicateGroup:
                 axes[_AXIS_MAP[n]] = d
         import jax
         devs = devices if devices is not None else jax.devices()
-        if int(np.prod(list(axes.values()) or [1])) == len(devs):
+        need = int(np.prod(list(axes.values()) or [1]))
+        existing = _mesh.get_mesh()
+        if need == len(devs):
             _mesh.set_mesh(_mesh.build_mesh(axes or None, devs))
+        elif existing is not None and all(
+                existing.shape.get(a) == d for a, d in axes.items()):
+            pass  # a user-installed mesh (possibly on a device subset)
+            # already provides the requested axes — keep it
+        elif need > 1:
+            # A silently-skipped mesh would turn every dp/mp/pp collective
+            # into an identity no-op; fail loudly instead.
+            raise ValueError(
+                f"hybrid degrees {axes} need {need} devices but "
+                f"{len(devs)} are visible; fix hybrid_configs, pass "
+                f"devices= explicitly, or pre-install a matching mesh via "
+                f"distributed.set_mesh")
 
         self._dp_group = new_group(axis="dp")
         self._mp_group = new_group(axis="mp")

@@ -90,15 +90,17 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
 # -- iou helpers --------------------------------------------------------------
 
-def _pairwise_iou(a, b):
-    """a [M,4], b [K,4] x1y1x2y2 -> [M,K]."""
-    area_a = jnp.clip(a[:, 2] - a[:, 0], 0, None) * \
-        jnp.clip(a[:, 3] - a[:, 1], 0, None)
-    area_b = jnp.clip(b[:, 2] - b[:, 0], 0, None) * \
-        jnp.clip(b[:, 3] - b[:, 1], 0, None)
+def _pairwise_iou(a, b, normalized=True):
+    """a [M,4], b [K,4] x1y1x2y2 -> [M,K]. Unnormalized (pixel) boxes get
+    the reference's +1 extent offset (JaccardOverlap, detection/nms_util.h)."""
+    off = 0.0 if normalized else 1.0
+    area_a = jnp.clip(a[:, 2] - a[:, 0] + off, 0, None) * \
+        jnp.clip(a[:, 3] - a[:, 1] + off, 0, None)
+    area_b = jnp.clip(b[:, 2] - b[:, 0] + off, 0, None) * \
+        jnp.clip(b[:, 3] - b[:, 1] + off, 0, None)
     lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
     rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = jnp.clip(rb - lt, 0, None)
+    wh = jnp.clip(rb - lt + off, 0, None)
     inter = wh[..., 0] * wh[..., 1]
     union = area_a[:, None] + area_b[None, :] - inter
     return jnp.where(union > 0, inter / union, 0.0)
@@ -106,7 +108,9 @@ def _pairwise_iou(a, b):
 
 def iou_similarity(x, y, box_normalized=True, name=None):
     """reference: detection/iou_similarity_op.cc — [M,4]x[K,4] -> [M,K]."""
-    return apply("iou_similarity", _pairwise_iou, x, y)
+    return apply("iou_similarity",
+                 lambda a, b: _pairwise_iou(a, b, normalized=box_normalized),
+                 x, y)
 
 
 def box_clip(input, im_info, name=None):
@@ -123,24 +127,31 @@ def box_clip(input, im_info, name=None):
 
 # -- multiclass_nms -----------------------------------------------------------
 
-def _greedy_nms_mask(boxes, scores, iou_threshold, score_threshold, top_k):
+def _greedy_nms_mask(boxes, scores, iou_threshold, score_threshold, top_k,
+                     normalized=True, nms_eta=1.0):
     """Greedy per-class suppression over score-sorted candidates.
-    Returns (kept mask over the top_k sorted slots, their indices)."""
+    Returns (kept mask over the top_k sorted slots, their indices).
+    ``nms_eta < 1`` decays the threshold after each kept box while it stays
+    above 0.5 (reference: detection/nms_util.h NMSFast adaptive_threshold)."""
     k = min(top_k, scores.shape[0])
     top_scores, order = lax.top_k(scores, k)
     cand = boxes[order]
-    iou = _pairwise_iou(cand, cand)
+    iou = _pairwise_iou(cand, cand, normalized=normalized)
     valid = top_scores > score_threshold
+    adaptive = nms_eta < 1.0
 
-    def step(kept, i):
+    def step(carry, i):
+        kept, thr = carry
         # suppressed if any higher-scored kept candidate overlaps too much
-        sup = jnp.any(kept & (iou[:, i] > iou_threshold)
-                      & (jnp.arange(k) < i))
+        sup = jnp.any(kept & (iou[:, i] > thr) & (jnp.arange(k) < i))
         keep_i = valid[i] & ~sup
-        return kept.at[i].set(keep_i), keep_i
+        if adaptive:
+            thr = jnp.where(keep_i & (thr > 0.5), thr * nms_eta, thr)
+        return (kept.at[i].set(keep_i), thr), keep_i
 
     kept0 = jnp.zeros(k, bool)
-    kept, _ = lax.scan(step, kept0, jnp.arange(k))
+    thr0 = jnp.asarray(iou_threshold, jnp.float32)
+    (kept, _), _ = lax.scan(step, (kept0, thr0), jnp.arange(k))
     return kept, order, top_scores
 
 
@@ -165,7 +176,8 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
                     continue
                 kept, order, top_scores = _greedy_nms_mask(
                     boxes, cls_scores[cls], nms_threshold,
-                    score_threshold, nms_top_k)
+                    score_threshold, nms_top_k,
+                    normalized=normalized, nms_eta=nms_eta)
                 scores = jnp.where(kept, top_scores, -1.0)
                 labels_all.append(jnp.full_like(scores, cls))
                 scores_all.append(scores)

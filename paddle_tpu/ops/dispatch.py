@@ -44,7 +44,10 @@ def registered_ops():
 # non-tensor leaves + tensor signatures + diff positions. jax.vjp closures
 # ARE jit-returnable pytrees, so fwd+vjp compiles once per signature
 # (~40x less per-call overhead than re-tracing jax.vjp each op call).
-_EAGER_CACHE = {}
+from collections import OrderedDict
+
+_EAGER_CACHE = OrderedDict()
+_EAGER_CACHE_MAX = 4096  # LRU bound: one entry per op/impl/shape signature
 _UNCACHEABLE = object()
 
 
@@ -57,6 +60,16 @@ def _fn_cache_key(fn):
         return _UNCACHEABLE
     cells = getattr(fn, "__closure__", None) or ()
     vals = []
+    # per-call values bound via default args (not closure cells) must be in
+    # the key too, else two lambdas sharing a code object would collide
+    kwdefaults = getattr(fn, "__kwdefaults__", None) or {}
+    defaults = tuple(getattr(fn, "__defaults__", None) or ()) + \
+        tuple(v for _, v in sorted(kwdefaults.items()))
+    for d in defaults:
+        if isinstance(d, (int, float, bool, str, bytes, type(None))):
+            vals.append(("default", type(d).__name__, d))
+        else:
+            return _UNCACHEABLE
     for c in cells:
         try:
             v = c.cell_contents
@@ -176,7 +189,11 @@ def apply(name: str, fn: Callable, *args, **attrs):
         if entry is None:
             entry = _build_cached(name, fn, leaves, treedef, attrs, t_idx,
                                   diff_pos)
+            if len(_EAGER_CACHE) >= _EAGER_CACHE_MAX:
+                _EAGER_CACHE.popitem(last=False)
             _EAGER_CACHE[cache_key] = entry
+        else:
+            _EAGER_CACHE.move_to_end(cache_key)
         jfn, out_td = entry
         diff_raws = tuple(leaves[p]._data for p in diff_pos)
         other_raws = tuple(leaves[i]._data for i in t_idx

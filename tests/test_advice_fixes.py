@@ -170,3 +170,141 @@ class TestStaticClipOrderParity:
                                    rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(lin_s.bias.numpy(), lin_d.bias.numpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Round-2 advisor findings (ADVICE.md round 2):
+# 5. send/recv must not silently route via rank 0 — explicit endpoints only.
+# 6. scatter over an arbitrary-rank group indexes by *group* rank and leaves
+#    non-members untouched.
+# 7. HybridCommunicateGroup raises on degree/device-count mismatch.
+# 8. The eager op cache keys default-bound lambda args.
+# 9. multiclass_nms honors normalized=False (+1 extent) and nms_eta decay.
+# ---------------------------------------------------------------------------
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+import paddle_tpu.ops as ops
+
+
+class TestCollectiveRouting:
+    def _spmd(self, fn, in_specs, out_specs):
+        return jax.shard_map(fn, mesh=dist.get_mesh(),
+                             in_specs=in_specs, out_specs=out_specs)
+
+    def test_send_recv_require_explicit_endpoints(self):
+        dist.set_mesh(dist.build_mesh({"dp": 8}))
+        try:
+            x = jnp.arange(8.0, dtype=jnp.float32)
+            with pytest.raises(NotImplementedError):
+                self._spmd(lambda v: dist.send(v, dst=3), P("dp"), P("dp"))(x)
+            with pytest.raises(NotImplementedError):
+                self._spmd(lambda v: dist.recv(v, src=3), P("dp"), P("dp"))(x)
+            # explicit endpoints route correctly (not via rank 0)
+            out = self._spmd(lambda v: dist.send(v, dst=5, src=2),
+                             P("dp"), P("dp"))(x)
+            expected = np.arange(8.0, dtype=np.float32)
+            expected[5] = 2.0
+            np.testing.assert_allclose(np.asarray(out), expected)
+        finally:
+            dist.set_mesh(None)
+
+    def test_scatter_subgroup_group_rank_and_mask(self):
+        dist.set_mesh(dist.build_mesh({"dp": 8}))
+        try:
+            g = dist.new_group(ranks=[2, 3])
+            parts = [jnp.full((2,), 100.0, jnp.float32),
+                     jnp.full((2,), 200.0, jnp.float32)]
+            x = np.tile(np.arange(8.0, dtype=np.float32)[:, None], (1, 2))
+
+            def fn(v):
+                return dist.scatter(v[0], tensor_list=parts, src=2, group=g)[None]
+            out = np.asarray(self._spmd(fn, P("dp", None), P("dp", None))(
+                jnp.asarray(x)))
+            expected = x.copy()
+            expected[2] = 100.0  # group rank 0
+            expected[3] = 200.0  # group rank 1
+            np.testing.assert_allclose(out, expected)
+        finally:
+            dist.set_mesh(None)
+
+    def test_scatter_full_mesh(self):
+        dist.set_mesh(dist.build_mesh({"dp": 8}))
+        try:
+            parts = [jnp.full((2,), 10.0 * r, jnp.float32) for r in range(8)]
+            x = np.zeros((8, 2), np.float32)
+
+            def fn(v):
+                return dist.scatter(v[0], tensor_list=parts, src=0)[None]
+            out = np.asarray(self._spmd(fn, P("dp", None), P("dp", None))(
+                jnp.asarray(x)))
+            np.testing.assert_allclose(
+                out, np.arange(8.0)[:, None] * 10.0 * np.ones((1, 2)))
+        finally:
+            dist.set_mesh(None)
+
+
+class TestTopologyMismatchRaises:
+    def test_degree_device_mismatch(self):
+        from paddle_tpu.distributed.topology import HybridCommunicateGroup
+        with pytest.raises(ValueError):
+            HybridCommunicateGroup(dp_degree=3, mp_degree=2)  # 6 != 8 devices
+
+
+class TestEagerCacheDefaults:
+    def test_default_bound_lambda_values_keyed(self):
+        from paddle_tpu.ops.dispatch import apply
+
+        def make(c):
+            return lambda x, c=c: x * c
+
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        r1 = apply("_test_mul_const", make(2.0), t)
+        r2 = apply("_test_mul_const", make(3.0), t)
+        np.testing.assert_allclose(r1.numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(r2.numpy(), [3.0, 3.0])
+
+
+class TestNMSNormalizedEta:
+    def test_unnormalized_plus_one_extent(self):
+        # pixel boxes touching at a corner: iou = 0 normalized, 1/7 with +1
+        bboxes = np.array([[[0, 0, 1, 1], [1, 1, 2, 2]]], np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.8]
+        kw = dict(score_threshold=0.1, nms_top_k=2, keep_top_k=2,
+                  nms_threshold=0.1, background_label=0)
+        _, counts_norm = ops.multiclass_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            normalized=True, **kw)
+        _, counts_pix = ops.multiclass_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            normalized=False, **kw)
+        assert int(counts_norm.numpy()[0]) == 2   # iou 0 < 0.1: both kept
+        assert int(counts_pix.numpy()[0]) == 1    # iou 1/7 > 0.1: suppressed
+
+    def test_nms_eta_decays_threshold(self):
+        # iou(A,B) ~ 0.65 < 0.7: B survives at eta=1; after keeping A with
+        # eta=0.5 the threshold drops to 0.35 and B is suppressed
+        bboxes = np.array([[[0.0, 0.0, 1.0, 1.0],
+                            [0.2121, 0.0, 1.2121, 1.0]]], np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.8]
+        kw = dict(score_threshold=0.1, nms_top_k=2, keep_top_k=2,
+                  nms_threshold=0.7, background_label=0)
+        _, c_plain = ops.multiclass_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            nms_eta=1.0, **kw)
+        _, c_eta = ops.multiclass_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            nms_eta=0.5, **kw)
+        assert int(c_plain.numpy()[0]) == 2
+        assert int(c_eta.numpy()[0]) == 1
+
+    def test_iou_similarity_unnormalized(self):
+        a = np.array([[0, 0, 1, 1]], np.float32)
+        b = np.array([[1, 1, 2, 2]], np.float32)
+        got = ops.iou_similarity(paddle.to_tensor(a), paddle.to_tensor(b),
+                                 box_normalized=False).numpy()
+        np.testing.assert_allclose(got, [[1.0 / 7.0]], rtol=1e-6)
