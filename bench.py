@@ -38,7 +38,8 @@ def _drive(model, opt, x_np, y_np, steps, use_amp, amp_dtype="bfloat16"):
         model.train_batch([x], [y])
 
     ts = model._train_step_fn
-    opt_states = [opt._state[id(p)] for p in ts["trainable"]]
+    from paddle_tpu.core.tensor import stable_uid
+    opt_states = [opt._state[stable_uid(p)] for p in ts["trainable"]]
     train_raws = [p._data for p in ts["trainable"]]
     fixed_raws = [ts["state"][i]._data for i in ts["fixed_pos"]]
     x_raws = [x._data]
@@ -68,7 +69,7 @@ def bench_resnet50(on_tpu: bool):
     from paddle_tpu.vision import models
 
     if on_tpu:
-        batch, size, steps = 128, 224, 20
+        batch, size, steps = 256, 224, 20
     else:
         batch, size, steps = 4, 32, 2
     paddle.seed(0)
@@ -102,7 +103,7 @@ def bench_bert(on_tpu: bool):
 
     if on_tpu:
         cfg = BertConfig()              # base: 12L, 768h
-        batch, seq, steps = 32, 128, 10
+        batch, seq, steps = 64, 128, 10
     else:
         cfg = BertConfig(vocab_size=1000, hidden_size=64, num_layers=2,
                          num_heads=2, intermediate_size=128,

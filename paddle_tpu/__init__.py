@@ -60,7 +60,12 @@ from .ops import linalg  # noqa: F401
 # grad function (paddle.grad)
 grad = _functional_grad
 
+from . import autograd  # noqa: E402,F401
+from .autograd import PyLayer, PyLayerContext  # noqa: E402,F401
+
 from . import nn  # noqa: E402,F401
+from .ops import _late_alias as _ops_late_alias  # noqa: E402
+_ops_late_alias()
 from . import optimizer  # noqa: E402,F401
 from . import regularizer  # noqa: E402,F401
 from .nn.layer_base import ParamAttr  # noqa: E402,F401
@@ -85,6 +90,13 @@ from . import models  # noqa: E402,F401
 from .distributed import DataParallel  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import amp  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+from .hapi import hub  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401

@@ -1,0 +1,145 @@
+"""paddle.autograd: user-facing autograd utilities — PyLayer (user-defined
+differentiable ops) and the functional grad/backward surface.
+
+Reference: python/paddle/autograd/ (PyLayer in py_layer.py backed by
+imperative/py_layer_fwd.h; paddle.autograd.backward/grad). TPU design: a
+PyLayer becomes one tape GradNode whose vjp calls the user's ``backward``
+staticmethod; because the backward itself executes through the op funnel
+when invoked with differentiable cotangents, double grad through a PyLayer
+composes for free (reference: partial_grad_engine.cc handles this with a
+dedicated engine).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd_engine as _ag
+from ..core.autograd_engine import grad, backward, no_grad  # noqa: F401
+
+
+class PyLayerContext:
+    """reference: py_layer.py PyLayerContext (save_for_backward /
+    saved_tensor; ``container`` kept for API parity)."""
+
+    def __init__(self):
+        self.container = None
+        self._saved: List[Tensor] = []
+        self._non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def mark_non_differentiable(self, *tensors):
+        self._non_differentiable |= {id(t) for t in tensors}
+
+
+class _PyLayerNode(_ag.GradNode):
+    __slots__ = ("cls", "ctx", "single_out")
+
+    def __init__(self, cls, ctx, inputs, outs, single_out):
+        self.cls = cls
+        self.ctx = ctx
+        self.single_out = single_out
+        out_avals = [(tuple(o._data.shape), o._data.dtype) for o in outs]
+        super().__init__(cls.__name__, self._vjp, inputs, out_avals,
+                         replay=None)
+
+    def _wrap_cots(self, cot_tuple):
+        import jax
+        cts = []
+        for (shape, dtype), c in zip(self.out_avals, cot_tuple):
+            if isinstance(c, Tensor):
+                cts.append(c)
+            elif getattr(c, "dtype", None) == jax.dtypes.float0:
+                cts.append(Tensor(jnp.zeros(shape, jnp.float32)))
+            else:
+                cts.append(Tensor(c))
+        return cts
+
+    def _call_backward(self, cts):
+        gs = self.cls.backward(self.ctx, *(cts if not self.single_out
+                                           else cts[:1]))
+        gs = gs if isinstance(gs, (list, tuple)) else (gs,)
+        if len(gs) != len(self.inputs):
+            raise RuntimeError(
+                f"{self.cls.__name__}.backward returned {len(gs)} grads "
+                f"for {len(self.inputs)} tensor inputs")
+        return list(gs)
+
+    def _vjp(self, cot_tuple):
+        with _ag.no_grad():
+            gs = self._call_backward(self._wrap_cots(cot_tuple))
+        out = []
+        for g, ref in zip(gs, self.inputs):
+            if g is None:
+                out.append(jnp.zeros(ref.tensor._data.shape,
+                                     ref.tensor._data.dtype))
+            else:
+                out.append(g._data if isinstance(g, Tensor)
+                           else jnp.asarray(g))
+        return tuple(out)
+
+    def py_replay(self):
+        """Double-grad path: run the user backward with grad-tracked
+        cotangents so its ops record their own tape."""
+        cts = self._wrap_cots(self.cotangents())
+        gs = self._call_backward(cts)
+        out = []
+        for g, ref in zip(gs, self.inputs):
+            if g is None:
+                out.append(Tensor(jnp.zeros(ref.tensor._data.shape,
+                                            ref.tensor._data.dtype)))
+            else:
+                out.append(g if isinstance(g, Tensor) else Tensor(g))
+        return out
+
+
+class PyLayer:
+    """User-defined differentiable op (reference: paddle.autograd.PyLayer,
+    imperative/py_layer_fwd.h).
+
+    Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    staticmethods; call via ``.apply(*args)``.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _ag.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (list, tuple))
+        outs = [out] if single else list(out)
+        outs = [o if isinstance(o, Tensor) else Tensor(o) for o in outs]
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        need = (_ag.is_grad_enabled()
+                and any(not t.stop_gradient for t in tensor_inputs))
+        if need:
+            node = _PyLayerNode(cls, ctx, tensor_inputs, outs, single)
+            bound = []
+            for i, o in enumerate(outs):
+                differentiable = (id(o) not in ctx._non_differentiable
+                                  and _ag._is_inexact(o._data.dtype))
+                t = Tensor(o._data, stop_gradient=not differentiable)
+                if differentiable:
+                    t._grad_node = (node, i)
+                bound.append(t)
+            outs = bound
+        return outs[0] if single else tuple(outs)
+
+
+PyLayerMeta = type  # API-parity alias (reference exposes a metaclass)

@@ -85,19 +85,33 @@ class InputRef:
         self.version = tensor._inplace_version
 
 
+def _is_inexact(dtype):
+    return np.issubdtype(np.dtype(dtype), np.inexact) or dtype == jnp.bfloat16
+
+
 class GradNode:
     """One recorded op on the tape. Holds the vjp closure (residuals live in
     device memory until backward frees them) and the differentiable input
-    bindings (reference: imperative/op_base.h:182 GradOpNode)."""
+    bindings (reference: imperative/op_base.h:182 GradOpNode).
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "accum", "__weakref__")
+    ``replay`` is ``(pure_fn, other_raws)`` where
+    ``pure_fn(diff_raws, other_raws) -> out_leaves`` re-executes the op's
+    primal as a function of the differentiable inputs — the double-grad
+    path re-derives the vjp from it under a fresh trace so second-order
+    dependence on the primals is tracked (the reference keeps a dedicated
+    engine for this, imperative/partial_grad_engine.cc)."""
 
-    def __init__(self, name: str, vjp_fn, inputs: List, out_avals: List):
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "accum", "replay",
+                 "__weakref__")
+
+    def __init__(self, name: str, vjp_fn, inputs: List, out_avals: List,
+                 replay=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = [InputRef(t) for t in inputs]
         self.out_avals = out_avals    # [(shape, dtype)] for every output leaf
         self.accum: dict = {}         # out leaf index -> accumulated cotangent
+        self.replay = replay
 
     def seed(self, idx: int, g):
         if idx in self.accum:
@@ -112,7 +126,7 @@ class GradNode:
         for i, (shape, dtype) in enumerate(self.out_avals):
             if i in self.accum:
                 cots.append(self.accum[i])
-            elif np.issubdtype(np.dtype(dtype), np.inexact) or dtype == jnp.bfloat16:
+            elif _is_inexact(dtype):
                 cots.append(jnp.zeros(shape, dtype))
             else:
                 # integer/bool outputs take symbolic zero cotangents
@@ -134,7 +148,58 @@ def _run_hooks(t, g):
     return g
 
 
-def _execute(roots, retain_graph: bool = False, watched: Optional[dict] = None):
+def _replay_node(node: "GradNode"):
+    """Re-derive and run the node's vjp as a *recorded op*, so the computed
+    cotangents carry their own tape (double grad). The vjp is rebuilt from
+    the primal inputs under a fresh trace — second-order dependence on the
+    primals is tracked, unlike calling the stored vjp closure whose
+    residuals are baked constants."""
+    from .tensor import Tensor
+    from ..ops.dispatch import apply
+
+    custom = getattr(node, "py_replay", None)
+    if custom is not None:  # PyLayer: the user backward IS the grad program
+        return custom()
+
+    if node.replay is None:
+        raise NotImplementedError(
+            f"create_graph=True through op '{node.name}' is not supported: "
+            f"the op recorded no replayable primal")
+    pure2, other_raws = node.replay
+    inexact_ix = [i for i, (s, d) in enumerate(node.out_avals)
+                  if _is_inexact(d)]
+    inexact_set = set(inexact_ix)
+    cots = node.cotangents()
+    cot_args = [cots[i] if isinstance(cots[i], Tensor) else Tensor(cots[i])
+                for i in inexact_ix]
+    for ref in node.inputs:
+        if ref.tensor._inplace_version != ref.version:
+            raise RuntimeError(
+                f"Tensor needed for the double-grad of op '{node.name}' was "
+                f"modified in place (version {ref.version} -> "
+                f"{ref.tensor._inplace_version})")
+    prim = [ref.tensor for ref in node.inputs]
+    n_prim = len(prim)
+    avals = list(node.out_avals)
+
+    def raw_fn(*raws):
+        p, c_in = raws[:n_prim], raws[n_prim:]
+        it = iter(c_in)
+        full = []
+        for i, (s, d) in enumerate(avals):
+            if i in inexact_set:
+                full.append(next(it))
+            else:
+                full.append(np.zeros(s, dtype=jax.dtypes.float0))
+        _, vjp2 = jax.vjp(lambda *dd: pure2(dd, other_raws), *p)
+        return vjp2(tuple(full))
+
+    out = apply(node.name + "_grad", raw_fn, *prim, *cot_args)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def _execute(roots, retain_graph: bool = False, watched: Optional[dict] = None,
+             create_graph: bool = False):
     """Queue-driven reverse-topological tape walk over possibly multiple
     seeded roots (reference: imperative/basic_engine.cc:305 Execute).
 
@@ -142,7 +207,11 @@ def _execute(roots, retain_graph: bool = False, watched: Optional[dict] = None):
     (a dict keyed by id(tensor)), cotangents arriving at those tensors are
     accumulated there and leaf ``.grad`` fields are left untouched —
     functional `paddle.grad` mode (reference: partial_grad_engine.cc).
+    With ``create_graph`` every node's vjp runs through the op funnel
+    (see _replay_node) so the results are differentiable again.
     """
+    from .tensor import Tensor
+
     root_nodes = []
     for root, grad in roots:
         entry = _node_of(root)
@@ -152,6 +221,8 @@ def _execute(roots, retain_graph: bool = False, watched: Optional[dict] = None):
         if grad is None:
             shape, dtype = root_node.out_avals[root_idx]
             grad = jnp.ones(shape, dtype)
+        if create_graph and not isinstance(grad, Tensor):
+            grad = Tensor(grad)
         root_node.seed(root_idx, grad)
         root_nodes.append(root_node)
         if watched is not None and id(root) in watched:
@@ -187,7 +258,10 @@ def _execute(roots, retain_graph: bool = False, watched: Optional[dict] = None):
                 "set retain_graph=True to allow this.")
         # apply() arranges every op's pure fn to return a flat tuple of output
         # leaves, so the cotangent is always a tuple.
-        in_cots = node.vjp_fn(tuple(node.cotangents()))
+        if create_graph:
+            in_cots = _replay_node(node)
+        else:
+            in_cots = node.vjp_fn(tuple(node.cotangents()))
         if not retain_graph:
             node.vjp_fn = None
         node.accum = {}
@@ -218,16 +292,12 @@ def backward(root, grad=None, retain_graph: bool = False):
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
     """Functional ``paddle.grad`` (reference: imperative/partial_grad_engine.cc
-    via python/paddle/fluid/dygraph/base.py grad). ``create_graph`` (double
-    grad) is not yet supported on the eager tape; use jax.grad composition via
-    jit.to_static for higher-order gradients."""
+    via python/paddle/fluid/dygraph/base.py grad). With ``create_graph=True``
+    the returned gradients carry their own tape, so they can be
+    differentiated again (gradient penalties, double grad — the reference's
+    PartialGradEngine with create_graph)."""
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported yet; "
-            "wrap the computation with paddle_tpu.jit.to_static and use "
-            "nested vjp there.")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -238,9 +308,16 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     # Collect mode: one multi-root walk; leaf .grad fields are untouched and
     # intermediate (non-leaf) inputs get their cotangents too.
     watched = {id(t): [] for t in inputs}
-    roots = [(o, None if g is None else (g._data if isinstance(g, Tensor) else g))
-             for o, g in zip(outputs, grad_outputs)]
-    _execute(roots, retain_graph=bool(retain_graph), watched=watched)
+    if create_graph:
+        roots = [(o, g) for o, g in zip(outputs, grad_outputs)]
+        retain = True if retain_graph is None else bool(retain_graph)
+    else:
+        roots = [(o, None if g is None
+                  else (g._data if isinstance(g, Tensor) else g))
+                 for o, g in zip(outputs, grad_outputs)]
+        retain = bool(retain_graph)
+    _execute(roots, retain_graph=retain, watched=watched,
+             create_graph=create_graph)
 
     results = []
     for t in inputs:
@@ -251,6 +328,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "One of the differentiated tensors appears unused; "
                     "pass allow_unused=True to return None for it.")
             results.append(None)
+        elif create_graph:
+            total = contribs[0]
+            for c in contribs[1:]:
+                total = total + c
+            results.append(total if isinstance(total, Tensor)
+                           else Tensor(total))
         else:
             total = contribs[0]
             for c in contribs[1:]:

@@ -1,0 +1,81 @@
+"""Named stats gauges (reference: paddle/fluid/platform/monitor.h:77
+StatRegistry, STAT_ADD :130 — int/float gauges e.g. device memory stats).
+
+TPU note: device memory accounting lives with XLA; `device_memory_stats`
+surfaces what the backend reports, and the generic registry is available
+to any subsystem (io workers, checkpointing, launcher) for counters.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+
+class StatRegistry:
+    """reference: platform/monitor.h:77."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Number] = {}
+
+    def add(self, name: str, value: Number) -> Number:
+        with self._lock:
+            self._stats[name] = self._stats.get(name, 0) + value
+            return self._stats[name]
+
+    def set(self, name: str, value: Number):
+        with self._lock:
+            self._stats[name] = value
+
+    def get(self, name: str, default: Number = 0) -> Number:
+        with self._lock:
+            return self._stats.get(name, default)
+
+    def reset(self, name: str = None):
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+    def stats(self) -> Dict[str, Number]:
+        with self._lock:
+            return dict(self._stats)
+
+    def print_stats(self):
+        for k, v in sorted(self.stats().items()):
+            print(f"STAT {k} = {v}")
+
+
+_REGISTRY = StatRegistry()
+
+
+def default_registry() -> StatRegistry:
+    return _REGISTRY
+
+
+def stat_add(name: str, value: Number) -> Number:
+    """reference: monitor.h:130 STAT_ADD."""
+    return _REGISTRY.add(name, value)
+
+
+def stat_set(name: str, value: Number):
+    _REGISTRY.set(name, value)
+
+
+def stat_get(name: str, default: Number = 0) -> Number:
+    return _REGISTRY.get(name, default)
+
+
+def device_memory_stats(device=None) -> Dict[str, Number]:
+    """Per-device memory stats as reported by the backend (the reference
+    tracks these via its own allocator; XLA owns allocation here)."""
+    import jax
+    d = device or jax.devices()[0]
+    try:
+        s = d.memory_stats() or {}
+    except Exception:
+        s = {}
+    return {k: v for k, v in s.items() if isinstance(v, (int, float))}

@@ -1,0 +1,141 @@
+"""ctypes binding for the C++ shared-memory ring (csrc/shm_ring.cpp) —
+the native DataLoader transport (reference:
+memory/allocation/mmap_allocator.cc + reader/buffered_reader.cc).
+
+Builds the .so on first use with the system g++ (cached under
+csrc/build/); environments without a toolchain fall back to queue
+transport in the DataLoader (``available()`` is the gate).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import struct
+import subprocess
+import threading
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libshm_ring.so")
+_LIB = None
+_BUILD_LOCK = threading.Lock()
+
+
+def _build():
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    src = os.path.join(_CSRC, "shm_ring.cpp")
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o",
+           _SO + ".tmp", src, "-lrt", "-lpthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_SO + ".tmp", _SO)
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None:
+            return _LIB
+        if not os.path.exists(_SO):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.shm_ring_create.restype = ctypes.c_void_p
+        lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.shm_ring_open.restype = ctypes.c_void_p
+        lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+        lib.shm_ring_push.restype = ctypes.c_int
+        lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64, ctypes.c_int64]
+        lib.shm_ring_pop.restype = ctypes.c_int
+        lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_int64, ctypes.c_int64]
+        lib.shm_ring_capacity.restype = ctypes.c_int64
+        lib.shm_ring_capacity.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_used.restype = ctypes.c_int64
+        lib.shm_ring_used.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+        lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    if os.name != "posix":
+        return False
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+class ShmRing:
+    """Single-producer single-consumer byte ring in POSIX shared memory."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 create: bool = True):
+        self._lib = _load()
+        self.name = name.encode()
+        if create:
+            self._base = self._lib.shm_ring_create(self.name, capacity)
+        else:
+            self._base = self._lib.shm_ring_open(self.name)
+        if not self._base:
+            raise OSError(f"shm_ring {'create' if create else 'open'} "
+                          f"failed for {name}")
+        self._creator = create
+
+    def push_bytes(self, data: bytes, timeout_ms: int = 120_000):
+        rc = self._lib.shm_ring_push(self._base, data, len(data), timeout_ms)
+        if rc == -2:
+            raise ValueError(f"payload of {len(data)}B exceeds ring "
+                             f"capacity; raise DataLoader shm capacity")
+        if rc != 0:
+            raise TimeoutError("shm_ring push timed out (consumer stalled)")
+
+    def pop_bytes(self, n: int, timeout_ms: int = 120_000) -> bytes:
+        buf = ctypes.create_string_buffer(n)
+        rc = self._lib.shm_ring_pop(self._base, buf, n, timeout_ms)
+        if rc != 0:
+            raise TimeoutError("shm_ring pop timed out (producer stalled)")
+        return buf.raw
+
+    # -- pickled-object transport (protocol-5 out-of-band buffers) ---------
+    def push_object(self, obj, timeout_ms: int = 120_000) -> int:
+        """Returns total bytes pushed; the caller ships that count through
+        its metadata channel so the consumer knows how much to pop."""
+        buffers = []
+        payload = pickle.dumps(obj, protocol=5,
+                               buffer_callback=buffers.append)
+        parts = [payload] + [bytes(b.raw()) for b in buffers]
+        header = struct.pack("<q", len(parts)) + b"".join(
+            struct.pack("<q", len(p)) for p in parts)
+        blob = header + b"".join(parts)
+        self.push_bytes(blob, timeout_ms)
+        return len(blob)
+
+    def pop_object(self, total: int, timeout_ms: int = 120_000):
+        blob = self.pop_bytes(total, timeout_ms)
+        (n_parts,) = struct.unpack_from("<q", blob, 0)
+        sizes = struct.unpack_from(f"<{n_parts}q", blob, 8)
+        off = 8 + 8 * n_parts
+        parts = []
+        for s in sizes:
+            parts.append(blob[off:off + s])
+            off += s
+        return pickle.loads(parts[0], buffers=parts[1:])
+
+    def close(self):
+        if self._base:
+            self._lib.shm_ring_close(self._base)
+            self._base = None
+        if self._creator:
+            self._lib.shm_ring_unlink(self.name)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -30,7 +30,7 @@ class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "name",
                  "persistable", "_inplace_version", "_backward_hooks",
                  "_hook_counter", "trainable", "__weakref__", "is_distributed",
-                 "_sharding_spec")
+                 "_sharding_spec", "_uid")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None, persistable=False):
@@ -164,9 +164,41 @@ class Tensor:
         return Tensor(np.asarray(self._data), stop_gradient=self.stop_gradient)
 
     def pin_memory(self):
+        # host staging is XLA's job on TPU; identity is the honest behavior
         return self
 
+    _DEVICE_PREFIXES = ("cpu", "gpu", "xpu", "npu", "tpu", "ipu")
+
     def to(self, *args, **kwargs):
+        """paddle.Tensor.to(dtype) / to(device) / to(device, dtype):
+        dtype strings/objects really cast (a ported ``.to('float64')``
+        must not silently stay float32); device moves return self on the
+        single-backend runtime — preserving the autograd chain and the
+        Parameter identity. Unrecognized strings raise (a dtype typo must
+        not silently become a device no-op)."""
+        dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
+        for a in args:
+            if isinstance(a, str):
+                try:
+                    dt = _dtypes.convert_dtype(a)
+                except (KeyError, TypeError, ValueError):
+                    dt = None
+                if dt is not None:
+                    dtype = a
+                elif a.split(":")[0] in Tensor._DEVICE_PREFIXES:
+                    device = a
+                else:
+                    raise ValueError(
+                        f"Tensor.to: {a!r} is neither a known dtype nor a "
+                        f"device (cpu/gpu/xpu[:N])")
+            elif isinstance(a, (np.dtype, type)):
+                dtype = a
+            elif isinstance(a, Place):
+                device = a
+        del device  # placement is XLA's job here; .to(device) is identity
+        if dtype is not None:
+            return self.astype(dtype)
         return self
 
     def value(self):
@@ -349,3 +381,19 @@ class Parameter(Tensor):
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()
+
+
+_UID_COUNTER = iter(range(1, 2 ** 62))
+
+
+def stable_uid(t: Tensor) -> int:
+    """Process-unique id for a Tensor, assigned lazily on first use.
+
+    Unlike ``id()``, never reused after the object is garbage-collected —
+    optimizer accumulators keyed by it can't silently alias a new
+    Parameter that CPython placed at a recycled address."""
+    try:
+        return t._uid
+    except AttributeError:
+        t._uid = next(_UID_COUNTER)
+        return t._uid

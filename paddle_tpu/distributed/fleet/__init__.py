@@ -28,6 +28,9 @@ from ..topology import (CommunicateTopology, HybridCommunicateGroup,
 from .. import mesh as _mesh
 from ..env import get_rank, get_world_size, init_parallel_env
 from . import utils  # noqa: F401 (recompute lives here)
+from . import fs  # noqa: F401 (LocalFS/HDFSClient facade)
+from .sharded_embedding import (ShardedEmbedding,  # noqa: F401
+                                sparse_row_update, make_row_state)
 
 
 class _FleetState:
@@ -41,7 +44,15 @@ _F = _FleetState()
 
 
 def init(role_maker=None, is_collective=False, strategy=None):
-    """reference: fleet_base.py:139."""
+    """reference: fleet_base.py:139. Collective mode only: the brpc
+    parameter-server world is out of scope by ADR
+    (docs/adr/0001-parameter-server.md) — its capability is covered by
+    fleet.ShardedEmbedding."""
+    if role_maker is not None and not is_collective:
+        raise NotImplementedError(
+            "parameter-server role makers are out of scope "
+            "(docs/adr/0001-parameter-server.md); use is_collective=True "
+            "and fleet.ShardedEmbedding for large sparse tables")
     if strategy is None:
         strategy = DistributedStrategy()
     _F.strategy = strategy

@@ -146,6 +146,23 @@ class PipelineLayer(Layer):
         return all([(t.shape, str(t.dtype)) for t in tr] == sig0
                    for tr in trees[1:])
 
+    def stage_parameters(self):
+        """Per-stage lists of Parameter objects (grad targets for the
+        compiled engine)."""
+        return [[p for _, p in stage.named_parameters()]
+                for stage in self._stages]
+
+    def build_stage_pures(self):
+        """Functionalize every stage (arbitrary, heterogeneous Layers) into
+        pure fns for the compiled engine — no stages_uniform requirement.
+        Returns [(pure, meta)] per stage; pure(param_raws, (x,), key, None)
+        -> (out_raw, *effects)."""
+        from ...jit.functionalize import build_pure
+        pures = []
+        for stage, pts in zip(self._stages, self.stage_parameters()):
+            pures.append(build_pure(stage.forward, pts))
+        return pures
+
 
 class PipelineParallel(Layer):
     """reference: fleet/meta_parallel/pipeline_parallel.py PipelineParallel."""
@@ -219,6 +236,93 @@ class PipelineParallel(Layer):
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor(np.float32(total / m))
+
+    def train_batch_compiled(self, data, optimizer, lr_scheduler=None):
+        """One GPipe step as ONE compiled SPMD program over the "pp" mesh
+        axis (pipeline_engine.gpipe_stages): heterogeneous stage lists are
+        supported — per-stage activation signatures are fixed at build time
+        by abstract eval (the TPU answer to the reference's _send_meta
+        handshake, pipeline_parallel.py:272). Forward through the rotating
+        schedule, in-pipe per-microbatch loss, grads by AD through
+        scan+ppermute, then the framework optimizer applies the update."""
+        import jax
+        import jax.numpy as jnp
+        from ...core import generator as _gen
+        from . import pipeline_engine as PE
+        from .. import mesh as _mesh
+
+        x, y = data
+        x = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+        y = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+        m = self._accumulate_steps
+        loss_fn = self._layers.loss_fn
+        if loss_fn is None:
+            raise RuntimeError("PipelineLayer needs loss_fn for train_batch")
+
+        if getattr(self, "_compiled_step", None) is None:
+            mesh = _mesh.ensure_mesh()
+            S = self._layers._num_stages
+            pures = self._layers.build_stage_pures()
+            stage_tensors = self._layers.stage_parameters()
+            loss_params = ([p for _, p in loss_fn.named_parameters()]
+                           if isinstance(loss_fn, Layer) else [])
+            from ...jit.functionalize import build_pure
+            loss_pure, _ = build_pure(
+                loss_fn.forward if isinstance(loss_fn, Layer) else loss_fn,
+                loss_params)
+
+            def step(all_raws, loss_raws, xs, ys, key):
+                def mk(s):
+                    pure = pures[s][0]
+
+                    def fn(p, inp):
+                        k = jax.random.fold_in(key, s)
+                        if s == S - 1:
+                            carry, xy = inp
+                            out = pure(p, (carry,), k, None)[0]
+                            return loss_pure(loss_raws, (out, xy[1]),
+                                             jax.random.fold_in(key, S),
+                                             None)[0]
+                        xin = inp[0] if s == 0 else inp  # (x_mb, y_mb) -> x
+                        return pure(p, (xin,), k, None)[0]
+                    return fn
+
+                losses = PE.gpipe_stages(
+                    [mk(s) for s in range(S)], all_raws, (xs, ys),
+                    mesh=mesh, last_takes_input=True)
+                return jnp.mean(losses)
+
+            grad_step = jax.jit(jax.value_and_grad(step, argnums=(0, 1)))
+            self._compiled_step = (grad_step, stage_tensors, loss_params,
+                                   pures)
+
+        grad_step, stage_tensors, loss_params, pures = self._compiled_step
+        mb = x.shape[0] // m
+        xs = x._data.reshape((m, mb) + tuple(x.shape[1:]))
+        ys = y._data.reshape((m, mb) + tuple(y.shape[1:]))
+        all_raws = [[p._data for p in ts] for ts in stage_tensors]
+        loss_raws = [p._data for p in loss_params]
+        loss, (g_stages, g_loss) = grad_step(all_raws, loss_raws, xs, ys,
+                                             _gen.next_key())
+        # effect metadata is populated during the first trace (inside
+        # grad_step); reject unsupported stages BEFORE touching any grads
+        # so a caller can fall back to train_batch cleanly
+        for pm in pures:
+            if pm[1].get("effect_holders"):
+                raise NotImplementedError(
+                    "compiled pipeline does not yet thread buffer effects "
+                    "(e.g. BN running stats) — use train_batch for such "
+                    "stages")
+        for ts, gs in zip(stage_tensors, g_stages):
+            for p, g in zip(ts, gs):
+                p._grad = g if p._grad is None else p._grad + g
+        for p, g in zip(loss_params, g_loss):
+            p._grad = g if p._grad is None else p._grad + g
+        optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(np.asarray(loss))
 
     def eval_batch(self, data, compute_loss=True):
         from ...core.autograd_engine import no_grad

@@ -1,0 +1,135 @@
+"""Mesh-sharded embedding tables: the TPU-native answer to the reference's
+parameter-server sparse tables (see docs/adr/0001-parameter-server.md).
+
+Reference capability being replaced:
+- `paddle/fluid/distributed/table/common_sparse_table.h:112` — vocab rows
+  sharded across PS servers, pulled/pushed over brpc, per-row Adam state
+- `python/paddle/distributed/fleet/runtime/the_one_ps.py:434` — the
+  runtime that rewrites programs into send/recv against those tables
+
+TPU design: the table is ONE jax array sharded on the vocab dimension over
+mesh axes; lookups are plain gathers that GSPMD lowers to the right
+collectives over ICI, and per-row optimizer state shards with the table.
+No RPC layer, no program rewriting — sharding annotations do the work.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor, Parameter
+from ...nn.layer_base import Layer
+from ...ops.dispatch import apply
+from .. import mesh as _mesh
+
+
+class ShardedEmbedding(Layer):
+    """Embedding whose table is sharded on the vocab dim over mesh axes.
+
+    Unlike ``fleet.VocabParallelEmbedding`` (the Megatron TP layer for use
+    *inside* shard_map), this is the GSPMD form: construct under a mesh,
+    call it from jitted or eager code with global ids — XLA partitions the
+    gather. Scales table memory with the number of devices on ``axes``.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 axes: Tuple[str, ...] = None, mesh=None, weight_attr=None,
+                 sparse: bool = False, name: Optional[str] = None):
+        super().__init__()
+        self._num_embeddings = int(num_embeddings)
+        self._embedding_dim = int(embedding_dim)
+        m = mesh or _mesh.ensure_mesh()
+        self._mesh = m
+        axes = tuple(axes) if axes is not None else tuple(m.axis_names)
+        n_shards = int(np.prod([m.shape[a] for a in axes])) or 1
+        if num_embeddings % n_shards != 0:
+            raise ValueError(
+                f"num_embeddings {num_embeddings} must divide the {axes} "
+                f"shard count {n_shards} (pad the vocab)")
+        self._axes = axes
+        from ...nn import initializer as I
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0 / np.sqrt(embedding_dim)))
+        sharding = NamedSharding(m, P(axes, None))
+        self.weight._data = jax.device_put(self.weight._data, sharding)
+        self.weight._sharding_spec = P(axes, None)
+
+    @property
+    def partition_spec(self):
+        return P(self._axes, None)
+
+    def forward(self, ids):
+        w, table_spec, m = self.weight, self.partition_spec, self._mesh
+
+        def impl(table, idx):
+            out = jnp.take(table, idx, axis=0)
+            return jax.lax.with_sharding_constraint(
+                out, NamedSharding(m, P()))  # gathered rows replicated
+
+        return apply("sharded_embedding", impl, w, ids)
+
+    def state_dict(self, *a, **k):
+        sd = super().state_dict(*a, **k)
+        return sd
+
+
+@jax.jit
+def _sparse_adam(t, mm, vv, idx, g, lr, beta1, beta2, eps, step):
+    # segment-sum duplicate ids into dense per-row grads via scatter-add
+    dense_g = jnp.zeros_like(t).at[idx].add(g)
+    touched = jnp.zeros((t.shape[0], 1), t.dtype).at[idx].set(1.0)
+    new_m = jnp.where(touched > 0, beta1 * mm + (1 - beta1) * dense_g, mm)
+    new_v = jnp.where(touched > 0,
+                      beta2 * vv + (1 - beta2) * dense_g * dense_g, vv)
+    mhat = new_m / (1 - beta1 ** step)
+    vhat = new_v / (1 - beta2 ** step)
+    new_t = jnp.where(touched > 0,
+                      t - lr * mhat / (jnp.sqrt(vhat) + eps), t)
+    return new_t, new_m, new_v
+
+
+def sparse_row_update(table, m_state, v_state, ids, grad_rows, *, lr=1e-3,
+                      beta1=0.9, beta2=0.999, eps=1e-8, step=1):
+    """Row-sparse Adam update against a (sharded) table — the semantics of
+    the reference's CommonSparseTable push (common_sparse_table.h:112):
+    duplicate ids are segment-summed, only touched rows update their Adam
+    moments. One fused XLA program; GSPMD partitions the scatters the same
+    way as the table.
+
+    All of ``table``/``m_state``/``v_state`` are [V, D] arrays (Tensors or
+    raw); ``ids`` [N] int, ``grad_rows`` [N, D]. Returns the updated
+    (table, m, v) — functional, caller rebinds.
+    """
+    t_raw = table._data if isinstance(table, Tensor) else table
+    m_raw = m_state._data if isinstance(m_state, Tensor) else m_state
+    v_raw = v_state._data if isinstance(v_state, Tensor) else v_state
+    ids_raw = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+    g_raw = (grad_rows._data if isinstance(grad_rows, Tensor)
+             else jnp.asarray(grad_rows))
+
+    # hyperparams traced (module-level jit: ONE compile per table shape,
+    # not one per call/step value)
+    new_t, new_m, new_v = _sparse_adam(
+        t_raw, m_raw, v_raw, ids_raw, g_raw,
+        jnp.asarray(lr, t_raw.dtype), jnp.asarray(beta1, t_raw.dtype),
+        jnp.asarray(beta2, t_raw.dtype), jnp.asarray(eps, t_raw.dtype),
+        jnp.asarray(step, jnp.float32))
+    if isinstance(table, Tensor):
+        return Tensor(new_t), Tensor(new_m), Tensor(new_v)
+    return new_t, new_m, new_v
+
+
+def make_row_state(table, mesh=None):
+    """Adam moment tensors sharded exactly like the table (the PS servers'
+    per-row optimizer state, here just same-spec arrays)."""
+    raw = table._data if isinstance(table, Tensor) else table
+    zeros = jnp.zeros_like(raw)
+    sh = getattr(raw, "sharding", None)
+    if sh is not None:
+        zeros = jax.device_put(zeros, sh)
+    return zeros, jnp.zeros_like(zeros)

@@ -1,0 +1,67 @@
+"""paddle.hub: load models from hubconf.py entrypoints
+(reference: python/paddle/hapi/hub.py — list/help/load over github, gitee
+or local sources; remote archives fetched via utils/download.py).
+
+Offline-first: ``source='local'`` is fully functional; remote sources go
+through paddle_tpu.utils.download and raise a clear error without egress.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve_dir(repo_dir, source):
+    if source == "local":
+        return repo_dir
+    from ..utils.download import get_path_from_url
+    if source == "github":
+        repo, _, branch = repo_dir.partition(":")
+        branch = branch or "main"
+        url = f"https://github.com/{repo}/archive/{branch}.zip"
+    elif source == "gitee":
+        repo, _, branch = repo_dir.partition(":")
+        branch = branch or "main"
+        url = f"https://gitee.com/{repo}/repository/archive/{branch}.zip"
+    else:
+        raise ValueError(f"unknown hub source {source!r}")
+    return get_path_from_url(url)
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """reference: hapi/hub.py list — callable entrypoint names."""
+    mod = _load_hubconf(_resolve_dir(repo_dir, source))
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """reference: hapi/hub.py help — the entrypoint's docstring."""
+    mod = _load_hubconf(_resolve_dir(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """reference: hapi/hub.py load — call the entrypoint."""
+    mod = _load_hubconf(_resolve_dir(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"no entrypoint {model!r} in {repo_dir}")
+    return fn(**kwargs)

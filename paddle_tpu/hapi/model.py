@@ -15,7 +15,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor, Parameter
+from ..core.tensor import Tensor, Parameter, stable_uid
 from ..core import generator as _gen
 from ..core import autograd_engine as _ag
 from ..nn.layer_base import Layer
@@ -37,8 +37,9 @@ class Model:
         self.stop_training = False
         self._train_step_fn = None
         self._train_sig = None
-        self._eval_fn = None
-        self._eval_sig = None
+        from collections import OrderedDict
+        self._eval_fns = OrderedDict()  # (sig, mode) -> compiled program
+        self._eval_fns_max = 64         # LRU bound (cf. dispatch cache)
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -136,10 +137,10 @@ class Model:
         ts = self._train_step_fn
         opt = self._optimizer
         for p in ts["trainable"]:
-            if id(p) not in opt._state:
-                opt._state[id(p)] = opt._init_state(p)
+            if stable_uid(p) not in opt._state:
+                opt._state[stable_uid(p)] = opt._init_state(p)
         opt._accumulators_built = True
-        opt_states = [opt._state[id(p)] for p in ts["trainable"]]
+        opt_states = [opt._state[stable_uid(p)] for p in ts["trainable"]]
         train_raws = [p._data for p in ts["trainable"]]
         fixed_raws = [ts["state"][i]._data for i in ts["fixed_pos"]]
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -150,7 +151,7 @@ class Model:
         for p, npr, ns in zip(ts["trainable"], new_p, new_s):
             p._data = npr
             p._inplace_version += 1
-            opt._state[id(p)] = ns
+            opt._state[stable_uid(p)] = ns
         opt._global_step += 1
         for h, v in zip(ts["meta"].get("effect_holders", []), effects):
             h._data = v
@@ -167,26 +168,86 @@ class Model:
             out.append(r)
         return out
 
+    def _eval_cache_get(self, sig):
+        ef = self._eval_fns.get(sig)
+        if ef is not None:
+            self._eval_fns.move_to_end(sig)
+        return ef
+
+    def _eval_cache_put(self, sig, ef):
+        if len(self._eval_fns) >= self._eval_fns_max:
+            self._eval_fns.popitem(last=False)
+        self._eval_fns[sig] = ef
+        return ef
+
+    def _build_eval_step(self, with_loss):
+        """Compile (state, x, y) -> (preds, loss) — eval/predict as ONE
+        cached XLA program per signature instead of per-op dispatch
+        (reference: hapi/model.py:250 StaticGraphAdapter compiles a
+        separate eval Program; per-op eager here would pay the device
+        round-trip per op, ~100ms each through the axon tunnel)."""
+        params, buffers = self._state()
+        state = params + buffers
+        loss_fn = self._loss
+        net = self.network
+
+        def ev(state_raws, x_raws, y_raws, key):
+            with trace_context(key):
+                with swap_params(state, state_raws):
+                    with _ag.no_grad():
+                        xs = [Tensor(r) for r in x_raws]
+                        ys = [Tensor(r) for r in y_raws]
+                        preds = net.forward(*xs)
+                        preds_t = preds if isinstance(preds, (list, tuple)) \
+                            else [preds]
+                        if with_loss:
+                            loss = loss_fn(*preds_t, *ys)
+                            loss_raw = (loss._data if isinstance(loss, Tensor)
+                                        else jnp.asarray(loss))
+                        else:
+                            loss_raw = jnp.zeros(())
+            # eval-mode traces have no buffer effects (BN uses running
+            # stats); any stray effect is deliberately not applied
+            return [p._data for p in preds_t], loss_raw
+
+        return {"fn": jax.jit(ev), "state": state}
+
     def eval_batch(self, inputs, labels=None):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else (
             [labels] if labels is not None else [])
-        self.network.eval()
-        with _ag.no_grad():
-            preds = self.network(*inputs)
-        preds_t = preds if isinstance(preds, (list, tuple)) else [preds]
-        loss = None
-        if self._loss is not None and labels:
-            loss = float(self._loss(*preds_t, *labels))
-        metrics = self._update_metrics([p._data for p in preds_t], labels)
+        x_raws = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        y_raws = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                  for l in labels]
+        with_loss = self._loss is not None and bool(labels)
+        sig = (tuple((tuple(r.shape), str(r.dtype))
+                     for r in x_raws + y_raws), with_loss)
+        ef = self._eval_cache_get(sig)
+        if ef is None:
+            self.network.eval()
+            ef = self._eval_cache_put(sig, self._build_eval_step(with_loss))
+        preds, loss_raw = ef["fn"]([s._data for s in ef["state"]],
+                                   x_raws, y_raws, _gen.next_key())
+        loss = float(loss_raw) if with_loss else None
+        metrics = self._update_metrics(preds, labels)
         return loss, metrics
 
     def predict_batch(self, inputs):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        self.network.eval()
-        with _ag.no_grad():
-            out = self.network(*inputs)
-        return out
+        x_raws = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        sig = (tuple((tuple(r.shape), str(r.dtype)) for r in x_raws),
+               "predict")
+        ef = self._eval_cache_get(sig)
+        if ef is None:
+            self.network.eval()
+            ef = self._eval_cache_put(
+                sig, self._build_eval_step(with_loss=False))
+        preds, _ = ef["fn"]([s._data for s in ef["state"]], x_raws, [],
+                            _gen.next_key())
+        out = [Tensor(p) for p in preds]
+        return out[0] if len(out) == 1 else out
 
     # ------------------------------------------------------------------
     def _as_loader(self, data, batch_size, shuffle, num_workers):

@@ -1,0 +1,3 @@
+"""paddle.incubate: graduated-API staging area (reference:
+python/paddle/fluid/incubate/)."""
+from . import checkpoint  # noqa: F401

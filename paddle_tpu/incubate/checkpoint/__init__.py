@@ -1,0 +1,19 @@
+"""Sharded / async / auto checkpointing for multichip training.
+
+Reference:
+- auto-checkpoint: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:71
+  (TrainEpochRange — epoch-range loop that snapshots state keyed by job id
+  and resumes after a restart; EDL hooks)
+- saver: incubate/checkpoint/checkpoint_saver.py
+- PS sharded tables: distributed/common/sparse_sharding_merge.h
+
+TPU design: a checkpoint is a directory of per-host shard files + a JSON
+metadata index. Each host writes only the array shards it can address
+(``jax.Array.addressable_shards``), so a multi-host job writes in parallel
+with no cross-host traffic; load reassembles the global arrays and
+re-shards them onto the *current* mesh (which may have a different
+topology — resharding on restore). Async mode moves the device→host fetch
+and file write off the training thread (the orbax-style pattern).
+"""
+from .sharded import (save_sharded, load_sharded, AsyncSaver)  # noqa: F401
+from .auto_checkpoint import TrainEpochRange, train_epoch_range  # noqa: F401

@@ -1,0 +1,150 @@
+"""Epoch-range auto-checkpoint: restart-safe training loops.
+
+Reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:71
+(TrainEpochRange / AutoCheckpointChecker — wraps the epoch loop, snapshots
+executor scope + epoch counters keyed by job id to HDFS, resumes after an
+elastic restart; enabled by PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT,
+job id from PADDLE_JOB_ID, storage from PADDLE_EDL_HDFS_CHECKPOINT_PATH).
+
+TPU design: the snapshot is a sharded checkpoint (sharded.py) of the
+registered model/optimizer state plus the epoch counter; storage goes
+through the FS facade so a LocalFS path and an HDFS-shaped path behave the
+same. A killed job rebuilt with the same name resumes at the next
+unfinished epoch with identical state.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Optional
+
+from .sharded import save_sharded, load_sharded, AsyncSaver
+
+
+def _default_root():
+    return os.environ.get("PADDLE_EDL_HDFS_CHECKPOINT_PATH",
+                          os.environ.get("PADDLE_CHECKPOINT_DIR",
+                                         "./paddle_auto_checkpoint"))
+
+
+def _job_id():
+    return os.environ.get("PADDLE_JOB_ID", "default_job")
+
+
+class TrainEpochRange:
+    """Iterate epochs with automatic save/restore.
+
+    ::
+
+        r = TrainEpochRange(10, "job0", model=model, optimizer=opt)
+        for epoch in r:
+            train_one_epoch(...)
+        # kill + rerun: the loop resumes at the first unfinished epoch
+        # with model/optimizer state restored.
+    """
+
+    def __init__(self, max_epoch_num: int, name: Optional[str] = None,
+                 model=None, optimizer=None, checkpoint_path: Optional[str] = None,
+                 save_checkpoint_inter: int = 1, async_save: bool = False,
+                 keep_last: int = 2):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name or _job_id()
+        self._model = model
+        self._optimizer = optimizer
+        self._dir = os.path.join(checkpoint_path or _default_root(),
+                                 self.name)
+        self._inter = max(1, int(save_checkpoint_inter))
+        self._keep_last = keep_last
+        self._saver = AsyncSaver() if async_save else None
+        self.restored_epoch = -1
+        self._restore()
+
+    # -- persistence --------------------------------------------------------
+    def _status_path(self):
+        return os.path.join(self._dir, "status.json")
+
+    def _epoch_dir(self, epoch):
+        return os.path.join(self._dir, f"epoch_{epoch}")
+
+    def _state(self):
+        state = {}
+        if self._model is not None:
+            state["model"] = dict(self._model.state_dict())
+        if self._optimizer is not None:
+            state["optimizer"] = dict(self._optimizer.state_dict())
+        return state
+
+    def _restore(self):
+        sp = self._status_path()
+        if not os.path.exists(sp):
+            return
+        with open(sp) as f:
+            status = json.load(f)
+        epoch = int(status.get("epoch_no", -1))
+        if epoch < 0:
+            return
+        ckpt = self._epoch_dir(epoch)
+        if not os.path.isdir(ckpt):
+            return
+        state = load_sharded(ckpt)
+        if self._model is not None and "model" in state:
+            self._model.set_state_dict(state["model"])
+        if self._optimizer is not None and "optimizer" in state:
+            self._optimizer.set_state_dict(state["optimizer"])
+        self.restored_epoch = epoch
+
+    def _commit(self, epoch: int):
+        # status.json is written only after the shard files exist, so a
+        # crash mid-save leaves the previous checkpoint referenced
+        with open(self._status_path(), "w") as f:
+            json.dump({"epoch_no": epoch, "max_epoch_num": self.max_epoch_num},
+                      f)
+        self._gc(epoch)
+
+    def save(self, epoch: int):
+        ckpt = self._epoch_dir(epoch)
+        if self._saver is not None:
+            # async: the fetch+write AND the status commit happen on the
+            # background thread — training overlaps the whole save, and
+            # AsyncSaver.save waits for any previous in-flight save first
+            self._saver.save(self._state(), ckpt,
+                             on_done=lambda: self._commit(epoch))
+        else:
+            save_sharded(self._state(), ckpt)
+            self._commit(epoch)
+
+    def _gc(self, current):
+        if self._keep_last is None:
+            return
+        for name in os.listdir(self._dir):
+            if not name.startswith("epoch_"):
+                continue
+            e = int(name.split("_", 1)[1])
+            if e <= current - self._keep_last * self._inter:
+                shutil.rmtree(os.path.join(self._dir, name),
+                              ignore_errors=True)
+
+    # -- iteration ----------------------------------------------------------
+    def get(self):
+        return iter(self)
+
+    def wait(self):
+        if self._saver is not None:
+            self._saver.wait()
+
+    def __iter__(self):
+        try:
+            for epoch in range(self.restored_epoch + 1, self.max_epoch_num):
+                yield epoch
+                if ((epoch + 1) % self._inter == 0
+                        or epoch == self.max_epoch_num - 1):
+                    self.save(epoch)
+        finally:
+            self.wait()  # don't exit with an uncommitted in-flight save
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, **kw):
+    """Function form (reference: auto_checkpoint.py train_epoch_range)."""
+    return TrainEpochRange(max_epoch_num,
+                           save_checkpoint_inter=save_checkpoint_inter, **kw)

@@ -1,0 +1,209 @@
+"""Sharded checkpoint format: per-host shard archives + JSON metadata.
+
+Layout of a checkpoint directory::
+
+    ckpt/
+      metadata_<proc>.json   # per-host: key -> {shape, dtype, spec, shards}
+      shards_<proc>.npz      # per-host: "<key>|<i>" -> shard ndarray
+      scalars.json           # non-array leaves (ints, floats, strings)
+
+Multi-host jobs write only addressable shards (parallel, no cross-host
+traffic); load expects all hosts' files on a shared filesystem (the
+reference makes the same assumption for its HDFS checkpoints,
+fleet/utils/fs.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def _flatten(tree, prefix=""):
+    """Nested dict/list -> {joined_key: leaf}."""
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]):
+    root: Dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = val
+    return root
+
+
+def _spec_of(raw):
+    """PartitionSpec axis names of a jax.Array, or None."""
+    sh = getattr(raw, "sharding", None)
+    if sh is None or not hasattr(sh, "spec"):
+        return None
+    return [list(ax) if isinstance(ax, tuple) else ax for ax in sh.spec]
+
+
+def _slices_of(shard, ndim):
+    idx = shard.index
+    out = []
+    for d in range(ndim):
+        s = idx[d] if d < len(idx) else slice(None)
+        out.append([s.start, s.stop] if s.start is not None or s.stop is not None
+                   else None)
+    return out
+
+
+def save_sharded(state, path: str, overwrite: bool = True):
+    """Write ``state`` (nested dict/list of Tensors/arrays/scalars) as a
+    sharded checkpoint directory. Safe to call from every process of a
+    multi-host job — each writes its own files."""
+    os.makedirs(path, exist_ok=True)
+    proc = jax.process_index()
+    flat = _flatten(state)
+    meta: Dict[str, Any] = {}
+    shard_blobs: Dict[str, np.ndarray] = {}
+    scalars: Dict[str, Any] = {}
+    for key, val in flat.items():
+        raw = val._data if isinstance(val, Tensor) else val
+        if isinstance(raw, (int, float, str, bool, type(None))):
+            scalars[key] = raw
+            continue
+        if isinstance(raw, np.ndarray):
+            raw = jnp.asarray(raw)
+        entry = {"shape": list(raw.shape), "dtype": str(raw.dtype),
+                 "spec": _spec_of(raw), "shards": []}
+        for i, s in enumerate(getattr(raw, "addressable_shards", [])) or []:
+            blob_key = f"{key}|{i}"
+            shard_blobs[blob_key] = np.asarray(s.data)
+            entry["shards"].append(
+                {"blob": blob_key, "index": _slices_of(s, raw.ndim)})
+        if not entry["shards"]:  # plain value with no shard view
+            blob_key = f"{key}|0"
+            shard_blobs[blob_key] = np.asarray(raw)
+            entry["shards"].append({"blob": blob_key, "index": None})
+        meta[key] = entry
+    tmp = os.path.join(path, f".tmp_shards_{proc}.npz")
+    np.savez(tmp, **shard_blobs)
+    os.replace(tmp, os.path.join(path, f"shards_{proc}.npz"))
+    with open(os.path.join(path, f"metadata_{proc}.json"), "w") as f:
+        json.dump(meta, f)
+    if proc == 0:
+        with open(os.path.join(path, "scalars.json"), "w") as f:
+            json.dump(scalars, f)
+
+
+def load_sharded(path: str, mesh=None, return_tensor: bool = True):
+    """Load a sharded checkpoint, reassembling global arrays from every
+    host's shard files and (when ``mesh`` is given) re-sharding each array
+    onto the current mesh using its recorded PartitionSpec — axes missing
+    from the new mesh degrade to replication (resharding on restore)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    metas = {}
+    for fn in sorted(os.listdir(path)):
+        if fn.startswith("metadata_"):
+            with open(os.path.join(path, fn)) as f:
+                m = json.load(f)
+            proc = fn[len("metadata_"):-len(".json")]
+            for k, v in m.items():
+                metas.setdefault(k, []).append((proc, v))
+    blobs = {}
+    for fn in sorted(os.listdir(path)):
+        if fn.startswith("shards_") and fn.endswith(".npz"):
+            proc = fn[len("shards_"):-len(".npz")]
+            with np.load(os.path.join(path, fn)) as z:
+                for k in z.files:
+                    blobs[(proc, k)] = z[k]
+
+    flat: Dict[str, Any] = {}
+    for key, entries in metas.items():
+        shape = tuple(entries[0][1]["shape"])
+        dtype = entries[0][1]["dtype"]
+        spec = entries[0][1]["spec"]
+        full = np.zeros(shape, dtype=dtype) if shape else None
+        for proc, e in entries:
+            for sh in e["shards"]:
+                data = blobs[(proc, sh["blob"])]
+                if sh["index"] is None or not shape:
+                    full = data
+                    continue
+                sl = tuple(slice(None) if s is None else slice(s[0], s[1])
+                           for s in sh["index"])
+                full[sl] = data
+        arr = _reshard(full, spec, mesh)
+        flat[key] = Tensor(arr) if return_tensor else arr
+
+    scalars_path = os.path.join(path, "scalars.json")
+    if os.path.exists(scalars_path):
+        with open(scalars_path) as f:
+            flat.update(json.load(f))
+    return _unflatten(flat)
+
+
+def _reshard(full_np, spec, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if mesh is None or spec is None:
+        return jnp.asarray(full_np)
+    axes = []
+    names = set(mesh.axis_names)
+    for ax in spec:
+        if ax is None:
+            axes.append(None)
+        elif isinstance(ax, list):
+            keep = [a for a in ax if a in names]
+            axes.append(tuple(keep) if keep else None)
+        else:
+            axes.append(ax if ax in names else None)
+    return jax.device_put(full_np, NamedSharding(mesh, P(*axes)))
+
+
+class AsyncSaver:
+    """Asynchronous checkpointing: the device→host fetch + file write run on
+    a background thread so the training loop keeps stepping (orbax-style;
+    the reference's PS tables save server-side for the same reason)."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error = None
+
+    def save(self, state, path: str, on_done=None):
+        self.wait()
+        # snapshot raw references now; numpy conversion happens off-thread
+        flat = _flatten(state)
+        snapshot = _unflatten({k: (v._data if isinstance(v, Tensor) else v)
+                               for k, v in flat.items()})
+
+        def run():
+            try:
+                save_sharded(snapshot, path)
+                if on_done is not None:
+                    on_done()
+            except Exception as e:  # surfaced on wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err

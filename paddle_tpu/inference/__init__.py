@@ -1,0 +1,136 @@
+"""paddle.inference: standalone predictor over exported artifacts.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:82
+(AnalysisPredictor: Config → create_predictor → input handles →
+ZeroCopyRun :165) and paddle_infer Python API.
+
+TPU design: the deployable artifact is the serialized StableHLO program
+jit.save writes (*.pdmodel = jax.export payload, *.pdiparams = pickled
+params) — the predictor deserializes and executes it WITHOUT the model's
+Python code, the role AnalysisPredictor's ProgramDesc loading served. The
+analysis pass pipeline (fusions, TRT subgraphs) has no equivalent here by
+design: XLA compiles the whole program at load.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Config:
+    """reference: paddle_infer.Config (api/paddle_analysis_config.h)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._prefix = prog_file
+        self._params_file = params_file
+        self._enable_memory_optim = True
+
+    def set_prog_file(self, path):
+        self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    # accepted-and-ignored GPU-era knobs (kept for ported deploy scripts)
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT subgraphs are CUDA-era; XLA compiles the whole "
+            "program on TPU")
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    """reference: api/analysis_predictor.h:82 (Run :120 / ZeroCopyRun
+    :165)."""
+
+    def __init__(self, config: Config):
+        prefix = config._prefix
+        from jax import export as jax_export
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        with open(config._params_file or prefix + ".pdiparams", "rb") as f:
+            blob = pickle.load(f)
+        self._params = [jnp.asarray(p) for p in blob["params"]]
+        self._n_out = blob.get("n_out")
+        # in_avals flattens the params list + the real inputs
+        n_in = blob.get("n_in")
+        if n_in is None:
+            n_in = len(self._exported.in_avals) - len(self._params)
+        self._input_names = [f"x{i}" for i in range(max(n_in, 0))]
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle() for n in self._input_names}
+        self._outputs: List[_IOHandle] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name) -> _IOHandle:
+        return self._outputs[int(name.replace("out", ""))]
+
+    def run(self, inputs=None):
+        """Either positional (returns numpy list, reference Run) or via the
+        input handles (reference ZeroCopyRun)."""
+        if inputs is not None:
+            xs = [jnp.asarray(a) for a in inputs]
+        else:
+            xs = [self._inputs[n]._value for n in self._input_names]
+        outs = self._exported.call(self._params, *xs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        if self._n_out is not None:
+            outs = outs[:self._n_out]
+        self._outputs = []
+        for o in outs:
+            h = _IOHandle()
+            h._value = o
+            self._outputs.append(h)
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer.create_predictor."""
+    return Predictor(config)

@@ -294,10 +294,28 @@ def get_worker_info():
 
 
 def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
-                 num_workers, seed, iterable_mode):
-    """Worker process body (reference: dataloader_iter.py _worker_loop)."""
+                 num_workers, seed, iterable_mode, shm_name=None):
+    """Worker process body (reference: dataloader_iter.py _worker_loop).
+    With ``shm_name`` the batch payload goes through the C++ shared-memory
+    ring (csrc/shm_ring.cpp) and only (order, "SHM", (wid, nbytes)) rides
+    the queue — the reference's mmap_allocator transport."""
     np.random.seed((seed + wid) & 0xFFFFFFFF)
     _worker_info[0] = _WorkerInfo(wid, num_workers, dataset, seed)
+    ring = None
+    if shm_name is not None:
+        from ..core.shm_ring import ShmRing
+        ring = ShmRing(shm_name, create=False)
+
+    def send(order, batch):
+        if ring is not None:
+            try:
+                n = ring.push_object(batch)
+                out_queue.put((order, "SHM", (wid, n)))
+                return
+            except ValueError:
+                pass  # payload larger than the ring: queue fallback
+        out_queue.put((order, "OK", batch))
+
     try:
         if iterable_mode:
             it = iter(dataset)
@@ -310,7 +328,7 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
                 if not batch:
                     out_queue.put((order, "END", None))
                     continue
-                out_queue.put((order, "OK", collate_fn(batch)))
+                send(order, collate_fn(batch))
         else:
             while True:
                 msg = index_queue.get()
@@ -319,7 +337,7 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
                 order, indices = msg
                 try:
                     batch = [dataset[i] for i in indices]
-                    out_queue.put((order, "OK", collate_fn(batch)))
+                    send(order, collate_fn(batch))
                 except Exception:
                     out_queue.put((order, "ERR", traceback.format_exc()))
     except KeyboardInterrupt:
@@ -334,13 +352,15 @@ class DataLoader:
                  drop_last=False, collate_fn=None, num_workers=0,
                  use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=120, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, shm_capacity=64 << 20):
         self.dataset = dataset
         self.num_workers = num_workers
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch_factor = prefetch_factor
         self.timeout = timeout
         self.return_list = return_list
+        self.use_shared_memory = use_shared_memory
+        self.shm_capacity = int(shm_capacity)
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -399,13 +419,24 @@ class DataLoader:
         index_queues = []
         out_queue = ctx.Queue()
         workers = []
+        self._rings = {}
+        use_shm = False
+        if self.use_shared_memory and os.name == "posix":
+            from ..core.shm_ring import ShmRing, available as _shm_ok
+            if _shm_ok():
+                use_shm = True
         seed = int(np.random.randint(0, 2 ** 31))
         for wid in range(self.num_workers):
             iq = ctx.Queue()
+            shm_name = None
+            if use_shm:
+                shm_name = f"/pt_dl_{os.getpid()}_{id(self) & 0xFFFF}_{wid}"
+                self._rings[wid] = ShmRing(shm_name, create=True,
+                                           capacity=self.shm_capacity)
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, iq, out_queue, self.collate_fn, wid,
-                      self.num_workers, seed, self._iterable),
+                      self.num_workers, seed, self._iterable, shm_name),
                 daemon=True)
             w.start()
             index_queues.append(iq)
@@ -426,6 +457,9 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
+            for r in self._rings.values():
+                r.close()
+            self._rings = {}
 
     def _mp_map(self, index_queues, out_queue):
         batches = list(self.batch_sampler)
@@ -445,6 +479,9 @@ class DataLoader:
             inflight -= 1
             if status == "ERR":
                 raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            if status == "SHM":
+                wid, nbytes = payload
+                payload = self._rings[wid].pop_object(nbytes)
             hold[order] = payload
             while next_recv in hold:
                 yield self._to_tensors(hold.pop(next_recv))
@@ -462,6 +499,9 @@ class DataLoader:
                 continue
             if status == "ERR":
                 raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+            if status == "SHM":
+                rwid, nbytes = payload
+                payload = self._rings[rwid].pop_object(nbytes)
             if wid in live:
                 index_queues[wid].put((wid, self.batch_size))
             yield self._to_tensors(payload)

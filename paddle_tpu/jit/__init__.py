@@ -155,10 +155,27 @@ class StaticFunction:
             def vjp_fn(cots):
                 return bwd_jit(sd, idr, state_raws, in_raws, call_key, skw,
                                tuple(cots))
+
+            fwd_jit = entry["fwd"]
+            n_ds = len(diff_s)
+
+            def replay_pure(diff_raws, _other, _sr=tuple(state_raws),
+                            _ir=tuple(in_raws)):
+                # re-run the compiled forward as a function of the diff
+                # inputs so double grad tracks them (autograd _replay_node)
+                s_full = list(_sr)
+                i_full = list(_ir)
+                for pos, r in zip(diff_s, diff_raws[:n_ds]):
+                    s_full[pos] = r
+                for pos, r in zip(diff_i, diff_raws[n_ds:]):
+                    i_full[pos] = r
+                return tuple(fwd_jit(s_full, i_full, call_key, skw))
+
             node = _ag.GradNode(
                 f"to_static:{getattr(self._fn, '__name__', 'fn')}",
                 vjp_fn, diff_tensors,
-                [(tuple(o.shape), o.dtype) for o in out_raws])
+                [(tuple(o.shape), o.dtype) for o in out_raws],
+                replay=(replay_pure, ()))
 
         n_out = meta["n_out"]
         outs = []
@@ -276,6 +293,7 @@ def save(layer, path, input_spec=None, **config):
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({"params": params_np,
                      "n_out": meta.get("n_out"),
+                     "n_in": len(specs),
                      "out_treedef_children": None}, f, protocol=4)
 
 
@@ -311,3 +329,53 @@ def load(path, **config):
         blob = pickle.load(f)
     params = [jnp.asarray(p) for p in blob["params"]]
     return TranslatedLayer(exported, params, blob.get("n_out"))
+
+
+class TracedLayer:
+    """reference: fluid/dygraph/jit.py:1104 TracedLayer — trace a dygraph
+    Layer once into a compiled program; call it like the layer, and export
+    with save_inference_model. Here the trace is the functionalized pure
+    step compiled by jax.jit (the reference records a ProgramDesc)."""
+
+    def __init__(self, layer, pure, meta, state, out_single):
+        self._layer = layer
+        self._pure = pure
+        self._meta = meta
+        self._state = state
+        self._out_single = out_single
+        import jax as _jax
+        self._jitted = _jax.jit(
+            lambda raws, xs, key: pure(raws, xs, key, None))
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (outputs, traced_layer) — reference TracedLayer.trace."""
+        from .functionalize import build_pure
+        from ..core import generator as _gen
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        params = [p for _, p in layer.named_parameters()]
+        params += [b for _, b in layer.named_buffers()]
+        pure, meta = build_pure(layer.forward, params)
+        raws = [p._data for p in params]
+        x_raws = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                       for i in inputs)
+        out_raws = pure(raws, x_raws, _gen.next_key(), None)
+        n_out = meta["n_out"]
+        outs = [Tensor(o) for o in out_raws[:n_out]]
+        single = n_out == 1
+        tl = TracedLayer(layer, pure, meta, params, single)
+        return (outs[0] if single else outs), tl
+
+    def __call__(self, inputs):
+        from ..core import generator as _gen
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        x_raws = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                       for i in inputs)
+        raws = [p._data for p in self._state]
+        out = self._jitted(raws, x_raws, _gen.next_key())
+        outs = [Tensor(o) for o in out[:self._meta["n_out"]]]
+        return outs[0] if self._out_single else outs
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        """Export via the same StableHLO path as jit.save."""
+        save(self._layer, path)

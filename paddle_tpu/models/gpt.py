@@ -135,3 +135,95 @@ def tp_partition_specs(model: Layer) -> Dict[str, tuple]:
                 break
         specs[name] = spec
     return specs
+
+
+# -- pipeline plan ------------------------------------------------------------
+
+def gpt_pipeline_fns(model: "GPTForCausalLM", num_stages: int):
+    """Decompose a GPTForCausalLM into (embed_fn, block_fn, head_fn) pure
+    functions + their param trees for the compiled heterogeneous pipeline
+    engine (fleet.pipeline_engine.gpipe_blocks): embedding runs as stage
+    0's preamble, each stage applies num_layers/num_stages decoder blocks
+    (params stacked [S, k, ...] and sharded over "pp"), and the head (final
+    norm + tied-embedding logits + shifted CE loss) runs on the last stage.
+
+    The reference schedules these heterogeneous stage signatures with a
+    runtime handshake (fleet/meta_parallel/pipeline_parallel.py:272
+    _send_meta); here they are fixed at build time. Dropout must be 0 (the
+    engine threads no RNG through the schedule).
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..jit.functionalize import build_pure
+
+    cfg = model.gpt.config
+    if cfg.hidden_dropout_prob or cfg.attention_dropout_prob:
+        raise ValueError("gpt_pipeline_fns requires dropout 0")
+    L, S = cfg.num_layers, int(num_stages)
+    if L % S != 0:
+        raise ValueError(f"{L} layers not divisible by {S} stages")
+    k = L // S
+
+    emb = model.gpt.word_embeddings.weight._data
+    pos = model.gpt.position_embeddings.weight._data
+    dec_layers = list(model.gpt.decoder.layers)
+    final_norm = model.gpt.decoder.norm
+
+    # one pure fn traced from a representative block; per-stage params are
+    # the per-layer raw lists, stacked [S, k, ...]
+    layer0_params = [p for _, p in dec_layers[0].named_parameters()]
+    block_pure, _ = build_pure(dec_layers[0].forward, layer0_params)
+    per_layer_raws = [[p._data for _, p in lyr.named_parameters()]
+                     for lyr in dec_layers]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[jax.tree_util.tree_map(
+            lambda *ys: jnp.stack(ys), *per_layer_raws[s * k:(s + 1) * k])
+          for s in range(S)])
+
+    norm_params = [p for _, p in final_norm.named_parameters()]
+    norm_pure, _ = build_pure(final_norm.forward, norm_params)
+    norm_raws = [p._data for p in norm_params]
+
+    key = jax.random.PRNGKey(0)  # unused: dropout is 0
+
+    def _mask(h):
+        L_seq = h.shape[1]
+        m = jnp.triu(jnp.full((L_seq, L_seq), -1e4, h.dtype), 1)
+        return m[None, None]
+
+    def embed_fn(p, ids):
+        seq = ids.shape[1]
+        return p["tok"][ids] + p["pos"][None, :seq, :]
+
+    def block_fn(stage_params, h):
+        for i in range(k):
+            lp = jax.tree_util.tree_map(lambda a: a[i], stage_params)
+            h = block_pure(lp, (h, _mask(h)), key, None)[0]
+        return h
+
+    def head_fn(p, h, xy):
+        ids = xy if not isinstance(xy, tuple) else xy[0]
+        h = norm_pure(p["norm"], (h,), key, None)[0]
+        logits = h @ p["tok"].T
+        lo = jax.nn.log_softmax(logits[:, :-1, :].astype(jnp.float32))
+        tgt = ids[:, 1:]
+        nll = -jnp.take_along_axis(lo, tgt[..., None].astype(jnp.int32),
+                                   axis=-1)
+        return jnp.mean(nll)
+
+    embed_params = {"tok": emb, "pos": pos}
+    head_params = {"tok": emb, "norm": norm_raws}
+    block_tensors = [[p for _, p in lyr.named_parameters()]
+                     for lyr in dec_layers]
+    return {
+        "embed_fn": embed_fn, "block_fn": block_fn, "head_fn": head_fn,
+        "embed_params": embed_params, "stacked_block_params": stacked,
+        "head_params": head_params,
+        "param_tensors": {
+            "embed": [model.gpt.word_embeddings.weight,
+                      model.gpt.position_embeddings.weight],
+            "blocks": block_tensors, "norm": norm_params,
+        },
+        "stages": S, "layers_per_stage": k,
+    }

@@ -1,7 +1,7 @@
 """paddle.nn.functional parity namespace."""
 from .activation import *  # noqa: F401,F403
 from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,  # noqa: F401
-                   conv2d_transpose, conv3d_transpose)
+                   conv2d_transpose, conv3d_transpose, deformable_conv)
 from .pooling import (max_pool1d, max_pool2d, max_pool3d, avg_pool1d,  # noqa: F401
                       avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
                       adaptive_avg_pool2d, adaptive_avg_pool3d,

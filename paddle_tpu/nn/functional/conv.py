@@ -156,3 +156,113 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     return _conv_transpose_nd(3, x, weight, bias, stride, padding,
                               output_padding, dilation, groups, output_size,
                               data_format)
+
+
+def deformable_conv(x, offset, weight, mask=None, bias=None, stride=1,
+                    padding=0, dilation=1, deformable_groups=1, groups=1,
+                    im2col_step=None, name=None):
+    """reference: operators/deformable_conv_op.cc (v1) /
+    deformable_conv_v2 (with modulation ``mask``).
+
+    x [B, C, H, W]; offset [B, 2*dg*kh*kw, Ho, Wo] (y,x interleaved per
+    tap, reference layout); mask [B, dg*kh*kw, Ho, Wo]; weight
+    [Cout, C/groups, kh, kw]. Implemented as bilinear sampling (gather) +
+    one big contraction — the MXU does the matmul, XLA fuses the sampling.
+    """
+    sh, sw = _tuplize(stride, 2)
+    dh, dw = _tuplize(dilation, 2)
+    if isinstance(padding, (list, tuple)) and len(padding) == 4:
+        pt, pb, pl, pr = padding
+    else:
+        ph_, pw_ = _tuplize(padding, 2)
+        pt = pb = ph_
+        pl = pr = pw_
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    dg = int(deformable_groups)
+
+    def impl(a, off, w, *rest):
+        it = iter(rest)
+        msk = next(it) if mask is not None else None
+        b = next(it) if bias is not None else None
+        B, C, H, W = a.shape
+        Ho, Wo = off.shape[2], off.shape[3]
+        K = kh * kw
+        # base sampling grid per output position and tap
+        oy = jnp.arange(Ho) * sh - pt
+        ox = jnp.arange(Wo) * sw - pl
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]  # Ho,1,kh,1
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]  # 1,Wo,1,kw
+        off_r = off.reshape(B, dg, K, 2, Ho, Wo)
+        dy = off_r[:, :, :, 0]                      # [B,dg,K,Ho,Wo]
+        dx = off_r[:, :, :, 1]
+        # per-tap base grids [K, Ho, Wo]
+        yy = (ky[:, None, None] + oy[None, :, None]).astype(jnp.float32)
+        xx = (kx[:, None, None] + ox[None, None, :]).astype(jnp.float32)
+        grid_y = jnp.broadcast_to(yy[:, None, :, :],
+                                  (kh, kw, Ho, Wo)).reshape(K, Ho, Wo)
+        grid_x = jnp.broadcast_to(xx[None, :, :, :],
+                                  (kh, kw, Ho, Wo)).reshape(K, Ho, Wo)
+        sy = grid_y[None, None] + dy                # [B,dg,K,Ho,Wo]
+        sx = grid_x[None, None] + dx
+
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+        valid = (sy > -1) & (sy < H) & (sx > -1) & (sx < W)
+
+        def tap(yi, xi):
+            # out-of-range corners contribute ZERO (reference
+            # DmcnIm2colBilinear zeroes corners with h_low < 0 etc.,
+            # it does not substitute edge pixels)
+            ok = ((yi >= 0) & (yi <= H - 1) & (xi >= 0)
+                  & (xi <= W - 1))                         # [B,dg,K,Ho,Wo]
+            ycl = jnp.clip(yi.astype(jnp.int32), 0, H - 1)
+            xcl = jnp.clip(xi.astype(jnp.int32), 0, W - 1)
+            # gather per deformable group: channels split into dg blocks
+            a_g = a.reshape(B, dg, C // dg, H, W)
+
+            def per_b(ab, yb, xb):
+                # ab [dg, C/dg, H, W]; yb/xb [dg, K, Ho, Wo]
+                def per_g(ag, yg, xg):
+                    flat = ag.reshape(ag.shape[0], -1)     # [C/dg, H*W]
+                    lin = (yg * W + xg).reshape(-1)        # [K*Ho*Wo]
+                    return flat[:, lin].reshape(
+                        ag.shape[0], K, Ho, Wo)
+                return jax.vmap(per_g)(ab, yb, xb)
+            vals = jax.vmap(per_b)(a_g, ycl, xcl)          # [B,dg,C/dg,K,...]
+            return vals * ok[:, :, None]
+
+        v00 = tap(y0, x0)
+        v01 = tap(y0, x0 + 1)
+        v10 = tap(y0 + 1, x0)
+        v11 = tap(y0 + 1, x0 + 1)
+        wy_ = wy[:, :, None]
+        wx_ = wx[:, :, None]
+        sampled = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                   + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+        sampled = jnp.where(valid[:, :, None], sampled, 0.0)
+        if msk is not None:
+            m_r = msk.reshape(B, dg, 1, K, Ho, Wo)
+            sampled = sampled * m_r
+        sampled = sampled.reshape(B, C, K, Ho, Wo)
+        wk = w.reshape(w.shape[0], C // groups, K)
+        if groups == 1:
+            out = jnp.einsum("bckhw,ock->bohw", sampled, wk)
+        else:
+            sp = sampled.reshape(B, groups, C // groups, K, Ho, Wo)
+            wg = wk.reshape(groups, w.shape[0] // groups, C // groups, K)
+            out = jnp.einsum("bgckhw,gock->bgohw", sp, wg)
+            out = out.reshape(B, w.shape[0], Ho, Wo)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply("deformable_conv", impl, *args)

@@ -12,8 +12,17 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+import jax
+
 from ...ops.dispatch import apply
 from ...core.tensor import Tensor
+
+
+def _stat_dtype(a):
+    """Normalization statistics accumulate in f32 for low-precision inputs
+    (the TPU bf16 recipe: bf16 tensors, f32 statistics)."""
+    return (jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16)
+            else a.dtype)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
@@ -33,15 +42,21 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         def impl(a, w, b):
             axes = tuple(i for i in range(a.ndim)
                          if i != (channel_axis % a.ndim))
-            mean = jnp.mean(a, axis=axes)
-            var = jnp.var(a, axis=axes)
+            # statistics accumulate in f32 even for bf16/f16 activations
+            # (XLA fuses the upcast into the reduction; the normalized
+            # output is cast back, so activation HBM traffic stays low)
+            sdt = _stat_dtype(a)
+            af = a.astype(sdt)
+            mean = jnp.mean(af, axis=axes)
+            var = jnp.var(af, axis=axes)
             ss = stat_shape(a)
-            out = (a - mean.reshape(ss)) / jnp.sqrt(var.reshape(ss) + epsilon)
+            out = (af - mean.reshape(ss)) * jax.lax.rsqrt(
+                var.reshape(ss) + epsilon)
             if w is not None:
-                out = out * w.reshape(ss)
+                out = out * w.reshape(ss).astype(sdt)
             if b is not None:
-                out = out + b.reshape(ss)
-            return out, mean, var
+                out = out + b.reshape(ss).astype(sdt)
+            return out.astype(a.dtype), mean, var
         out, batch_mean, batch_var = apply(
             "batch_norm", impl, x,
             weight if weight is not None else None,
@@ -59,12 +74,14 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
     def impl_eval(a, m, v, w, b):
         ss = stat_shape(a)
-        out = (a - m.reshape(ss)) / jnp.sqrt(v.reshape(ss) + epsilon)
+        sdt = _stat_dtype(a)
+        out = (a.astype(sdt) - m.reshape(ss).astype(sdt)) * jax.lax.rsqrt(
+            v.reshape(ss).astype(sdt) + epsilon)
         if w is not None:
-            out = out * w.reshape(ss)
+            out = out * w.reshape(ss).astype(sdt)
         if b is not None:
-            out = out + b.reshape(ss)
-        return out
+            out = out + b.reshape(ss).astype(sdt)
+        return out.astype(a.dtype)
     return apply("batch_norm", impl_eval, x, running_mean, running_var,
                  weight, bias)
 
@@ -82,15 +99,17 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
     def impl(a, *wb):
         axes = tuple(range(a.ndim - n_axes, a.ndim))
-        mean = jnp.mean(a, axis=axes, keepdims=True)
-        var = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - mean) / jnp.sqrt(var + epsilon)
+        sdt = _stat_dtype(a)
+        af = a.astype(sdt)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
         it = iter(wb)
         if weight is not None:
-            out = out * next(it)
+            out = out * next(it).astype(sdt)
         if bias is not None:
-            out = out + next(it)
-        return out
+            out = out + next(it).astype(sdt)
+        return out.astype(a.dtype)
     args = [x] + [t for t in (weight, bias) if t is not None]
     return apply("layer_norm", impl, *args)
 
@@ -101,16 +120,18 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
     """reference: operators/instance_norm_op.cc."""
     def impl(a, *wb):
         axes = tuple(range(2, a.ndim))  # per-sample per-channel stats
-        mean = jnp.mean(a, axis=axes, keepdims=True)
-        var = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - mean) / jnp.sqrt(var + eps)
+        sdt = _stat_dtype(a)
+        af = a.astype(sdt)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + eps)
         it = iter(wb)
         ss = [1, a.shape[1]] + [1] * (a.ndim - 2)
         if weight is not None:
-            out = out * next(it).reshape(ss)
+            out = out * next(it).reshape(ss).astype(sdt)
         if bias is not None:
-            out = out + next(it).reshape(ss)
-        return out
+            out = out + next(it).reshape(ss).astype(sdt)
+        return out.astype(a.dtype)
     args = [x] + [t for t in (weight, bias) if t is not None]
     return apply("instance_norm", impl, *args)
 
@@ -121,18 +142,19 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
     def impl(a, *wb):
         n, c = a.shape[0], a.shape[1]
         spatial = a.shape[2:]
-        g = a.reshape((n, num_groups, c // num_groups) + spatial)
+        sdt = _stat_dtype(a)
+        g = a.astype(sdt).reshape((n, num_groups, c // num_groups) + spatial)
         axes = tuple(range(2, g.ndim))
         mean = jnp.mean(g, axis=axes, keepdims=True)
         var = jnp.var(g, axis=axes, keepdims=True)
-        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(a.shape)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
         it = iter(wb)
         ss = [1, c] + [1] * (a.ndim - 2)
         if weight is not None:
-            out = out * next(it).reshape(ss)
+            out = out * next(it).reshape(ss).astype(sdt)
         if bias is not None:
-            out = out + next(it).reshape(ss)
-        return out
+            out = out + next(it).reshape(ss).astype(sdt)
+        return out.astype(a.dtype)
     args = [x] + [t for t in (weight, bias) if t is not None]
     return apply("group_norm", impl, *args)
 

@@ -9,7 +9,7 @@ methods are attached from a table at import time.
 from __future__ import annotations
 
 from ..core.tensor import Tensor
-from . import creation, math, manipulation, linalg, dispatch
+from . import creation, math, manipulation, linalg, dispatch, custom
 from .dispatch import (apply, apply_raw, OP_REGISTRY, in_dygraph_mode,
                        enable_static, disable_static)
 
@@ -20,7 +20,22 @@ from .control_flow import (cond, while_loop, case, switch_case,  # noqa: F401
                            increment, create_array, array_write, array_read,
                            array_length)
 from .detection import (yolo_box, yolov3_loss, multiclass_nms,  # noqa: F401
-                        prior_box, box_coder, iou_similarity, box_clip)
+                        prior_box, box_coder, iou_similarity, box_clip,
+                        roi_align, roi_pool, anchor_generator,
+                        generate_proposals, distribute_fpn_proposals,
+                        collect_fpn_proposals, bipartite_match,
+                        target_assign, box_decoder_and_assign,
+                        polygon_box_transform, smooth_l1, matrix_nms,
+                        density_prior_box)
+from .sequence import (sequence_mask, sequence_pad, sequence_unpad,  # noqa: F401
+                       sequence_pool, sequence_first_step,
+                       sequence_last_step, sequence_softmax,
+                       sequence_reverse, sequence_expand,
+                       sequence_expand_as, sequence_concat, sequence_slice,
+                       sequence_enumerate, sequence_erase, sequence_conv,
+                       im2sequence)
+from .beam import (gather_tree, beam_search, beam_search_decode,  # noqa: F401
+                   ctc_align, edit_distance)
 
 
 def _attach_methods():
@@ -130,3 +145,81 @@ def _attach_methods():
 
 
 _attach_methods()
+
+
+def _late_alias():
+    """Expose the fluid-era op-name surface (reference: Appendix A of
+    SURVEY — names registered via REGISTER_OPERATOR) for functionality that
+    lives in nn.functional / ops.linalg under the 2.x API. Called from
+    paddle_tpu/__init__ after nn loads (avoids the ops<->nn import cycle)."""
+    import sys
+    from ..nn import functional as F
+    from . import linalg as L
+
+    mod = sys.modules[__name__]
+    f_names = ["relu", "relu6", "gelu", "silu", "selu", "elu", "celu",
+               "mish", "swish", "softmax", "log_softmax", "leaky_relu",
+               "prelu", "maxout", "softplus", "softsign", "hardshrink",
+               "softshrink", "tanhshrink", "hardsigmoid", "hardswish",
+               "hardtanh", "log_sigmoid", "thresholded_relu", "grid_sample",
+               "affine_grid", "interpolate", "upsample", "pixel_shuffle",
+               "dropout", "label_smooth", "sigmoid_focal_loss",
+               "smooth_l1_loss", "kl_div", "one_hot",
+               "deformable_conv"]
+    for n in f_names:
+        if hasattr(F, n) and not hasattr(mod, n):
+            setattr(mod, n, getattr(F, n))
+    # fluid spellings
+    fluid_map = {"logsigmoid": "log_sigmoid", "hard_sigmoid": "hardsigmoid",
+                 "hard_shrink": "hardshrink", "tanh_shrink": "tanhshrink",
+                 "hard_swish": "hardswish", "brelu": "hardtanh",
+                 "kldiv_loss": "kl_div"}
+    for alias, src in fluid_map.items():
+        if hasattr(F, src) and not hasattr(mod, alias):
+            setattr(mod, alias, getattr(F, src))
+    l_names = ["cholesky", "inverse", "det", "slogdet", "qr", "svd", "eig",
+               "eigh", "eigvals", "eigvalsh", "matrix_power", "matrix_rank",
+               "multi_dot", "pinv", "lstsq", "solve", "triangular_solve",
+               "cholesky_solve", "lu", "matrix_exp"]
+    for n in l_names:
+        if hasattr(L, n) and not hasattr(mod, n):
+            setattr(mod, n, getattr(L, n))
+    # 1:1 renames of existing ops
+    # fluid 'mul' is a flattened MATRIX multiply (operators/mul_op.cc)
+    renames = {"arg_max": "argmax", "arg_min": "argmin", "mul": "matmul",
+               "minus": "subtract", "reverse": "flip",
+               "fill_constant": "full", "reduce_sum": "sum",
+               "reduce_mean": "mean", "reduce_max": "max",
+               "reduce_min": "min", "reduce_prod": "prod",
+               "reduce_all": "all", "reduce_any": "any",
+               "elementwise_add": "add", "elementwise_sub": "subtract",
+               "elementwise_mul": "multiply", "elementwise_div": "divide",
+               "elementwise_pow": "pow", "elementwise_max": "maximum",
+               "elementwise_min": "minimum", "elementwise_mod": "mod",
+               "elementwise_floordiv": "floor_divide",
+               "expand_as_v2": "expand_as", "expand_v2": "expand",
+               "matmul_v2": "matmul", "one_hot_v2": "one_hot",
+               "p_norm": "norm", "nonzero": "nonzero"}
+    for alias, src in renames.items():
+        if hasattr(mod, src) and not hasattr(mod, alias):
+            setattr(mod, alias, getattr(mod, src))
+
+
+def _stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """reference: activation_op.h STanhFunctor."""
+    from .dispatch import apply as _apply
+    import jax.numpy as _jnp
+    return _apply("stanh", lambda a: scale_b * _jnp.tanh(scale_a * a), x)
+
+
+def _soft_relu(x, threshold=40.0, name=None):
+    """reference: activation_op.h SoftReluFunctor."""
+    from .dispatch import apply as _apply
+    import jax.numpy as _jnp
+    return _apply("soft_relu",
+                  lambda a: _jnp.log1p(_jnp.exp(
+                      _jnp.clip(a, -threshold, threshold))), x)
+
+
+stanh = _stanh
+soft_relu = _soft_relu

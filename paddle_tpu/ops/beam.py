@@ -1,0 +1,165 @@
+"""Beam-search decode ops (reference: paddle/fluid/operators/
+beam_search_op.cc, beam_search_decode_op.cc, gather_tree_op.cc,
+ctc_align_op.cc, edit_distance_op.cc).
+
+The reference's beam_search mutates LoD to track per-beam lineage; here
+lineage is an explicit static [T, B, W] parents tensor and the final
+backtrace is one gather_tree scan — the TPU form used by dynamic_decode.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import apply
+from ..core.tensor import Tensor
+
+
+def gather_tree(ids, parents):
+    """reference: gather_tree_op.cc — backtrace beam lineage.
+    ids/parents: [T, B, W] (step-major). Returns full sequences [T, B, W]
+    where column w holds the tokens along the ancestry of final beam w."""
+    def impl(idt, par):
+        T = idt.shape[0]
+
+        def step(beam, t):
+            # beam: [B, W] current beam slot per final column
+            tok = jnp.take_along_axis(idt[t], beam, axis=1)
+            nxt = jnp.take_along_axis(par[t], beam, axis=1)
+            return nxt.astype(beam.dtype), tok
+
+        init = jnp.broadcast_to(
+            jnp.arange(idt.shape[2], dtype=idt.dtype)[None, :],
+            idt.shape[1:])
+        _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+    return apply("gather_tree", impl, ids, parents)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, return_parent_idx=True):
+    """reference: beam_search_op.cc — ONE expansion step.
+
+    pre_ids [B, W], pre_scores [B, W], scores [B, W, V] (log-probs of the
+    next token per live beam; already accumulated when is_accumulated).
+    Selects the top ``beam_size`` of the W*V candidates per batch row.
+    Finished beams (pre_id == end_id) only propagate themselves.
+    Returns (selected_ids [B, W], selected_scores [B, W],
+    parent_idx [B, W]).
+    """
+    W = int(beam_size)
+
+    def impl(p_ids, p_sc, sc):
+        B, Wv, V = sc.shape
+        total = sc if is_accumulated else p_sc[..., None] + sc
+        finished = p_ids == end_id
+        # a finished beam contributes exactly one candidate: itself
+        only_end = jnp.full((B, Wv, V), -jnp.inf, total.dtype)
+        only_end = only_end.at[:, :, end_id].set(p_sc)
+        cand = jnp.where(finished[..., None], only_end, total)
+        flat = cand.reshape(B, Wv * V)
+        top_sc, top_ix = lax.top_k(flat, W)
+        parent = (top_ix // V).astype(jnp.int64)
+        token = (top_ix % V).astype(p_ids.dtype)
+        return token, top_sc, parent
+    return apply("beam_search", impl, pre_ids, pre_scores, scores)
+
+
+def beam_search_decode(ids, parents, scores, beam_size=None, end_id=0):
+    """reference: beam_search_decode_op.cc — backtrace all steps into final
+    sequences + their scores. ids/parents [T, B, W] (from beam_search
+    steps), scores [B, W] final accumulated scores. Returns
+    (sequences [T, B, W], scores [B, W])."""
+    seqs = gather_tree(ids, parents)
+    return seqs, scores
+
+
+def ctc_align(input, blank=0, merge_repeated=True, padding_value=0,
+              lengths=None, name=None):
+    """reference: ctc_align_op.cc — collapse repeats then drop blanks,
+    left-packing survivors ([B, T] + lengths convention). Returns
+    (aligned [B, T], new_lengths [B])."""
+    def impl(ids, *rest):
+        lens = rest[0] if rest else None
+        B, T = ids.shape
+        t = jnp.arange(T)[None, :]
+        valid = t < lens[:, None] if lens is not None else jnp.ones(
+            (B, T), bool)
+        prev = jnp.concatenate(
+            [jnp.full((B, 1), -1, ids.dtype), ids[:, :-1]], axis=1)
+        keep = valid & (ids != blank)
+        if merge_repeated:
+            keep = keep & (ids != prev)
+        new_len = keep.sum(axis=1)
+        pos = jnp.cumsum(keep, axis=1) - 1
+        dest = jnp.where(keep, pos, T - 1)
+        out = jnp.full_like(ids, padding_value)
+        b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], ids.shape)
+        # left-pack: every kept token writes its unique slot; the T-1 junk
+        # slot is overwritten last by a real token only if it owns it
+        out = out.at[b_idx, dest].set(
+            jnp.where(keep, ids, padding_value))
+        fixl = (new_len == T)
+        return out, new_len.astype(jnp.int64) + 0 * fixl
+    args = (input,) + ((lengths,) if lengths is not None else ())
+    out, nl = apply("ctc_align", impl, *args)
+    return out, nl
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """reference: edit_distance_op.cc — Levenshtein distance per batch row
+    over the padded+lengths convention. Returns (dist [B, 1],
+    seq_num [1])."""
+    def impl(hyp, ref, *rest):
+        it = iter(rest)
+        hlen = next(it) if input_length is not None else None
+        rlen = next(it) if label_length is not None else None
+        B, Th = hyp.shape
+        Tr = ref.shape[1]
+        if hlen is None:
+            hlen = jnp.full((B,), Th, jnp.int32)
+        if rlen is None:
+            rlen = jnp.full((B,), Tr, jnp.int32)
+        hlen = hlen.astype(jnp.int32)
+        rlen = rlen.astype(jnp.int32)
+
+        # DP over ref positions; row carries distances for hyp prefix
+        def row_step(carry, j):
+            drow = carry                     # [B, Th+1] distances for ref[:j]
+            jj = j + 1
+
+            def col_step(dprev, i):
+                # dprev: [B] = D[j+1][i]; returns D[j+1][i+1]
+                sub = drow[:, i] + (hyp[:, i] != ref[:, j])
+                ins = dprev + 1
+                dele = drow[:, i + 1] + 1
+                out = jnp.minimum(jnp.minimum(sub, ins), dele)
+                # clamp: beyond valid ref length the row is just copied
+                out = jnp.where(j < rlen, out, drow[:, i + 1])
+                return out, out
+
+            d0 = jnp.where(j < rlen, jnp.full((B,), jj, jnp.int32),
+                           drow[:, 0])
+            _, cols = lax.scan(col_step, d0, jnp.arange(Th))
+            new_row = jnp.concatenate([d0[:, None], cols.T], axis=1)
+            return new_row.astype(jnp.int32), None
+
+        row0 = jnp.broadcast_to(jnp.arange(Th + 1, dtype=jnp.int32)[None, :],
+                                (B, Th + 1))
+        # positions past the hyp length must not contribute: we take the
+        # entry at index hlen at the end, so padding columns are ignored
+        final, _ = lax.scan(row_step, row0, jnp.arange(Tr))
+        d = jnp.take_along_axis(final, hlen[:, None], axis=1)[:, 0]
+        d = d.astype(jnp.float32)
+        if normalized:
+            d = d / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+        return d[:, None], jnp.asarray([B], jnp.int64)
+    args = [input, label]
+    if input_length is not None:
+        args.append(input_length)
+    if label_length is not None:
+        args.append(label_length)
+    return apply("edit_distance", impl, *args)

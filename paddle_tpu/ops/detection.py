@@ -14,8 +14,11 @@ explicit validity convention —
 - multiclass_nms returns exactly ``keep_top_k`` rows per image, invalid
   rows carry label -1 (callers mask on label >= 0) plus an explicit count.
 This keeps one compiled XLA program per shape bucket instead of per input.
-All ops are pure jnp/lax compositions — XLA fuses them; none needed a
-Pallas kernel at the measured sizes (SURVEY App. C item 4 candidates).
+Most ops are pure jnp/lax compositions — XLA fuses them. The greedy NMS
+scan additionally ships as a hand-written Pallas kernel
+(ops/custom.py pallas_greedy_nms — IoU matrix + kept-mask stay
+VMEM/register resident across the sequential loop), equivalence-tested
+against the lax.scan form here.
 """
 from __future__ import annotations
 
@@ -27,9 +30,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from .dispatch import apply
+from ..core.tensor import Tensor
 
 __all__ = ["yolo_box", "yolov3_loss", "multiclass_nms", "prior_box",
-           "box_coder", "iou_similarity", "box_clip"]
+           "box_coder", "iou_similarity", "box_clip",
+           "roi_align", "roi_pool", "anchor_generator",
+           "generate_proposals", "distribute_fpn_proposals",
+           "collect_fpn_proposals", "bipartite_match", "target_assign",
+           "box_decoder_and_assign", "polygon_box_transform", "smooth_l1",
+           "matrix_nms", "density_prior_box"]
 
 
 def _sigmoid(x):
@@ -417,3 +426,545 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         return apply("yolov3_loss", lambda a, b, c, s: impl(a, b, c),
                      x, gt_box, gt_label, gt_score)
     return apply("yolov3_loss", impl, x, gt_box, gt_label)
+
+
+# -- roi ops ------------------------------------------------------------------
+
+def roi_align(input, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              rois_num=None, aligned=True, name=None):
+    """reference: operators/roi_align_op.cc. input [N,C,H,W]; rois [R,4]
+    (x1,y1,x2,y2 in input-image coords); ``rois_num`` [N] maps rois to
+    batch images (LoD replacement). Output [R, C, ph, pw]."""
+    if isinstance(output_size, int):
+        ph = pw = int(output_size)
+    else:
+        ph, pw = output_size
+    roff = 0.5 if aligned else 0.0
+    if rois_num is not None:
+        rn = np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                        else rois_num)
+        batch_of = np.repeat(np.arange(rn.shape[0]), rn).astype(np.int32)
+    else:
+        batch_of = None
+
+    def impl(feat, boxes):
+        N, C, H, W = feat.shape
+        R = boxes.shape[0]
+        bidx = (jnp.asarray(batch_of) if batch_of is not None
+                else jnp.zeros((R,), jnp.int32))
+        b = boxes * spatial_scale - roff
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample points: per bin, sr x sr bilinear taps, averaged
+        iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
+        ys = y1[:, None, None] + bin_h[:, None, None] * iy[None]  # [R,ph,sr]
+        xs = x1[:, None, None] + bin_w[:, None, None] * ix[None]  # [R,pw,sr]
+
+        def bilinear(img, yy, xx):
+            # img [C,H,W]; yy [ph,sr]; xx [pw,sr] -> [C,ph,sr,pw,sr]
+            # points in (-1, 0) are clamped to 0 BEFORE the corner split
+            # (reference roi_align_op kernel: `if (y <= 0) y = 0`), so the
+            # border band interpolates within the image, not across it
+            oky = (yy >= -1) & (yy <= H)
+            okx = (xx >= -1) & (xx <= W)
+            yy = jnp.clip(yy, 0.0, float(H - 1))
+            xx = jnp.clip(xx, 0.0, float(W - 1))
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy1 = yy - y0
+            wx1 = xx - x0
+            y0i = y0.astype(jnp.int32)
+            y1i = jnp.clip(y0i + 1, 0, H - 1)
+            x0i = x0.astype(jnp.int32)
+            x1i = jnp.clip(x0i + 1, 0, W - 1)
+
+            def g(yi, xi):
+                return img[:, yi][:, :, :, xi]      # [C,ph,sr,pw,sr]
+            v = (g(y0i, x0i) * ((1 - wy1)[None, :, :, None, None]
+                                * (1 - wx1)[None, None, None, :, :])
+                 + g(y1i, x0i) * (wy1[None, :, :, None, None]
+                                  * (1 - wx1)[None, None, None, :, :])
+                 + g(y0i, x1i) * ((1 - wy1)[None, :, :, None, None]
+                                  * wx1[None, None, None, :, :])
+                 + g(y1i, x1i) * (wy1[None, :, :, None, None]
+                                  * wx1[None, None, None, :, :]))
+            ok = (oky[None, :, :, None, None]
+                  & okx[None, None, None, :, :])
+            return jnp.where(ok, v, 0.0)
+
+        def per_roi(bi, yy, xx):
+            img = feat[bi]
+            v = bilinear(img, yy, xx)               # [C,ph,sr,pw,sr]
+            return v.mean(axis=(2, 4))              # [C,ph,pw]
+        return jax.vmap(per_roi)(bidx, ys, xs)
+    return apply("roi_align", impl, input, rois)
+
+
+def roi_pool(input, rois, output_size, spatial_scale=1.0, rois_num=None,
+             name=None):
+    """reference: operators/roi_pool_op.cc (max pool per bin, integer
+    quantized boundaries)."""
+    if isinstance(output_size, int):
+        ph = pw = int(output_size)
+    else:
+        ph, pw = output_size
+    if rois_num is not None:
+        rn = np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                        else rois_num)
+        batch_of = np.repeat(np.arange(rn.shape[0]), rn).astype(np.int32)
+    else:
+        batch_of = None
+
+    def impl(feat, boxes):
+        N, C, H, W = feat.shape
+        R = boxes.shape[0]
+        bidx = (jnp.asarray(batch_of) if batch_of is not None
+                else jnp.zeros((R,), jnp.int32))
+        b = jnp.round(boxes * spatial_scale)
+        x1 = jnp.clip(b[:, 0], 0, W - 1).astype(jnp.int32)
+        y1 = jnp.clip(b[:, 1], 0, H - 1).astype(jnp.int32)
+        x2 = jnp.clip(b[:, 2], 0, W - 1).astype(jnp.int32)
+        y2 = jnp.clip(b[:, 3], 0, H - 1).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+
+        yy = jnp.arange(H)
+        xx = jnp.arange(W)
+
+        def per_roi(bi, xx1, yy1, hh, ww):
+            img = feat[bi]                           # [C,H,W]
+            # bin id of every pixel (or -1 outside the roi)
+            py = ((yy - yy1) * ph) // hh
+            px = ((xx - xx1) * pw) // ww
+            py = jnp.where((yy >= yy1) & (yy < yy1 + hh), py, -1)
+            px = jnp.where((xx >= xx1) & (xx < xx1 + ww), px, -1)
+            onehot_y = (py[None, :] == jnp.arange(ph)[:, None])  # [ph,H]
+            onehot_x = (px[None, :] == jnp.arange(pw)[:, None])  # [pw,W]
+            big = jnp.where(onehot_y[None, :, :, None, None]
+                            & onehot_x[None, None, None, :, :],
+                            img[:, None, :, None, :], -jnp.inf)
+            out = big.max(axis=(2, 4))               # [C,ph,pw]
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+        return jax.vmap(per_roi)(bidx, x1, y1, rh, rw)
+    return apply("roi_pool", impl, input, rois)
+
+
+# -- rpn / fpn ----------------------------------------------------------------
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
+                     stride, offset=0.5, name=None):
+    """reference: detection/anchor_generator_op.cc — grid anchors
+    [H, W, A, 4] + variances broadcast."""
+    sizes = [float(s) for s in np.atleast_1d(anchor_sizes)]
+    ratios = [float(r) for r in np.atleast_1d(aspect_ratios)]
+    var = np.asarray(variances, np.float32)
+    sx, sy = (stride if isinstance(stride, (list, tuple))
+              else (stride, stride))
+
+    def impl(x):
+        H, W = x.shape[2], x.shape[3]
+        cx = (jnp.arange(W) + offset) * sx
+        cy = (jnp.arange(H) + offset) * sy
+        ws, hs = [], []
+        for r in ratios:
+            for s in sizes:
+                ws.append(s * np.sqrt(1.0 / r))
+                hs.append(s * np.sqrt(r))
+        ws = jnp.asarray(ws, jnp.float32)
+        hs = jnp.asarray(hs, jnp.float32)
+        boxes = jnp.stack([
+            cx[None, :, None] - 0.5 * ws[None, None, :]
+            + 0 * cy[:, None, None],
+            cy[:, None, None] - 0.5 * hs[None, None, :]
+            + 0 * cx[None, :, None],
+            cx[None, :, None] + 0.5 * ws[None, None, :]
+            + 0 * cy[:, None, None],
+            cy[:, None, None] + 0.5 * hs[None, None, :]
+            + 0 * cx[None, :, None],
+        ], axis=-1)                                   # [H, W, A, 4]
+        v = jnp.broadcast_to(jnp.asarray(var), boxes.shape)
+        return boxes, v
+    return apply("anchor_generator", impl, input)
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """reference: detection/generate_proposals_op.cc (RPN): decode deltas
+    against anchors, clip, filter small boxes, top-k, NMS. Fixed-size
+    masked outputs: rois [N, post_nms_top_n, 4], scores [N, post_nms_top_n],
+    rois_num [N]."""
+    off = 1.0 if pixel_offset else 0.0
+
+    def impl(sc, deltas, imshape, anc, var):
+        N = sc.shape[0]
+        A = anc.reshape(-1, 4).shape[0]
+        anc_f = anc.reshape(-1, 4)
+        var_f = var.reshape(-1, 4)
+
+        def per_image(s, d, ish):
+            # scores [A,H,W] / deltas [4A,H,W] flatten in (H,W,A) order to
+            # line up with anchor_generator's [H,W,A,4] layout (reference
+            # transposes with axis{0,2,3,1} the same way)
+            if s.ndim == 3:
+                s_f = jnp.transpose(s, (1, 2, 0)).reshape(-1)
+            else:
+                s_f = s.reshape(-1)
+            if d.ndim == 3:
+                d_r = d.reshape(-1, 4, d.shape[-2], d.shape[-1])
+                d_f = jnp.transpose(d_r, (2, 3, 0, 1)).reshape(-1, 4)
+            else:
+                d_f = d.reshape(-1, 4)
+            # decode (box_coder decode_center_size semantics)
+            aw = anc_f[:, 2] - anc_f[:, 0] + off
+            ah = anc_f[:, 3] - anc_f[:, 1] + off
+            acx = anc_f[:, 0] + 0.5 * aw
+            acy = anc_f[:, 1] + 0.5 * ah
+            cx = var_f[:, 0] * d_f[:, 0] * aw + acx
+            cy = var_f[:, 1] * d_f[:, 1] * ah + acy
+            w = jnp.exp(jnp.minimum(var_f[:, 2] * d_f[:, 2], 10.0)) * aw
+            h = jnp.exp(jnp.minimum(var_f[:, 3] * d_f[:, 3], 10.0)) * ah
+            boxes = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                               cx + 0.5 * w - off, cy + 0.5 * h - off],
+                              axis=1)
+            # clip to image
+            hgt, wid = ish[0], ish[1]
+            boxes = jnp.stack([
+                jnp.clip(boxes[:, 0], 0, wid - off),
+                jnp.clip(boxes[:, 1], 0, hgt - off),
+                jnp.clip(boxes[:, 2], 0, wid - off),
+                jnp.clip(boxes[:, 3], 0, hgt - off)], axis=1)
+            ww = boxes[:, 2] - boxes[:, 0] + off
+            hh = boxes[:, 3] - boxes[:, 1] + off
+            ok = (ww >= min_size) & (hh >= min_size)
+            s_m = jnp.where(ok, s_f, -jnp.inf)
+            k = min(int(pre_nms_top_n), s_m.shape[0])
+            top_s, top_i = lax.top_k(s_m, k)
+            cand = boxes[top_i]
+            kept, order, kept_s = _greedy_nms_mask(
+                cand, top_s, nms_thresh, -jnp.inf, k,
+                normalized=not pixel_offset, nms_eta=eta)
+            sel_sc = jnp.where(kept & jnp.isfinite(kept_s), kept_s, -jnp.inf)
+            kk = min(int(post_nms_top_n), sel_sc.shape[0])
+            fin_s, fin_i = lax.top_k(sel_sc, kk)
+            fin_boxes = cand[order][fin_i]
+            valid = jnp.isfinite(fin_s)
+            out_boxes = jnp.where(valid[:, None], fin_boxes, 0.0)
+            out_s = jnp.where(valid, fin_s, 0.0)
+            if kk < post_nms_top_n:
+                padb = jnp.zeros((post_nms_top_n - kk, 4), out_boxes.dtype)
+                out_boxes = jnp.concatenate([out_boxes, padb], 0)
+                out_s = jnp.concatenate(
+                    [out_s, jnp.zeros(post_nms_top_n - kk, out_s.dtype)], 0)
+                valid = jnp.concatenate(
+                    [valid, jnp.zeros(post_nms_top_n - kk, bool)], 0)
+            return out_boxes, out_s, valid.sum().astype(jnp.int32)
+        rois, rsc, rn = jax.vmap(per_image)(sc, deltas, imshape)
+        return rois, rsc, rn
+    return apply("generate_proposals", impl, scores, bbox_deltas, im_shape,
+                 anchors, variances)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, pixel_offset=False,
+                             name=None):
+    """reference: detection/distribute_fpn_proposals_op.cc — route each roi
+    to the FPN level matching its scale. Masked fixed-size outputs: one
+    [R, 4] tensor + validity mask per level, plus restore_index [R]."""
+    off = 1.0 if pixel_offset else 0.0
+    levels = list(range(int(min_level), int(max_level) + 1))
+
+    def impl(rois):
+        w = rois[:, 2] - rois[:, 0] + off
+        h = rois[:, 3] - rois[:, 1] + off
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-12))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs = []
+        masks = []
+        for L in levels:
+            m = lvl == L
+            outs.append(jnp.where(m[:, None], rois, 0.0))
+            masks.append(m)
+        # restore_index: position of each original roi in the level-major
+        # concatenation (reference returns the inverse permutation)
+        order_key = lvl * rois.shape[0] + jnp.arange(rois.shape[0])
+        perm = jnp.argsort(order_key)
+        restore = jnp.argsort(perm).astype(jnp.int32)
+        return tuple(outs) + tuple(masks) + (restore,)
+    flat = apply("distribute_fpn_proposals", impl, fpn_rois)
+    n = len(levels)
+    return list(flat[:n]), list(flat[n:2 * n]), flat[2 * n]
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n,
+                          rois_num_per_level=None, name=None):
+    """reference: detection/collect_fpn_proposals_op.cc — merge per-level
+    rois, keep global top-k by score."""
+    k = int(post_nms_top_n)
+
+    def impl(*args):
+        n = len(args) // 2
+        rois = jnp.concatenate(args[:n], axis=0)
+        scores = jnp.concatenate([a.reshape(-1) for a in args[n:]], axis=0)
+        kk = min(k, scores.shape[0])
+        top_s, top_i = lax.top_k(scores, kk)
+        return rois[top_i], top_s
+    return apply("collect_fpn_proposals", impl,
+                 *(list(multi_rois) + list(multi_scores)))
+
+
+# -- matching / assignment ----------------------------------------------------
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """reference: detection/bipartite_match_op.cc — greedy bipartite
+    matching on a [M, N] distance (similarity) matrix: repeatedly take the
+    globally largest entry, retire its row+column. Returns
+    (match_indices [1, N] int, match_dist [1, N])."""
+    def impl(dm):
+        M, N = dm.shape[-2], dm.shape[-1]
+        steps = min(M, N)
+
+        def step(carry, _):
+            mat, row_ok, col_ok = carry
+            masked = jnp.where(row_ok[:, None] & col_ok[None, :], mat,
+                               -jnp.inf)
+            flat = masked.reshape(-1)
+            best = jnp.argmax(flat)
+            r, c = best // N, best % N
+            good = flat[best] > -jnp.inf
+            row_ok = row_ok.at[r].set(jnp.where(good, False, row_ok[r]))
+            col_ok = col_ok.at[c].set(jnp.where(good, False, col_ok[c]))
+            return (mat, row_ok, col_ok), (r, c, flat[best], good)
+
+        (_, _, _), (rs, cs, vs, goods) = lax.scan(
+            step, (dm, jnp.ones(M, bool), jnp.ones(N, bool)),
+            jnp.arange(steps))
+        match = jnp.full((N,), -1, jnp.int32)
+        mdist = jnp.zeros((N,), dm.dtype)
+        match = match.at[cs].set(
+            jnp.where(goods, rs.astype(jnp.int32), match[cs]))
+        mdist = mdist.at[cs].set(jnp.where(goods, vs, mdist[cs]))
+        if match_type == "per_prediction" and dist_threshold is not None:
+            # additionally match every unmatched column to its best row if
+            # above threshold (reference match_type='per_prediction')
+            best_r = jnp.argmax(dm, axis=0).astype(jnp.int32)
+            best_v = jnp.max(dm, axis=0)
+            extra = (match < 0) & (best_v >= dist_threshold)
+            match = jnp.where(extra, best_r, match)
+            mdist = jnp.where(extra, best_v, mdist)
+        return match[None, :], mdist[None, :]
+    return apply("bipartite_match", impl, dist_matrix)
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """reference: detection/target_assign_op.cc — out[i][j] =
+    input[matched_indices[i][j]] (mismatch -> mismatch_value);
+    weights 1 for matched, 0 otherwise."""
+    def impl(x, mi):
+        def per_row(m):
+            ok = m >= 0
+            g = x[jnp.clip(m, 0, x.shape[0] - 1)]
+            out = jnp.where(ok[..., None] if g.ndim > m.ndim else ok, g,
+                            jnp.asarray(mismatch_value, g.dtype))
+            w = ok.astype(jnp.float32)
+            return out, w
+        return jax.vmap(per_row)(mi)
+    return apply("target_assign", impl, input, matched_indices)
+
+
+def box_decoder_and_assign(prior_box_t, prior_box_var, target_box,
+                           box_score, box_clip=4.135, name=None):
+    """reference: detection/box_decoder_and_assign_op.cc — decode per-class
+    deltas then pick each box's best-scoring class decode."""
+    def impl(pb, pbv, tb, sc):
+        n = pb.shape[0]
+        c4 = tb.shape[1]
+        ncls = c4 // 4
+        pw = pb[:, 2] - pb[:, 0] + 1.0
+        phh = pb[:, 3] - pb[:, 1] + 1.0
+        pcx = pb[:, 0] + 0.5 * pw
+        pcy = pb[:, 1] + 0.5 * phh
+        d = tb.reshape(n, ncls, 4)
+        dx = d[..., 0] * pbv[:, None, 0]
+        dy = d[..., 1] * pbv[:, None, 1]
+        dw = jnp.clip(d[..., 2] * pbv[:, None, 2], None, box_clip)
+        dh = jnp.clip(d[..., 3] * pbv[:, None, 3], None, box_clip)
+        cx = dx * pw[:, None] + pcx[:, None]
+        cy = dy * phh[:, None] + pcy[:, None]
+        w = jnp.exp(dw) * pw[:, None]
+        h = jnp.exp(dh) * phh[:, None]
+        decoded = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                             cx + 0.5 * w - 1, cy + 0.5 * h - 1], axis=-1)
+        best = jnp.argmax(sc[:, 1:], axis=1) + 1  # skip background col 0
+        assigned = jnp.take_along_axis(
+            decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        return decoded.reshape(n, c4), assigned
+    return apply("box_decoder_and_assign", impl, prior_box_t, prior_box_var,
+                 target_box, box_score)
+
+
+def polygon_box_transform(input, name=None):
+    """reference: detection/polygon_box_transform_op.cc — offset-map to
+    absolute quad coords: out = 4*stride_grid + in (even channels x,
+    odd y)."""
+    def impl(x):
+        N, C, H, W = x.shape
+        gx = jnp.broadcast_to(jnp.arange(W)[None, :] * 4.0, (H, W))
+        gy = jnp.broadcast_to(jnp.arange(H)[:, None] * 4.0, (H, W))
+        grid = jnp.where((jnp.arange(C) % 2 == 0)[None, :, None, None],
+                         gx[None, None], gy[None, None])
+        return grid + x
+    return apply("polygon_box_transform", impl, input)
+
+
+# -- losses / misc ------------------------------------------------------------
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0,
+              name=None):
+    """reference: operators/smooth_l1_loss_op.cc — per-row summed huberized
+    loss with inside/outside weights."""
+    s2 = float(sigma) * float(sigma)
+
+    def impl(a, b, *ws):
+        it = iter(ws)
+        iw = next(it) if inside_weight is not None else None
+        ow = next(it) if outside_weight is not None else None
+        d = a - b
+        if iw is not None:
+            d = d * iw
+        ad = jnp.abs(d)
+        val = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+        if ow is not None:
+            val = val * ow
+        return val.reshape(a.shape[0], -1).sum(axis=1, keepdims=True)
+    args = [x, y]
+    if inside_weight is not None:
+        args.append(inside_weight)
+    if outside_weight is not None:
+        args.append(outside_weight)
+    return apply("smooth_l1", impl, *args)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """reference: detection/matrix_nms_op.cc — parallel soft-NMS: each
+    box's score is decayed by min_j f(iou_ij)/f(max_iou_j) over
+    higher-scored boxes j (no sequential suppression loop — MXU friendly).
+    Fixed-size output like multiclass_nms: out [N, keep_top_k, 6],
+    index [N, keep_top_k], counts [N]."""
+    def decay_fn(iou, comp):
+        if use_gaussian:
+            return jnp.exp((comp * comp - iou * iou) / gaussian_sigma)
+        return (1.0 - iou) / (1.0 - comp)
+
+    def impl(bb, sc):
+        n, c, m = sc.shape
+
+        def per_image(boxes, cls_scores):
+            outs = []
+            for cls in range(c):
+                if cls == background_label:
+                    continue
+                s = cls_scores[cls]
+                k = min(int(nms_top_k), m)
+                top_s, order = lax.top_k(s, k)
+                cand = boxes[order]
+                iou = _pairwise_iou(cand, cand, normalized=normalized)
+                idx = jnp.arange(k)
+                before = idx[:, None] < idx[None, :]    # [j, i]: j ranks
+                # above i — j is a potential suppressor of i
+                iou_ji = jnp.where(before, iou, 0.0)
+                # compensation: each suppressor j's own max overlap with
+                # anything ranked above IT (matrix_nms_op.cc decay/comp)
+                comp = jnp.max(jnp.where(before.T, iou, 0.0), axis=1)  # [j]
+                factor = decay_fn(iou_ji, comp[:, None])
+                factor = jnp.where(before, factor, 1.0)
+                dec = jnp.min(factor, axis=0)           # per i over all j
+                ds = jnp.where(top_s > score_threshold, top_s * dec, -1.0)
+                ds = jnp.where(ds > post_threshold, ds, -1.0)
+                outs.append((jnp.full_like(ds, cls), ds, cand, order))
+            labels = jnp.concatenate([o[0] for o in outs])
+            dscores = jnp.concatenate([o[1] for o in outs])
+            cboxes = jnp.concatenate([o[2] for o in outs], axis=0)
+            kk = min(int(keep_top_k), dscores.shape[0])
+            best, idx = lax.top_k(dscores, kk)
+            valid = best >= 0
+            row = jnp.concatenate([
+                jnp.where(valid, labels[idx], -1.0)[:, None],
+                jnp.where(valid, best, 0.0)[:, None],
+                jnp.where(valid[:, None], cboxes[idx], 0.0)], axis=1)
+            if kk < keep_top_k:
+                pad = jnp.zeros((keep_top_k - kk, 6), row.dtype)
+                pad = pad.at[:, 0].set(-1.0)
+                row = jnp.concatenate([row, pad], axis=0)
+                idx = jnp.concatenate(
+                    [idx, jnp.zeros(keep_top_k - kk, idx.dtype)])
+                valid = jnp.concatenate(
+                    [valid, jnp.zeros(keep_top_k - kk, bool)])
+            return row, idx.astype(jnp.int32), valid.sum().astype(jnp.int32)
+        outs, idxs, counts = jax.vmap(per_image)(bb, sc)
+        return outs, idxs, counts
+    out, idx, counts = apply("matrix_nms", impl, bboxes, scores)
+    if return_index:
+        return out, idx, counts
+    return out, counts
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """reference: detection/density_prior_box_op.cc (SSD densified
+    anchors)."""
+    var = np.asarray(variance, np.float32)
+
+    def impl(x, img):
+        H, W = x.shape[2], x.shape[3]
+        IH, IW = img.shape[2], img.shape[3]
+        sx = steps[0] or IW / W
+        sy = steps[1] or IH / H
+        boxes_per_loc = []
+        for density, fs in zip(densities, fixed_sizes):
+            for fr in fixed_ratios:
+                bw = fs * np.sqrt(fr)
+                bh = fs / np.sqrt(fr)
+                shift = fs / density
+                for di in range(density):
+                    for dj in range(density):
+                        ox = (-fs / 2.0 + shift / 2.0 + dj * shift)
+                        oy = (-fs / 2.0 + shift / 2.0 + di * shift)
+                        boxes_per_loc.append((ox, oy, bw, bh))
+        A = len(boxes_per_loc)
+        cx = (jnp.arange(W) + offset) * sx
+        cy = (jnp.arange(H) + offset) * sy
+        params = jnp.asarray(boxes_per_loc, jnp.float32)  # [A,4]
+        bx = cx[None, :, None] + params[None, None, :, 0] \
+            + 0 * cy[:, None, None]
+        by = cy[:, None, None] + params[None, None, :, 1] \
+            + 0 * cx[None, :, None]
+        bw = params[None, None, :, 2]
+        bh = params[None, None, :, 3]
+        out = jnp.stack([
+            (bx - bw / 2) / IW, (by - bh / 2) / IH,
+            (bx + bw / 2) / IW, (by + bh / 2) / IH], axis=-1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        v = jnp.broadcast_to(jnp.asarray(var), out.shape)
+        if flatten_to_2d:
+            return out.reshape(-1, 4), v.reshape(-1, 4)
+        return out, v
+    return apply("density_prior_box", impl, input, image)

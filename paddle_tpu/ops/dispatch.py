@@ -26,6 +26,7 @@ from jax.tree_util import tree_flatten, tree_unflatten
 from ..core.tensor import Tensor
 from ..core import autograd_engine as _ag
 from ..core.flags import flag_value
+from ..profiler import _ACTIVE as _PROF_ACTIVE
 
 # Live registry: op name -> most recent impl, populated by the dispatch
 # funnel itself, so every executed op is introspectable
@@ -145,6 +146,15 @@ def apply(name: str, fn: Callable, *args, **attrs):
     takes a list of tensors); ``attrs`` are static python attributes closed
     over at trace time (the reference's OpDesc attrs).
     """
+    if _PROF_ACTIVE[0]:
+        # per-op host annotation while a profile is being captured
+        # (reference: RecordEvent pushed in Tracer::TraceOp, tracer.cc:137)
+        with jax.profiler.TraceAnnotation("op::" + name):
+            return _apply_inner(name, fn, args, attrs)
+    return _apply_inner(name, fn, args, attrs)
+
+
+def _apply_inner(name, fn, args, attrs):
     leaves, treedef = tree_flatten(args, is_leaf=_is_tensor_leaf)
 
     if _STATIC_MODE[0] and _STATIC_HANDLER[0] is not None:
@@ -194,7 +204,7 @@ def apply(name: str, fn: Callable, *args, **attrs):
             _EAGER_CACHE[cache_key] = entry
         else:
             _EAGER_CACHE.move_to_end(cache_key)
-        jfn, out_td = entry
+        jfn, out_td, assemble = entry
         diff_raws = tuple(leaves[p]._data for p in diff_pos)
         other_raws = tuple(leaves[i]._data for i in t_idx
                            if i not in diff_pos)
@@ -202,7 +212,8 @@ def apply(name: str, fn: Callable, *args, **attrs):
             out_raw, vjp_fn = jfn(diff_raws, other_raws)
             node = _ag.GradNode(
                 name, vjp_fn, [leaves[p] for p in diff_pos],
-                [(tuple(o.shape), o.dtype) for o in out_raw])
+                [(tuple(o.shape), o.dtype) for o in out_raw],
+                replay=(assemble, other_raws))
         else:
             out_raw = jfn(diff_raws, other_raws)
             node = None
@@ -228,7 +239,8 @@ def apply(name: str, fn: Callable, *args, **attrs):
         out_raw, vjp_fn = jax.vjp(pure, *[t._data for t in diff_tensors])
         node = _ag.GradNode(
             name, vjp_fn, diff_tensors,
-            [(tuple(o.shape), o.dtype) for o in out_raw])
+            [(tuple(o.shape), o.dtype) for o in out_raw],
+            replay=(lambda d, _o: pure(*d), ()))
     else:
         out_raw = pure()
         node = None
@@ -268,7 +280,7 @@ def _build_cached(name, fn, leaves, treedef, attrs, t_idx, diff_pos):
                    tuple(jax.ShapeDtypeStruct(leaves[p]._data.shape,
                                               leaves[p]._data.dtype)
                          for p in other_pos))
-    return jfn, td_box["td"]
+    return jfn, td_box["td"], assemble
 
 
 def _wrap_outputs(name, out_raw, node, out_td):

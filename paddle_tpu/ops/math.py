@@ -642,3 +642,203 @@ def increment(x, value=1.0, name=None):
     out = apply("increment", lambda a: a + value, x)
     x._swap_payload(out)
     return x
+
+
+# -- round-3 long tail (reference: operators/ activation_op.cc, cum_op.cc,
+# cos_sim_op.cc, shard_index_op.cc, etc.) ------------------------------------
+
+def logit(x, eps=None, name=None):
+    """reference: operators/logit_op.cc."""
+    def impl(a):
+        z = jnp.clip(a, eps, 1.0 - eps) if eps is not None else a
+        return jnp.log(z / (1.0 - z))
+    return apply("logit", impl, x)
+
+
+def rad2deg(x, name=None):
+    return apply("rad2deg", lambda a: a * (180.0 / np.pi), x)
+
+
+def deg2rad(x, name=None):
+    return apply("deg2rad", lambda a: a * (np.pi / 180.0), x)
+
+
+def ldexp(x, y, name=None):
+    return apply("ldexp", lambda a, b: a * jnp.power(
+        jnp.asarray(2.0, a.dtype if jnp.issubdtype(a.dtype, jnp.floating)
+                    else jnp.float32), b.astype(jnp.float32)), x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """reference: operators/diff_op (paddle.diff)."""
+    def impl(a, *extra):
+        it = iter(extra)
+        pre = next(it) if prepend is not None else None
+        app = next(it) if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    args = [x] + [t for t in (prepend, append) if t is not None]
+    return apply("diff", impl, *args)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    """reference: cum_op.cc cummin — returns (values, indices)."""
+    def impl(a):
+        ax = axis if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        hit = arr == vals
+        idx = jnp.arange(arr.shape[ax]).reshape(
+            [-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
+        idx = jnp.broadcast_to(idx, arr.shape)
+        big = arr.shape[ax] + 1
+        marked = jnp.where(hit, idx, big)
+        imin = jax.lax.associative_scan(jnp.minimum, marked, axis=ax)
+        return vals, imin.astype(np.dtype(dtype))
+    return apply("cummin", impl, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """reference: cum_op.cc logcumsumexp."""
+    def impl(a):
+        ax = axis if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+
+        def comb(p, q):
+            return jnp.logaddexp(p, q)
+        return jax.lax.associative_scan(comb, arr, axis=ax)
+    return apply("logcumsumexp", impl, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def impl(a):
+        return jnp.vander(a, N=n, increasing=increasing)
+    return apply("vander", impl, x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def impl(a, *ws):
+        it = iter(ws)
+        fw = next(it) if fweights is not None else None
+        aw = next(it) if aweights is not None else None
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+    args = [x] + [w for w in (fweights, aweights) if w is not None]
+    return apply("cov", impl, *args)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    def impl(a):
+        return jnp.corrcoef(a, rowvar=rowvar)
+    return apply("corrcoef", impl, x)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """reference: searchsorted family (paddle.bucketize)."""
+    def impl(a, s):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(s, a, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply("bucketize", impl, x, sorted_sequence)
+
+
+digitize = bucketize
+
+
+def take(x, index, mode="raise", name=None):
+    """reference: paddle.take — flat-index gather with clip/wrap modes."""
+    def impl(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            ii = jnp.mod(idx, n)
+        else:  # raise/clip both clamp under jit (no host assert)
+            ii = jnp.clip(idx, -n, n - 1)
+        ii = jnp.where(ii < 0, ii + n, ii)
+        return flat[ii]
+    return apply("take", impl, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    """reference: paddle.index_add — x.at[..., index, ...] += value."""
+    def impl(a, idx, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vm)
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_add", impl, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """reference: paddle.index_put."""
+    def impl(a, *rest):
+        *idxs, v = rest
+        ii = tuple(idxs)
+        if accumulate:
+            return a.at[ii].add(v)
+        return a.at[ii].set(v)
+    return apply("index_put", impl, x, *list(indices), value)
+
+
+def index_fill(x, index, axis, fill_value, name=None):
+    def impl(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[idx].set(jnp.asarray(fill_value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_fill", impl, x, index)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """reference: operators/renorm_op.cc — clamp each sub-tensor's p-norm."""
+    def impl(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p), axis=1),
+                          1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * factor[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply("renorm", impl, x)
+
+
+def cos_sim(X, Y, name=None):
+    """reference: operators/cos_sim_op.cc — row-wise cosine similarity."""
+    def impl(a, b):
+        a2 = a.reshape(a.shape[0], -1)
+        b2 = jnp.broadcast_to(b.reshape(b.shape[0], -1),
+                              (a.shape[0], a.reshape(a.shape[0], -1).shape[1]))
+        num = jnp.sum(a2 * b2, axis=1)
+        den = jnp.sqrt(jnp.sum(a2 * a2, axis=1)) * \
+            jnp.sqrt(jnp.sum(b2 * b2, axis=1))
+        return (num / jnp.maximum(den, 1e-12))[:, None]
+    return apply("cos_sim", impl, X, Y)
+
+
+def l1_norm(x, name=None):
+    """reference: operators/l1_norm_op.cc."""
+    return apply("l1_norm", lambda a: jnp.sum(jnp.abs(a)), x)
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    """reference: reduce_ops/frobenius_norm_op.cc."""
+    def impl(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+    return apply("frobenius_norm", impl, x)
+
+
+def where_index(condition, name=None):
+    """reference: operators/where_index_op.cc (nonzero coordinates). Output
+    is data-dependent so the result is computed eagerly via numpy — usable
+    outside jit only (the reference op is likewise host-side dynamic)."""
+    from ..core.tensor import Tensor
+    cond_np = np.asarray(condition._data if isinstance(condition, Tensor)
+                         else condition)
+    return Tensor(np.stack(np.nonzero(cond_np), axis=1).astype(np.int64))
+
+
+def unflatten(x, axis, shape, name=None):
+    def impl(a):
+        new_shape = (a.shape[:axis % a.ndim] + tuple(shape)
+                     + a.shape[axis % a.ndim + 1:])
+        return a.reshape(new_shape)
+    return apply("unflatten", impl, x)

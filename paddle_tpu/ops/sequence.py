@@ -1,0 +1,355 @@
+"""Sequence ops over the masked-ragged convention.
+
+The reference represents ragged batches as LoDTensor — a flat value tensor
+plus level-of-detail offsets (reference: paddle/fluid/framework/
+lod_tensor.h:109) consumed by the ~30 ops in
+paddle/fluid/operators/sequence_ops/. A static-shape compiler can't carry
+data-dependent offsets, so this framework's ragged convention is
+**padded + lengths** (SURVEY "hard parts" #1):
+
+    data:    [B, T, ...]  — batch of sequences padded to T
+    lengths: [B] int      — true length of each row
+
+Every op here takes/returns that pair (lengths may be None = fully dense).
+This is the same trade the reference itself makes at inference (its
+sequence_pad/unpad ops convert LoD <-> padded, sequence_pad_op.cc);
+here padded IS the native form and lod exists only at the API edge.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: operators/sequence_ops/sequence_mask_op.cc — lengths [B]
+    -> mask [B, maxlen]."""
+    d = np.dtype(dtype) if dtype != "bool" else np.bool_
+    ml = int(maxlen) if maxlen is not None else int(np.asarray(_raw(x)).max())
+
+    def impl(lens):
+        r = jnp.arange(ml)
+        return (r[None, :] < lens[..., None]).astype(d)
+    return apply("sequence_mask", impl, x)
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """reference: sequence_pad_op.cc. Input here is (flat values [N, ...],
+    lengths [B]) — the LoD edge form; returns ([B, T, ...], lengths).
+    ``maxlen`` must be static (None = max length rounded up at trace time
+    from the concrete lengths)."""
+    lens_np = np.asarray(_raw(length))
+    B = int(lens_np.shape[0])
+    T = int(maxlen) if maxlen is not None else int(lens_np.max())
+    offs = np.concatenate([[0], np.cumsum(lens_np)]).astype(np.int32)
+
+    def impl(flat, pv, lens):
+        idx = offs[:-1, None] + np.arange(T)[None, :]
+        idx = jnp.minimum(jnp.asarray(idx), flat.shape[0] - 1)
+        rows = flat[idx]                      # [B, T, ...]
+        mask = jnp.arange(T)[None, :] < lens[:, None]
+        mshape = mask.shape + (1,) * (rows.ndim - 2)
+        return jnp.where(mask.reshape(mshape), rows,
+                         jnp.asarray(pv, rows.dtype))
+    out = apply("sequence_pad", impl, x, pad_value, length)
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    """reference: sequence_unpad_op.cc — padded [B, T, ...] + lengths ->
+    flat [N, ...] (N = sum(lengths), computed at trace time from the
+    concrete lengths — the one unavoidable host sync of the ragged edge)."""
+    lens_np = np.asarray(_raw(length))
+    T = int(_raw(x).shape[1])
+    keep = np.concatenate([np.arange(l) + i * T
+                           for i, l in enumerate(lens_np)]).astype(np.int32)
+
+    def impl(padded, lens):
+        flat = padded.reshape((-1,) + padded.shape[2:])
+        return flat[jnp.asarray(keep)]
+    return apply("sequence_unpad", impl, x, length)
+
+
+def sequence_pool(x, pool_type="sum", lengths=None, pad_value=0.0, name=None):
+    """reference: sequence_pool_op.cc (sum/average/sqrt/max/min/last/first
+    over each row's valid prefix)."""
+    pt = pool_type.lower()
+
+    def impl(data, *rest):
+        lens = rest[0] if rest else None
+        T = data.shape[1]
+        if lens is None:
+            mask = jnp.ones(data.shape[:2], bool)
+            lensf = jnp.full((data.shape[0],), T, jnp.float32)
+        else:
+            mask = jnp.arange(T)[None, :] < lens[:, None]
+            lensf = jnp.maximum(lens.astype(jnp.float32), 1.0)
+        mshape = mask.shape + (1,) * (data.ndim - 2)
+        m = mask.reshape(mshape)
+        if pt == "sum":
+            return jnp.sum(jnp.where(m, data, 0), axis=1)
+        if pt == "average":
+            s = jnp.sum(jnp.where(m, data, 0), axis=1)
+            return s / lensf.reshape((-1,) + (1,) * (data.ndim - 2))
+        if pt == "sqrt":
+            s = jnp.sum(jnp.where(m, data, 0), axis=1)
+            return s / jnp.sqrt(lensf).reshape((-1,) + (1,) * (data.ndim - 2))
+        if pt == "max":
+            return jnp.max(jnp.where(m, data, -jnp.inf), axis=1)
+        if pt == "min":
+            return jnp.min(jnp.where(m, data, jnp.inf), axis=1)
+        if pt == "first":
+            return data[:, 0]
+        if pt == "last":
+            if lens is None:
+                return data[:, -1]
+            i = jnp.maximum(lens - 1, 0)
+            return jnp.take_along_axis(
+                data, i.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+            ).squeeze(1)
+        raise ValueError(f"bad pool_type {pool_type}")
+    args = (x,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_pool", impl, *args)
+
+
+def sequence_first_step(x, lengths=None):
+    """reference: sequence_ops — first-step pooling."""
+    return sequence_pool(x, "first", lengths)
+
+
+def sequence_last_step(x, lengths=None):
+    return sequence_pool(x, "last", lengths)
+
+
+def sequence_softmax(x, lengths=None, name=None):
+    """reference: sequence_softmax_op.cc — softmax over each valid prefix."""
+    def impl(data, *rest):
+        lens = rest[0] if rest else None
+        T = data.shape[1]
+        if lens is None:
+            logits = data
+        else:
+            mask = jnp.arange(T)[None, :] < lens[:, None]
+            logits = jnp.where(mask, data, -jnp.inf)
+        out = jax.nn.softmax(logits, axis=1)
+        return jnp.where(jnp.isfinite(logits), out, 0.0)
+    args = (x,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_softmax", impl, *args)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    """reference: sequence_reverse_op.cc — reverse each valid prefix,
+    padding stays in place."""
+    def impl(data, *rest):
+        lens = rest[0] if rest else None
+        T = data.shape[1]
+        r = jnp.arange(T)
+        if lens is None:
+            idx = jnp.broadcast_to(r[::-1], data.shape[:2])
+        else:
+            rev = lens[:, None] - 1 - r[None, :]
+            idx = jnp.where(r[None, :] < lens[:, None], rev, r[None, :])
+        ishape = idx.shape + (1,) * (data.ndim - 2)
+        return jnp.take_along_axis(
+            data, idx.reshape(ishape).astype(jnp.int32), axis=1)
+    args = (x,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_reverse", impl, *args)
+
+
+def sequence_expand(x, y_lengths, ref_level=0, name=None):
+    """reference: sequence_expand_op.cc — repeat row i of ``x``
+    ``y_lengths[i]`` times along dim 0. Repeat counts are read at trace
+    time (static output shape)."""
+    reps = np.asarray(_raw(y_lengths)).astype(np.int64)
+    idx = np.repeat(np.arange(reps.shape[0]), reps).astype(np.int32)
+
+    def impl(data, lens):
+        return data[jnp.asarray(idx)]
+    return apply("sequence_expand", impl, x, y_lengths)
+
+
+def sequence_expand_as(x, y, name=None):
+    """reference: sequence_expand_as_op.cc."""
+    n = int(_raw(y).shape[0])
+    b = int(_raw(x).shape[0])
+    if n % b != 0:
+        raise ValueError(f"cannot expand {b} rows to {n}")
+    rep = n // b
+
+    def impl(data, _):
+        return jnp.repeat(data, rep, axis=0)
+    return apply("sequence_expand_as", impl, x, y)
+
+
+def sequence_concat(xs: Sequence, lengths: Sequence, name=None):
+    """reference: sequence_concat_op.cc — interleave per-row: row b of the
+    result is x1[b][:l1[b]] ++ x2[b][:l2[b]] ++ ..., padded to the summed
+    max length. Returns (data, lengths)."""
+    raws = [_raw(x) for x in xs]
+    lens = [_raw(l) for l in lengths]
+    T_out = sum(int(r.shape[1]) for r in raws)
+
+    def impl(*args):
+        k = len(raws)
+        datas, ls = args[:k], args[k:]
+        B = datas[0].shape[0]
+        total = ls[0]
+        for l in ls[1:]:
+            total = total + l
+        out_shape = (B, T_out) + datas[0].shape[2:]
+        out = jnp.zeros(out_shape, datas[0].dtype)
+        offset = jnp.zeros((B,), jnp.int32)
+        for d, l in zip(datas, ls):
+            T = d.shape[1]
+            t_idx = jnp.arange(T)[None, :]
+            valid = t_idx < l[:, None]
+            dest = offset[:, None] + t_idx
+            dest = jnp.where(valid, dest, T_out - 1)
+            b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], dest.shape)
+            contrib = jnp.where(
+                valid.reshape(valid.shape + (1,) * (d.ndim - 2)), d, 0)
+            out = out.at[b_idx, dest].add(
+                jnp.where(valid.reshape(valid.shape + (1,) * (d.ndim - 2)),
+                          contrib, 0))
+            offset = offset + l.astype(jnp.int32)
+        return out, total
+    flat = list(xs) + list(lengths)
+    data, total = apply("sequence_concat", impl, *flat)
+    return data, total
+
+
+def sequence_slice(x, offset, length, name=None):
+    """reference: sequence_slice_op.cc — per-row slice [offset, offset+len)
+    re-packed to the left; returns (data, new_lengths)."""
+    T = int(_raw(x).shape[1])
+
+    def impl(data, off, ln):
+        t = jnp.arange(T)[None, :]
+        src = off[:, None] + t
+        src = jnp.clip(src, 0, T - 1)
+        gathered = jnp.take_along_axis(
+            data, src.reshape(src.shape + (1,) * (data.ndim - 2)).astype(
+                jnp.int32), axis=1)
+        valid = t < ln[:, None]
+        vshape = valid.shape + (1,) * (data.ndim - 2)
+        return jnp.where(valid.reshape(vshape), gathered, 0), ln
+    data, ln = apply("sequence_slice", impl, x, offset, length)
+    return data, ln
+
+
+def sequence_enumerate(x, win_size, pad_value=0, lengths=None, name=None):
+    """reference: sequence_enumerate_op.cc — sliding windows of ids:
+    [B, T] -> [B, T, win_size]."""
+    w = int(win_size)
+
+    def impl(ids, *rest):
+        lens = rest[0] if rest else None
+        T = ids.shape[1]
+        t = jnp.arange(T)[:, None] + jnp.arange(w)[None, :]   # [T, w]
+        limit = (lens[:, None, None] if lens is not None
+                 else jnp.asarray(T))
+        src = jnp.minimum(t, T - 1)
+        vals = ids[:, src]                                     # [B, T, w]
+        ok = t[None, :, :] < limit
+        return jnp.where(ok, vals, jnp.asarray(pad_value, ids.dtype))
+    args = (x,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_enumerate", impl, *args)
+
+
+def sequence_erase(x, tokens, lengths=None, name=None):
+    """reference: sequence_erase_op.cc — remove the listed token ids from
+    each row, left-packing survivors; returns (data, new_lengths) with the
+    padded shape preserved (masked-ragged form of the LoD shrink)."""
+    toks = np.asarray(tokens).reshape(-1)
+
+    def impl(ids, *rest):
+        lens = rest[0] if rest else None
+        B, T = ids.shape
+        t = jnp.arange(T)[None, :]
+        valid = t < lens[:, None] if lens is not None else jnp.ones(
+            (B, T), bool)
+        keep = valid & ~jnp.isin(ids, jnp.asarray(toks, ids.dtype))
+        new_len = keep.sum(axis=1).astype(
+            lens.dtype if lens is not None else jnp.int64)
+        # left-pack surviving tokens: position = exclusive cumsum of keep
+        pos = jnp.cumsum(keep, axis=1) - 1
+        dest = jnp.where(keep, pos, T - 1)
+        out = jnp.zeros_like(ids)
+        b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], ids.shape)
+        out = out.at[b_idx, dest].max(jnp.where(keep, ids, 0))
+        return out, new_len
+    args = (x,) + ((lengths,) if lengths is not None else ())
+    return apply("sequence_erase", impl, *args)
+
+
+def sequence_conv(x, weight, bias=None, context_length=3, context_start=None,
+                  context_stride=1, lengths=None, name=None):
+    """reference: sequence_conv_op.cc — context-window projection: for each
+    step, concat [t+start, t+start+length) rows (zeros outside the valid
+    prefix) and project by ``weight`` [ctx*D, H]."""
+    cl = int(context_length)
+    cs = int(context_start) if context_start is not None else -((cl - 1) // 2)
+
+    def impl(data, w, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        lens = next(it, None) if lengths is not None else None
+        B, T, D = data.shape
+        cols = []
+        for k in range(cl):
+            off = cs + k
+            t = jnp.arange(T) + off
+            ok = (t >= 0) & (t < T)
+            if lens is not None:
+                ok = ok[None, :] & (t[None, :] < lens[:, None])
+            else:
+                ok = jnp.broadcast_to(ok[None, :], (B, T))
+            src = jnp.clip(t, 0, T - 1)
+            vals = data[:, src, :]
+            cols.append(jnp.where(ok[..., None], vals, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)          # [B, T, cl*D]
+        out = ctx @ w
+        if b is not None:
+            out = out + b
+        if lens is not None:
+            ok_t = jnp.arange(T)[None, :] < lens[:, None]
+            out = jnp.where(ok_t[..., None], out, 0.0)
+        return out
+    args = [x, weight]
+    if bias is not None:
+        args.append(bias)
+    if lengths is not None:
+        args.append(lengths)
+    return apply("sequence_conv", impl, *args)
+
+
+def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0), name=None):
+    """reference: im2sequence_op.cc — NCHW image to patch rows
+    [B*out_h*out_w, kh*kw*C]."""
+    kh, kw = kernels
+    sh, sw = strides
+
+    def impl(img):
+        pad = [(0, 0), (0, 0), (paddings[0], paddings[1]),
+               (paddings[2], paddings[3])]
+        p = jnp.pad(img, pad)
+        B, C, H, W = p.shape
+        oh = (H - kh) // sh + 1
+        ow = (W - kw) // sw + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            p, (kh, kw), (sh, sw), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))  # [B, C*kh*kw, oh, ow]
+        patches = jnp.moveaxis(patches, 1, -1)           # [B, oh, ow, C*kh*kw]
+        return patches.reshape(B * oh * ow, C * kh * kw)
+    return apply("im2sequence", impl, x)

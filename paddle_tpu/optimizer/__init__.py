@@ -1,4 +1,5 @@
 """paddle.optimizer parity (reference: python/paddle/optimizer/__init__.py)."""
-from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+from .optimizer import (ExponentialMovingAverage,  # noqa: F401
+                        Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
                         Adagrad, Adadelta, RMSProp, Lamb, LarsMomentum, Ftrl)
 from . import lr

@@ -17,7 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor, Parameter
+from ..core.tensor import Tensor, stable_uid, Parameter
 from ..core import dtypes as _dt
 from .lr import LRScheduler
 
@@ -59,7 +59,7 @@ class Optimizer:
         if self._accumulators_built:
             return
         for p in self._parameter_list:
-            self._state[id(p)] = self._init_state(p)
+            self._state[stable_uid(p)] = self._init_state(p)
         self._accumulators_built = True
 
     def _init_state(self, p: Parameter) -> dict:
@@ -71,7 +71,7 @@ class Optimizer:
         self._ensure_state()
         out = {}
         for i, p in enumerate(self._parameter_list):
-            for k, v in self._state[id(p)].items():
+            for k, v in self._state[stable_uid(p)].items():
                 out[f"param_{i}.{k}"] = Tensor(v)
         out["global_step"] = self._global_step
         if isinstance(self._learning_rate, LRScheduler):
@@ -81,11 +81,11 @@ class Optimizer:
     def set_state_dict(self, state):
         self._ensure_state()
         for i, p in enumerate(self._parameter_list):
-            for k in self._state[id(p)]:
+            for k in self._state[stable_uid(p)]:
                 key = f"param_{i}.{k}"
                 if key in state:
                     v = state[key]
-                    self._state[id(p)][k] = (
+                    self._state[stable_uid(p)][k] = (
                         v._data if isinstance(v, Tensor) else jnp.asarray(v))
         self._global_step = int(state.get("global_step", self._global_step))
         if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
@@ -131,7 +131,7 @@ class Optimizer:
         grads = [p._grad for p in params]
         if self._grad_clip is not None:
             grads = self._grad_clip._clip_raw(params, grads)
-        states = [self._state[id(p)] for p in params]
+        states = [self._state[stable_uid(p)] for p in params]
         lr = jnp.asarray(self.get_lr(), self._lr_dtype)
         step_no = jnp.asarray(self._global_step + 1, jnp.float32)
 
@@ -160,7 +160,7 @@ class Optimizer:
         for p, np_, ns in zip(params, new_params, new_states):
             p._data = np_
             p._inplace_version += 1
-            self._state[id(p)] = ns
+            self._state[stable_uid(p)] = ns
         self._global_step += 1
 
     def clear_grad(self, set_to_zero=False):
@@ -493,3 +493,100 @@ class Ftrl(Optimizer):
         pre = jnp.clip(lin, -self._l1, self._l1) - lin
         p2 = pre / quad
         return p2, {"squared": new_sq, "linear": lin}
+
+
+@jax.jit
+def _ema_step(emas, praws, d):
+    return [d.astype(e.dtype) * e + (1.0 - d).astype(e.dtype) * p
+            for e, p in zip(emas, praws)]
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference: fluid/optimizer.py:3694
+    ExponentialMovingAverage — shadow vars updated as
+    ema = decay * ema + (1 - decay) * param, with the optional
+    ``thres_steps`` ramp decay' = min(decay, (1 + steps) / (10 + steps)),
+    bias-corrected on apply; apply()/restore() swap params).
+
+    Usage::
+
+        ema = ExponentialMovingAverage(0.999,
+                                       parameters=model.parameters())
+        for batch in data:
+            train_step(...)
+            ema.update()
+        with ema.apply():
+            evaluate(...)
+
+    (The reference registers every trainable param from the global static
+    program at construction; dygraph has no global registry, so pass
+    ``parameters=`` here or on the first ``update()``.)
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameters=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._step = 0
+        self._shadow = {}      # uid -> (param, ema_raw)
+        self._backup = {}
+        self._decay_pow = 1.0  # prod of decays for bias correction
+        self._params = []
+        if parameters is not None:
+            self._register(parameters)
+
+    def _register(self, params):
+        for p in params:
+            uid = stable_uid(p)
+            if uid not in self._shadow and not p.stop_gradient:
+                self._shadow[uid] = (p, jnp.zeros_like(p._data))
+                self._params.append(p)
+
+    def update(self, parameters=None):
+        """One EMA step over the registered (or given) parameters."""
+        if parameters is not None:
+            self._register(parameters)
+        elif not self._shadow:
+            raise ValueError(
+                "no parameters registered; pass parameters= to the "
+                "constructor or to the first update()")
+        self._step += 1
+        d = self._decay
+        if self._thres_steps is not None:
+            d = min(d, (1.0 + self._step) / (10.0 + self._step))
+        self._decay_pow *= d
+        # one fused program for all shadows (not O(n_params) dispatches —
+        # same reasoning as amp._fused_unscale)
+        uids = list(self._shadow)
+        emas = [self._shadow[u][1] for u in uids]
+        praws = [self._shadow[u][0]._data for u in uids]
+        new = _ema_step(emas, praws, jnp.asarray(d, jnp.float32))
+        for u, e in zip(uids, new):
+            self._shadow[u] = (self._shadow[u][0], e)
+
+    def apply(self, parameters=None, need_restore=True):
+        """Context manager: params hold their (bias-corrected) EMA values
+        inside the block. ``parameters`` registers late additions; the
+        swap always covers the full registered set."""
+        import contextlib
+        if parameters is not None:
+            self._register(parameters)
+
+        @contextlib.contextmanager
+        def ctx():
+            corr = 1.0 - self._decay_pow
+            self._backup = {}
+            for uid, (p, ema) in self._shadow.items():
+                self._backup[uid] = p._data
+                p._data = ema / corr if corr > 0 else ema
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore()
+        return ctx()
+
+    def restore(self, parameters=None):
+        for uid, raw in self._backup.items():
+            self._shadow[uid][0]._data = raw
+        self._backup = {}

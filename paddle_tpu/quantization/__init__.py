@@ -1,0 +1,211 @@
+"""Quantization (QAT + PTQ) — paddle.quantization / slim parity.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/ —
+QuantizationTransformPass (fake-quant op insertion),
+ImperativeQuantAware (imperative_qat.py, dygraph layer wrapping), PTQ
+calibration, and the fake_quantize kernels
+(operators/fake_quantize_op.cc: abs_max, channel_wise_abs_max,
+moving_average_abs_max).
+
+TPU design: fake-quant is expressed functionally with the straight-through
+estimator — ``x + stop_gradient(quant(x) - x)`` — so autograd gives STE
+for free and XLA fuses the whole simulate-quantize chain; no graph pass
+is needed (layers are wrapped, the reference's dygraph path).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply, apply_raw
+from ..nn.layer_base import Layer
+from ..nn import functional as F
+
+
+def fake_quantize_abs_max(x, bit_length=8):
+    """reference: fake_quantize_op.cc FakeQuantizeAbsMax — symmetric
+    per-tensor quantize/dequantize with STE gradient. Returns (out, scale)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def impl(a):
+        scale = jnp.max(jnp.abs(a))
+        s = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.round(a / s * qmax) / qmax * s
+        # straight-through: value of q, gradient of a
+        out = a + jax.lax.stop_gradient(q - a)
+        return out, scale
+    import jax
+    return apply("fake_quantize_abs_max", impl, x)
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
+    """reference: fake_quantize_op.cc channel-wise variant (weights)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def impl(a):
+        import jax
+        axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(a), axis=axes, keepdims=True)
+        s = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.round(a / s * qmax) / qmax * s
+        out = a + jax.lax.stop_gradient(q - a)
+        return out, scale.reshape(-1)
+    return apply("fake_channel_wise_quantize_abs_max", impl, x)
+
+
+class MovingAverageAbsMaxObserver:
+    """reference: fake_quantize_op.cc FakeQuantizeMovingAverageAbsMax
+    state (accum/state/scale buffers)."""
+
+    def __init__(self, moving_rate=0.9):
+        self._rate = moving_rate
+        self.scale: Optional[float] = None
+
+    def observe(self, x):
+        raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.max(jnp.abs(raw)))
+        if self.scale is None:
+            self.scale = cur
+        else:
+            self.scale = self._rate * self.scale + (1 - self._rate) * cur
+        return self.scale
+
+
+def quant_dequant_with_scale(x, scale, bit_length=8):
+    """Simulated int quantize with a FIXED scale (PTQ inference form)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def impl(a):
+        import jax
+        s = max(float(scale), 1e-8)
+        q = jnp.clip(jnp.round(a / s * qmax), -qmax - 1, qmax) / qmax * s
+        return a + jax.lax.stop_gradient(q - a)
+    return apply("quant_dequant", impl, x)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight + input (reference:
+    slim/quantization/imperative/qat.py QuantizedLinear)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._observer = MovingAverageAbsMaxObserver(moving_rate)
+
+    def forward(self, x):
+        self._observer.observe(x)
+        xq, _ = fake_quantize_abs_max(x, self._abits)
+        wq, _ = fake_channel_wise_quantize_abs_max(self.weight, self._wbits,
+                                                   quant_axis=1)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantedConv2D(Layer):
+    """reference: imperative/qat.py QuantizedConv2D."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = getattr(layer, "bias", None)
+        self._cfg = {k: getattr(layer, k) for k in
+                     ("_stride", "_padding", "_dilation", "_groups")
+                     if hasattr(layer, k)}
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._observer = MovingAverageAbsMaxObserver(moving_rate)
+
+    def forward(self, x):
+        self._observer.observe(x)
+        xq, _ = fake_quantize_abs_max(x, self._abits)
+        wq, _ = fake_channel_wise_quantize_abs_max(self.weight, self._wbits,
+                                                   quant_axis=0)
+        return F.conv2d(xq, wq, self.bias,
+                        stride=self._cfg.get("_stride", 1),
+                        padding=self._cfg.get("_padding", 0),
+                        dilation=self._cfg.get("_dilation", 1),
+                        groups=self._cfg.get("_groups", 1))
+
+
+class ImperativeQuantAware:
+    """QAT driver (reference: slim/quantization/imperative/qat.py
+    ImperativeQuantAware.quantize — swaps Linear/Conv2D sublayers for
+    quantized wrappers in place)."""
+
+    QUANT_MAP = None  # filled below
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 quantizable_layer_type=("Conv2D", "Linear"), **kw):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._types = set(quantizable_layer_type)
+
+    def quantize(self, model: Layer):
+        from ..nn import Linear, Conv2D
+        for name, sub in list(model._sub_layers.items()):
+            cls = type(sub).__name__
+            # setattr (not a _sub_layers poke): Layer.__setattr__ keeps the
+            # instance attribute and the registry in sync
+            if cls == "Linear" and "Linear" in self._types:
+                setattr(model, name, QuantedLinear(
+                    sub, self._wbits, self._abits, self._rate))
+            elif cls == "Conv2D" and "Conv2D" in self._types:
+                setattr(model, name, QuantedConv2D(
+                    sub, self._wbits, self._abits, self._rate))
+            else:
+                self.quantize(sub)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+        jit.save(model, path, input_spec=input_spec)
+
+
+class ImperativePTQ:
+    """Post-training quantization (reference: slim/quantization/imperative/
+    ptq.py): wrap, run calibration batches, then ``convert`` freezes the
+    observed activation scales."""
+
+    def __init__(self, quant_config=None):
+        self._cfg = quant_config or {}
+
+    def quantize(self, model: Layer):
+        return ImperativeQuantAware().quantize(model)
+
+    def convert(self, model: Layer):
+        """Freeze observers: replace moving-average observation with the
+        calibrated fixed scale."""
+        for sub in model._sub_layers.values():
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                scale = sub._observer.scale or 1.0
+
+                def freeze(layer=sub, s=scale):
+                    def fwd(x):
+                        xq = quant_dequant_with_scale(x, s, layer._abits)
+                        wq, _ = fake_channel_wise_quantize_abs_max(
+                            layer.weight, layer._wbits,
+                            quant_axis=1 if isinstance(layer, QuantedLinear)
+                            else 0)
+                        if isinstance(layer, QuantedLinear):
+                            return F.linear(xq, wq, layer.bias)
+                        return F.conv2d(
+                            xq, wq, layer.bias,
+                            stride=layer._cfg.get("_stride", 1),
+                            padding=layer._cfg.get("_padding", 0),
+                            dilation=layer._cfg.get("_dilation", 1),
+                            groups=layer._cfg.get("_groups", 1))
+                    return fwd
+                sub.forward = freeze()
+            else:
+                self.convert(sub)
+        return model

@@ -51,16 +51,20 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 
 class CompiledProgram:
     """reference: fluid/compiler.py:88 — multi-device compilation wrapper.
-    On TPU the Executor already compiles whole programs; data parallelism is
-    mesh sharding (paddle_tpu.distributed), so this is a thin pass-through
-    kept for API compatibility."""
+    On TPU the Executor already compiles whole programs;
+    ``with_data_parallel`` marks the program so Executor.run shards each
+    feed's batch dim over the mesh (GSPMD then partitions the compiled
+    step and inserts the gradient all-reduce — the role of the reference's
+    ParallelExecutor graph passes, parallel_executor.cc:618)."""
 
     def __init__(self, program, build_strategy=None):
         self._program = program
         self._build_strategy = build_strategy
+        self._data_parallel = False
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
+        self._data_parallel = True
         return self
 
     def __getattr__(self, name):

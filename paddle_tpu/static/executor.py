@@ -17,7 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, stable_uid
 from ..core import dtypes as _dt
 from .graph import Program, Variable, default_main_program
 
@@ -80,6 +80,9 @@ class Executor:
             feed_var_name="feed", fetch_var_name="fetch", scope=None,
             return_numpy=True, use_program_cache=True):
         program = program if program is not None else default_main_program()
+        data_parallel = bool(getattr(program, "_data_parallel", False))
+        if hasattr(program, "_program"):   # CompiledProgram wrapper
+            program = program._program
         feed = feed or {}
         fetch_list = list(fetch_list or [])
 
@@ -93,6 +96,8 @@ class Executor:
             if isinstance(v, Tensor):
                 v = v._data
             feed_vals[k] = jnp.asarray(v)
+        if data_parallel:
+            self._shard_feeds_dp(feed_vals, program)
         sig = tuple((k, tuple(feed_vals[k].shape), str(feed_vals[k].dtype))
                     for k in feed_names)
         fetch_key = tuple(f.name if isinstance(f, Variable) else str(f)
@@ -111,9 +116,9 @@ class Executor:
         param_raws = [p._data for p in params]
         if opt is not None:
             for p in params:
-                if id(p) not in opt._state:
-                    opt._state[id(p)] = opt._init_state(p)
-            opt_states = [opt._state[id(p)] for p in params]
+                if stable_uid(p) not in opt._state:
+                    opt._state[stable_uid(p)] = opt._init_state(p)
+            opt_states = [opt._state[stable_uid(p)] for p in params]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_no = jnp.asarray(opt._global_step + 1, jnp.float32)
             fetches, new_params, new_states, effects = entry(
@@ -122,7 +127,7 @@ class Executor:
             for p, npr, ns in zip(params, new_params, new_states):
                 p._data = npr
                 p._inplace_version += 1
-                opt._state[id(p)] = ns
+                opt._state[stable_uid(p)] = ns
             opt._global_step += 1
         else:
             fetches, effects = entry(param_raws,
@@ -135,6 +140,33 @@ class Executor:
         return [Tensor(f) for f in fetches]
 
     # ------------------------------------------------------------------
+    def _shard_feeds_dp(self, feed_vals, program):
+        """Static data parallelism (reference: ParallelExecutor): shard
+        every feed's batch dim over the mesh's "dp" axis (or an implicit
+        all-device mesh) and replicate the params — GSPMD partitions the
+        compiled step and inserts the gradient all-reduce."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..distributed import mesh as _mesh_mod
+
+        mesh = _mesh_mod.get_mesh()
+        if mesh is None:
+            devs = jax.devices()
+            if len(devs) == 1:
+                return  # single device: DP is a no-op, not an error
+            mesh = _mesh_mod.build_mesh({"dp": len(devs)}, devs)
+        axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+        n = int(mesh.shape[axis])
+        for k, v in feed_vals.items():
+            if v.ndim >= 1 and v.shape[0] % n == 0:
+                spec = P(axis, *([None] * (v.ndim - 1)))
+                feed_vals[k] = jax.device_put(v, NamedSharding(mesh, spec))
+        repl = NamedSharding(mesh, P())
+        for p in program.all_parameters():
+            sh = getattr(p._data, "sharding", None)
+            if sh != repl:
+                p._data = jax.device_put(p._data, repl)
+
     def _compile(self, program: Program, feed_names, fetch_list, params, opt,
                  feed_vals):
         data_vars = {name: program.vars[name] for name in feed_names

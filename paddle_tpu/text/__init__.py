@@ -1,0 +1,13 @@
+"""paddle.text: NLP datasets (reference: python/paddle/text/__init__.py —
+Imdb, Imikolov, Movielens, UCIHousing, Conll05st, WMT14, WMT16 over
+paddle.io.Dataset).
+
+Offline-first: every dataset accepts ``data_file=`` pointing at the
+original archive; ``download=True`` goes through paddle_tpu.utils.download
+(clear error when the environment has no egress).
+"""
+from .datasets import (Imdb, Imikolov, Movielens, UCIHousing,  # noqa: F401
+                       Conll05st, WMT14, WMT16)
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16"]

@@ -1,0 +1,37 @@
+"""paddle.utils (reference: python/paddle/utils/)."""
+from . import download  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    """reference: utils/lazy_import.py try_import."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"{module_name} is required") from e
+
+
+def run_check():
+    """reference: utils/install_check.py run_check — sanity-train a tiny
+    model on the visible devices."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    losses = []
+    for _ in range(2):
+        loss = paddle.mean(lin(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[1] <= losses[0]
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! {n} device(s) "
+          f"({jax.devices()[0].platform}) available.")

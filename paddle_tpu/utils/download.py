@@ -1,0 +1,98 @@
+"""Model/dataset download cache (reference: python/paddle/utils/download.py
+get_weights_path_from_url :72, get_path_from_url :119 — URL fetch into
+~/.cache with md5 verification and archive decompression).
+
+Environments without egress (like this build's CI) get a clear error
+instead of a hang; all consumers accept a local ``data_file``/path so
+everything works offline with pre-fetched files.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import zipfile
+
+WEIGHTS_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_HOME", "~/.cache/paddle_tpu"))
+
+
+class DownloadError(RuntimeError):
+    pass
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _download(url, path, md5sum=None):
+    os.makedirs(path, exist_ok=True)
+    fname = os.path.split(url)[-1].replace("%2F", "_")
+    fullname = os.path.join(path, fname)
+    if os.path.exists(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    import urllib.request
+    try:
+        tmp = fullname + ".tmp"
+        urllib.request.urlretrieve(url, tmp)
+        os.replace(tmp, fullname)
+    except Exception as e:
+        raise DownloadError(
+            f"failed to download {url}: {e!r}. This environment may have "
+            f"no network egress — fetch the file manually and pass its "
+            f"path (data_file=/path) or place it at {fullname}") from e
+    if not _md5check(fullname, md5sum):
+        raise DownloadError(f"md5 mismatch for {fullname}")
+    return fullname
+
+
+def _decompress(fname):
+    dst = os.path.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as t:
+            t.extractall(dst)
+            names = t.getnames()
+        root = names[0].split("/")[0] if names else ""
+        return os.path.join(dst, root)
+    if zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as z:
+            z.extractall(dst)
+            names = z.namelist()
+        root = names[0].split("/")[0] if names else ""
+        return os.path.join(dst, root)
+    return fname
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True,
+                      decompress=True):
+    """reference: utils/download.py:119."""
+    root_dir = root_dir or WEIGHTS_HOME
+    fullname = _download(url, root_dir, md5sum)
+    if decompress and (tarfile.is_tarfile(fullname)
+                       or zipfile.is_zipfile(fullname)):
+        return _decompress(fullname)
+    return fullname
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """reference: utils/download.py:72."""
+    return get_path_from_url(url, os.path.join(WEIGHTS_HOME, "weights"),
+                             md5sum, decompress=False)
+
+
+def _check_exists_and_download(path, url, md5sum, name, download):
+    """reference: dataset/common.py _check_exists_and_download."""
+    if path and os.path.exists(path):
+        return path
+    if download:
+        return get_path_from_url(
+            url, os.path.join(WEIGHTS_HOME, "dataset", name), md5sum,
+            decompress=False)
+    raise ValueError(f"{path} not exists and auto download disabled")

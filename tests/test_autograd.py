@@ -125,3 +125,141 @@ class TestNanCheck:
                 paddle.log(x - 2.0) * 1.0  # log(-1) = nan
         finally:
             paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestDoubleGrad:
+    """Round-3: create_graph=True (reference: partial_grad_engine.cc) and
+    PyLayer (reference: imperative/py_layer_fwd.h)."""
+
+    def test_second_derivative_polynomial(self):
+        x = paddle.to_tensor(np.array([2.0, -1.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2,
+                                   rtol=1e-6)
+        (ggx,) = paddle.grad((gx * gx).sum(), x)
+        np.testing.assert_allclose(ggx.numpy(), 36 * x.numpy() ** 3,
+                                   rtol=1e-5)
+
+    def test_gradient_penalty_matches_numeric(self):
+        # WGAN-GP shape: penalty = (||d f/d x|| - 1)^2, grads wrt W
+        rng = np.random.RandomState(0)
+        W0 = rng.randn(3, 4).astype(np.float32)
+        xv = rng.randn(2, 3).astype(np.float32)
+
+        def penalty(Wnp):
+            h = np.tanh(xv @ Wnp)
+            gx = (1 - h ** 2) @ Wnp.T
+            return (np.sqrt((gx ** 2).sum()) - 1.0) ** 2
+
+        W = paddle.to_tensor(W0, stop_gradient=False)
+        xt = paddle.to_tensor(xv, stop_gradient=False)
+        s = paddle.tanh(paddle.matmul(xt, W)).sum()
+        (gx,) = paddle.grad(s, xt, create_graph=True)
+        pen = (paddle.sqrt((gx * gx).sum()) - 1.0) ** 2
+        pen.backward()
+        eps = 1e-3
+        num = np.zeros_like(W0)
+        for i in range(W0.shape[0]):
+            for j in range(W0.shape[1]):
+                Wp, Wm = W0.copy(), W0.copy()
+                Wp[i, j] += eps
+                Wm[i, j] -= eps
+                num[i, j] = (penalty(Wp) - penalty(Wm)) / (2 * eps)
+        np.testing.assert_allclose(W.grad.numpy(), num, rtol=2e-2,
+                                   atol=1e-4)
+
+    def test_third_order(self):
+        x = paddle.to_tensor(np.array([1.3], np.float32),
+                             stop_gradient=False)
+        y = paddle.exp(x)
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x, create_graph=True)
+        (g3,) = paddle.grad(g2, x)
+        np.testing.assert_allclose(g3.numpy(), np.exp([1.3]), rtol=1e-5)
+
+
+class TestPyLayer:
+    def test_forward_backward(self):
+        class Cube(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, gy):
+                (x,) = ctx.saved_tensor()
+                return gy * 3.0 * x * x
+
+        x = paddle.to_tensor(np.array([2.0, -1.0], np.float32),
+                             stop_gradient=False)
+        y = Cube.apply(x)
+        np.testing.assert_allclose(y.numpy(), x.numpy() ** 3)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 3 * x.numpy() ** 2,
+                                   rtol=1e-6)
+
+    def test_double_grad_through_pylayer(self):
+        class Cube(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, gy):
+                (x,) = ctx.saved_tensor()
+                return gy * 3.0 * x * x
+
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        (g,) = paddle.grad(Cube.apply(x), x, create_graph=True)
+        (gg,) = paddle.grad(g, x)
+        np.testing.assert_allclose(gg.numpy(), [12.0], rtol=1e-6)
+
+    def test_multi_io_and_wrong_arity_raises(self):
+        class MulAdd(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, g1, g2):
+                a, b = ctx.saved_tensor()
+                return g1 * b + g2, g1 * a + g2
+
+        a = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.array([4.0], np.float32),
+                             stop_gradient=False)
+        o1, o2 = MulAdd.apply(a, b)
+        (o1.sum() + 2 * o2.sum()).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [6.0])
+        np.testing.assert_allclose(b.grad.numpy(), [5.0])
+
+        class Bad(paddle.PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                return a + b
+
+            @staticmethod
+            def backward(ctx, g):
+                return g  # one grad for two tensor inputs
+
+        with pytest.raises(RuntimeError, match="grads"):
+            Bad.apply(a, b).sum().backward()
+
+
+class TestDoubleGradThroughToStatic:
+    def test_create_graph_over_compiled_fn(self):
+        f = paddle.jit.to_static(lambda x: x * x * x)
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = f(x)
+        (g,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-5)
+        (gg,) = paddle.grad(g, x)
+        np.testing.assert_allclose(gg.numpy(), [12.0], rtol=1e-5)

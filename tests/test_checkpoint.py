@@ -1,0 +1,146 @@
+"""Sharded / async / auto checkpointing + FS facade
+(reference: incubate/checkpoint/auto_checkpoint.py:71 TrainEpochRange,
+fleet/utils/fs.py LocalFS:115/HDFSClient:419)."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+import paddle_tpu.distributed as dist
+from paddle_tpu.incubate.checkpoint import (save_sharded, load_sharded,
+                                            AsyncSaver, TrainEpochRange)
+from paddle_tpu.distributed.fleet.fs import (LocalFS, HDFSClient,
+                                             ExecuteError)
+
+
+class TestShardedCheckpoint:
+    def test_roundtrip_sharded_array(self, tmp_path):
+        mesh = dist.build_mesh({"dp": 8})
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        arr = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        state = {"w": arr, "nested": {"b": jnp.ones(3)}, "step": 7}
+        save_sharded(state, str(tmp_path / "ck"))
+        out = load_sharded(str(tmp_path / "ck"), mesh=mesh)
+        np.testing.assert_allclose(out["w"].numpy(), x)
+        # resharded onto the mesh with the recorded spec
+        assert "dp" in str(out["w"]._data.sharding.spec)
+        np.testing.assert_allclose(out["nested"]["b"].numpy(), np.ones(3))
+        assert out["step"] == 7
+
+    def test_reshard_on_load_to_different_mesh(self, tmp_path):
+        mesh8 = dist.build_mesh({"dp": 8})
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        arr = jax.device_put(x, NamedSharding(mesh8, P("dp", None)))
+        save_sharded({"w": arr}, str(tmp_path / "ck"))
+        # new topology: 4-device mesh with a different axis name
+        mesh4 = dist.build_mesh({"mp": 4}, jax.devices()[:4])
+        out = load_sharded(str(tmp_path / "ck"), mesh=mesh4)
+        np.testing.assert_allclose(out["w"].numpy(), x)  # replicated now
+
+    def test_async_saver(self, tmp_path):
+        s = AsyncSaver()
+        state = {"a": jnp.arange(10.0)}
+        s.save(state, str(tmp_path / "ck"))
+        s.wait()
+        out = load_sharded(str(tmp_path / "ck"))
+        np.testing.assert_allclose(out["a"].numpy(), np.arange(10.0))
+
+
+def _make_model_and_data():
+    paddle.seed(7)
+    net = nn.Linear(4, 2)
+    opt = optim.AdamW(learning_rate=1e-2, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 4).astype(np.float32)
+    Y = rng.randn(16, 2).astype(np.float32)
+    return net, opt, X, Y
+
+
+def _train_epoch(net, opt, X, Y):
+    x, y = paddle.to_tensor(X), paddle.to_tensor(Y)
+    loss = paddle.mean((net(x) - y) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss.numpy())
+
+
+class TestAutoCheckpoint:
+    def test_kill_and_resume_identical_losses(self, tmp_path):
+        ckpt = str(tmp_path / "auto")
+        # uninterrupted run: 6 epochs
+        net, opt, X, Y = _make_model_and_data()
+        full_losses = [_train_epoch(net, opt, X, Y) for _ in range(6)]
+
+        # interrupted run: 3 epochs, then "kill"
+        net1, opt1, X, Y = _make_model_and_data()
+        r1 = TrainEpochRange(6, "job0", model=net1, optimizer=opt1,
+                             checkpoint_path=ckpt)
+        losses_a = []
+        for epoch in r1:
+            losses_a.append(_train_epoch(net1, opt1, X, Y))
+            if epoch == 2:
+                break  # simulated failure AFTER epoch 2 was checkpointed
+        r1.save(2)
+
+        # restart: fresh objects, same job name -> resumes at epoch 3
+        net2, opt2, X, Y = _make_model_and_data()
+        r2 = TrainEpochRange(6, "job0", model=net2, optimizer=opt2,
+                             checkpoint_path=ckpt)
+        assert r2.restored_epoch == 2
+        losses_b = []
+        for epoch in r2:
+            losses_b.append(_train_epoch(net2, opt2, X, Y))
+        resumed = losses_a[:3] + losses_b
+        np.testing.assert_allclose(resumed, full_losses, rtol=1e-5)
+
+    def test_sharded_params_roundtrip_on_mesh(self, tmp_path):
+        mesh = dist.build_mesh({"dp": 8})
+        dist.set_mesh(mesh)
+        try:
+            net, opt, X, Y = _make_model_and_data()
+            dist.shard_tensor(net.weight, P(None, None), mesh)
+            _train_epoch(net, opt, X, Y)
+            state = {"model": net.state_dict(),
+                     "optimizer": opt.state_dict()}
+            save_sharded(state, str(tmp_path / "ck"))
+            out = load_sharded(str(tmp_path / "ck"), mesh=mesh)
+            np.testing.assert_allclose(
+                out["model"]["weight"].numpy(), net.weight.numpy())
+            got = {k for k in out["optimizer"]}
+            assert any(k.startswith("param_0.") for k in got)
+        finally:
+            dist.set_mesh(None)
+
+
+class TestFSFacade:
+    def test_localfs(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "a" / "b")
+        fs.mkdirs(d)
+        assert fs.is_dir(d) and fs.is_exist(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(d)
+        assert files == ["x.txt"] and dirs == []
+        fs.mv(f, os.path.join(d, "y.txt"))
+        assert fs.is_file(os.path.join(d, "y.txt"))
+        assert not fs.need_upload_download()
+        fs.upload(os.path.join(d, "y.txt"), str(tmp_path / "z.txt"))
+        assert fs.is_file(str(tmp_path / "z.txt"))
+        assert fs.list_dirs(str(tmp_path)) == ["a"]
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_requires_binary(self):
+        if __import__("shutil").which("hadoop"):
+            pytest.skip("hadoop present")
+        with pytest.raises(ExecuteError):
+            HDFSClient()

@@ -1,0 +1,64 @@
+"""Custom-op extension path (reference: framework/custom_operator.cc:511,
+utils/cpp_extension/) + the Pallas greedy-NMS kernel."""
+import shutil
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as ops
+from paddle_tpu.ops import custom
+
+
+class TestRegisterOp:
+    def test_register_and_autograd(self):
+        if not hasattr(ops, "_test_cube3"):
+            custom.register_op("_test_cube3", lambda a: a * a * a)
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = ops._test_cube3(x)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)
+
+    def test_duplicate_name_raises(self):
+        with pytest.raises(ValueError, match="already"):
+            custom.register_op("matmul", lambda a: a)
+
+
+class TestPallasNMS:
+    def test_matches_scan_reference(self):
+        from paddle_tpu.ops.detection import (_pairwise_iou,
+                                              _greedy_nms_mask)
+        rng = np.random.RandomState(0)
+        k = 32
+        boxes = rng.rand(k, 4).astype(np.float32) * 10
+        boxes[:, 2:] = boxes[:, :2] + 1 + boxes[:, 2:]
+        scores = rng.rand(k).astype(np.float32)
+        kept_ref, order, top_s = _greedy_nms_mask(
+            jnp.asarray(boxes), jnp.asarray(scores), 0.5, 0.05, k)
+        iou = _pairwise_iou(jnp.asarray(boxes)[order],
+                            jnp.asarray(boxes)[order])
+        valid = (top_s > 0.05).astype(jnp.int32)
+        kept_pl = custom.pallas_greedy_nms(iou, valid, jnp.asarray([0.5]),
+                                           interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(kept_ref).astype(np.int32), np.asarray(kept_pl))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no toolchain")
+class TestCppOp:
+    def test_host_cpp_op(self, tmp_path):
+        src = r'''
+extern "C" void double_plus_one(const float* in, float* out, long n) {
+  for (long i = 0; i < n; ++i) out[i] = in[i] * 2.0f + 1.0f;
+}
+'''
+        if not hasattr(ops, "_test_dpo"):
+            custom.register_cpp_op("_test_dpo", src,
+                                   fn_name="double_plus_one",
+                                   build_dir=str(tmp_path))
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        out = ops._test_dpo(x)
+        np.testing.assert_allclose(out.numpy(), [3.0, 5.0])

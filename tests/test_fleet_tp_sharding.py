@@ -9,6 +9,7 @@ from jax.sharding import PartitionSpec as P
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu.core.tensor import stable_uid
 import paddle_tpu.nn as nn
 import paddle_tpu.optimizer as optim
 import paddle_tpu.distributed as dist
@@ -125,7 +126,7 @@ class TestZeroSharding:
             w_ref, _ = run(False)
             w_sh, opt = run(True)
             np.testing.assert_allclose(w_sh, w_ref, atol=1e-6)
-            st = opt._state[id(opt._parameter_list[0])]
+            st = opt._state[stable_uid(opt._parameter_list[0])]
             assert "dp" in str(st["moment1"].sharding.spec)
         finally:
             dist.set_mesh(None)

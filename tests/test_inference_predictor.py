@@ -1,0 +1,45 @@
+"""Standalone predictor over the StableHLO artifact (reference:
+inference/api/analysis_predictor.h:82; deploy-without-framework-code)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+class TestPredictor:
+    def _export(self, tmp_path):
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+
+            def forward(self, x):
+                return nn.functional.softmax(self.fc(x), axis=-1)
+
+        net = Net()
+        prefix = str(tmp_path / "model")
+        paddle.jit.save(net, prefix,
+                        input_spec=[InputSpec([2, 4], "float32", "x")])
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        return prefix, x, net(paddle.to_tensor(x)).numpy()
+
+    def test_positional_run(self, tmp_path):
+        prefix, x, ref = self._export(tmp_path)
+        pred = create_predictor(Config(prefix + ".pdmodel"))
+        out = pred.run([x])
+        np.testing.assert_allclose(out[0], ref, rtol=1e-5)
+
+    def test_handle_api(self, tmp_path):
+        prefix, x, ref = self._export(tmp_path)
+        pred = create_predictor(Config(prefix))
+        names = pred.get_input_names()
+        assert len(names) == 1
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        np.testing.assert_allclose(out.copy_to_cpu(), ref, rtol=1e-5)

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu.core.tensor import stable_uid
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 
@@ -351,5 +352,5 @@ class TestOptimizers:
         opt2 = optim.Adam(0.1, parameters=[p2])
         opt2.set_state_dict(state)
         np.testing.assert_allclose(
-            np.asarray(opt2._state[id(p2)]["moment1"]),
-            np.asarray(opt._state[id(p)]["moment1"]))
+            np.asarray(opt2._state[stable_uid(p2)]["moment1"]),
+            np.asarray(opt._state[stable_uid(p)]["moment1"]))

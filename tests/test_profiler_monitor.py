@@ -1,0 +1,64 @@
+"""Profiler + monitor gauges (reference: platform/profiler.cc RecordEvent,
+fluid/profiler.py:314 profiler context, platform/monitor.h:77 StatRegistry)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+
+
+class TestProfiler:
+    def test_capture_trace_directory(self, tmp_path):
+        log_dir = str(tmp_path / "prof")
+        with paddle.profiler.Profiler(log_dir=log_dir) as prof:
+            x = paddle.to_tensor(np.ones((8, 8), np.float32))
+            with paddle.profiler.RecordEvent("my_region"):
+                y = paddle.matmul(x, x)
+            y.numpy()
+            prof.step()
+        assert not paddle.profiler.is_profiling()
+        # jax writes plugins/profile/<ts>/*.xplane.pb under the log dir
+        captured = [str(p) for p in (tmp_path / "prof").rglob("*")
+                    if p.is_file()]
+        assert captured, "no trace files captured"
+        assert "steps=1" in prof.step_info()
+
+    def test_timer_only_mode(self):
+        with paddle.profiler.Profiler(timer_only=True) as prof:
+            for _ in range(3):
+                prof.step()
+        assert "steps=3" in prof.step_info()
+
+    def test_fluid_style_context(self, tmp_path):
+        with paddle.profiler.profiler(log_dir=str(tmp_path / "p2")):
+            x = paddle.to_tensor(np.ones((4,), np.float32))
+            (x + x).numpy()
+        assert not paddle.profiler.is_profiling()
+
+    def test_record_event_begin_end(self):
+        ev = paddle.profiler.RecordEvent("manual")
+        ev.begin()
+        ev.end()  # no active trace: must not raise
+
+
+class TestMonitor:
+    def test_stat_registry(self):
+        reg = monitor.StatRegistry()
+        assert reg.add("mem", 10) == 10
+        assert reg.add("mem", 5) == 15
+        reg.set("peak", 99.5)
+        assert reg.get("peak") == 99.5
+        assert reg.stats() == {"mem": 15, "peak": 99.5}
+        reg.reset("mem")
+        assert reg.get("mem") == 0
+        reg.reset()
+        assert reg.stats() == {}
+
+    def test_module_level_gauges(self):
+        monitor.stat_add("test_gauge", 3)
+        monitor.stat_add("test_gauge", 4)
+        assert monitor.stat_get("test_gauge") == 7
+        monitor.default_registry().reset("test_gauge")
+
+    def test_device_memory_stats_shape(self):
+        stats = monitor.device_memory_stats()
+        assert isinstance(stats, dict)

@@ -1,0 +1,95 @@
+"""QAT/PTQ (reference: fluid/contrib/slim/quantization — fake_quantize ops
++ ImperativeQuantAware/ImperativePTQ)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.quantization import (
+    fake_quantize_abs_max, fake_channel_wise_quantize_abs_max,
+    ImperativeQuantAware, ImperativePTQ, QuantedLinear)
+
+
+class TestFakeQuant:
+    def test_abs_max_roundtrip_and_scale(self):
+        x = paddle.to_tensor(np.array([-1.0, 0.5, 0.25], np.float32))
+        out, scale = fake_quantize_abs_max(x, bit_length=8)
+        assert abs(float(scale.numpy()) - 1.0) < 1e-6
+        # values land on the 127-level grid of [-1, 1]
+        q = out.numpy() * 127
+        np.testing.assert_allclose(q, np.round(q), atol=1e-4)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1 / 127)
+
+    def test_channel_wise_scales(self):
+        w = np.array([[1.0, -2.0], [0.5, 4.0]], np.float32)
+        out, scales = fake_channel_wise_quantize_abs_max(
+            paddle.to_tensor(w), quant_axis=0)
+        np.testing.assert_allclose(scales.numpy(), [2.0, 4.0])
+
+    def test_ste_gradient_is_identity(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                             stop_gradient=False)
+        out, _ = fake_quantize_abs_max(x)
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+class TestQAT:
+    def test_quantize_swaps_layers_and_trains(self):
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(nn.functional.relu(self.fc1(x)))
+
+        net = Net()
+        ImperativeQuantAware().quantize(net)
+        assert isinstance(net._sub_layers["fc1"], QuantedLinear)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                             .astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).randn(8, 2)
+                             .astype(np.float32))
+        losses = []
+        for _ in range(5):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]  # STE lets training proceed
+
+
+class TestPTQ:
+    def test_calibrate_and_convert(self):
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        ptq = ImperativePTQ()
+        ptq.quantize(net)
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            net(paddle.to_tensor(rng.randn(8, 4).astype(np.float32)))
+        scale = net._sub_layers["fc"]._observer.scale
+        assert scale is not None and scale > 0
+        ptq.convert(net)
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        out = net(x).numpy()
+        # simulated-int8 output stays close to fp32 for in-range data
+        ref = (x.numpy() @ net._sub_layers["fc"].weight.numpy()
+               + net._sub_layers["fc"].bias.numpy())
+        assert np.abs(out - ref).max() < 0.2

@@ -1,0 +1,155 @@
+"""Sequence family over the masked-ragged (padded + lengths) convention +
+beam-search ops, numpy-checked (reference: operators/sequence_ops/,
+beam_search_op.cc, gather_tree_op.cc, ctc_align_op.cc,
+edit_distance_op.cc; test style: unittests/op_test.py numpy references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as ops
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestSequenceOps:
+    def test_sequence_mask(self):
+        out = ops.sequence_mask(T([2, 0, 3]), maxlen=4).numpy()
+        np.testing.assert_array_equal(
+            out, [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_pad_unpad_roundtrip(self):
+        flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+        lens = np.array([2, 1, 3])
+        padded, L = ops.sequence_pad(T(flat), 0.0, maxlen=3, length=T(lens))
+        p = padded.numpy()
+        np.testing.assert_allclose(p[0, :2], flat[:2])
+        np.testing.assert_allclose(p[1, :1], flat[2:3])
+        np.testing.assert_allclose(p[2], flat[3:6])
+        assert (p[0, 2] == 0).all() and (p[1, 1:] == 0).all()
+        back = ops.sequence_unpad(padded, T(lens)).numpy()
+        np.testing.assert_allclose(back, flat)
+
+    def test_sequence_pool_types(self):
+        x = np.array([[[1.], [2.], [9.]],
+                      [[4.], [7.], [9.]]], np.float32)
+        lens = np.array([2, 3])
+        assert ops.sequence_pool(T(x), "sum", T(lens)).numpy().tolist() == \
+            [[3.0], [20.0]]
+        np.testing.assert_allclose(
+            ops.sequence_pool(T(x), "average", T(lens)).numpy(),
+            [[1.5], [20 / 3]], rtol=1e-6)
+        assert ops.sequence_pool(T(x), "max", T(lens)).numpy().tolist() == \
+            [[2.0], [9.0]]
+        assert ops.sequence_last_step(T(x), T(lens)).numpy().tolist() == \
+            [[2.0], [9.0]]
+        assert ops.sequence_first_step(T(x), T(lens)).numpy().tolist() == \
+            [[1.0], [4.0]]
+
+    def test_sequence_softmax_masks_padding(self):
+        x = np.array([[1.0, 1.0, 99.0]], np.float32)
+        out = ops.sequence_softmax(T(x), T(np.array([2]))).numpy()
+        np.testing.assert_allclose(out, [[0.5, 0.5, 0.0]], atol=1e-6)
+
+    def test_sequence_reverse(self):
+        x = np.array([[1, 2, 3, 0], [4, 5, 6, 7]], np.float32)
+        out = ops.sequence_reverse(T(x), T(np.array([3, 4]))).numpy()
+        np.testing.assert_array_equal(out, [[3, 2, 1, 0], [7, 6, 5, 4]])
+
+    def test_sequence_expand(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        out = ops.sequence_expand(T(x), T(np.array([2, 3]))).numpy()
+        np.testing.assert_allclose(out.ravel(), [1, 1, 2, 2, 2])
+
+    def test_sequence_concat(self):
+        a = np.array([[1, 2, 0]], np.float32)
+        b = np.array([[7, 8, 9]], np.float32)
+        data, total = ops.sequence_concat(
+            [T(a), T(b)], [T(np.array([2])), T(np.array([3]))])
+        assert total.numpy().tolist() == [5]
+        np.testing.assert_allclose(data.numpy()[0, :5], [1, 2, 7, 8, 9])
+
+    def test_sequence_erase(self):
+        x = np.array([[3, 5, 3, 7], [5, 5, 1, 0]], np.int64)
+        out, nl = ops.sequence_erase(T(x), [5], T(np.array([4, 3])))
+        assert nl.numpy().tolist() == [3, 1]
+        np.testing.assert_array_equal(out.numpy()[0, :3], [3, 3, 7])
+        assert out.numpy()[1, 0] == 1
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3]], np.int64)
+        out = ops.sequence_enumerate(T(x), 2, pad_value=0).numpy()
+        np.testing.assert_array_equal(out[0], [[1, 2], [2, 3], [3, 0]])
+
+    def test_sequence_conv_matches_manual(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 3).astype(np.float32)
+        w = rng.randn(9, 5).astype(np.float32)
+        out = ops.sequence_conv(T(x), T(w), context_length=3,
+                                context_start=-1).numpy()
+        # manual: ctx(t) = [x[t-1], x[t], x[t+1]] zero-padded
+        padded = np.pad(x, [(0, 0), (1, 1), (0, 0)])
+        ctx = np.concatenate([padded[:, :-2], padded[:, 1:-1],
+                              padded[:, 2:]], axis=-1)
+        np.testing.assert_allclose(out, ctx @ w, rtol=1e-4, atol=1e-5)
+
+    def test_im2sequence(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = ops.im2sequence(T(x), (2, 2), strides=(2, 2)).numpy()
+        assert out.shape == (4, 4)
+        np.testing.assert_allclose(out[0], [0, 1, 4, 5])
+
+
+class TestBeamOps:
+    def test_gather_tree(self):
+        # reference unit test values (test_gather_tree_op.py)
+        ids = np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+                       np.int64)
+        parents = np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                            [[0, 0], [0, 1]]], np.int64)
+        out = ops.gather_tree(T(ids), T(parents)).numpy()
+        expect = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                           [[0, 1], [9, 0]]], np.int64)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_beam_search_step(self):
+        # 1 batch row, 2 beams, vocab 4
+        pre_ids = T(np.array([[1, 2]], np.int64))
+        pre_scores = T(np.array([[0.0, -1.0]], np.float32))
+        scores = np.full((1, 2, 4), -np.inf, np.float32)
+        scores[0, 0] = [-1.0, -0.1, -5.0, -3.0]     # beam 0 candidates
+        scores[0, 1] = [-2.0, -0.2, -6.0, -4.0]     # beam 1 candidates
+        tok, sc, parent = ops.beam_search(
+            pre_ids, pre_scores, None, T(scores), beam_size=2, end_id=3)
+        # best two: beam0/tok1 (-0.1), beam1/tok1 (-0.2)
+        np.testing.assert_array_equal(tok.numpy(), [[1, 1]])
+        np.testing.assert_allclose(sc.numpy(), [[-0.1, -0.2]], rtol=1e-6)
+        np.testing.assert_array_equal(parent.numpy(), [[0, 1]])
+
+    def test_beam_search_finished_beam_propagates(self):
+        pre_ids = T(np.array([[3, 2]], np.int64))   # beam 0 finished (end=3)
+        pre_scores = T(np.array([[-0.5, -1.0]], np.float32))
+        scores = np.zeros((1, 2, 4), np.float32) - 10.0
+        scores[0, 1, 1] = -0.7
+        tok, sc, parent = ops.beam_search(
+            pre_ids, pre_scores, None, T(scores), beam_size=2, end_id=3)
+        assert tok.numpy()[0, 0] == 3 and abs(sc.numpy()[0, 0] + 0.5) < 1e-6
+
+    def test_ctc_align(self):
+        x = np.array([[0, 1, 1, 0, 2, 2, 0]], np.int32)
+        out, nl = ops.ctc_align(T(x), blank=0, merge_repeated=True)
+        assert nl.numpy().tolist() == [2]
+        np.testing.assert_array_equal(out.numpy()[0, :2], [1, 2])
+
+    def test_edit_distance(self):
+        hyp = np.array([[1, 2, 3, 0]], np.int64)
+        ref = np.array([[1, 3, 3]], np.int64)
+        d, n = ops.edit_distance(T(hyp), T(ref), normalized=False,
+                                 input_length=T(np.array([3])),
+                                 label_length=T(np.array([3])))
+        assert d.numpy()[0, 0] == 1.0
+        d2, _ = ops.edit_distance(T(hyp), T(ref), normalized=True,
+                                  input_length=T(np.array([3])),
+                                  label_length=T(np.array([3])))
+        np.testing.assert_allclose(d2.numpy()[0, 0], 1 / 3, rtol=1e-6)

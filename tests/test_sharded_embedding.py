@@ -1,0 +1,83 @@
+"""TPU-native PS replacement: mesh-sharded embedding table
+(docs/adr/0001-parameter-server.md; reference capability:
+distributed/table/common_sparse_table.h:112, the_one_ps.py:434)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import (ShardedEmbedding,
+                                          sparse_row_update, make_row_state)
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    dist.set_mesh(dist.build_mesh({"dp": 8}))
+    yield
+    dist.set_mesh(None)
+
+
+class TestShardedEmbedding:
+    def test_table_is_sharded_and_lookup_correct(self):
+        paddle.seed(0)
+        emb = ShardedEmbedding(64, 16)
+        # table rows sharded over the mesh: each device holds 8 rows
+        shards = emb.weight._data.addressable_shards
+        assert len(shards) == 8
+        assert shards[0].data.shape == (8, 16)
+        ids = paddle.to_tensor(np.array([[0, 13, 63], [5, 5, 42]], np.int32))
+        out = emb(ids)
+        ref = emb.weight.numpy()[ids.numpy()]
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_gradients_flow(self):
+        paddle.seed(0)
+        emb = ShardedEmbedding(32, 8)
+        ids = paddle.to_tensor(np.array([1, 3, 1], np.int32))
+        loss = emb(ids).sum()
+        loss.backward()
+        g = emb.weight.grad.numpy()
+        assert g[1].sum() == 16.0  # id 1 appears twice, D=8
+        assert g[3].sum() == 8.0
+        assert np.abs(g[[0, 2, 4]]).sum() == 0
+
+    def test_vocab_not_divisible_raises(self):
+        with pytest.raises(ValueError, match="divide"):
+            ShardedEmbedding(30, 8)
+
+
+class TestSparseRowUpdate:
+    def test_only_touched_rows_change_and_dups_sum(self):
+        rng = np.random.RandomState(0)
+        V, D = 16, 4
+        t = jnp.asarray(rng.randn(V, D).astype(np.float32))
+        m, v = jnp.zeros((V, D)), jnp.zeros((V, D))
+        ids = jnp.asarray([2, 2, 7], jnp.int32)
+        g = jnp.asarray(rng.randn(3, D).astype(np.float32))
+        nt, nm, nv = sparse_row_update(t, m, v, ids, g, lr=0.1, step=1)
+        nt, nm, nv = map(np.asarray, (nt, nm, nv))
+        untouched = [i for i in range(V) if i not in (2, 7)]
+        np.testing.assert_allclose(nt[untouched], np.asarray(t)[untouched])
+        assert np.abs(nm[untouched]).sum() == 0
+        # row 2 saw the SUM of its two grad rows (segment-sum semantics)
+        dense = np.zeros((V, D), np.float32)
+        dense[2] = np.asarray(g[0] + g[1])
+        dense[7] = np.asarray(g[2])
+        expect_m = 0.1 * dense  # (1-beta1) * g
+        np.testing.assert_allclose(nm, expect_m, rtol=1e-5, atol=1e-6)
+        assert not np.allclose(nt[2], np.asarray(t)[2])
+
+    def test_sharded_state_follows_table(self):
+        paddle.seed(0)
+        emb = ShardedEmbedding(64, 16)
+        m, v = make_row_state(emb.weight)
+        assert m.sharding == emb.weight._data.sharding
+        ids = jnp.asarray([0, 8, 63], jnp.int32)
+        g = jnp.ones((3, 16), jnp.float32)
+        nt, nm, nv = sparse_row_update(emb.weight._data, m, v, ids, g,
+                                       lr=0.01, step=1)
+        assert np.abs(np.asarray(nm)[1]).sum() == 0
+        assert np.abs(np.asarray(nm)[8]).sum() > 0

@@ -1,0 +1,80 @@
+"""C++ shared-memory ring transport (csrc/shm_ring.cpp) + DataLoader
+integration (reference: memory/allocation/mmap_allocator.cc transport,
+reader/buffered_reader.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.shm_ring import ShmRing, available
+from paddle_tpu.io import DataLoader, Dataset
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="no g++/posix shm available")
+
+
+class TestShmRing:
+    def test_bytes_roundtrip_with_wraparound(self):
+        ring = ShmRing(f"/pt_t1_{os.getpid()}", capacity=256, create=True)
+        try:
+            for i in range(10):  # 10 * 100B > 256B: exercises wraparound
+                data = bytes([i]) * 100
+                ring.push_bytes(data)
+                assert ring.pop_bytes(100) == data
+        finally:
+            ring.close()
+
+    def test_object_roundtrip(self):
+        ring = ShmRing(f"/pt_t2_{os.getpid()}", capacity=1 << 20,
+                       create=True)
+        try:
+            obj = {"x": np.arange(100, dtype=np.float32),
+                   "y": [np.ones((3, 4))], "meta": "hello"}
+            n = ring.push_object(obj)
+            out = ring.pop_object(n)
+            np.testing.assert_allclose(out["x"], obj["x"])
+            np.testing.assert_allclose(out["y"][0], obj["y"][0])
+            assert out["meta"] == "hello"
+        finally:
+            ring.close()
+
+    def test_oversized_payload_raises(self):
+        ring = ShmRing(f"/pt_t3_{os.getpid()}", capacity=128, create=True)
+        try:
+            with pytest.raises(ValueError, match="capacity"):
+                ring.push_bytes(b"x" * 1024)
+        finally:
+            ring.close()
+
+
+class _ArrDataset(Dataset):
+    def __init__(self, n=64):
+        self.x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+
+    def __getitem__(self, i):
+        return self.x[i], np.int64(i)
+
+    def __len__(self):
+        return len(self.x)
+
+
+class TestDataLoaderShm:
+    def test_multiworker_shm_matches_single(self):
+        ds = _ArrDataset()
+        single = [b for b in DataLoader(ds, batch_size=8, num_workers=0,
+                                        shuffle=False)]
+        multi = [b for b in DataLoader(ds, batch_size=8, num_workers=2,
+                                       shuffle=False,
+                                       use_shared_memory=True)]
+        assert len(single) == len(multi)
+        for a, b in zip(single, multi):
+            np.testing.assert_allclose(a[0].numpy(), b[0].numpy())
+            np.testing.assert_array_equal(a[1].numpy(), b[1].numpy())
+
+    def test_queue_fallback_matches(self):
+        ds = _ArrDataset()
+        multi = [b for b in DataLoader(ds, batch_size=8, num_workers=2,
+                                       shuffle=False,
+                                       use_shared_memory=False)]
+        assert len(multi) == 8

@@ -1,0 +1,156 @@
+"""Text datasets (synthetic-archive fixtures) + hub + download utils
+(reference: python/paddle/text/datasets/, hapi/hub.py,
+utils/download.py)."""
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (Imdb, Imikolov, Movielens, UCIHousing,
+                             Conll05st, WMT16)
+from paddle_tpu.hapi import hub
+from paddle_tpu.utils.download import DownloadError, _md5check
+
+
+def _add_text(tf, name, text):
+    data = text.encode()
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+class TestUCIHousing:
+    def test_split_and_normalize(self, tmp_path):
+        rng = np.random.RandomState(0)
+        table = rng.rand(50, 14) * 10
+        f = tmp_path / "housing.data"
+        np.savetxt(f, table)
+        tr = UCIHousing(data_file=str(f), mode="train")
+        te = UCIHousing(data_file=str(f), mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features are normalized, targets are raw
+        allx = np.stack([tr[i][0] for i in range(len(tr))])
+        assert np.abs(allx).max() <= 1.0 + 1e-6
+
+
+class TestImdb:
+    def _make_archive(self, tmp_path):
+        f = tmp_path / "aclImdb.tar.gz"
+        texts = {"train/pos/0.txt": "good movie great fun " * 3,
+                 "train/neg/0.txt": "bad movie awful bad " * 3,
+                 "test/pos/0.txt": "great good",
+                 "test/neg/0.txt": "awful bad"}
+        with tarfile.open(f, "w:gz") as t:
+            for name, txt in texts.items():
+                _add_text(t, f"aclImdb/{name}", txt)
+        return str(f)
+
+    def test_vocab_and_labels(self, tmp_path):
+        path = self._make_archive(tmp_path)
+        ds = Imdb(data_file=path, mode="train", cutoff=1)
+        assert "<unk>" in ds.word_idx
+        assert len(ds) == 2
+        docs = {tuple(d.tolist()): int(l[0]) for d, l in
+                [ds[i] for i in range(len(ds))]}
+        labels = sorted(docs.values())
+        assert labels == [0, 1]
+        te = Imdb(data_file=path, mode="test", cutoff=1)
+        assert len(te) == 2
+
+
+class TestImikolov:
+    def _make_archive(self, tmp_path):
+        f = tmp_path / "simple-examples.tgz"
+        with tarfile.open(f, "w:gz") as t:
+            _add_text(t, "./simple-examples/data/ptb.train.txt",
+                      "the cat sat\nthe dog sat\n" * 5)
+            _add_text(t, "./simple-examples/data/ptb.test.txt",
+                      "the cat ran\n")
+        return str(f)
+
+    def test_ngram_and_seq(self, tmp_path):
+        path = self._make_archive(tmp_path)
+        ng = Imikolov(data_file=path, data_type="NGRAM", window_size=2,
+                      mode="train", min_word_freq=1)
+        assert len(ng) > 0
+        assert ng[0].shape == (2,)
+        seq = Imikolov(data_file=path, data_type="SEQ", mode="test",
+                       min_word_freq=1)
+        src, trg = seq[0]
+        assert len(src) == len(trg)
+
+
+class TestMovielens:
+    def test_parse(self, tmp_path):
+        f = tmp_path / "ml-1m.zip"
+        with zipfile.ZipFile(f, "w") as z:
+            z.writestr("ml-1m/movies.dat",
+                       "1::Toy Story (1995)::Animation|Comedy\n"
+                       "2::Jumanji (1995)::Adventure\n")
+            z.writestr("ml-1m/users.dat",
+                       "1::M::25::10::48067\n2::F::35::3::55117\n")
+            z.writestr("ml-1m/ratings.dat",
+                       "1::1::5::978300760\n2::2::3::978302109\n")
+        ds = Movielens(data_file=str(f), mode="train", test_ratio=0.0)
+        assert len(ds) == 2
+        fields = ds[0]
+        assert len(fields) == 8
+        assert fields[-1].dtype == np.float32
+
+
+class TestConll05:
+    def test_two_column(self, tmp_path):
+        f = tmp_path / "srl.txt"
+        f.write_text("The -\ncat A0\nsat V\n\nDogs A0\nrun V\n")
+        ds = Conll05st(data_file=str(f))
+        assert len(ds) == 2
+        wid, pred, lid = ds[0]
+        assert wid.shape == (3,) and lid.shape == (3,)
+
+
+class TestWMT16:
+    def test_pairs(self, tmp_path):
+        f = tmp_path / "wmt16.tar.gz"
+        with tarfile.open(f, "w:gz") as t:
+            _add_text(t, "wmt16/vocab_en", "hello\nworld\n")
+            _add_text(t, "wmt16/vocab_de", "hallo\nwelt\n")
+            _add_text(t, "wmt16/train", "hello world\thallo welt\n")
+        ds = WMT16(data_file=str(f), mode="train", lang="en")
+        src, trg, trg_next = ds[0]
+        assert src.tolist() == [ds.src_dict["hello"], ds.src_dict["world"]]
+        assert trg[0] == 0 and trg_next[-1] == 1  # BOS / EOS
+
+
+class TestHub:
+    def test_local_hubconf(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(scale=1):\n"
+            "    \"\"\"A tiny entrypoint.\"\"\"\n"
+            "    return {'scale': scale}\n")
+        assert "tiny" in hub.list(str(tmp_path), source="local")
+        assert "tiny entrypoint" in hub.help(str(tmp_path), "tiny",
+                                             source="local")
+        assert hub.load(str(tmp_path), "tiny", source="local",
+                        scale=3) == {"scale": 3}
+
+
+class TestDownload:
+    def test_md5check(self, tmp_path):
+        f = tmp_path / "x.bin"
+        f.write_bytes(b"hello")
+        import hashlib
+        good = hashlib.md5(b"hello").hexdigest()
+        assert _md5check(str(f), good)
+        assert not _md5check(str(f), "0" * 32)
+
+    def test_no_network_raises_clear_error(self, tmp_path, monkeypatch):
+        from paddle_tpu.utils import download as dl
+        monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path))
+        with pytest.raises(DownloadError, match="egress"):
+            dl.get_path_from_url("http://203.0.113.1/none.tgz")

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare the two newest BENCH_r{N}.json files and
+fail on a >5% throughput drop.
+
+TPU-native equivalent of the reference's PR-gated op benchmark
+(reference: tools/check_op_benchmark_result.py:69-90 — a PR fails if
+gpu_time regresses more than 5% vs the develop branch).
+
+Usage: python tools/check_bench_regression.py [--threshold 0.05] [dir]
+Exit code 1 on regression, 0 otherwise (including when fewer than two
+rounds exist yet).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def load_value(path):
+    """Returns (value, metric) or None for rounds with no parsed result
+    (e.g. the round-1 file predates bench.py's JSON line)."""
+    with open(path) as f:
+        data = json.load(f)
+    parsed = data.get("parsed", data)
+    if not isinstance(parsed, dict) or "value" not in parsed:
+        return None
+    return float(parsed["value"]), parsed.get("metric", "?")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dir", nargs="?", default=".")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="max allowed fractional drop (default 5%%)")
+    args = ap.parse_args()
+
+    files = glob.glob(os.path.join(args.dir, "BENCH_r*.json"))
+    files.sort(key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p))
+                                 .group(1)))
+    loaded = [(p, load_value(p)) for p in files]
+    loaded = [(p, v) for p, v in loaded if v is not None]
+    if len(loaded) < 2:
+        print(f"bench gate: {len(loaded)} comparable round(s) recorded, "
+              f"nothing to compare")
+        return 0
+
+    (prev_path, (prev, metric)), (cur_path, (cur, _)) = loaded[-2:]
+    change = (cur - prev) / prev
+    print(f"bench gate [{metric}]: {os.path.basename(prev_path)} "
+          f"{prev:.2f} -> {os.path.basename(cur_path)} {cur:.2f} "
+          f"({change * 100:+.2f}%)")
+    if -change > args.threshold:
+        print(f"FAIL: throughput dropped more than "
+              f"{args.threshold * 100:.0f}% "
+              f"(reference gate: check_op_benchmark_result.py:69)")
+        return 1
+    print("OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
