@@ -52,15 +52,25 @@ def _drive(model, opt, x_np, y_np, steps, use_amp, amp_dtype="bfloat16"):
         _gen.next_key(), lr, jnp.asarray(2.0, jnp.float32))
     jax.block_until_ready(loss)
 
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss, _, train_raws, opt_states, _ = ts["fn"](
-            train_raws, fixed_raws, opt_states, x_raws, y_raws,
-            _gen.next_key(), lr, jnp.asarray(float(i + 3), jnp.float32))
-    jax.block_until_ready((loss, train_raws))
-    dt = (time.perf_counter() - t0) / steps
-    assert np.isfinite(float(np.asarray(loss))), "bench loss diverged"
-    return dt
+    # best-of-3 windows: the shared chip + tunnel add occasional stalls;
+    # steady-state throughput is the min per-step time over windows
+    # (the loss fetch at each window end forces real completion — plain
+    # block_until_ready returns early through the axon tunnel)
+    best = None
+    step_no = 3
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss, _, train_raws, opt_states, _ = ts["fn"](
+                train_raws, fixed_raws, opt_states, x_raws, y_raws,
+                _gen.next_key(), lr,
+                jnp.asarray(float(step_no), jnp.float32))
+            step_no += 1
+        lv = float(np.asarray(loss))
+        dt = (time.perf_counter() - t0) / steps
+        assert np.isfinite(lv), "bench loss diverged"
+        best = dt if best is None else min(best, dt)
+    return best
 
 
 def bench_resnet50(on_tpu: bool):

@@ -37,3 +37,5 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     from .spawn_impl import spawn as _spawn
     return _spawn(func, args=args, nprocs=nprocs, join=join, daemon=daemon,
                   **options)
+
+from .fleet.mp_layers import split  # noqa: E402,F401

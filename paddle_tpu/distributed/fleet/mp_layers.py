@@ -161,3 +161,44 @@ def model_parallel_random_seed(seed):
     tracker = get_rng_state_tracker()
     tracker.reset()
     tracker.add("model_parallel_rng", int(seed))
+
+
+def split(x, size, operation, axis=0, num_partitions=None, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: python/paddle/distributed/collective.py:1154 ``split`` —
+    the one-call model-parallel layer builder for ported static scripts:
+    creates the partitioned weight and applies the parallel op.
+
+    operation='linear': size=(in, out); axis=1 → column-parallel (output
+    split), axis=0 → row-parallel (input split).
+    operation='embedding': size=(vocab, hidden); the table is
+    vocab-partitioned.
+
+    Like the reference, this is a *builder* (creates parameters) meant to
+    be called once at model-construction time; reuse the returned layer's
+    parameters for repeated application by building the layer directly
+    (Column/RowParallelLinear / VocabParallelEmbedding).
+    """
+    if num_partitions is not None and num_partitions != max(_mp_size(), 1):
+        raise ValueError(
+            f"num_partitions={num_partitions} does not match the mesh's "
+            f"mp degree {_mp_size()}")
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        elif axis == 0:
+            layer = RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False)
+        else:
+            raise ValueError("linear split axis must be 0 or 1")
+        return layer(x)
+    if operation == "embedding":
+        vocab, hidden = size
+        layer = VocabParallelEmbedding(vocab, hidden,
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")

@@ -75,6 +75,11 @@ class Model:
 
         meta = {}
 
+        # materializing predictions is an extra HBM write per step (a
+        # [B, S, vocab] logits tensor for LM heads); skip it when no
+        # metric consumes them
+        want_preds = bool(self._metrics)
+
         def fwd_loss(train_raws, fixed_raws, x_raws, y_raws, key):
             full = [None] * len(state)
             for pos, r in zip(fixed_pos, fixed_raws):
@@ -93,7 +98,8 @@ class Model:
                 effects = [r for _, r in ctx.state_effects]
                 meta["effect_holders"] = [h for h, _ in ctx.state_effects]
             loss_raw = loss._data if isinstance(loss, Tensor) else loss
-            return loss_raw, ([p._data for p in preds_t], effects)
+            out_preds = [p._data for p in preds_t] if want_preds else []
+            return loss_raw, (out_preds, effects)
 
         def step(train_raws, fixed_raws, opt_states, x_raws, y_raws, key, lr,
                  step_no):
@@ -129,7 +135,8 @@ class Model:
                   for i in inputs]
         y_raws = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
                   for l in labels]
-        sig = tuple((tuple(r.shape), str(r.dtype)) for r in x_raws + y_raws)
+        sig = (tuple((tuple(r.shape), str(r.dtype))
+                     for r in x_raws + y_raws), bool(self._metrics))
         if self._train_step_fn is None or self._train_sig != sig:
             self.network.train()
             self._train_step_fn = self._build_train_step(sig)

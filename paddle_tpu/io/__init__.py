@@ -328,7 +328,10 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
                 if not batch:
                     out_queue.put((order, "END", None))
                     continue
-                send(order, collate_fn(batch))
+                try:
+                    send(order, collate_fn(batch))
+                except Exception:
+                    out_queue.put((order, "ERR", traceback.format_exc()))
         else:
             while True:
                 msg = index_queue.get()
@@ -346,6 +349,8 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
 
 class DataLoader:
     """reference: fluid/reader.py:149 DataLoader (return_list=True mode)."""
+
+    _iter_serial = 0  # distinguishes shm namespaces of concurrent iterators
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False,
@@ -419,7 +424,11 @@ class DataLoader:
         index_queues = []
         out_queue = ctx.Queue()
         workers = []
-        self._rings = {}
+        # rings are PER-ITERATOR state: two live iterators of one loader
+        # must not share (or unlink) each other's rings
+        rings = {}
+        DataLoader._iter_serial += 1
+        serial = DataLoader._iter_serial
         use_shm = False
         if self.use_shared_memory and os.name == "posix":
             from ..core.shm_ring import ShmRing, available as _shm_ok
@@ -430,9 +439,9 @@ class DataLoader:
             iq = ctx.Queue()
             shm_name = None
             if use_shm:
-                shm_name = f"/pt_dl_{os.getpid()}_{id(self) & 0xFFFF}_{wid}"
-                self._rings[wid] = ShmRing(shm_name, create=True,
-                                           capacity=self.shm_capacity)
+                shm_name = f"/pt_dl_{os.getpid()}_{serial}_{wid}"
+                rings[wid] = ShmRing(shm_name, create=True,
+                                     capacity=self.shm_capacity)
             w = ctx.Process(
                 target=_worker_loop,
                 args=(self.dataset, iq, out_queue, self.collate_fn, wid,
@@ -444,9 +453,9 @@ class DataLoader:
 
         try:
             if self._iterable:
-                yield from self._mp_iterable(index_queues, out_queue)
+                yield from self._mp_iterable(index_queues, out_queue, rings)
             else:
-                yield from self._mp_map(index_queues, out_queue)
+                yield from self._mp_map(index_queues, out_queue, rings)
         finally:
             for iq in index_queues:
                 try:
@@ -457,11 +466,10 @@ class DataLoader:
                 w.join(timeout=1)
                 if w.is_alive():
                     w.terminate()
-            for r in self._rings.values():
+            for r in rings.values():
                 r.close()
-            self._rings = {}
 
-    def _mp_map(self, index_queues, out_queue):
+    def _mp_map(self, index_queues, out_queue, rings):
         batches = list(self.batch_sampler)
         n = len(batches)
         inflight = 0
@@ -481,13 +489,13 @@ class DataLoader:
                 raise RuntimeError(f"DataLoader worker failed:\n{payload}")
             if status == "SHM":
                 wid, nbytes = payload
-                payload = self._rings[wid].pop_object(nbytes)
+                payload = rings[wid].pop_object(nbytes)
             hold[order] = payload
             while next_recv in hold:
                 yield self._to_tensors(hold.pop(next_recv))
                 next_recv += 1
 
-    def _mp_iterable(self, index_queues, out_queue):
+    def _mp_iterable(self, index_queues, out_queue, rings):
         # each worker consumes its own iterator copy; messages tagged by wid
         live = set(range(self.num_workers))
         for wid in live:
@@ -501,7 +509,7 @@ class DataLoader:
                 raise RuntimeError(f"DataLoader worker failed:\n{payload}")
             if status == "SHM":
                 rwid, nbytes = payload
-                payload = self._rings[rwid].pop_object(nbytes)
+                payload = rings[rwid].pop_object(nbytes)
             if wid in live:
                 index_queues[wid].put((wid, self.batch_size))
             yield self._to_tensors(payload)

@@ -539,7 +539,8 @@ def roi_pool(input, rois, output_size, spatial_scale=1.0, rois_num=None,
         yy = jnp.arange(H)
         xx = jnp.arange(W)
 
-        def per_roi(bi, xx1, yy1, hh, ww):
+        def per_roi(args):
+            bi, xx1, yy1, hh, ww = args
             img = feat[bi]                           # [C,H,W]
             # bin id of every pixel (or -1 outside the roi)
             py = ((yy - yy1) * ph) // hh
@@ -548,12 +549,15 @@ def roi_pool(input, rois, output_size, spatial_scale=1.0, rois_num=None,
             px = jnp.where((xx >= xx1) & (xx < xx1 + ww), px, -1)
             onehot_y = (py[None, :] == jnp.arange(ph)[:, None])  # [ph,H]
             onehot_x = (px[None, :] == jnp.arange(pw)[:, None])  # [pw,W]
-            big = jnp.where(onehot_y[None, :, :, None, None]
-                            & onehot_x[None, None, None, :, :],
-                            img[:, None, :, None, :], -jnp.inf)
-            out = big.max(axis=(2, 4))               # [C,ph,pw]
-            return jnp.where(jnp.isfinite(out), out, 0.0)
-        return jax.vmap(per_roi)(bidx, x1, y1, rh, rw)
+            # two-step windowed max keeps the peak intermediate at
+            # [C,H,pw] instead of a dense [C,ph,H,pw,W] product
+            mx = jnp.where(onehot_x[None, None, :, :],
+                           img[:, :, None, :], -jnp.inf).max(axis=3)
+            out = jnp.where(onehot_y[None, :, :, None],
+                            mx[:, None, :, :], -jnp.inf).max(axis=2)
+            return jnp.where(jnp.isfinite(out), out, 0.0)   # [C,ph,pw]
+        # lax.map serializes ROIs: peak memory is ONE roi's intermediate
+        return jax.lax.map(per_roi, (bidx, x1, y1, rh, rw))
     return apply("roi_pool", impl, input, rois)
 
 
@@ -752,9 +756,12 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
             jnp.arange(steps))
         match = jnp.full((N,), -1, jnp.int32)
         mdist = jnp.zeros((N,), dm.dtype)
-        match = match.at[cs].set(
-            jnp.where(goods, rs.astype(jnp.int32), match[cs]))
-        mdist = mdist.at[cs].set(jnp.where(goods, vs, mdist[cs]))
+        # bad steps (all remaining pairs masked/-inf) must not scatter at
+        # all — route them to an out-of-range index with drop mode, else
+        # duplicate writes at column 0 clobber a real match
+        cs_ok = jnp.where(goods, cs, N)
+        match = match.at[cs_ok].set(rs.astype(jnp.int32), mode="drop")
+        mdist = mdist.at[cs_ok].set(vs, mode="drop")
         if match_type == "per_prediction" and dist_threshold is not None:
             # additionally match every unmatched column to its best row if
             # above threshold (reference match_type='per_prediction')

@@ -574,6 +574,10 @@ class ExponentialMovingAverage:
 
         @contextlib.contextmanager
         def ctx():
+            if self._step == 0:
+                raise RuntimeError(
+                    "ExponentialMovingAverage.apply() before any update(): "
+                    "the shadow values are still zero-initialized")
             corr = 1.0 - self._decay_pow
             self._backup = {}
             for uid, (p, ema) in self._shadow.items():

@@ -209,3 +209,20 @@ class TestHapiModel:
         model = self._model()
         info = model.summary()
         assert info["total_params"] == 3 * 16 + 16 + 16 * 2 + 2
+
+
+class TestMetricsAfterPrepareRecompiles:
+    def test_late_metrics_get_predictions(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 3)
+        m = paddle.Model(net)
+        import paddle_tpu.optimizer as optim
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        m.prepare(opt, nn.CrossEntropyLoss())
+        X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        Y = np.random.RandomState(1).randint(0, 3, (8,)).astype(np.int64)
+        m.train_batch([X], [Y])  # compiles WITHOUT predictions
+        from paddle_tpu.metric import Accuracy
+        m.prepare(opt, nn.CrossEntropyLoss(), metrics=Accuracy())
+        loss, mets = m.train_batch([X], [Y])  # must recompile WITH preds
+        assert mets and mets[0] is not None

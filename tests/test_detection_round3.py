@@ -270,3 +270,16 @@ class TestRoiAlignBorderClamp:
                             sampling_ratio=1, aligned=True).numpy()
         # the single sample lands at y = -0.5 -> clamped to row 0 -> 0.0
         np.testing.assert_allclose(out[0, 0, 0, 0], 0.0, atol=1e-6)
+
+
+class TestBipartiteMatchMaskedEntries:
+    def test_neg_inf_padding_does_not_clobber(self):
+        """Regression: once all finite pairs are retired, the remaining
+        greedy steps must not scatter -1 over column 0's real match."""
+        dist = np.array([[0.9, -np.inf, -np.inf],
+                         [-np.inf, -np.inf, -np.inf]], np.float32)
+        match, mdist = ops.bipartite_match(T(dist))
+        m = match.numpy()[0]
+        assert m[0] == 0            # the one real pair survives
+        assert m[1] == -1 and m[2] == -1
+        np.testing.assert_allclose(mdist.numpy()[0][0], 0.9)

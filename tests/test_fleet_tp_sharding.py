@@ -261,3 +261,35 @@ class TestRecompute:
             return net.a.weight.grad.numpy()
 
         np.testing.assert_allclose(grads(True), grads(False), atol=1e-5)
+
+
+class TestDistributedSplit:
+    """reference: distributed/collective.py:1154 split — one-call MP layer
+    builder (GSPMD style: call under the mesh, not inside shard_map)."""
+
+    def test_column_split_output_shape_and_sharding(self, mp_mesh):
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype(np.float32))
+        out = dist.split(x, (16, 32), operation="linear", axis=1,
+                         gather_out=True)
+        assert out.shape == [4, 32]
+
+    def test_row_split(self, mp_mesh):
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype(np.float32))
+        out = dist.split(x, (16, 8), operation="linear", axis=0)
+        assert out.shape == [4, 8]
+
+    def test_embedding_split(self, mp_mesh):
+        paddle.seed(0)
+        ids = paddle.to_tensor(np.array([[1, 5, 31]], np.int32))
+        out = dist.split(ids, (32, 16), operation="embedding")
+        assert out.shape == [1, 3, 16]
+
+    def test_bad_partitions_raises(self, mp_mesh):
+        with pytest.raises(ValueError, match="num_partitions"):
+            dist.split(paddle.to_tensor(np.zeros((2, 16), np.float32)),
+                       (16, 32), operation="linear", axis=1,
+                       num_partitions=2)
