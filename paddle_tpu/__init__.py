@@ -96,6 +96,7 @@ from . import utils  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
 from .hapi import hub  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401

@@ -183,6 +183,12 @@ def split(x, size, operation, axis=0, num_partitions=None, gather_out=True,
         raise ValueError(
             f"num_partitions={num_partitions} does not match the mesh's "
             f"mp degree {_mp_size()}")
+    if bias_attr not in (None, False, True):
+        # the TP layers take has_bias only; a custom bias initializer
+        # would be silently dropped — refuse instead
+        raise NotImplementedError(
+            "split() supports bias_attr None/True/False; build the "
+            "Column/RowParallelLinear directly for a custom bias attr")
     if operation == "linear":
         in_f, out_f = size
         if axis == 1:
@@ -197,6 +203,8 @@ def split(x, size, operation, axis=0, num_partitions=None, gather_out=True,
             raise ValueError("linear split axis must be 0 or 1")
         return layer(x)
     if operation == "embedding":
+        if bias_attr not in (None, False):
+            raise ValueError("embedding split takes no bias")
         vocab, hidden = size
         layer = VocabParallelEmbedding(vocab, hidden,
                                        weight_attr=weight_attr)

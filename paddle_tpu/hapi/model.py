@@ -121,8 +121,17 @@ class Model:
                 new_s.append(s2)
             return loss, preds, new_p, new_s, effects
 
+        def grads_only(train_raws, fixed_raws, x_raws, y_raws, key):
+            # update=False form (gradient accumulation): raw grads, no
+            # clip/regularize/update — those belong to the eventual step
+            (loss, (preds, effects)), grads = jax.value_and_grad(
+                fwd_loss, has_aux=True)(train_raws, fixed_raws, x_raws,
+                                        y_raws, key)
+            return loss, preds, list(grads), effects
+
         jitted = jax.jit(step, donate_argnums=(0, 2))
-        return {"fn": jitted, "meta": meta, "state": state,
+        return {"fn": jitted, "grads_fn": jax.jit(grads_only),
+                "meta": meta, "state": state,
                 "trainable": trainable, "t_pos": t_pos,
                 "fixed_pos": fixed_pos}
 
@@ -150,16 +159,25 @@ class Model:
         opt_states = [opt._state[stable_uid(p)] for p in ts["trainable"]]
         train_raws = [p._data for p in ts["trainable"]]
         fixed_raws = [ts["state"][i]._data for i in ts["fixed_pos"]]
-        lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        step_no = jnp.asarray(opt._global_step + 1, jnp.float32)
         key = _gen.next_key()
-        loss, preds, new_p, new_s, effects = ts["fn"](
-            train_raws, fixed_raws, opt_states, x_raws, y_raws, key, lr, step_no)
-        for p, npr, ns in zip(ts["trainable"], new_p, new_s):
-            p._data = npr
-            p._inplace_version += 1
-            opt._state[stable_uid(p)] = ns
-        opt._global_step += 1
+        if not update:
+            # gradient accumulation (reference train_batch(update=False)):
+            # accumulate into .grad, defer clip/regularize/step
+            loss, preds, grads, effects = ts["grads_fn"](
+                train_raws, fixed_raws, x_raws, y_raws, key)
+            for p, g in zip(ts["trainable"], grads):
+                p._grad = g if p._grad is None else p._grad + g
+        else:
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_no = jnp.asarray(opt._global_step + 1, jnp.float32)
+            loss, preds, new_p, new_s, effects = ts["fn"](
+                train_raws, fixed_raws, opt_states, x_raws, y_raws, key,
+                lr, step_no)
+            for p, npr, ns in zip(ts["trainable"], new_p, new_s):
+                p._data = npr
+                p._inplace_version += 1
+                opt._state[stable_uid(p)] = ns
+            opt._global_step += 1
         for h, v in zip(ts["meta"].get("effect_holders", []), effects):
             h._data = v
             h._inplace_version += 1

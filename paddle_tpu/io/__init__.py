@@ -324,11 +324,11 @@ def _worker_loop(dataset, index_queue, out_queue, collate_fn, wid,
                 if msg is None:
                     break
                 order, batch_size = msg
-                batch = list(itertools.islice(it, batch_size))
-                if not batch:
-                    out_queue.put((order, "END", None))
-                    continue
                 try:
+                    batch = list(itertools.islice(it, batch_size))
+                    if not batch:
+                        out_queue.put((order, "END", None))
+                        continue
                     send(order, collate_fn(batch))
                 except Exception:
                     out_queue.put((order, "ERR", traceback.format_exc()))

@@ -6,4 +6,7 @@ families natively so BASELINE configs 3 and 5 (BERT finetune, GPT hybrid
 parallel) are expressible inside the framework.
 """
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion  # noqa: F401
-from .bert import BertConfig, BertModel, BertForSequenceClassification  # noqa: F401
+from .bert import (BertConfig, BertModel,  # noqa: F401
+                   BertForSequenceClassification,
+                   ErnieConfig, ErnieModel,
+                   ErnieForSequenceClassification)

@@ -100,3 +100,25 @@ class BertForSequenceClassification(Layer):
         _, pooled = self.bert(input_ids, token_type_ids,
                               attention_mask=attention_mask)
         return self.classifier(self.dropout(pooled))
+
+
+class ErnieConfig(BertConfig):
+    """ERNIE-base (BASELINE config 3): architecturally the BERT encoder —
+    ERNIE differs in *pretraining* (knowledge/entity masking), not graph
+    structure — with the ERNIE 1.0 defaults (vocab 18000, the rest
+    BERT-base)."""
+
+    def __init__(self, vocab_size=18000, **kw):
+        super().__init__(vocab_size=vocab_size, **kw)
+
+
+class ErnieModel(BertModel):
+    """reference capability: PaddleNLP ErnieModel; same encoder graph."""
+
+    def __init__(self, config: "ErnieConfig" = None):
+        super().__init__(config or ErnieConfig())
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    def __init__(self, config: "ErnieConfig" = None, num_classes: int = 2):
+        super().__init__(config or ErnieConfig(), num_classes)

@@ -1,0 +1,28 @@
+"""paddle.onnx API surface (reference: python/paddle/onnx/export.py —
+paddle.onnx.export via paddle2onnx).
+
+TPU design: the portable deployment artifact here is StableHLO
+(`paddle_tpu.jit.save` → loadable by `paddle_tpu.inference.Predictor`, or
+by any PJRT runtime). ONNX is a CUDA/CPU-deployment interchange format;
+converting jaxpr→ONNX needs an external converter that is not part of
+this image, so `export` writes the StableHLO artifact and tells the
+caller exactly that, rather than failing obscurely.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """API-parity export. Writes the StableHLO artifact at ``path`` (the
+    same files jit.save produces) and raises if a true .onnx file was
+    demanded, with the supported alternative spelled out."""
+    from . import jit
+
+    if path.endswith(".onnx"):
+        raise NotImplementedError(
+            "ONNX serialization requires an external jax->ONNX converter "
+            "not bundled here; export the portable StableHLO artifact "
+            "instead: paddle_tpu.jit.save(layer, prefix) -> "
+            "paddle_tpu.inference.create_predictor runs it without any "
+            "model code")
+    jit.save(layer, path, input_spec=input_spec)
+    return path

@@ -226,3 +226,25 @@ class TestMetricsAfterPrepareRecompiles:
         m.prepare(opt, nn.CrossEntropyLoss(), metrics=Accuracy())
         loss, mets = m.train_batch([X], [Y])  # must recompile WITH preds
         assert mets and mets[0] is not None
+
+
+class TestTrainBatchNoUpdate:
+    def test_update_false_accumulates_grads_only(self):
+        import paddle_tpu.optimizer as optim
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        m = paddle.Model(net)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        m.prepare(opt, nn.MSELoss())
+        X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        Y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+        w0 = net.weight.numpy().copy()
+        m.train_batch([X], [Y], update=False)
+        np.testing.assert_allclose(net.weight.numpy(), w0)  # no update
+        assert net.weight.grad is not None
+        g1 = net.weight.grad.numpy().copy()
+        m.train_batch([X], [Y], update=False)
+        np.testing.assert_allclose(net.weight.grad.numpy(), 2 * g1,
+                                   rtol=1e-5)  # accumulated
+        opt.step()  # the deferred update applies the summed grads
+        assert not np.allclose(net.weight.numpy(), w0)
