@@ -29,6 +29,7 @@ from .. import mesh as _mesh
 from ..env import get_rank, get_world_size, init_parallel_env
 from . import utils  # noqa: F401 (recompute lives here)
 from . import fs  # noqa: F401 (LocalFS/HDFSClient facade)
+from .moe import moe_ffn, MoELayer  # noqa: F401
 from .sequence_parallel import (ring_attention, RingAttention,  # noqa: F401
                                 split_sequence, gather_sequence)
 from .sharded_embedding import (ShardedEmbedding,  # noqa: F401

@@ -1,0 +1,130 @@
+"""Mixture-of-Experts with expert parallelism over an "ep" mesh axis.
+
+The reference snapshot predates its MoE work (no expert-parallel code in
+the tree); like sequence parallelism this is the parity-plus capability
+the TPU build plan treats as first-class: expert weights are sharded over
+"ep" (each rank owns E/ep experts) and tokens travel to their expert's
+rank and back via two all_to_alls over ICI — the TPU-native form of the
+reference-era brpc PS "send the row to its shard" idea applied to dense
+expert FFNs.
+
+Routing is Switch-style top-1 with a fixed per-expert capacity so every
+shape is static: a token over capacity is dropped (its output is the
+residual zero), the standard trade for one compiled program.
+Differentiable end to end (the dispatch/combine tensors are one-hots
+weighted by the gate probability, so gate grads flow).
+"""
+from __future__ import annotations
+
+import math
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import mesh as _mesh
+
+
+def _moe_local(x, wg, w1, w2, axis: str, capacity: int):
+    """Runs INSIDE shard_map. x [Nl, D] local tokens; wg [D, E] replicated
+    gate; w1 [El, D, F], w2 [El, F, D] this rank's experts. Returns
+    [Nl, D] plus the load-balancing aux loss."""
+    ep = lax.axis_size(axis)
+    Nl, D = x.shape
+    El = w1.shape[0]
+    E = El * ep
+
+    logits = x @ wg                                    # [Nl, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_p = jnp.max(probs, axis=-1)                   # top-1 prob
+    expert = jnp.argmax(probs, axis=-1)                # [Nl]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)  # [Nl, E]
+    # position of each token within its expert's queue (0-based)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    keep = (pos >= 0) & (pos < capacity)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=x.dtype)               # [Nl, E, C]
+    dispatch = slot * keep.astype(x.dtype)[..., None]  # [Nl, E, C]
+    combine = dispatch * gate_p[:, None, None]
+
+    # gather expert inputs [E, C, D], then all_to_all so each rank holds
+    # ITS experts' tokens from every rank: [E, C, D] -> [ep, El, C, D]
+    exp_in = jnp.einsum("nec,nd->ecd", dispatch, x)
+    exp_in = exp_in.reshape(ep, El, capacity, D)
+    exp_in = lax.all_to_all(exp_in, axis, split_axis=0, concat_axis=0,
+                            tiled=False)               # [ep, El, C, D]
+    exp_in = jnp.swapaxes(exp_in, 0, 1).reshape(El, ep * capacity, D)
+
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", exp_in, w1))
+    out = jnp.einsum("ecf,efd->ecd", h, w2)            # [El, ep*C, D]
+
+    out = jnp.swapaxes(out.reshape(El, ep, capacity, D), 0, 1)
+    out = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                         tiled=False)                  # [ep, El, C, D]
+    out = out.reshape(E, capacity, D)
+    y = jnp.einsum("nec,ecd->nd", combine, out)        # [Nl, D]
+
+    # Switch aux loss: E * sum_e fraction_tokens_e * mean_prob_e
+    frac = jnp.mean(onehot, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    return y, aux
+
+
+def moe_ffn(x, gate_w, expert_w1, expert_w2, mesh=None, axis: str = "ep",
+            capacity_factor: float = 1.25):
+    """Expert-parallel Switch FFN.
+
+    x GLOBAL [B, T, D] (batch sharded over ``axis``); gate_w [D, E]
+    replicated; expert_w1 [E, D, F] / expert_w2 [E, F, D] sharded on the
+    expert dim over ``axis``. Returns ([B, T, D], aux_loss).
+    """
+    m = mesh or _mesh.ensure_mesh()
+    ep = int(m.shape[axis])
+    B, T, D = x.shape
+    E = expert_w1.shape[0]
+    if E % ep != 0:
+        raise ValueError(f"{E} experts not divisible by ep={ep}")
+    n_local = (B // ep) * T
+    capacity = max(1, int(math.ceil(n_local * capacity_factor / E)))
+
+    def per_rank(xb, wg, w1, w2):
+        Bl = xb.shape[0]
+        y, aux = _moe_local(xb.reshape(Bl * T, D), wg, w1, w2, axis,
+                            capacity)
+        return y.reshape(Bl, T, D), lax.pmean(aux, axis)
+
+    fn = jax.shard_map(
+        per_rank, mesh=m,
+        in_specs=(P(axis, None, None), P(), P(axis, None, None),
+                  P(axis, None, None)),
+        out_specs=(P(axis, None, None), P()))
+    return fn(x, gate_w, expert_w1, expert_w2)
+
+
+def _moe_impl(xx, wg, w1, w2, axis="ep", capacity_factor=1.25):
+    # module-level for eager-cache keyability (see _ring_impl)
+    return moe_ffn(xx, wg, w1, w2, mesh=None, axis=axis,
+                   capacity_factor=capacity_factor)
+
+
+class MoELayer:
+    """Functional expert-parallel layer over raw param arrays (models own
+    their params; this owns the schedule — mirrors RingAttention). Uses
+    the ambient mesh."""
+
+    def __init__(self, mesh=None, axis: str = "ep",
+                 capacity_factor: float = 1.25):
+        if mesh is not None and mesh is not _mesh.get_mesh():
+            raise ValueError(
+                "MoELayer uses the ambient mesh (set_mesh); pass mesh= "
+                "only to moe_ffn directly")
+        self._axis = axis
+        self._cf = capacity_factor
+
+    def __call__(self, x, gate_w, expert_w1, expert_w2):
+        from ...ops.dispatch import apply
+        return apply("moe_ffn", _moe_impl, x, gate_w, expert_w1, expert_w2,
+                     axis=self._axis, capacity_factor=self._cf)

@@ -67,27 +67,33 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool, scale):
         vn = lax.ppermute(vc, axis, perm=perm)
         return (o2, new_m, l2, kn, vn), None
 
-    o0 = lax.pcast(jnp.zeros(q.shape, acc), (axis,), to="varying")
-    m0 = lax.pcast(jnp.full((B, H, Tl), _NEG, acc), (axis,), to="varying")
-    l0 = lax.pcast(jnp.zeros((B, H, Tl), acc), (axis,), to="varying")
+    # derive the initial carries from q so they inherit ALL of q's varying
+    # axes (sp plus any batch axis the caller sharded over)
+    o0 = q.astype(acc) * 0
+    base = jnp.sum(o0, axis=-1)                       # [B,H,Tl], q's vma
+    m0 = base + _NEG
+    l0 = base
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(S))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh=None, axis: str = "sp",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axes=None):
     """Exact attention with the sequence dim sharded over ``axis``.
 
     q/k/v: GLOBAL [B, H, T, D] arrays (T divisible by the axis size).
-    Returns [B, H, T, D], sequence-sharded the same way. Call from
-    un-mapped code — this wraps its own shard_map; inside an existing
-    shard_map use :func:`_ring_attention_local` directly.
+    Returns [B, H, T, D], sequence-sharded the same way. Pass
+    ``batch_axes`` (e.g. "dp") when the batch dim is data-parallel —
+    otherwise the shard_map replicates it over the other mesh axes.
+    Call from un-mapped code — this wraps its own shard_map; inside an
+    existing shard_map use :func:`_ring_attention_local` directly.
     """
     m = mesh or _mesh.ensure_mesh()
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    spec = P(None, None, axis, None)
+    spec = P(batch_axes, None, axis, None)
     fn = jax.shard_map(
         lambda qq, kk, vv: _ring_attention_local(qq, kk, vv, axis, causal,
                                                  scale),
@@ -119,23 +125,35 @@ def gather_sequence(x, mesh=None, axis: str = "sp", seq_dim: int = 2):
     return jax.device_put(x, NamedSharding(m, P(*spec)))
 
 
+def _ring_impl(qq, kk, vv, axis="sp", causal=False, batch_axes=None):
+    # module-level (no closure) so the eager op cache can key it: a
+    # per-call lambda over a Mesh is _UNCACHEABLE and re-traces the whole
+    # ring program each call (dispatch.py cache rules)
+    ba = tuple(batch_axes) if isinstance(batch_axes, (list, tuple)) \
+        else batch_axes
+    return ring_attention(qq, kk, vv, mesh=None, axis=axis, causal=causal,
+                          batch_axes=ba)
+
+
 class RingAttention:
     """Layer-ish wrapper so models can swap their attention core for the
     sequence-parallel one (EP/CP engines in later frameworks expose the
     same shape: SURVEY §5.7 TPU build implication)."""
 
-    def __init__(self, mesh=None, axis: str = "sp", causal: bool = False):
-        self._mesh = mesh
+    def __init__(self, mesh=None, axis: str = "sp", causal: bool = False,
+                 batch_axes=None):
+        if mesh is not None and mesh is not _mesh.get_mesh():
+            raise ValueError(
+                "RingAttention uses the ambient mesh (set_mesh); pass "
+                "mesh= only to ring_attention directly")
         self._axis = axis
         self._causal = causal
+        self._batch_axes = batch_axes
 
     def __call__(self, q, k, v):
         from ...ops.dispatch import apply
         # through the op funnel: tape-recorded (backprop works), visible
         # to AMP/nan-check/profiler like every other op
-        return apply(
-            "ring_attention",
-            lambda qq, kk, vv: ring_attention(
-                qq, kk, vv, mesh=self._mesh, axis=self._axis,
-                causal=self._causal),
-            q, k, v)
+        return apply("ring_attention", _ring_impl, q, k, v,
+                     axis=self._axis, causal=self._causal,
+                     batch_axes=self._batch_axes)

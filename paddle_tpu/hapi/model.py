@@ -167,6 +167,17 @@ class Model:
                 train_raws, fixed_raws, x_raws, y_raws, key)
             for p, g in zip(ts["trainable"], grads):
                 p._grad = g if p._grad is None else p._grad + g
+        elif any(p._grad is not None for p in ts["trainable"]):
+            # finishing an accumulation window: add this batch's grads to
+            # the carried sum and let the eager optimizer (clip/regularize
+            # inside step()) apply the combined update — reference
+            # semantics for train_batch after update=False calls
+            loss, preds, grads, effects = ts["grads_fn"](
+                train_raws, fixed_raws, x_raws, y_raws, key)
+            for p, g in zip(ts["trainable"], grads):
+                p._grad = g if p._grad is None else p._grad + g
+            opt.step()
+            opt.clear_grad()
         else:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_no = jnp.asarray(opt._global_step + 1, jnp.float32)

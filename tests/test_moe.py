@@ -1,0 +1,106 @@
+"""Expert parallelism: Switch-style MoE over the "ep" mesh axis with
+all_to_all token dispatch (parity-plus; the reference snapshot has no MoE).
+Forward checked exactly against a per-token dense reference."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import moe_ffn, MoELayer
+
+
+@pytest.fixture
+def ep_mesh():
+    dist.set_mesh(dist.build_mesh({"ep": 8}))
+    yield dist.get_mesh()
+    dist.set_mesh(None)
+
+
+def _params(seed=0, D=16, F=32, E=8):
+    rng = np.random.RandomState(seed)
+    wg = rng.randn(D, E).astype(np.float32) * 0.5
+    w1 = rng.randn(E, D, F).astype(np.float32) * 0.1
+    w2 = rng.randn(E, F, D).astype(np.float32) * 0.1
+    return wg, w1, w2
+
+
+def _dense_ref(x, wg, w1, w2):
+    B, T, D = x.shape
+    xt = x.reshape(-1, D)
+    logits = xt @ wg
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    e = p.argmax(-1)
+    gp = p.max(-1)
+    y = np.zeros_like(xt)
+    for i in range(xt.shape[0]):
+        h = xt[i] @ w1[e[i]]
+        h = 0.5 * h * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                   * (h + 0.044715 * h ** 3)))
+        y[i] = gp[i] * (h @ w2[e[i]])
+    return y.reshape(B, T, D)
+
+
+class TestMoE:
+    def test_forward_matches_dense(self, ep_mesh):
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 4, 16).astype(np.float32)
+        wg, w1, w2 = _params()
+        out, aux = moe_ffn(jnp.asarray(x), jnp.asarray(wg),
+                           jnp.asarray(w1), jnp.asarray(w2),
+                           mesh=ep_mesh, capacity_factor=8.0)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _dense_ref(x, wg, w1, w2),
+                                   rtol=2e-3, atol=2e-4)
+        assert float(aux) > 0
+
+    def test_capacity_drops_overflow(self, ep_mesh):
+        # gate forced to expert 0: with tiny capacity most tokens drop
+        rng = np.random.RandomState(1)
+        # positive inputs so the linear gate really sends EVERY token to
+        # expert 0 (zero-mean inputs would flip sign per token)
+        x = (np.abs(rng.randn(8, 4, 16)) + 0.1).astype(np.float32)
+        wg = np.zeros((16, 8), np.float32)
+        wg[:, 0] = 10.0 / 16
+        _, w1, w2 = _params(1)
+        out, _ = moe_ffn(jnp.asarray(x), jnp.asarray(wg * 100),
+                         jnp.asarray(w1), jnp.asarray(w2),
+                         mesh=ep_mesh, capacity_factor=0.25)
+        dropped = np.asarray(out).reshape(-1, 16)
+        # capacity = ceil(4 * 0.25 / 8 * ... ) = 1 per expert per rank:
+        # exactly 1 token per rank routed, the other 3 zeroed
+        zero_rows = (np.abs(dropped).sum(-1) < 1e-7).sum()
+        assert zero_rows == 8 * 4 - 8
+
+    def test_training_decreases_loss(self, ep_mesh):
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 4, 16).astype(np.float32)
+        y = rng.randn(8, 4, 16).astype(np.float32)
+        wg, w1, w2 = _params(2)
+
+        def loss_fn(params):
+            o, aux = moe_ffn(jnp.asarray(x), *params, mesh=ep_mesh,
+                             capacity_factor=8.0)
+            return jnp.mean((o - jnp.asarray(y)) ** 2) + 0.01 * aux
+
+        params = tuple(jnp.asarray(a) for a in (wg, w1, w2))
+        l1, g = jax.value_and_grad(loss_fn)(params)
+        assert all(np.abs(np.asarray(gi)).sum() > 0 for gi in g)
+        params = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg,
+                                        params, g)
+        l2 = loss_fn(params)
+        assert float(l2) < float(l1)
+
+    def test_layer_wrapper_tape(self, ep_mesh):
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(8, 4, 16).astype(np.float32),
+                             stop_gradient=False)
+        wg, w1, w2 = _params(3)
+        layer = MoELayer(mesh=ep_mesh, capacity_factor=8.0)
+        out, aux = layer(x, paddle.to_tensor(wg, stop_gradient=False),
+                         paddle.to_tensor(w1, stop_gradient=False),
+                         paddle.to_tensor(w2, stop_gradient=False))
+        (out * out).sum().backward()
+        assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
