@@ -15,7 +15,9 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,  # noqa:
                    kl_div, smooth_l1_loss, huber_loss, hinge_loss, log_loss,
                    margin_ranking_loss, cosine_similarity,
                    cosine_embedding_loss, triplet_margin_loss, label_smooth,
-                   ctc_loss)
+                   ctc_loss,
+                   warpctc, hinge_embedding_loss, rank_loss,
+                   dice_loss, ctc_greedy_decoder)
 from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,  # noqa: F401
                      embedding, one_hot, interpolate, upsample, grid_sample,
                      affine_grid, bilinear, pad, temporal_shift,

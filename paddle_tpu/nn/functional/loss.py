@@ -282,3 +282,76 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return jnp.mean(loss / lab_len.astype(loss.dtype))
         return _reduce(loss, reduction)
     return apply("warpctc", impl, log_probs, labels, input_lengths, label_lengths)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """Fluid-era alias of ctc_loss (reference: operators/warpctc_op.cc;
+    per-sequence losses, the op's raw output). Lengths default to the
+    full padded extents."""
+    import numpy as _np
+    from ...core.tensor import Tensor as _T
+    T_len = input.shape[0]
+    S_len = label.shape[1]
+    N = input.shape[1]
+    if input_length is None:
+        input_length = _T(_np.full((N,), T_len, _np.int64))
+    if label_length is None:
+        label_length = _T(_np.full((N,), S_len, _np.int64))
+    return ctc_loss(input, label, input_length, label_length, blank=blank,
+                    reduction="none", norm_by_times=norm_by_times)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    """reference: paddle.nn.functional.hinge_embedding_loss — label in
+    {1, -1}: loss = x if y==1 else max(0, margin - x)."""
+    def impl(x, y):
+        val = jnp.where(y > 0, x, jnp.maximum(0.0, margin - x))
+        return _reduce(val, reduction)
+    return apply("hinge_embedding_loss", impl, input, label)
+
+
+def rank_loss(label, left, right, name=None):
+    """reference: operators/rank_loss_op.cc — pairwise RankNet loss:
+    C = log(1 + exp(o)) - o * label with o = left - right."""
+    def impl(lab, l, r):
+        o = l - r
+        return jnp.log1p(jnp.exp(-jnp.abs(o))) + jnp.maximum(o, 0.0) \
+            - o * lab
+    return apply("rank_loss", impl, label, left, right)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: python/paddle/fluid/layers/nn.py dice_loss — 1 - 2|X∩Y| /
+    (|X|+|Y|); input [N, ..., C] probabilities, label [N, ..., 1] ids."""
+    def impl(x, y):
+        num_classes = x.shape[-1]
+        oh = jax.nn.one_hot(y.squeeze(-1), num_classes, dtype=x.dtype)
+        x_flat = x.reshape(x.shape[0], -1)
+        y_flat = oh.reshape(x.shape[0], -1)
+        inter = jnp.sum(x_flat * y_flat, axis=1)
+        union = jnp.sum(x_flat, axis=1) + jnp.sum(y_flat, axis=1)
+        # epsilon on the denominator ONLY — fluid layers.nn dice_loss
+        return jnp.mean(1.0 - (2.0 * inter) / (union + epsilon))
+    return apply("dice_loss", impl, input, label)
+
+
+def ctc_greedy_decoder(input, blank=None, input_length=None, padding_value=0):
+    """reference: operators/ctc_align_op.cc + fluid layers
+    ctc_greedy_decoder — argmax per step then collapse repeats/blanks.
+    input: [T, N, C] log-probs (paddle warpctc layout), or [N, T, C]
+    when ``input_length`` is given (the padded+lengths convention);
+    returns (decoded [N, T], lengths)."""
+    from ...ops import beam as _beam
+
+    batch_major = input_length is not None
+
+    def impl(lp):
+        ids = jnp.argmax(lp, axis=-1)      # [T, N] or [N, T]
+        return ids if batch_major else ids.T
+    ids = apply("ctc_argmax", impl, input)
+    b = blank if blank is not None else 0
+    return _beam.ctc_align(ids, blank=b, merge_repeated=True,
+                           padding_value=padding_value,
+                           lengths=input_length)

@@ -354,3 +354,57 @@ class TestOptimizers:
         np.testing.assert_allclose(
             np.asarray(opt2._state[stable_uid(p2)]["moment1"]),
             np.asarray(opt._state[stable_uid(p)]["moment1"]))
+
+
+class TestRound3Losses:
+    """warpctc alias, hinge_embedding/rank/dice losses, ctc_greedy_decoder
+    (reference: warpctc_op.cc, rank_loss_op.cc, fluid layers dice_loss,
+    ctc_greedy_decoder)."""
+
+    def test_hinge_embedding_loss(self):
+        out = F.hinge_embedding_loss(
+            paddle.to_tensor(np.array([0.5, 2.0], np.float32)),
+            paddle.to_tensor(np.array([1.0, -1.0], np.float32)),
+            reduction="none")
+        np.testing.assert_allclose(out.numpy(), [0.5, 0.0])
+
+    def test_rank_loss(self):
+        rl = F.rank_loss(paddle.to_tensor(np.array([1.0], np.float32)),
+                         paddle.to_tensor(np.array([2.0], np.float32)),
+                         paddle.to_tensor(np.array([1.0], np.float32)))
+        np.testing.assert_allclose(rl.numpy(),
+                                   np.log1p(np.exp(1.0)) - 1.0, rtol=1e-6)
+
+    def test_dice_loss_perfect_prediction(self):
+        x = np.zeros((2, 3, 4), np.float32)
+        y = np.zeros((2, 3, 1), np.int32)
+        for i in range(2):
+            for j in range(3):
+                c = (i + j) % 4
+                x[i, j, c] = 1.0
+                y[i, j, 0] = c
+        d = F.dice_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+        assert float(d.numpy()) < 1e-3
+
+    def test_ctc_greedy_decoder(self):
+        lp = np.full((5, 1, 3), -5.0, np.float32)
+        for t, c in enumerate([1, 1, 0, 2, 2]):
+            lp[t, 0, c] = 0.0
+        dec, nl = F.ctc_greedy_decoder(paddle.to_tensor(lp), blank=0)
+        assert nl.numpy().tolist() == [2]
+        assert dec.numpy()[0, :2].tolist() == [1, 2]
+
+    def test_warpctc_matches_ctc_loss_none(self):
+        rng = np.random.RandomState(0)
+        lp = np.log(np.random.RandomState(0).dirichlet(
+            np.ones(4), size=(6, 2)).astype(np.float32))
+        labels = np.array([[1, 2], [3, 1]], np.int64)
+        il = np.array([6, 6], np.int64)
+        ll = np.array([2, 2], np.int64)
+        a = F.warpctc(paddle.to_tensor(lp), paddle.to_tensor(labels),
+                      input_length=paddle.to_tensor(il),
+                      label_length=paddle.to_tensor(ll))
+        b = F.ctc_loss(paddle.to_tensor(lp), paddle.to_tensor(labels),
+                       paddle.to_tensor(il), paddle.to_tensor(ll),
+                       reduction="none")
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
