@@ -78,3 +78,29 @@ class TestDataLoaderShm:
                                        shuffle=False,
                                        use_shared_memory=False)]
         assert len(multi) == 8
+
+
+class TestConcurrentIterators:
+    def test_two_live_iterators_do_not_clobber_rings(self):
+        """Regression: rings are per-iterator state; a second iterator of
+        the same loader must not unlink/overwrite the first one's."""
+        ds = _ArrDataset(32)
+        dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
+                        use_shared_memory=True)
+        it1 = iter(dl)
+        first = next(it1)
+        # full second pass while it1 is still live
+        second_pass = [b for b in dl]
+        ref = [b for b in DataLoader(ds, batch_size=4, num_workers=0,
+                                     shuffle=False)]
+        assert len(second_pass) == 8
+        for a, b in zip(second_pass, ref):
+            np.testing.assert_allclose(a[0].numpy(), b[0].numpy())
+            np.testing.assert_array_equal(a[1].numpy(), b[1].numpy())
+        # it1 continues draining correctly afterwards
+        rest = list(it1)
+        got = [first] + rest
+        assert len(got) == 8
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(a[0].numpy(), b[0].numpy())
+            np.testing.assert_array_equal(a[1].numpy(), b[1].numpy())
