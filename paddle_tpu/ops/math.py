@@ -681,18 +681,22 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    """reference: cum_op.cc cummin — returns (values, indices)."""
+    """reference: cum_op.cc cummin — returns (values, indices); indices
+    track WHERE the running minimum was set (earliest on ties)."""
     def impl(a):
         ax = axis if axis is not None else 0
         arr = a.reshape(-1) if axis is None else a
-        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
-        hit = arr == vals
         idx = jnp.arange(arr.shape[ax]).reshape(
             [-1 if i == (ax % arr.ndim) else 1 for i in range(arr.ndim)])
-        idx = jnp.broadcast_to(idx, arr.shape)
-        big = arr.shape[ax] + 1
-        marked = jnp.where(hit, idx, big)
-        imin = jax.lax.associative_scan(jnp.minimum, marked, axis=ax)
+        idx = jnp.broadcast_to(idx, arr.shape).astype(jnp.int32)
+
+        def comb(lhs, rhs):
+            lv, li = lhs
+            rv, ri = rhs
+            take_r = rv < lv  # strict: earliest index wins ties
+            return (jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li))
+
+        vals, imin = jax.lax.associative_scan(comb, (arr, idx), axis=ax)
         return vals, imin.astype(np.dtype(dtype))
     return apply("cummin", impl, x)
 

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+import paddle_tpu.ops as ops
 from op_test import check_output, check_grad
 
 
@@ -263,3 +264,99 @@ class TestCreation:
     def test_one_hot(self):
         oh = paddle.one_hot(paddle.to_tensor([0, 2]), 3)
         np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+class TestRound3MathTail:
+    """Numpy checks for the round-3 math additions (reference: logit_op,
+    cum_op cummin/logcumsumexp, renorm_op, cos_sim_op, shard_index_op,
+    paddle.take/index_add/bucketize/diff/cov)."""
+
+    def test_logit(self):
+        x = np.array([0.2, 0.5, 0.8], np.float32)
+        out = ops.logit(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.log(x / (1 - x)), rtol=1e-6)
+
+    def test_rad2deg_deg2rad_roundtrip(self):
+        x = np.array([0.0, np.pi / 2, -np.pi], np.float32)
+        deg = ops.rad2deg(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(deg, [0, 90, -180], atol=1e-4)
+        back = ops.deg2rad(paddle.to_tensor(deg)).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_cummin_values_and_indices(self):
+        x = np.array([3.0, 1.0, 2.0, 0.5], np.float32)
+        vals, idx = ops.cummin(paddle.to_tensor(x))
+        np.testing.assert_allclose(vals.numpy(), [3, 1, 1, 0.5])
+        np.testing.assert_array_equal(idx.numpy(), [0, 1, 1, 3])
+        # ties: the EARLIEST index wins
+        vals2, idx2 = ops.cummin(paddle.to_tensor(
+            np.array([2.0, 1.0, 1.0, 3.0], np.float32)))
+        np.testing.assert_allclose(vals2.numpy(), [2, 1, 1, 1])
+        np.testing.assert_array_equal(idx2.numpy(), [0, 1, 1, 1])
+
+    def test_logcumsumexp(self):
+        x = np.array([0.1, -2.0, 1.5], np.float32)
+        out = ops.logcumsumexp(paddle.to_tensor(x)).numpy()
+        ref = np.log(np.cumsum(np.exp(x)))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_diff_with_prepend(self):
+        x = np.array([1.0, 4.0, 9.0], np.float32)
+        out = ops.diff(paddle.to_tensor(x),
+                       prepend=paddle.to_tensor(
+                           np.array([0.0], np.float32))).numpy()
+        np.testing.assert_allclose(out, [1, 3, 5])
+
+    def test_take_modes(self):
+        x = np.arange(6.0, dtype=np.float32).reshape(2, 3)
+        idx = np.array([0, 5, -1], np.int32)
+        out = ops.take(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy()
+        np.testing.assert_allclose(out, [0, 5, 5])
+        wrap = ops.take(paddle.to_tensor(x),
+                        paddle.to_tensor(np.array([7], np.int32)),
+                        mode="wrap").numpy()
+        np.testing.assert_allclose(wrap, [1.0])
+
+    def test_index_add(self):
+        x = np.zeros((3, 2), np.float32)
+        v = np.ones((2, 2), np.float32)
+        out = ops.index_add(paddle.to_tensor(x),
+                            paddle.to_tensor(np.array([0, 2], np.int32)),
+                            0, paddle.to_tensor(v)).numpy()
+        np.testing.assert_allclose(out, [[1, 1], [0, 0], [1, 1]])
+
+    def test_renorm_clamps_norms(self):
+        x = np.array([[3.0, 4.0], [0.3, 0.4]], np.float32)
+        out = ops.renorm(paddle.to_tensor(x), p=2.0, axis=0,
+                         max_norm=1.0).numpy()
+        np.testing.assert_allclose(np.linalg.norm(out[0]), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(out[1], x[1], rtol=1e-5)  # under limit
+
+    def test_cos_sim(self):
+        a = np.array([[1.0, 0.0], [1.0, 1.0]], np.float32)
+        b = np.array([[1.0, 0.0], [1.0, 0.0]], np.float32)
+        out = ops.cos_sim(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(out.ravel(), [1.0, 1 / np.sqrt(2)],
+                                   rtol=1e-5)
+
+    def test_bucketize(self):
+        edges = np.array([1.0, 3.0, 5.0], np.float32)
+        x = np.array([0.5, 1.0, 4.0, 6.0], np.float32)
+        # searchsorted-left semantics (paddle.bucketize is 1-D
+        # searchsorted): equal values insert BEFORE the edge
+        out = ops.bucketize(paddle.to_tensor(x),
+                            paddle.to_tensor(edges)).numpy()
+        np.testing.assert_array_equal(out, [0, 0, 2, 3])
+        out_r = ops.bucketize(paddle.to_tensor(x),
+                              paddle.to_tensor(edges), right=True).numpy()
+        np.testing.assert_array_equal(out_r, [0, 1, 2, 3])
+
+    def test_shard_index_ceiling_convention(self):
+        # reference shard_index_op: shard_size = ceil(index_num/nshards)
+        x = np.array([1, 6, 12, 19], np.int64)
+        out = ops.shard_index(paddle.to_tensor(x), index_num=20, nshards=3,
+                              shard_id=0).numpy()
+        np.testing.assert_array_equal(out, [1, 6, -1, -1])
+        out1 = ops.shard_index(paddle.to_tensor(x), index_num=20, nshards=3,
+                               shard_id=1).numpy()
+        np.testing.assert_array_equal(out1, [-1, -1, 5, -1])
