@@ -38,7 +38,10 @@ def _load():
     with _BUILD_LOCK:
         if _LIB is not None:
             return _LIB
-        if not os.path.exists(_SO):
+        src = os.path.join(_CSRC, "shm_ring.cpp")
+        stale = (os.path.exists(_SO) and os.path.exists(src)
+                 and os.path.getmtime(_SO) < os.path.getmtime(src))
+        if not os.path.exists(_SO) or stale:
             _build()
         lib = ctypes.CDLL(_SO)
         lib.shm_ring_create.restype = ctypes.c_void_p

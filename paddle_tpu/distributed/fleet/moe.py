@@ -40,12 +40,14 @@ def _moe_local(x, wg, w1, w2, axis: str, capacity: int):
     gate_p = jnp.max(probs, axis=-1)                   # top-1 prob
     expert = jnp.argmax(probs, axis=-1)                # [Nl]
 
-    onehot = jax.nn.one_hot(expert, E, dtype=x.dtype)  # [Nl, E]
-    # position of each token within its expert's queue (0-based)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    # Position of each token within its expert's queue (0-based). Counted
+    # in int32: bf16 inputs can't represent integers past 256, so a
+    # x.dtype cumsum would collide capacity slots for >256 local tokens.
+    onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)
+    onehot = onehot_i.astype(x.dtype)                  # [Nl, E]
+    pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - 1  # [Nl, E] int32
     keep = (pos >= 0) & (pos < capacity)
-    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                          dtype=x.dtype)               # [Nl, E, C]
+    slot = jax.nn.one_hot(pos, capacity, dtype=x.dtype)  # [Nl, E, C]
     dispatch = slot * keep.astype(x.dtype)[..., None]  # [Nl, E, C]
     combine = dispatch * gate_p[:, None, None]
 

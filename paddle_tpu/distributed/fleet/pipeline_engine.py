@@ -38,8 +38,10 @@ def gpipe_apply(block_fn: Callable, stacked_params, mb_x, mesh=None,
     """Apply S pipeline stages to M microbatches.
 
     block_fn(params, x) -> y must be shape-preserving (x and y same shape —
-    the transformer-block case). ``stacked_params``: pytree with leading dim
-    S on every leaf. ``mb_x``: [M, ...] microbatched input (replicated).
+    the transformer-block case). For heterogeneous stages (embedding →
+    blocks → head, different shapes per stage) use ``gpipe_blocks`` /
+    ``gpipe_stages`` below instead. ``stacked_params``: pytree with leading
+    dim S on every leaf. ``mb_x``: [M, ...] microbatched input (replicated).
     Returns [M, ...] outputs. Differentiable end-to-end.
     """
     m = mesh or _mesh.ensure_mesh()

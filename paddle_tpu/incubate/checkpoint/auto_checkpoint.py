@@ -96,10 +96,15 @@ class TrainEpochRange:
 
     def _commit(self, epoch: int):
         # status.json is written only after the shard files exist, so a
-        # crash mid-save leaves the previous checkpoint referenced
-        with open(self._status_path(), "w") as f:
+        # crash mid-save leaves the previous checkpoint referenced; the
+        # write itself is tmp+replace so a crash mid-write can't leave
+        # truncated JSON (matching the shard files' atomic pattern)
+        sp = self._status_path()
+        tmp = sp + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"epoch_no": epoch, "max_epoch_num": self.max_epoch_num},
                       f)
+        os.replace(tmp, sp)
         self._gc(epoch)
 
     def save(self, epoch: int):

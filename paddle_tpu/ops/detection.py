@@ -434,7 +434,17 @@ def roi_align(input, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
               rois_num=None, aligned=True, name=None):
     """reference: operators/roi_align_op.cc. input [N,C,H,W]; rois [R,4]
     (x1,y1,x2,y2 in input-image coords); ``rois_num`` [N] maps rois to
-    batch images (LoD replacement). Output [R, C, ph, pw]."""
+    batch images (LoD replacement). Output [R, C, ph, pw].
+
+    ``sampling_ratio=-1`` matches the reference's adaptive per-bin grid
+    of ceil(roi_extent / pooled_size) taps. The adaptive count is a
+    data-dependent *value*, not shape: taps are laid out on a static
+    grid of min(ceil(H/ph), 8) x min(ceil(W/pw), 8) (a trace-time
+    constant), positioned per ROI by its actual grid count and masked
+    beyond it — exact reference numerics with XLA-static shapes for any
+    bin needing <=8 taps per axis (an ROI up to 8x the output size;
+    beyond that the taps become a uniform 8-per-axis subsample of the
+    bin, still unbiased, bounding compute/memory at 64 taps/bin)."""
     if isinstance(output_size, int):
         ph = pw = int(output_size)
     else:
@@ -461,12 +471,26 @@ def roi_align(input, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
             rh = jnp.maximum(rh, 1.0)
         bin_w = rw / pw
         bin_h = rh / ph
-        sr = sampling_ratio if sampling_ratio > 0 else 2
-        # sample points: per bin, sr x sr bilinear taps, averaged
-        iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
-        ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr)
-        ys = y1[:, None, None] + bin_h[:, None, None] * iy[None]  # [R,ph,sr]
-        xs = x1[:, None, None] + bin_w[:, None, None] * ix[None]  # [R,pw,sr]
+        if sampling_ratio > 0:
+            Gy = Gx = sampling_ratio
+            gy = jnp.full_like(bin_h, sampling_ratio)
+            gx = jnp.full_like(bin_w, sampling_ratio)
+        else:
+            # adaptive ceil(bin_extent) taps on a static grid bounded by
+            # the feature-map extent and the documented 8-tap/axis cap
+            Gy = min(8, max(1, int(np.ceil(H / ph))))
+            Gx = min(8, max(1, int(np.ceil(W / pw))))
+            gy = jnp.clip(jnp.ceil(bin_h), 1, Gy)
+            gx = jnp.clip(jnp.ceil(bin_w), 1, Gx)
+        # per-ROI tap offsets within a bin: (s + 0.5)/g for s < g
+        offy = (jnp.arange(Gy)[None, :] + 0.5) / gy[:, None]   # [R,Gy]
+        offx = (jnp.arange(Gx)[None, :] + 0.5) / gx[:, None]   # [R,Gx]
+        my = jnp.arange(Gy)[None, :] < gy[:, None]             # [R,Gy]
+        mx = jnp.arange(Gx)[None, :] < gx[:, None]             # [R,Gx]
+        iy = jnp.arange(ph)[None, :, None] + offy[:, None, :]  # [R,ph,Gy]
+        ix = jnp.arange(pw)[None, :, None] + offx[:, None, :]  # [R,pw,Gx]
+        ys = y1[:, None, None] + bin_h[:, None, None] * iy     # [R,ph,Gy]
+        xs = x1[:, None, None] + bin_w[:, None, None] * ix     # [R,pw,Gx]
 
         def bilinear(img, yy, xx):
             # img [C,H,W]; yy [ph,sr]; xx [pw,sr] -> [C,ph,sr,pw,sr]
@@ -500,11 +524,14 @@ def roi_align(input, rois, output_size, spatial_scale=1.0, sampling_ratio=-1,
                   & okx[None, None, None, :, :])
             return jnp.where(ok, v, 0.0)
 
-        def per_roi(bi, yy, xx):
+        def per_roi(bi, yy, xx, vy, vx, ny, nx):
             img = feat[bi]
-            v = bilinear(img, yy, xx)               # [C,ph,sr,pw,sr]
-            return v.mean(axis=(2, 4))              # [C,ph,pw]
-        return jax.vmap(per_roi)(bidx, ys, xs)
+            v = bilinear(img, yy, xx)               # [C,ph,Gy,pw,Gx]
+            w = (vy[None, None, :, None, None]
+                 & vx[None, None, None, None, :])
+            return jnp.sum(jnp.where(w, v, 0.0),
+                           axis=(2, 4)) / (ny * nx)  # [C,ph,pw]
+        return jax.vmap(per_roi)(bidx, ys, xs, my, mx, gy, gx)
     return apply("roi_align", impl, input, rois)
 
 

@@ -324,3 +324,46 @@ class TestTensorTo:
         out = t.to("cpu")
         np.testing.assert_allclose(out.numpy(), t.numpy())
         assert str(out._data.dtype) == "float32"
+
+
+class TestMoeBf16SlotCounting:
+    """Round-3 advisor (medium): capacity-slot positions must be counted
+    in int32 — a bf16 cumsum can't represent integers past 256, so >256
+    local tokens routed to one expert silently collided into the same
+    slot."""
+
+    def test_bf16_over_256_tokens_no_collision(self):
+        import jax.numpy as jnp
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet import moe_ffn
+        dist.set_mesh(dist.build_mesh({"ep": 8}))
+        try:
+            rng = np.random.RandomState(0)
+            D, F, E, T = 16, 32, 8, 320  # 320 local tokens > 256
+            # positive inputs so the linear gate really sends EVERY token
+            # to expert 0 (zero-mean inputs would flip sign per token)
+            x = (np.abs(rng.randn(8, T, D)) + 0.1).astype(np.float32)
+            wg = np.zeros((D, E), np.float32)
+            wg[:, 0] = 100.0 / D          # every token -> expert 0
+            w1 = rng.randn(E, D, F).astype(np.float32) * 0.1
+            w2 = rng.randn(E, F, D).astype(np.float32) * 0.1
+            out, _ = moe_ffn(jnp.asarray(x, jnp.bfloat16),
+                             jnp.asarray(wg, jnp.bfloat16),
+                             jnp.asarray(w1, jnp.bfloat16),
+                             jnp.asarray(w2, jnp.bfloat16),
+                             capacity_factor=float(E))  # capacity = T
+            got = np.asarray(out, np.float32).reshape(-1, D)
+            # dense reference (all tokens through expert 0, gate prob 1)
+            xt = x.reshape(-1, D)
+            h = xt @ w1[0]
+            h = 0.5 * h * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                       * (h + 0.044715 * h ** 3)))
+            ref = h @ w2[0]
+            # bf16 tolerance; slot collisions would give O(1) errors on
+            # most rows (summed/zeroed tokens), not 1e-1 rounding
+            err = np.abs(got - ref).max()
+            assert err < 0.15, err
+            # and no dropped (all-zero) rows at full capacity
+            assert (np.abs(got).sum(-1) < 1e-6).sum() == 0
+        finally:
+            dist.set_mesh(None)

@@ -283,3 +283,72 @@ class TestBipartiteMatchMaskedEntries:
         assert m[0] == 0            # the one real pair survives
         assert m[1] == -1 and m[2] == -1
         np.testing.assert_allclose(mdist.numpy()[0][0], 0.9)
+
+
+class TestRoiAlignAdaptiveApprox:
+    """sampling_ratio=-1 adaptive grid (reference roi_align_op.cc:
+    ceil(roi_extent/pooled_size) taps per bin) — implemented via a
+    static worst-case grid with per-ROI masking, so parity must be
+    exact, including on large ROIs where a fixed grid would diverge."""
+
+    @staticmethod
+    def _ref_roi_align(feat, rois, ph, pw, scale, aligned):
+        # numpy transcription of roi_align_op.cc semantics with the
+        # ADAPTIVE grid (sampling_ratio=-1): grid = ceil(bin extent)
+        N, C, H, W = feat.shape
+        roff = 0.5 if aligned else 0.0
+        out = np.zeros((rois.shape[0], C, ph, pw), np.float32)
+
+        def bilin(img, y, x):
+            if y < -1 or y > H or x < -1 or x > W:
+                return np.zeros(C, np.float32)
+            y = min(max(y, 0.0), H - 1)
+            x = min(max(x, 0.0), W - 1)
+            y0, x0 = int(np.floor(y)), int(np.floor(x))
+            y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+            wy, wx = y - y0, x - x0
+            return ((1 - wy) * (1 - wx) * img[:, y0, x0]
+                    + wy * (1 - wx) * img[:, y1, x0]
+                    + (1 - wy) * wx * img[:, y0, x1]
+                    + wy * wx * img[:, y1, x1])
+
+        for r, (x1, y1, x2, y2) in enumerate(rois):
+            x1, y1, x2, y2 = (v * scale - roff for v in (x1, y1, x2, y2))
+            rw, rh = x2 - x1, y2 - y1
+            if not aligned:
+                rw, rh = max(rw, 1.0), max(rh, 1.0)
+            bw, bh = rw / pw, rh / ph
+            gy = int(np.ceil(rh / ph))
+            gx = int(np.ceil(rw / pw))
+            for i in range(ph):
+                for j in range(pw):
+                    acc = np.zeros(C, np.float32)
+                    for sy in range(gy):
+                        for sx in range(gx):
+                            yy = y1 + bh * (i + (sy + 0.5) / gy)
+                            xx = x1 + bw * (j + (sx + 0.5) / gx)
+                            acc += bilin(feat[0], yy, xx)
+                    out[r, :, i, j] = acc / (gy * gx)
+        return out
+
+    def test_large_roi_adaptive_grid_exact(self):
+        rng = np.random.default_rng(0)
+        feat = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+        # ROIs >> 2x output size: adaptive grid uses 7x7 taps/bin
+        rois = np.array([[1.0, 1.0, 29.0, 29.0],
+                         [0.0, 3.0, 27.0, 31.0]], np.float32)
+        got = ops.roi_align(T(feat), T(rois), output_size=4,
+                            sampling_ratio=-1, aligned=True,
+                            rois_num=T(np.array([2]))).numpy()
+        ref = self._ref_roi_align(feat, rois, 4, 4, 1.0, True)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_small_roi_exact(self):
+        # ROI <= 2x output: adaptive grid is also 2x2 -> exact match
+        rng = np.random.default_rng(1)
+        feat = rng.standard_normal((1, 2, 16, 16)).astype(np.float32)
+        rois = np.array([[2.0, 2.0, 9.0, 9.0]], np.float32)
+        got = ops.roi_align(T(feat), T(rois), output_size=4,
+                            sampling_ratio=-1, aligned=True).numpy()
+        ref = self._ref_roi_align(feat, rois, 4, 4, 1.0, True)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
