@@ -111,6 +111,22 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
+def enable_operator_amp(level="O1", dtype="bfloat16", custom_white_list=None,
+                        custom_black_list=None):
+    """Globally enable per-op auto-cast without a context manager — the
+    fleet-strategy path (reference: the AMP meta-optimizer makes the whole
+    program mixed-precision rather than a scoped region)."""
+    _STATE["enabled"] = True
+    _STATE["dtype"] = _dt.convert_dtype(dtype)
+    _STATE["level"] = level
+    _STATE["custom_white"] = set(custom_white_list or ())
+    _STATE["custom_black"] = set(custom_black_list or ())
+
+
+def disable_operator_amp():
+    _STATE["enabled"] = False
+
+
 def is_auto_cast_enabled():
     return _STATE["enabled"]
 

@@ -46,6 +46,50 @@ class _FleetState:
 _F = _FleetState()
 
 
+def _validate_strategy(st: DistributedStrategy):
+    """Every documented strategy flag either takes effect or raises/warns
+    here — no silent no-ops (round-3 verdict: a misconfigured job must
+    never run non-accelerated without a signal)."""
+    import warnings
+    hc = st.hybrid_configs
+    if st.dgc:
+        raise NotImplementedError(
+            "DGC (top-k gradient compression) is out of scope by ADR "
+            "(docs/adr/0002-dgc.md): on TPU the dense-gradient allreduce "
+            "rides ICI and overlaps with compute, and a sparse top-k "
+            "exchange compiles to gather/scatter traffic that is slower "
+            "than the dense collective it replaces. Use localsgd or "
+            "gradient_merge to cut cross-host communication instead.")
+    if st.pipeline and int(hc.get("pp_degree", 1)) <= 1:
+        raise ValueError(
+            "strategy.pipeline=True requires hybrid_configs['pp_degree']>1 "
+            "(the mesh needs a pp axis to pipeline over)")
+    tp_deg = int(st.tensor_parallel_configs.get("tensor_parallel_degree", 1))
+    if st.tensor_parallel and tp_deg <= 1 and int(hc.get("mp_degree", 1)) <= 1:
+        raise ValueError(
+            "strategy.tensor_parallel=True requires tensor_parallel_configs"
+            "['tensor_parallel_degree']>1 or hybrid_configs['mp_degree']>1")
+    if int(st.nccl_comm_num) != 1:
+        warnings.warn(
+            "nccl_comm_num has no effect on TPU: XLA owns collective "
+            "scheduling and multi-stream overlap (no NCCL rings to tune)",
+            UserWarning, stacklevel=3)
+    if not st.fuse_all_reduce_ops:
+        warnings.warn(
+            "fuse_all_reduce_ops=False cannot take effect: XLA always "
+            "fuses/schedules collectives itself on TPU", UserWarning,
+            stacklevel=3)
+    if int(st.fuse_grad_size_in_MB) != 32:
+        warnings.warn(
+            "fuse_grad_size_in_MB has no effect on TPU: gradient bucketing "
+            "is XLA's job", UserWarning, stacklevel=3)
+    if st.find_unused_parameters:
+        warnings.warn(
+            "find_unused_parameters is moot here: one global computation, "
+            "no replica can disagree about used parameters "
+            "(see DataParallel docstring)", UserWarning, stacklevel=3)
+
+
 def init(role_maker=None, is_collective=False, strategy=None):
     """reference: fleet_base.py:139. Collective mode only: the brpc
     parameter-server world is out of scope by ADR
@@ -58,12 +102,18 @@ def init(role_maker=None, is_collective=False, strategy=None):
             "and fleet.ShardedEmbedding for large sparse tables")
     if strategy is None:
         strategy = DistributedStrategy()
+    _validate_strategy(strategy)
     _F.strategy = strategy
     init_parallel_env()
     hc = strategy.hybrid_configs
+    mp_degree = int(hc.get("mp_degree", 1))
+    if strategy.tensor_parallel and mp_degree <= 1:
+        # the standalone tensor_parallel flag takes effect through the mesh
+        mp_degree = int(
+            strategy.tensor_parallel_configs["tensor_parallel_degree"])
     _F.hcg = HybridCommunicateGroup(
         dp_degree=int(hc.get("dp_degree", 1)),
-        mp_degree=int(hc.get("mp_degree", 1)),
+        mp_degree=mp_degree,
         pp_degree=int(hc.get("pp_degree", 1)),
         sharding_degree=int(hc.get("sharding_degree", 1)),
         sep_degree=int(hc.get("sep_degree", 1)))
@@ -94,18 +144,35 @@ def barrier_worker():
 
 
 def distributed_model(model):
-    """reference: fleet_base.py distributed_model — wrap per parallel mode."""
+    """reference: fleet_base.py distributed_model — wrap per parallel mode;
+    applies the model-side strategy levers (amp, recompute) the reference's
+    meta-optimizer stack would have compiled into the program."""
     hcg = _F.hcg
     if hcg is None:
         init()
         hcg = _F.hcg
+    st = _F.strategy or DistributedStrategy()
+    if st.amp:
+        from ... import amp as _amp
+        cfg = st.amp_configs
+        if cfg.get("use_pure_fp16"):
+            _amp.decorate(model, level="O2")
+            _amp.enable_operator_amp(
+                level="O2", custom_white_list=cfg.get("custom_white_list"),
+                custom_black_list=cfg.get("custom_black_list"))
+        else:
+            _amp.enable_operator_amp(
+                level="O1", custom_white_list=cfg.get("custom_white_list"),
+                custom_black_list=cfg.get("custom_black_list"))
+    if st.recompute:
+        _apply_recompute(model, st.recompute_configs.get("checkpoints", []))
     mode = hcg.get_parallel_mode()
     if mode == "pipeline":
         from .pipeline_parallel import PipelineParallel
         return PipelineParallel(model, hcg, _F.strategy)
     if mode == "data":
         from ..parallel import DataParallel
-        return DataParallel(model)
+        return DataParallel(model, bf16_allreduce=bool(st.fp16_allreduce))
     # model/tensor parallel: layers are already mesh-annotated; replicate the
     # rest (reference broadcasts non-mp params across the mp ring)
     for _, p in model.named_parameters():
@@ -118,18 +185,62 @@ def distributed_optimizer(optimizer, strategy=None):
     """reference: fleet_base.py:744 + the meta-optimizer stack. Applies the
     strategy levers that live optimizer-side."""
     st = strategy or _F.strategy or DistributedStrategy()
+    if st is not _F.strategy:
+        _validate_strategy(st)  # a strategy passed here must not dodge init's checks
     _F.strategy = st
     if st.sharding:
         from ..sharding import shard_optimizer_states
         shard_optimizer_states(optimizer)
     if st.lars or st.lamb:
         optimizer = _swap_optimizer(optimizer, st)
+    if st.localsgd:
+        from .utils import LocalSGDOptimizer
+        cfg = st.localsgd_configs
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=int(cfg["k_steps"]),
+            begin_step=int(cfg["begin_step"]))
     if st.gradient_merge:
+        # gradient merge wraps OUTSIDE localsgd so LocalSGD counts actual
+        # parameter updates, not accumulation micro-steps
         from .utils import GradientMergeOptimizer
         optimizer = GradientMergeOptimizer(
             optimizer, k_steps=int(st.gradient_merge_configs["k_steps"]),
             avg=bool(st.gradient_merge_configs["avg"]))
     return optimizer
+
+
+def _apply_recompute(model, checkpoints):
+    """Strategy-driven recompute (reference: meta_optimizers/recompute —
+    there a program rewrite; here each named sublayer's forward is routed
+    through fleet.utils.recompute, i.e. jax.checkpoint under a trace)."""
+    from . import utils as _utils
+    names = set(checkpoints or ())
+    if not names:
+        import warnings
+        warnings.warn(
+            "strategy.recompute=True with empty recompute_configs"
+            "['checkpoints']: nothing to wrap — name the sublayers to "
+            "rematerialize (model.named_sublayers() keys)", UserWarning,
+            stacklevel=2)
+        return
+    matched = set()
+    for name, sub in model.named_sublayers():
+        if name in names:
+            matched.add(name)
+            orig = sub.forward
+            if getattr(orig, "_fleet_recompute", False):
+                continue  # idempotent: re-wrapping would nest jax.checkpoint
+
+            def wrapped(*a, __f=orig, **k):
+                return _utils.recompute(__f, *a, **k)
+
+            wrapped._fleet_recompute = True
+            sub.forward = wrapped
+    missing = names - matched
+    if missing:
+        raise ValueError(
+            f"recompute checkpoints not found among sublayers: "
+            f"{sorted(missing)}")
 
 
 def _swap_optimizer(optimizer, st):

@@ -33,7 +33,11 @@ _DEFAULTS: Dict[str, Any] = {
     "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
                      "epsilon": 0.0, "exclude_from_weight_decay": []},
     "localsgd": False,
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
     "dgc": False,
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "fp16_allreduce": False,
     "pipeline": False,
     "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1},
     "tensor_parallel": False,

@@ -114,3 +114,47 @@ class GradientMergeOptimizer:
     def minimize(self, loss, **kw):
         loss.backward()
         self.step()
+
+
+class LocalSGDOptimizer:
+    """reference: distributed/fleet/meta_optimizers/localsgd_optimizer.py:25
+    — run k local optimizer steps between parameter averages instead of
+    all-reducing gradients every step.
+
+    TPU framing: inside one process, GSPMD's per-step gradient allreduce
+    rides ICI and overlaps with compute, so LocalSGD buys nothing there.
+    The win is at the multi-host/DCN boundary — each process trains locally
+    for ``k_steps`` and parameters are averaged across processes
+    periodically. Pair with ``DataParallel`` and do NOT call
+    ``apply_collective_grads`` (the whole point is to skip it); this
+    wrapper performs the periodic cross-process parameter average.
+    """
+
+    def __init__(self, inner_optimizer, k_steps=1, begin_step=1):
+        self._inner = inner_optimizer
+        self._k = max(1, int(k_steps))
+        self._begin = max(1, int(begin_step))
+        self._t = 0
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_inner"), name)
+
+    def _average_params(self):
+        from .. import collective as C
+        for p in self._inner._parameter_list:
+            C.all_reduce(p, op=C.ReduceOp.AVG)
+
+    def step(self):
+        self._inner.step()
+        self._t += 1
+        if self._t >= self._begin and (self._t - self._begin) % self._k == 0:
+            self._average_params()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()

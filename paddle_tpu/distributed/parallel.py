@@ -69,10 +69,14 @@ class DataParallel(Layer):
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, bf16_allreduce=False):
         super().__init__()
         self._layers = layers
         self._group = group
+        # strategy.fp16_allreduce analog (reference: fp16_allreduce_
+        # optimizer.py:20 — halve cross-process gradient bytes; bf16 is
+        # the TPU-native half-width format)
+        self._bf16_allreduce = bool(bf16_allreduce)
         self._mesh = _mesh.ensure_mesh()
         self.find_unused_parameters = find_unused_parameters
         # replicate parameters/buffers across the mesh (BCastParamsToDevices,
@@ -111,9 +115,15 @@ class DataParallel(Layer):
         for p in self._layers.parameters():
             if p._grad is None:
                 continue
-            g = Tensor(p._grad)
-            C.all_reduce(g, op=C.ReduceOp.AVG, group=self._group)
-            p._grad = g._data
+            raw = p._grad
+            if self._bf16_allreduce and raw.dtype == jnp.float32:
+                g = Tensor(raw.astype(jnp.bfloat16))
+                C.all_reduce(g, op=C.ReduceOp.AVG, group=self._group)
+                p._grad = g._data.astype(jnp.float32)
+            else:
+                g = Tensor(raw)
+                C.all_reduce(g, op=C.ReduceOp.AVG, group=self._group)
+                p._grad = g._data
 
     # delegate everything stateful to the wrapped layer
     def state_dict(self, *args, **kwargs):

@@ -1,0 +1,236 @@
+"""Every documented DistributedStrategy flag takes effect or raises/warns —
+no silent no-ops (round-3 verdict item 3; reference:
+fleet/base/distributed_strategy.py + meta_optimizers/{localsgd_optimizer.py,
+fp16_allreduce_optimizer.py, dgc_optimizer.py}, docs/adr/0002-dgc.md)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    import paddle_tpu.amp as amp
+    amp.disable_operator_amp()
+    dist.set_mesh(None)
+
+
+def _model():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+class TestFlagErrors:
+    def test_dgc_raises_with_adr_pointer(self):
+        st = DistributedStrategy()
+        st.dgc = True
+        with pytest.raises(NotImplementedError, match="0002-dgc"):
+            fleet.init(is_collective=True, strategy=st)
+
+    def test_pipeline_without_pp_degree_raises(self):
+        st = DistributedStrategy()
+        st.pipeline = True
+        with pytest.raises(ValueError, match="pp_degree"):
+            fleet.init(is_collective=True, strategy=st)
+
+    def test_tensor_parallel_without_degree_raises(self):
+        st = DistributedStrategy()
+        st.tensor_parallel = True
+        with pytest.raises(ValueError, match="tensor_parallel_degree"):
+            fleet.init(is_collective=True, strategy=st)
+
+    def test_unknown_field_raises(self):
+        st = DistributedStrategy()
+        with pytest.raises(AttributeError):
+            st.no_such_flag = True
+
+
+class TestFlagWarnings:
+    @pytest.mark.parametrize("field,value,pat", [
+        ("nccl_comm_num", 4, "nccl_comm_num"),
+        ("fuse_all_reduce_ops", False, "fuse_all_reduce_ops"),
+        ("fuse_grad_size_in_MB", 64, "fuse_grad_size"),
+        ("find_unused_parameters", True, "find_unused_parameters"),
+    ])
+    def test_absorbed_flags_warn(self, field, value, pat):
+        st = DistributedStrategy()
+        setattr(st, field, value)
+        with pytest.warns(UserWarning, match=pat):
+            fleet.init(is_collective=True, strategy=st)
+
+    def test_recompute_without_checkpoints_warns(self):
+        st = DistributedStrategy()
+        st.recompute = True
+        fleet.init(is_collective=True, strategy=st)
+        with pytest.warns(UserWarning, match="checkpoints"):
+            fleet.distributed_model(_model())
+
+
+class TestFlagEffects:
+    def test_amp_o1_enables_operator_autocast(self):
+        import paddle_tpu.amp as amp
+        st = DistributedStrategy()
+        st.amp = True
+        fleet.init(is_collective=True, strategy=st)
+        assert not amp.is_auto_cast_enabled()
+        m = fleet.distributed_model(_model())
+        assert amp.is_auto_cast_enabled()
+        # matmul (white-listed) actually runs in bf16
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        out = m(x)
+        assert str(out._data.dtype) == "bfloat16"
+
+    def test_amp_o2_casts_params(self):
+        import paddle_tpu.amp as amp
+        st = DistributedStrategy()
+        st.amp = True
+        st.amp_configs = {"use_pure_fp16": True}
+        fleet.init(is_collective=True, strategy=st)
+        m = _model()
+        fleet.distributed_model(m)
+        assert amp.is_auto_cast_enabled()
+        assert str(m.parameters()[0]._data.dtype) == "bfloat16"
+
+    def test_recompute_wraps_named_sublayers(self):
+        st = DistributedStrategy()
+        st.recompute = True
+        names = [n for n, _ in _model().named_sublayers()]
+        target = names[0]
+        st.recompute_configs = {"checkpoints": [target]}
+        fleet.init(is_collective=True, strategy=st)
+        m = _model()
+        fleet.distributed_model(m)
+        # the wrapped sublayer gets an instance-level forward; others keep
+        # the class method
+        overridden = {n for n, s in m.named_sublayers()
+                      if "forward" in s.__dict__}
+        assert overridden == {target}
+        # numerics unchanged
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        ref = _model()
+        ref.set_state_dict(m.state_dict())
+        np.testing.assert_allclose(m(x).numpy(), ref(x).numpy(), rtol=1e-6)
+
+    def test_recompute_unknown_checkpoint_raises(self):
+        st = DistributedStrategy()
+        st.recompute = True
+        st.recompute_configs = {"checkpoints": ["nope"]}
+        fleet.init(is_collective=True, strategy=st)
+        with pytest.raises(ValueError, match="nope"):
+            fleet.distributed_model(_model())
+
+    def test_localsgd_wraps_and_averages_every_k(self, monkeypatch):
+        st = DistributedStrategy()
+        st.localsgd = True
+        st.localsgd_configs = {"k_steps": 3, "begin_step": 2}
+        fleet.init(is_collective=True, strategy=st)
+        m = _model()
+        o = fleet.distributed_optimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()), st)
+        from paddle_tpu.distributed.fleet.utils import LocalSGDOptimizer
+        assert isinstance(o, LocalSGDOptimizer)
+        calls = []
+        monkeypatch.setattr(o, "_average_params",
+                            lambda: calls.append(o._t))
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        for _ in range(8):
+            loss = (m(x) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        # begin at step 2, then every 3: steps 2, 5, 8
+        assert calls == [2, 5, 8]
+
+    def test_fp16_allreduce_casts_grad_exchange(self, monkeypatch):
+        st = DistributedStrategy()
+        st.fp16_allreduce = True
+        st.hybrid_configs = {"dp_degree": 8}
+        fleet.init(is_collective=True, strategy=st)
+        dist.set_mesh(dist.build_mesh({"dp": 8}))
+        m = fleet.distributed_model(_model())
+        from paddle_tpu.distributed.parallel import DataParallel
+        assert isinstance(m, DataParallel)
+        assert m._bf16_allreduce
+        # drive apply_collective_grads with a fake multi-process world and
+        # capture the dtype crossing the collective
+        seen = []
+        import paddle_tpu.distributed.parallel as pmod
+
+        monkeypatch.setattr(pmod.jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            pmod.C, "all_reduce",
+            lambda t, op=None, group=None: seen.append(str(t._data.dtype)))
+        x = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32))
+        (m(x) ** 2).mean().backward()
+        m.apply_collective_grads()
+        assert seen and all(d == "bfloat16" for d in seen)
+        # grads come back f32 for the optimizer
+        assert all(str(p._grad.dtype) == "float32"
+                   for p in m.parameters() if p._grad is not None)
+
+    def test_gradient_merge_still_effective(self):
+        st = DistributedStrategy()
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        fleet.init(is_collective=True, strategy=st)
+        m = _model()
+        o = fleet.distributed_optimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()), st)
+        from paddle_tpu.distributed.fleet.utils import GradientMergeOptimizer
+        assert isinstance(o, GradientMergeOptimizer)
+
+    def test_serialization_roundtrips_new_fields(self, tmp_path):
+        st = DistributedStrategy()
+        st.localsgd = True
+        st.fp16_allreduce = True
+        st.localsgd_configs = {"k_steps": 7}
+        p = str(tmp_path / "s.prototxt")
+        st.save_to_prototxt(p)
+        st2 = DistributedStrategy()
+        st2.load_from_prototxt(p)
+        assert st2.localsgd and st2.fp16_allreduce
+        assert st2.localsgd_configs["k_steps"] == 7
+
+    def test_localsgd_composes_with_gradient_merge(self):
+        # GM wraps outside LocalSGD: averages count real updates, not
+        # accumulation micro-steps
+        st = DistributedStrategy()
+        st.localsgd = True
+        st.gradient_merge = True
+        st.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        fleet.init(is_collective=True, strategy=st)
+        m = _model()
+        o = fleet.distributed_optimizer(
+            opt.SGD(learning_rate=0.1, parameters=m.parameters()), st)
+        from paddle_tpu.distributed.fleet.utils import (
+            GradientMergeOptimizer, LocalSGDOptimizer)
+        assert isinstance(o, GradientMergeOptimizer)
+        assert isinstance(o._inner, LocalSGDOptimizer)
+
+    def test_distributed_optimizer_validates_strategy(self):
+        fleet.init(is_collective=True)
+        st = DistributedStrategy()
+        st.dgc = True
+        m = _model()
+        with pytest.raises(NotImplementedError, match="0002-dgc"):
+            fleet.distributed_optimizer(
+                opt.SGD(learning_rate=0.1, parameters=m.parameters()), st)
+
+    def test_distributed_model_recompute_idempotent(self):
+        st = DistributedStrategy()
+        st.recompute = True
+        target = [n for n, _ in _model().named_sublayers()][0]
+        st.recompute_configs = {"checkpoints": [target]}
+        fleet.init(is_collective=True, strategy=st)
+        m = _model()
+        fleet.distributed_model(m)
+        first = dict(m.named_sublayers())[target].forward
+        fleet.distributed_model(m)
+        assert dict(m.named_sublayers())[target].forward is first
