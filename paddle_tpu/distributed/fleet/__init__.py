@@ -167,6 +167,12 @@ def distributed_model(model):
     if st.recompute:
         _apply_recompute(model, st.recompute_configs.get("checkpoints", []))
     mode = hcg.get_parallel_mode()
+    if st.fp16_allreduce and mode != "data":
+        import warnings
+        warnings.warn(
+            f"fp16_allreduce applies to the DataParallel cross-process "
+            f"gradient exchange only; it has no effect in {mode!r} mode",
+            UserWarning, stacklevel=2)
     if mode == "pipeline":
         from .pipeline_parallel import PipelineParallel
         return PipelineParallel(model, hcg, _F.strategy)

@@ -9,9 +9,12 @@ Design difference: the reference REWRITES the Python AST (if→cond ops,
 for→while_loop ops) then runs the rewritten code under a static Program.
 Here the original Python executes under a jax trace (functionalize.py) and the
 whole forward becomes ONE XLA computation; its vjp is the compiled backward.
-Python control flow on tensor values must use lax-style ops
-(paddle_tpu.ops.cond/while_loop) — data-dependent `if` raises a tracer error
-with guidance, matching XLA's compilation model instead of hiding it.
+Plain-Python `if`/`while` on tensor values is AST-converted to
+ops.cond/ops.while_loop (ast_transform.py — runtime-dispatch helpers, one
+convert_call level deep, reference ifelse_transformer.py semantics);
+constructs the converter can't preserve (return inside a tensor branch)
+keep the clear tracer error. Disable with
+paddle_tpu.jit.enable_ast_conversion(False).
 
 The cache is keyed by input signature exactly like ProgramCache
 (program_translator.py:689): (shapes, dtypes, training-mode, param dtypes).
@@ -75,6 +78,9 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, layer=None, input_spec=None,
                  build_strategy=None):
+        from . import ast_transform
+        if ast_transform.ast_conversion_enabled():
+            fn = ast_transform.convert_function(fn)
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
@@ -243,6 +249,10 @@ declarative = to_static
 def not_to_static(fn):
     fn._not_to_static = True
     return fn
+
+
+from .ast_transform import (enable_ast_conversion,  # noqa: E402,F401
+                            ast_conversion_enabled, convert_function)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,444 @@
+"""AST conversion of plain-Python control flow for ``@to_static``.
+
+TPU-native analog of the reference dygraph_to_static transformer suite
+(reference: fluid/dygraph/dygraph_to_static/ifelse_transformer.py:38,
+loop_transformer.py, convert_call_func.py, convert_operators.py —
+there a 25-module AST pipeline rewriting to Program ops; here one pass
+rewriting ``if``/``while`` into runtime-dispatch helpers that fall through
+to plain Python for concrete predicates and lower to ``ops.cond`` /
+``ops.while_loop`` (lax.cond / lax.while_loop) when the predicate is a
+traced tensor).
+
+The rewrite (reference ifelse_transformer semantics):
+
+    if pred:                    def __pt_true_0(x):
+        x = x + 1        →          x = x + 1
+    else:                           return (x,)
+        x = x - 1               def __pt_false_0(x): ...
+                                (x,) = __pt_if__(pred, __pt_true_0,
+                                                 __pt_false_0,
+                                                 __pt_args__(locals(), ('x',)))
+
+Branch/loop functions receive the mutated names as parameters (Python
+closures cannot rebind outer locals) and return them; names possibly
+undefined on entry travel as an ``_Undefined`` sentinel that raises a
+clear error on first use (reference: dygraph_to_static UndefinedVar).
+
+Conversion is best-effort with a guaranteed fallback: any construct the
+pass cannot preserve exactly (``return``/``break``/``continue`` inside a
+converted branch, closures, unavailable source) leaves that node — or the
+whole function — untouched, so behaviour degrades to the pre-existing
+clear tracer error, never to silently-wrong code. ``convert_call``-style
+recursion is one level deep: calls to plain user functions are routed
+through ``__pt_call__`` which converts the callee's own if/while once.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Callable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+_ENABLED = True
+
+
+def enable_ast_conversion(flag: bool = True):
+    """Globally toggle plain-Python control-flow conversion under
+    to_static (reference: ProgramTranslator().enable)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def ast_conversion_enabled() -> bool:
+    return _ENABLED
+
+
+# ---------------------------------------------------------------------------
+# runtime pieces
+
+class _Undefined:
+    """Sentinel for a name not yet bound when a branch/loop captures scope
+    (reference: dygraph_to_static UndefinedVar). Any use raises clearly."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            f"variable {self.name!r} is used in converted control flow "
+            f"before assignment (define it before the if/while so both "
+            f"paths produce it)")
+
+    def __repr__(self):
+        return f"<undefined {self.name}>"
+
+    __call__ = __getattr__ = __add__ = __radd__ = __mul__ = __bool__ = _raise
+    __sub__ = __rsub__ = __truediv__ = __getitem__ = __iter__ = _raise
+
+
+def _is_traced(x):
+    import jax
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _is_tensorish(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor) or _is_traced(x)
+
+
+def __pt_args__(loc: dict, names: Sequence[str]) -> tuple:
+    return tuple(loc.get(n, _Undefined(n)) for n in names)
+
+
+def __pt_if__(pred, true_fn, false_fn, args):
+    from ..ops import control_flow
+    from ..static.graph import Variable
+    if isinstance(pred, Variable) or _is_tensorish(pred):
+        return control_flow.cond(pred, lambda: true_fn(*args),
+                                 lambda: false_fn(*args))
+    return true_fn(*args) if pred else false_fn(*args)
+
+
+def __pt_while__(cond_fn, body_fn, names, args):
+    from ..ops import control_flow
+    from ..core.tensor import Tensor
+    from ..static.graph import Variable
+    c = cond_fn(*args)
+    if isinstance(c, Variable) or _is_tensorish(c):
+        for n, a in zip(names, args):
+            if isinstance(a, _Undefined):
+                raise NameError(
+                    f"loop variable {n!r} must be initialised before a "
+                    f"tensor-condition while loop")
+        out = control_flow.while_loop(cond_fn, body_fn, list(args))
+        return tuple(out)
+    state = list(args)
+    if isinstance(c, Tensor):
+        c = bool(np.asarray(c._data))
+    while c:
+        out = body_fn(*state)
+        state = list(out) if isinstance(out, (list, tuple)) else [out]
+        c = cond_fn(*state)
+        if isinstance(c, Tensor):
+            c = bool(np.asarray(c._data))
+    return tuple(state)
+
+
+_SKIP_MODULE_PREFIXES = ("paddle_tpu", "jax", "numpy", "builtins", "torch",
+                         "flax", "optax")
+
+
+def __pt_call__(fn, *args, **kwargs):
+    """convert_call one level deep (reference: convert_call_func.py):
+    a plain user function called from converted code gets its own
+    if/while converted (without further call recursion). The converted
+    form is memoised on the function object itself so it is evicted
+    with it."""
+    f = getattr(fn, "__func__", fn)
+    if not isinstance(f, types.FunctionType):
+        return fn(*args, **kwargs)
+    mod = getattr(f, "__module__", "") or ""
+    if (any(mod.startswith(p) for p in _SKIP_MODULE_PREFIXES)
+            or getattr(f, "_not_to_static", False)
+            or getattr(f, "__pt_converted__", False)):
+        return fn(*args, **kwargs)
+    conv = f.__dict__.get("__pt_call_conv__")
+    if conv is None:
+        conv = convert_function(f, convert_calls=False)
+        f.__pt_call_conv__ = conv
+    if fn is not f:  # bound method: re-bind
+        return conv(fn.__self__, *args, **kwargs)
+    return conv(*args, **kwargs)
+
+
+_HELPERS = {
+    "__pt_if__": __pt_if__,
+    "__pt_while__": __pt_while__,
+    "__pt_args__": __pt_args__,
+    "__pt_call__": __pt_call__,
+}
+
+
+# ---------------------------------------------------------------------------
+# analysis
+
+def _assigned_names(stmts) -> Set[str]:
+    """Names (re)bound anywhere in the statement list, excluding nested
+    function/class scopes."""
+    out: Set[str] = set()
+
+    def targets(t):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets(e)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            out.add(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            out.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                targets(t)
+
+        def visit_AugAssign(self, node):
+            targets(node.target)
+
+        def visit_AnnAssign(self, node):
+            if node.value is not None:
+                targets(node.target)
+
+        def visit_For(self, node):
+            targets(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    targets(item.optional_vars)
+            self.generic_visit(node)
+
+        def visit_NamedExpr(self, node):
+            targets(node.target)
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return {n for n in out if not n.startswith("__pt_")}
+
+
+def _has_escape(stmts, *, through_loops: bool) -> bool:
+    """True if a return/break/continue at this control level would escape
+    the extracted function. Does not descend into nested function defs;
+    descends into loops only when ``through_loops`` (a break inside a
+    nested loop belongs to that loop)."""
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        if isinstance(s, getattr(ast, "Match", ())):
+            return True  # conservative: match capture/return analysis n/a
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor)):
+            # returns still escape from inside a nested loop
+            if _contains_return(list(s.body) + list(s.orelse)):
+                return True
+            continue
+        for field in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(s, field, None)
+            if sub:
+                items = []
+                for x in sub:
+                    if isinstance(x, ast.excepthandler):
+                        items.extend(x.body)
+                    else:
+                        items.append(x)
+                if _has_escape(items, through_loops=through_loops):
+                    return True
+    return False
+
+
+def _contains_return(stmts) -> bool:
+    """Return statements at any depth, excluding nested function scopes
+    (a proper recursive visitor — ast.walk's flat BFS cannot prune)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Return(self, node):
+            self.found = True
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+
+class _CtrlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, convert_calls: bool):
+        self._n = 0
+        self._convert_calls = convert_calls
+
+    def _uid(self):
+        self._n += 1
+        return self._n - 1
+
+    # -- calls --------------------------------------------------------------
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if not self._convert_calls or not isinstance(node.func, ast.Name):
+            return node
+        if node.func.id.startswith("__pt_"):
+            return node
+        return ast.Call(
+            func=ast.Name(id="__pt_call__", ctx=ast.Load()),
+            args=[node.func] + node.args, keywords=node.keywords)
+
+    # -- if -----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body, orelse = list(node.body), list(node.orelse)
+        if (_has_escape(body, through_loops=False)
+                or _has_escape(orelse, through_loops=False)):
+            return node
+        names = sorted(_assigned_names(body) | _assigned_names(orelse))
+        uid = self._uid()
+        tname, fname = f"__pt_true_{uid}", f"__pt_false_{uid}"
+        ret = (ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+            ctx=ast.Load())) if names else ast.Return(value=None))
+        tdef = self._mkfn(tname, names, body + [ret])
+        fdef = self._mkfn(fname, names, (orelse or [ast.Pass()]) + [ret])
+        call = ast.Call(
+            func=ast.Name(id="__pt_if__", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  self._args_call(names)],
+            keywords=[])
+        if names:
+            tail = ast.Assign(
+                targets=[ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                    ctx=ast.Store())],
+                value=call)
+        else:
+            tail = ast.Expr(value=call)
+        return [tdef, fdef, tail]
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        body = list(node.body)
+        if node.orelse or _has_escape(body, through_loops=False):
+            return node
+        names = sorted(_assigned_names(body))
+        if not names:
+            return node  # nothing evolves: not convertible, leave as-is
+        uid = self._uid()
+        cname, bname = f"__pt_cond_{uid}", f"__pt_body_{uid}"
+        cdef = self._mkfn(cname, names, [ast.Return(value=node.test)])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+            ctx=ast.Load()))
+        bdef = self._mkfn(bname, names, body + [ret])
+        call = ast.Call(
+            func=ast.Name(id="__pt_while__", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load()),
+                  self._args_call(names)],
+            keywords=[])
+        tail = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())],
+            value=call)
+        return [cdef, bdef, tail]
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _mkfn(name, params, body):
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=body, decorator_list=[], returns=None)
+
+    @staticmethod
+    def _args_call(names):
+        return ast.Call(
+            func=ast.Name(id="__pt_args__", ctx=ast.Load()),
+            args=[ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                           args=[], keywords=[]),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load())],
+            keywords=[])
+
+
+# ---------------------------------------------------------------------------
+# entry
+
+def convert_function(fn: Callable, convert_calls: bool = True) -> Callable:
+    """Return ``fn`` with plain-Python if/while converted, or ``fn``
+    unchanged when conversion cannot apply (no source, closures, already
+    converted). Never raises."""
+    f = getattr(fn, "__func__", None)
+    bound_self = getattr(fn, "__self__", None) if f is not None else None
+    f = f or fn
+    if not isinstance(f, types.FunctionType):
+        return fn
+    if getattr(f, "__pt_converted__", False):
+        return fn
+    if f.__closure__:
+        return fn  # recompiling would sever the closure cells
+    try:
+        src = textwrap.dedent(inspect.getsource(f))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    tr = _CtrlFlowTransformer(convert_calls)
+    new_tree = tr.visit(tree)
+    if tr._n == 0 and not convert_calls:
+        return fn  # nothing to do
+    ast.fix_missing_locations(new_tree)
+    try:
+        code = compile(new_tree, filename=f"<to_static {f.__name__} "
+                       f"({f.__code__.co_filename})>", mode="exec")
+    except SyntaxError:
+        return fn
+    glb = f.__globals__
+    for k, v in _HELPERS.items():
+        glb.setdefault(k, v)
+    loc: dict = {}
+    exec(code, glb, loc)
+    new_f = loc[fdef.name]
+    new_f.__defaults__ = f.__defaults__
+    new_f.__kwdefaults__ = f.__kwdefaults__
+    functools.update_wrapper(new_f, f)
+    new_f.__pt_converted__ = True
+    if bound_self is not None:
+        return new_f.__get__(bound_self)
+    return new_f
