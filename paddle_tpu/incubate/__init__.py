@@ -1,3 +1,5 @@
 """paddle.incubate: graduated-API staging area (reference:
 python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
+from ..ops.segment import (segment_sum, segment_mean, segment_max,  # noqa: F401
+                           segment_min, segment_pool)

@@ -200,13 +200,25 @@ def _assigned_names(stmts) -> Set[str]:
         def visit_Assign(self, node):
             for t in node.targets:
                 targets(t)
+            self.generic_visit(node)  # walrus bindings inside the value
+
+        def visit_Import(self, node):
+            for a in node.names:
+                out.add((a.asname or a.name).split(".")[0])
+
+        def visit_ImportFrom(self, node):
+            for a in node.names:
+                if a.name != "*":
+                    out.add(a.asname or a.name)
 
         def visit_AugAssign(self, node):
             targets(node.target)
+            self.generic_visit(node)
 
         def visit_AnnAssign(self, node):
             if node.value is not None:
                 targets(node.target)
+            self.generic_visit(node)
 
         def visit_For(self, node):
             targets(node.target)
@@ -238,6 +250,10 @@ def _has_escape(stmts, *, through_loops: bool) -> bool:
             return True
         if isinstance(s, (ast.Break, ast.Continue)):
             return True
+        if _contains_yield([s]):
+            # a yield/await moved into an extracted nested function would
+            # silently turn the branch into a never-consumed generator
+            return True
         if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
                           ast.ClassDef)):
             continue
@@ -260,6 +276,36 @@ def _has_escape(stmts, *, through_loops: bool) -> bool:
                 if _has_escape(items, through_loops=through_loops):
                     return True
     return False
+
+
+def _contains_yield(stmts) -> bool:
+    """yield / yield-from / await at any depth, excluding nested function
+    scopes (they establish their own generator frame)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Yield(self, node):
+            self.found = True
+
+        def visit_YieldFrom(self, node):
+            self.found = True
+
+        def visit_Await(self, node):
+            self.found = True
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
 
 
 def _contains_return(stmts) -> bool:
@@ -406,6 +452,9 @@ def convert_function(fn: Callable, convert_calls: bool = True) -> Callable:
     f = f or fn
     if not isinstance(f, types.FunctionType):
         return fn
+    if inspect.isgeneratorfunction(f) or inspect.iscoroutinefunction(f) \
+            or inspect.isasyncgenfunction(f):
+        return fn  # generator/async frames cannot be re-sliced into cond
     if getattr(f, "__pt_converted__", False):
         return fn
     if f.__closure__:

@@ -22,4 +22,6 @@ from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,  # no
                      embedding, one_hot, interpolate, upsample, grid_sample,
                      affine_grid, bilinear, pad, temporal_shift,
                      sequence_mask, diag_embed, unfold, npair_loss)
+from .sampled import (hsigmoid_loss, hierarchical_sigmoid, nce,  # noqa: F401
+                      class_center_sample, sampling_id, sample_logits)
 from ...ops.manipulation import pixel_shuffle, pixel_unshuffle  # noqa: F401

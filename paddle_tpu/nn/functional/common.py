@@ -266,10 +266,6 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     return apply("npair_loss", impl, anchor, positive, labels)
 
 
-def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: planned (PS-era op)")
-
-
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     """reference: operators/sequence_ops/sequence_mask_op.cc."""
     d = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype

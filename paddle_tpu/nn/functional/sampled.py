@@ -1,0 +1,248 @@
+"""Sampled / tree-structured classification heads.
+
+The reference implements these as single CPU/GPU kernels that mix RNG,
+gather and a tiny amount of math (nce_op.h:80, hierarchical_sigmoid_op.h,
+class_center_sample_op.cu, sample_logits_op.cc). TPU-first the split is
+different: the RNG uses the framework Generator's key stream, the gathers
+are plain jnp indexing, and everything stays fixed-shape so the whole head
+fuses into the surrounding jit region.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import generator as _gen
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply
+
+__all__ = ["hsigmoid_loss", "hierarchical_sigmoid", "nce",
+           "class_center_sample", "sampling_id", "sample_logits"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- hierarchical sigmoid -----------------------------------------------------
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: operators/hierarchical_sigmoid_op.cc + math/
+    matrix_bit_code.h SimpleCode (``code = label + num_classes``,
+    ``calc_index(b) = (code >> (b+1)) - 1``, ``calc_bit(b) = code & (1<<b)``).
+
+    Default (no path_table) builds the complete binary tree the reference's
+    SimpleCodeTable encodes; custom trees pass ``path_table`` [N, L] (node
+    ids, -1 padding) and ``path_code`` [N, L] (0/1 bits). ``is_sparse`` is
+    accepted for API parity — with XLA the dense path's gather/scatter is
+    already sparse in effect.
+
+    input [N, D]; label [N] or [N, 1]; weight [num_classes-1, D];
+    bias [num_classes-1] or [num_classes-1, 1]. Returns [N, 1].
+    """
+    C = int(num_classes)
+    if path_table is None:
+        # Bit budget: codes lie in [C, 2C-1] so floor(log2) <= ceil(log2(C)).
+        max_len = max(int(np.ceil(np.log2(max(C, 2)))) + 1, 1)
+
+        def impl(x, lab, w, *maybe_b):
+            lab = lab.reshape(-1).astype(jnp.int32)
+            code = lab + C
+            bits = jnp.arange(max_len, dtype=jnp.int32)
+            # length = floor(log2(code)): number of path edges.
+            length = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
+            valid = bits[None, :] < length[:, None]              # [N, L]
+            idx = jnp.where(valid, (code[:, None] >> (bits[None, :] + 1)) - 1, 0)
+            t = ((code[:, None] >> bits[None, :]) & 1).astype(x.dtype)
+            pre = jnp.einsum("nd,nld->nl", x, w[idx])            # [N, L]
+            if maybe_b:
+                pre = pre + maybe_b[0].reshape(-1)[idx]
+            pre = jnp.clip(pre, -40.0, 40.0)
+            loss = jax.nn.softplus(pre) - t * pre                # BCE-with-logits
+            return jnp.sum(jnp.where(valid, loss, 0), axis=1, keepdims=True)
+        args = (input, label, weight) + ((bias,) if bias is not None else ())
+        return apply("hsigmoid_loss", impl, *args)
+
+    def impl(x, lab, w, pt, pc, *maybe_b):
+        pt = pt.astype(jnp.int32)
+        valid = pt >= 0
+        idx = jnp.where(valid, pt, 0)
+        t = pc.astype(x.dtype)
+        pre = jnp.einsum("nd,nld->nl", x, w[idx])
+        if maybe_b:
+            pre = pre + maybe_b[0].reshape(-1)[idx]
+        pre = jnp.clip(pre, -40.0, 40.0)
+        loss = jax.nn.softplus(pre) - t * pre
+        return jnp.sum(jnp.where(valid, loss, 0), axis=1, keepdims=True)
+    args = (input, label, weight, path_table, path_code) + (
+        (bias,) if bias is not None else ())
+    return apply("hsigmoid_loss", impl, *args)
+
+
+def hierarchical_sigmoid(input, label, num_classes, weight, bias=None,
+                         path_table=None, path_code=None, is_sparse=False,
+                         name=None):
+    """Fluid-era alias (reference: fluid/layers/nn.py hsigmoid)."""
+    return hsigmoid_loss(input, label, num_classes, weight, bias,
+                         path_table, path_code, is_sparse)
+
+
+# -- NCE ----------------------------------------------------------------------
+
+def _log_uniform_prob(c, num_classes):
+    cf = c.astype(jnp.float32)
+    return jnp.log((cf + 2.0) / (cf + 1.0)) / np.log(num_classes + 1.0)
+
+
+def _sample_classes(key, shape, num_classes, sampler):
+    if sampler == "uniform":
+        s = jax.random.randint(key, shape, 0, num_classes)
+        p = jnp.full(shape, 1.0 / num_classes, jnp.float32)
+        return s, p
+    if sampler == "log_uniform":
+        u = jax.random.uniform(key, shape)
+        s = jnp.clip(
+            jnp.exp(u * np.log(num_classes + 1.0)).astype(jnp.int32) - 1,
+            0, num_classes - 1)
+        return s, _log_uniform_prob(s, num_classes)
+    raise ValueError(f"nce: unknown sampler {sampler!r} "
+                     "(uniform | log_uniform | custom_dist)")
+
+
+def nce(input, label, weight, bias=None, num_neg_samples=10,
+        num_total_classes=None, sampler="uniform", custom_dist=None,
+        seed=0, sample_weight=None, name=None):
+    """reference: operators/nce_op.h:80 (NCEKernel::Compute).
+
+    Per row: sample ``num_neg_samples`` negative classes, compute
+    ``o = sigmoid(x . w_c + b_c)`` for the true and sampled classes, and
+
+        cost = sum_true  -log(o / (o + b))  +  sum_neg -log(b / (o + b))
+
+    with ``b = P(class) * num_neg_samples`` (nce_op.h:203-205). The
+    reference samples on the host with a seeded std::mt19937; here the
+    negatives come from the Generator key stream (pass ``seed`` for a
+    fixed draw). Returns cost [N, 1].
+    """
+    C = int(num_total_classes if num_total_classes is not None
+            else _raw(weight).shape[0])
+    k = int(num_neg_samples)
+    key = _gen.next_key() if not seed else jax.random.PRNGKey(int(seed))
+
+    if sampler == "custom_dist":
+        probs = jnp.asarray(np.asarray(custom_dist, np.float32))
+
+    def impl(x, lab, w, *rest):
+        rest = list(rest)
+        b_vec = rest.pop(0) if bias is not None else None
+        sw = rest.pop(0) if sample_weight is not None else None
+        lab = lab.reshape(x.shape[0], -1).astype(jnp.int32)     # [N, T]
+        if sampler == "custom_dist":
+            neg = jax.random.categorical(key, jnp.log(probs + 1e-30)[None, :],
+                                         shape=(x.shape[0], k))
+            neg_p = probs[neg]
+        else:
+            neg, neg_p = _sample_classes(key, (x.shape[0], k), C, sampler)
+        classes = jnp.concatenate([lab, neg], axis=1)           # [N, T+k]
+        if sampler == "custom_dist":
+            p = probs[classes]
+        elif sampler == "uniform":
+            p = jnp.full(classes.shape, 1.0 / C, jnp.float32)
+        else:
+            p = _log_uniform_prob(classes, C)
+        logits = jnp.einsum("nd,nsd->ns", x, w[classes])
+        if b_vec is not None:
+            logits = logits + b_vec.reshape(-1)[classes]
+        o = jax.nn.sigmoid(logits)
+        bq = (p * k).astype(o.dtype)
+        T = lab.shape[1]
+        is_true = jnp.arange(classes.shape[1]) < T
+        cost = jnp.where(is_true[None, :],
+                         -jnp.log(o / (o + bq) + 1e-12),
+                         -jnp.log(bq / (o + bq) + 1e-12))
+        out = jnp.sum(cost, axis=1, keepdims=True)
+        if sw is not None:
+            out = out * sw.reshape(-1, 1)
+        return out
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if sample_weight is not None:
+        args.append(sample_weight)
+    return apply("nce", impl, *args)
+
+
+# -- class_center_sample ------------------------------------------------------
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """reference: operators/class_center_sample_op.cu (PartialFC sampling,
+    python/paddle/nn/functional/common.py class_center_sample).
+
+    Keeps every positive class center and pads with uniformly sampled
+    negatives up to ``num_samples``; returns (remapped_label [N],
+    sampled_class_center [num_samples]). Fixed-shape by construction:
+    positives sort first via a -1 key, negatives carry a random uniform
+    key, one argsort picks the sample set. Requires num_samples >= the
+    number of distinct positive classes (reference enforces the same).
+    """
+    C, S = int(num_classes), int(num_samples)
+    if S > C:
+        raise ValueError(f"class_center_sample: num_samples={S} > "
+                         f"num_classes={C}")
+    key = _gen.next_key()
+
+    def impl(lab):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        pos = jnp.zeros((C,), jnp.bool_).at[lab].set(True)
+        u = jax.random.uniform(key, (C,))
+        order = jnp.argsort(jnp.where(pos, -1.0, u))
+        sampled = jnp.sort(order[:S])                 # ascending like the ref
+        remap = jnp.zeros((C,), jnp.int32).at[sampled].set(
+            jnp.arange(S, dtype=jnp.int32))
+        return remap[lab], sampled
+    return apply("class_center_sample", impl, label)
+
+
+# -- sampling_id / sample_logits ---------------------------------------------
+
+def sampling_id(x, min=0, max=None, seed=0, dtype="int64", name=None):
+    """reference: operators/sampling_id_op.cc — one categorical draw per
+    row of a probability matrix [N, C]."""
+    key = _gen.next_key() if not seed else jax.random.PRNGKey(int(seed))
+
+    def impl(p):
+        return jax.random.categorical(
+            key, jnp.log(jnp.maximum(p, 1e-30)), axis=-1).astype(jnp.int64)
+    return apply("sampling_id", impl, x)
+
+
+def sample_logits(logits, label, num_samples, uniq=True,
+                  remove_accidental_hits=True, seed=0, name=None):
+    """reference: operators/sample_logits_op.cc — sampled-softmax
+    preparation: Samples = [true | log-uniform negatives], sampled logits
+    adjusted by -log(P(class)) (subtract-log-q), accidental hits masked to
+    -1e20. Returns (sampled_logits [N, T+S], sampled_label [N, T] — the
+    in-sample positions of the true classes, i.e. arange(T)).
+    """
+    S = int(num_samples)
+    key = _gen.next_key() if not seed else jax.random.PRNGKey(int(seed))
+
+    def impl(lg, lab):
+        n, C = lg.shape
+        lab = lab.reshape(n, -1).astype(jnp.int32)              # [N, T]
+        T = lab.shape[1]
+        neg, _ = _sample_classes(key, (n, S), C, "log_uniform")
+        classes = jnp.concatenate([lab, neg], axis=1)           # [N, T+S]
+        q = _log_uniform_prob(classes, C)
+        s_logits = jnp.take_along_axis(lg, classes, axis=1) - jnp.log(q)
+        if remove_accidental_hits:
+            hit = (neg[:, :, None] == lab[:, None, :]).any(-1)  # [N, S]
+            s_logits = s_logits.at[:, T:].set(
+                jnp.where(hit, -1e20, s_logits[:, T:]))
+        s_label = jnp.tile(jnp.arange(T, dtype=jnp.int64)[None, :], (n, 1))
+        return s_logits, s_label
+    return apply("sample_logits", impl, logits, label)

@@ -26,7 +26,13 @@ from .detection import (yolo_box, yolov3_loss, multiclass_nms,  # noqa: F401
                         collect_fpn_proposals, bipartite_match,
                         target_assign, box_decoder_and_assign,
                         polygon_box_transform, smooth_l1, matrix_nms,
-                        density_prior_box)
+                        density_prior_box, psroi_pool, prroi_pool,
+                        deformable_psroi_pooling)
+from .segment import (segment_sum, segment_mean, segment_max,  # noqa: F401
+                      segment_min, segment_pool)
+from .extras import *  # noqa: F401,F403
+from .crf import (linear_chain_crf, crf_decoding, viterbi_decode,  # noqa: F401
+                  chunk_eval)
 from .sequence import (sequence_mask, sequence_pad, sequence_unpad,  # noqa: F401
                        sequence_pool, sequence_first_step,
                        sequence_last_step, sequence_softmax,

@@ -1,0 +1,248 @@
+"""Linear-chain CRF ops (reference: operators/linear_chain_crf_op.h
+ForwardOneSequence, crf_decoding_op.h Decode, chunk_eval_op.h).
+
+The reference runs these CPU-only with hand-rolled L1-normalized scaling
+to avoid overflow; here the forward recursion is a ``lax.scan`` in
+log-space (logsumexp), which is both numerically cleaner and jit/grad-able
+— backward comes from autodiff instead of the reference's dedicated
+gradient kernel.
+
+Transition layout follows the reference: ``transition`` is [C+2, C] —
+row 0 start weights, row 1 stop weights, rows 2.. the square transition
+matrix. Ragged batches use the framework's padded+lengths convention.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["linear_chain_crf", "crf_decoding", "viterbi_decode",
+           "chunk_eval"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def linear_chain_crf(emission, transition, label, length=None, name=None):
+    """Negative log-likelihood of the labeled path (reference returns
+    ``-(score - logZ)``, linear_chain_crf_op.h:240).
+
+    emission [N, T, C]; transition [C+2, C]; label [N, T] int;
+    length [N] int (None = full length). Returns [N, 1].
+    """
+    def impl(em, tr, lab, *maybe_len):
+        N, T, C = em.shape
+        lab = lab.astype(jnp.int32)
+        lens = (maybe_len[0].astype(jnp.int32) if maybe_len
+                else jnp.full((N,), T, jnp.int32))
+        start, stop, W = tr[0], tr[1], tr[2:]
+
+        # -- logZ by forward recursion ---------------------------------------
+        def step(alpha, inp):
+            x_t, t = inp                                  # [N, C], scalar t
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + W[None, :, :], axis=1) + x_t
+            alpha = jnp.where((t < lens)[:, None], nxt, alpha)
+            return alpha, None
+        alpha0 = start[None, :] + em[:, 0]
+        ts = jnp.arange(1, T)
+        alphaT, _ = lax.scan(step, alpha0,
+                             (jnp.moveaxis(em[:, 1:], 1, 0), ts))
+        logZ = jax.scipy.special.logsumexp(alphaT + stop[None, :], axis=1)
+
+        # -- labeled path score ----------------------------------------------
+        t_idx = jnp.arange(T)
+        valid = t_idx[None, :] < lens[:, None]            # [N, T]
+        em_sc = jnp.take_along_axis(em, lab[:, :, None], 2)[:, :, 0]
+        em_score = jnp.sum(jnp.where(valid, em_sc, 0), axis=1)
+        tr_sc = W[lab[:, :-1], lab[:, 1:]]                # [N, T-1]
+        tr_valid = t_idx[None, 1:] < lens[:, None]
+        tr_score = jnp.sum(jnp.where(tr_valid, tr_sc, 0), axis=1)
+        last = jnp.take_along_axis(lab, (lens - 1)[:, None], 1)[:, 0]
+        score = (start[lab[:, 0]] + em_score + tr_score + stop[last])
+        return (logZ - score)[:, None]
+    args = (emission, transition, label) + ((length,)
+                                            if length is not None else ())
+    return apply("linear_chain_crf", impl, *args)
+
+
+def crf_decoding(emission, transition, label=None, length=None, name=None):
+    """Viterbi decode (reference: crf_decoding_op.h Decode). Returns the
+    best tag path [N, T] (positions past ``length`` are 0). If ``label``
+    is given, returns per-position correctness instead ([N, T] 0/1),
+    matching the reference's eval mode."""
+    def impl(em, tr, *rest):
+        rest = list(rest)
+        lab = rest.pop(0).astype(jnp.int32) if label is not None else None
+        lens = (rest.pop(0).astype(jnp.int32) if length is not None
+                else jnp.full((em.shape[0],), em.shape[1], jnp.int32))
+        N, T, C = em.shape
+        start, stop, W = tr[0], tr[1], tr[2:]
+
+        def fwd(carry, inp):
+            delta, t = carry, inp[1]
+            x_t = inp[0]
+            cand = delta[:, :, None] + W[None, :, :]      # [N, C_from, C_to]
+            best = jnp.max(cand, axis=1) + x_t
+            arg = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            nxt = jnp.where((t < lens)[:, None], best, delta)
+            arg = jnp.where((t < lens)[:, None], arg,
+                            jnp.tile(jnp.arange(C, dtype=jnp.int32)[None, :],
+                                     (N, 1)))
+            return nxt, arg
+        delta0 = start[None, :] + em[:, 0]
+        ts = jnp.arange(1, T)
+        deltaT, args_rev = lax.scan(fwd, delta0,
+                                    (jnp.moveaxis(em[:, 1:], 1, 0), ts))
+        lastbest = jnp.argmax(deltaT + stop[None, :], axis=1).astype(jnp.int32)
+
+        # Backtrack: args_rev[k] holds, for each tag at position k+1, its
+        # best predecessor at position k. reverse=True walks T-2..0 while
+        # emitting tags in position order.
+        def rebuild(tag, arg_t):
+            prev = jnp.take_along_axis(arg_t, tag[:, None], 1)[:, 0]
+            return prev, prev
+        _, prevs = lax.scan(rebuild, lastbest, args_rev, reverse=True)
+        full = jnp.concatenate([jnp.moveaxis(prevs, 0, 1),
+                                lastbest[:, None]], axis=1)
+        # mask positions beyond each row's length with 0
+        t_idx = jnp.arange(T)[None, :]
+        full = jnp.where(t_idx < lens[:, None], full, 0)
+        if lab is not None:
+            return (full == lab).astype(jnp.int64) * (t_idx < lens[:, None])
+        return full.astype(jnp.int64)
+    args = (emission, transition)
+    if label is not None:
+        args = args + (label,)
+    if length is not None:
+        args = args + (length,)
+    return apply("crf_decoding", impl, *args)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """reference: python/paddle/text/viterbi_decode.py ViterbiDecoder —
+    square [C, C] transition; with include_bos_eos_tag the last-but-one
+    column is BOS and the last is EOS (reference convention). Returns
+    (scores [N], paths [N, T])."""
+    def impl(em, tr, *maybe_len):
+        N, T, C = em.shape
+        lens = (maybe_len[0].astype(jnp.int32) if maybe_len
+                else jnp.full((N,), T, jnp.int32))
+        if include_bos_eos_tag:
+            start = tr[C - 2]                              # BOS row -> tags
+            stop = tr[:, C - 1]                            # tags -> EOS col
+        else:
+            start = jnp.zeros((C,), em.dtype)
+            stop = jnp.zeros((C,), em.dtype)
+
+        def fwd(delta, inp):
+            x_t, t = inp
+            cand = delta[:, :, None] + tr[None, :, :]
+            best = jnp.max(cand, axis=1) + x_t
+            arg = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            nxt = jnp.where((t < lens)[:, None], best, delta)
+            arg = jnp.where((t < lens)[:, None], arg,
+                            jnp.tile(jnp.arange(C, dtype=jnp.int32)[None, :],
+                                     (N, 1)))
+            return nxt, arg
+        delta0 = start[None, :] + em[:, 0]
+        deltaT, args_rev = lax.scan(
+            fwd, delta0, (jnp.moveaxis(em[:, 1:], 1, 0), jnp.arange(1, T)))
+        final = deltaT + stop[None, :]
+        lastbest = jnp.argmax(final, axis=1).astype(jnp.int32)
+        scores = jnp.max(final, axis=1)
+
+        def rebuild(tag, arg_t):
+            prev = jnp.take_along_axis(arg_t, tag[:, None], 1)[:, 0]
+            return prev, prev
+        _, prevs = lax.scan(rebuild, lastbest, args_rev, reverse=True)
+        full = jnp.concatenate([jnp.moveaxis(prevs, 0, 1),
+                                lastbest[:, None]], axis=1)
+        t_idx = jnp.arange(T)[None, :]
+        full = jnp.where(t_idx < lens[:, None], full, 0)
+        return scores, full.astype(jnp.int64)
+    args = (potentials, transition_params) + (
+        (lengths,) if lengths is not None else ())
+    return apply("viterbi_decode", impl, *args)
+
+
+# -- chunk_eval (host-side metric, like the reference's CPU-only kernel) ------
+
+def _extract_chunks(tags, scheme, num_chunk_types, excluded=()):
+    """Decode (chunk_type, begin, end) spans from a tag sequence under the
+    reference's tag layout: tag = chunk_type * num_tag_types + tag_type
+    (chunk_eval_op.h GetSegments; lenient conlleval-style parsing — a
+    stray continuation tag opens a chunk)."""
+    try:
+        n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    except KeyError:
+        raise ValueError(f"chunk_eval: unknown scheme {scheme!r}")
+    chunks = set()
+    open_type = None
+    start = 0
+
+    def emit(end):
+        nonlocal open_type
+        if open_type is not None and open_type not in excluded:
+            chunks.add((open_type, start, end))
+        open_type = None
+
+    for i, t in enumerate(tags):
+        t = int(t)
+        if 0 <= t < num_chunk_types * n_tag:
+            ct, tt = divmod(t, n_tag)
+        else:
+            ct = tt = None
+        if open_type is not None:
+            # does position i continue the open chunk?
+            cont = ct == open_type and (
+                scheme == "plain"
+                or (scheme == "IOB" and tt == 1)       # I continues
+                or scheme == "IOE"                      # I or E continue
+                or (scheme == "IOBES" and tt in (1, 2)))  # I/E continue
+            if not cont:
+                emit(i - 1)
+        if open_type is None and ct is not None:
+            open_type, start = ct, i
+        # tags that close the chunk at this position
+        if open_type is not None and (
+                (scheme == "IOE" and tt == 1)           # E
+                or (scheme == "IOBES" and tt in (2, 3))):  # E or S
+            emit(i)
+    emit(len(tags) - 1)
+    return chunks
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types, length=None,
+               excluded_chunk_types=None, name=None):
+    """reference: operators/chunk_eval_op.h — chunking precision/recall/F1.
+    Host-side numpy (it is an eval metric; the reference kernel is
+    CPU-only too). Returns (precision, recall, f1, num_infer_chunks,
+    num_label_chunks, num_correct_chunks) as python floats/ints."""
+    inf = np.asarray(_raw(input))
+    lab = np.asarray(_raw(label))
+    if inf.ndim == 1:
+        inf, lab = inf[None, :], lab[None, :]
+    lens = (np.asarray(_raw(length)) if length is not None
+            else np.full((inf.shape[0],), inf.shape[1], np.int64))
+    excluded = tuple(excluded_chunk_types or ())
+    n_inf = n_lab = n_cor = 0
+    for row_i, row_l, L in zip(inf, lab, lens):
+        ci = _extract_chunks(row_i[:int(L)], chunk_scheme, num_chunk_types,
+                             excluded)
+        cl = _extract_chunks(row_l[:int(L)], chunk_scheme, num_chunk_types,
+                             excluded)
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return p, r, f1, n_inf, n_lab, n_cor

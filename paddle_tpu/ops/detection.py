@@ -1002,3 +1002,231 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
             return out.reshape(-1, 4), v.reshape(-1, 4)
         return out, v
     return apply("density_prior_box", impl, input, image)
+
+
+# -- position-sensitive ROI pooling ------------------------------------------
+
+def _rois_batch_index(rois_num, R):
+    if rois_num is None:
+        return None
+    rn = np.asarray(rois_num._data if isinstance(rois_num, Tensor)
+                    else rois_num)
+    return np.repeat(np.arange(rn.shape[0]), rn).astype(np.int32)
+
+
+def psroi_pool(x, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """reference: operators/psroi_pool_op.cc:79 (CPUPSROIPoolOpKernel).
+
+    Position-sensitive ROI average pooling (R-FCN): input [N, C, H, W] with
+    C = output_channels * ph * pw; bin (i, j) of output channel c averages
+    input channel (c*ph + i)*pw + j over the bin region. The reference
+    walks each bin's pixels; bin edges are integer (floor/ceil of scaled
+    roi coords), so a summed-area table gives the same sums with static
+    shapes and one cumsum pass — no per-bin loops.
+
+    Output [R, output_channels, ph, pw]; empty bins are 0 (reference
+    ``is_empty`` branch).
+    """
+    ph, pw = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+    batch_of = _rois_batch_index(rois_num, None)
+
+    def impl(feat, boxes):
+        N, C, H, W = feat.shape
+        R = boxes.shape[0]
+        bidx = (jnp.asarray(batch_of) if batch_of is not None
+                else jnp.zeros((R,), jnp.int32))
+        # reference rounds the raw roi coords, then scales
+        x1 = jnp.round(boxes[:, 0]) * spatial_scale
+        y1 = jnp.round(boxes[:, 1]) * spatial_scale
+        x2 = jnp.round(boxes[:, 2] + 1.0) * spatial_scale
+        y2 = jnp.round(boxes[:, 3] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = jnp.arange(ph, dtype=feat.dtype)
+        ix = jnp.arange(pw, dtype=feat.dtype)
+        hs = jnp.clip(jnp.floor(iy[None, :] * bin_h[:, None] + y1[:, None]),
+                      0, H).astype(jnp.int32)                  # [R, ph]
+        he = jnp.clip(jnp.ceil((iy[None, :] + 1) * bin_h[:, None]
+                               + y1[:, None]), 0, H).astype(jnp.int32)
+        ws = jnp.clip(jnp.floor(ix[None, :] * bin_w[:, None] + x1[:, None]),
+                      0, W).astype(jnp.int32)                  # [R, pw]
+        we = jnp.clip(jnp.ceil((ix[None, :] + 1) * bin_w[:, None]
+                               + x1[:, None]), 0, W).astype(jnp.int32)
+        # summed-area table, zero-padded leading edge: [N, C, H+1, W+1]
+        sat = jnp.pad(jnp.cumsum(jnp.cumsum(
+            feat.astype(jnp.float32), axis=2), axis=3),
+            ((0, 0), (0, 0), (1, 0), (1, 0)))
+        sat_r = sat[bidx]                                       # [R,C,H1,W1]
+        cin = ((jnp.arange(oc)[:, None, None] * ph
+                + jnp.arange(ph)[None, :, None]) * pw
+               + jnp.arange(pw)[None, None, :])                 # [oc,ph,pw]
+        r_i = jnp.arange(R)[:, None, None, None]
+        c_i = cin[None]
+        h0 = hs[:, None, :, None]
+        h1 = he[:, None, :, None]
+        w0 = ws[:, None, None, :]
+        w1 = we[:, None, None, :]
+        s = (sat_r[r_i, c_i, h1, w1] - sat_r[r_i, c_i, h0, w1]
+             - sat_r[r_i, c_i, h1, w0] + sat_r[r_i, c_i, h0, w0])
+        count = ((he - hs)[:, None, :, None]
+                 * (we - ws)[:, None, None, :]).astype(jnp.float32)
+        out = jnp.where(count > 0, s / jnp.maximum(count, 1.0), 0.0)
+        return out.astype(feat.dtype)
+    return apply("psroi_pool", impl, x, rois)
+
+
+def _tri_integral(t):
+    """Antiderivative of the triangle kernel max(0, 1-|s|) evaluated at t:
+    g(t) = integral_{-1}^{t} max(0, 1-|s|) ds (piecewise quadratic)."""
+    t = jnp.clip(t, -1.0, 1.0)
+    return jnp.where(t <= 0, 0.5 * (t + 1.0) ** 2,
+                     0.5 + t * (1.0 - 0.5 * t))
+
+
+def prroi_pool(x, rois, pooled_height, pooled_width, spatial_scale=1.0,
+               rois_num=None, name=None):
+    """reference: operators/prroi_pool_op.cc (Precise RoI Pooling, no
+    quantization: the bin average is the exact integral of the bilinearly
+    interpolated feature over the continuous bin).
+
+    The bilinear surface is separable, so the integral factors into 1-D
+    triangle-kernel integrals per axis:
+
+        out[r,c,i,j] = (1/area) * sum_{h,w} feat[c,h,w] * Ih[r,i,h] * Iw[r,j,w]
+
+    with Ih/Iw closed-form (quadratic) antiderivative differences — the
+    whole op becomes two dense contractions, which XLA maps onto the MXU
+    (the reference GPU kernel instead walks pixels with atomicAdd).
+    """
+    ph, pw = int(pooled_height), int(pooled_width)
+    batch_of = _rois_batch_index(rois_num, None)
+
+    def impl(feat, boxes):
+        N, C, H, W = feat.shape
+        R = boxes.shape[0]
+        bidx = (jnp.asarray(batch_of) if batch_of is not None
+                else jnp.zeros((R,), jnp.int32))
+        b = boxes.astype(jnp.float32) * spatial_scale
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        bin_h = (y2 - y1) / ph                                  # [R]
+        bin_w = (x2 - x1) / pw
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        hs = y1[:, None] + iy[None, :] * bin_h[:, None]         # [R, ph]
+        he = hs + bin_h[:, None]
+        ws = x1[:, None] + ix[None, :] * bin_w[:, None]         # [R, pw]
+        we = ws + bin_w[:, None]
+        hh = jnp.arange(H, dtype=jnp.float32)
+        wws = jnp.arange(W, dtype=jnp.float32)
+        # weight of pixel h for bin i = g(he - h) - g(hs - h)
+        Ih = (_tri_integral(he[:, :, None] - hh[None, None, :])
+              - _tri_integral(hs[:, :, None] - hh[None, None, :]))  # [R,ph,H]
+        Iw = (_tri_integral(we[:, :, None] - wws[None, None, :])
+              - _tri_integral(ws[:, :, None] - wws[None, None, :]))  # [R,pw,W]
+        fr = feat.astype(jnp.float32)[bidx]                     # [R,C,H,W]
+        out = jnp.einsum("rchw,rih,rjw->rcij", fr, Ih, Iw)
+        area = jnp.maximum(bin_h[:, None, None, None]
+                           * bin_w[:, None, None, None], 1e-9)
+        return (out / area).astype(feat.dtype)
+    return apply("prroi_pool", impl, x, rois)
+
+
+def deformable_psroi_pooling(x, rois, trans, no_trans=False,
+                             spatial_scale=1.0, group_size=1,
+                             pooled_height=1, pooled_width=1, part_size=1,
+                             sample_per_part=4, trans_std=0.1,
+                             rois_num=None, name=None):
+    """reference: operators/deformable_psroi_pooling_op.cc
+    (DeformablePSROIPoolForwardCPUKernel): position-sensitive ROI pooling
+    with learned per-part offsets (Deformable R-FCN). Each bin is shifted
+    by ``trans[r, :, part_i, part_j] * trans_std * roi_extent`` then
+    averaged over a fixed ``sample_per_part`` x ``sample_per_part`` grid of
+    bilinear taps — the tap grid is static, so the op is one fused gather.
+
+    x [N, C, H, W] with C = oc * gs * gs; trans [R, 2, part, part]
+    (ignored when no_trans). Output [R, oc, ph, pw].
+    """
+    ph, pw = int(pooled_height), int(pooled_width)
+    gs, sp = int(group_size), int(sample_per_part)
+    pt = int(part_size)
+    batch_of = _rois_batch_index(rois_num, None)
+
+    def impl(feat, boxes, tr):
+        N, C, H, W = feat.shape
+        oc = C // (gs * gs)
+        R = boxes.shape[0]
+        bidx = (jnp.asarray(batch_of) if batch_of is not None
+                else jnp.zeros((R,), jnp.int32))
+        b = boxes.astype(jnp.float32)
+        # reference: round + 0.5-offset roi corners, min extent 0.1
+        x1 = jnp.round(b[:, 0]) * spatial_scale - 0.5
+        y1 = jnp.round(b[:, 1]) * spatial_scale - 0.5
+        x2 = (jnp.round(b[:, 2]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(b[:, 3]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ph                                          # [R]
+        bin_w = rw / pw
+        sub_h = bin_h / sp
+        sub_w = bin_w / sp
+        iy = jnp.arange(ph)
+        ix = jnp.arange(pw)
+        # part index of each bin (part grid may be coarser than the output)
+        py = jnp.clip((iy * pt) // ph, 0, pt - 1)                # [ph]
+        px = jnp.clip((ix * pt) // pw, 0, pt - 1)                # [pw]
+        if no_trans:
+            dy = jnp.zeros((R, ph, pw), jnp.float32)
+            dx = jnp.zeros((R, ph, pw), jnp.float32)
+        else:
+            cls = 0  # single offset class (reference: num_classes from trans)
+            # offset of bin (i, j) comes from its (part_i, part_j) cell
+            dy = tr[:, 2 * cls][:, py][:, :, px] * trans_std * rh[:, None, None]
+            dx = tr[:, 2 * cls + 1][:, py][:, :, px] * trans_std * rw[:, None, None]
+        s = jnp.arange(sp, dtype=jnp.float32)
+        # tap coords [R, ph(pw), sp]
+        ty = (y1[:, None] + iy[None, :] * bin_h[:, None])[:, :, None] \
+            + (s[None, None, :] + 0.5) * sub_h[:, None, None]
+        tx = (x1[:, None] + ix[None, :] * bin_w[:, None])[:, :, None] \
+            + (s[None, None, :] + 0.5) * sub_w[:, None, None]
+        ty = ty[:, :, None, :, None] + dy[:, :, :, None, None]   # [R,ph,pw,sp,1]
+        tx = tx[:, None, :, None, :] + dx[:, :, :, None, None]   # [R,ph,pw,1,sp]
+        ty = jnp.broadcast_to(ty, (R, ph, pw, sp, sp))
+        tx = jnp.broadcast_to(tx, (R, ph, pw, sp, sp))
+        # reference skips taps outside [-0.5, extent-0.5]
+        inside = ((ty >= -0.5) & (ty <= H - 0.5)
+                  & (tx >= -0.5) & (tx <= W - 0.5))
+        ty = jnp.clip(ty, 0.0, H - 1.0)
+        tx = jnp.clip(tx, 0.0, W - 1.0)
+        y0 = jnp.floor(ty).astype(jnp.int32)
+        x0 = jnp.floor(tx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        ay = ty - y0
+        ax = tx - x0
+        # position-sensitive input channel per (c, group bin)
+        gy = jnp.clip((iy * gs) // ph, 0, gs - 1)                # [ph]
+        gx = jnp.clip((ix * gs) // pw, 0, gs - 1)                # [pw]
+        cin = (jnp.arange(oc)[:, None, None] * gs
+               + gy[None, :, None]) * gs + gx[None, None, :]     # [oc,ph,pw]
+        fr = feat[bidx]                                          # [R,C,H,W]
+        r_i = jnp.arange(R)[:, None, None, None, None, None]
+        c_i = cin[None, :, :, :, None, None]
+        yA = y0[:, None]; yB = y1i[:, None]
+        xA = x0[:, None]; xB = x1i[:, None]
+        wA = ((1 - ay) * (1 - ax))[:, None]
+        wB = ((1 - ay) * ax)[:, None]
+        wC = (ay * (1 - ax))[:, None]
+        wD = (ay * ax)[:, None]
+        val = (fr[r_i, c_i, yA, xA] * wA + fr[r_i, c_i, yA, xB] * wB
+               + fr[r_i, c_i, yB, xA] * wC + fr[r_i, c_i, yB, xB] * wD)
+        m = inside[:, None].astype(val.dtype)
+        cnt = jnp.maximum(jnp.sum(m, axis=(-1, -2)), 1.0)
+        out = jnp.sum(val * m, axis=(-1, -2)) / cnt
+        return out.astype(feat.dtype)
+    if no_trans and trans is None:
+        trans = np.zeros((1, 2, pt, pt), np.float32)
+    return apply("deformable_psroi_pooling", impl, x, rois, trans)

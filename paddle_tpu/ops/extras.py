@@ -1,0 +1,577 @@
+"""Operator long tail: small tensor utilities, recommender-era feature ops,
+distillation/metric losses, and misc NN ops from the reference catalog
+(SURVEY Appendix A) that don't belong to a bigger family module.
+
+Everything is a fixed-shape jnp composition behind the dispatch funnel —
+these ops are glue, not FLOPs; the win is that they fuse into whatever jit
+region calls them instead of being standalone kernels like the reference's
+per-op CUDA implementations.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import apply
+from ..core.tensor import Tensor
+from ..core import generator as _gen
+
+__all__ = [
+    "shape", "size", "assign_value",
+    "fill_constant_batch_size_like", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "pad_constant_like",
+    "squared_l2_distance", "bpr_loss", "modified_huber_loss",
+    "teacher_student_sigmoid_loss", "center_loss", "mean_iou",
+    "precision_recall", "positive_negative_pair", "affine_channel",
+    "data_norm", "batch_fc", "partial_concat", "partial_sum",
+    "shuffle_batch", "cvm", "filter_by_instag", "row_conv", "conv_shift",
+    "add_position_encoding", "correlation", "similarity_focus", "fsp",
+    "spp", "max_unpool2d", "match_matrix_tensor", "margin_rank_loss",
+]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# -- tensor utilities ---------------------------------------------------------
+
+def shape(input, name=None):
+    """reference: operators/shape_op.cc — runtime shape as an int32 tensor.
+    Shapes are trace-time constants under XLA, so this is a constant."""
+    return apply("shape", lambda x: jnp.asarray(x.shape, jnp.int32), input)
+
+
+def size(input, name=None):
+    """reference: operators/size_op.cc — numel as an integer scalar (the
+    framework's default int width; x64 is off under jit)."""
+    return apply("size", lambda x: jnp.asarray(x.size), input)
+
+
+def assign_value(shape, dtype, values, name=None):
+    """reference: operators/assign_value_op.cc — materialize a constant."""
+    from .creation import to_tensor
+    return to_tensor(np.asarray(values, dtype).reshape(shape))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    """reference: operators/fill_constant_batch_size_like_op.cc."""
+    shp = list(shape)
+    shp[output_dim_idx] = _raw(input).shape[input_dim_idx]
+
+    def impl(x):
+        return jnp.full(shp, value, np.dtype(dtype))
+    return apply("fill_constant_batch_size_like", impl, input)
+
+
+def uniform_random_batch_size_like(input, shape, low=-1.0, high=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   dtype="float32", name=None):
+    """reference: operators/uniform_random_batch_size_like_op.cc."""
+    shp = list(shape)
+    shp[output_dim_idx] = _raw(input).shape[input_dim_idx]
+    key = _gen.next_key()
+
+    def impl(x):
+        return jax.random.uniform(key, shp, np.dtype(dtype), low, high)
+    return apply("uniform_random_batch_size_like", impl, input)
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    dtype="float32", name=None):
+    """reference: operators/gaussian_random_batch_size_like_op.cc."""
+    shp = list(shape)
+    shp[output_dim_idx] = _raw(input).shape[input_dim_idx]
+    key = _gen.next_key()
+
+    def impl(x):
+        return mean + std * jax.random.normal(key, shp, np.dtype(dtype))
+    return apply("gaussian_random_batch_size_like", impl, input)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """reference: operators/pad_constant_like_op.cc — pad ``y`` at the high
+    end of every axis up to ``x``'s shape."""
+    tgt = _raw(x).shape
+
+    def impl(xx, yy):
+        pads = [(0, int(t) - int(s)) for t, s in zip(tgt, yy.shape)]
+        return jnp.pad(yy, pads, constant_values=pad_value)
+    return apply("pad_constant_like", impl, x, y)
+
+
+# -- losses -------------------------------------------------------------------
+
+def squared_l2_distance(x, y, name=None):
+    """reference: operators/squared_l2_distance_op.cc — rowwise ||x-y||^2,
+    output [N, 1]."""
+    def impl(a, b):
+        d = a.reshape(a.shape[0], -1) - b.reshape(b.shape[0], -1)
+        return jnp.sum(d * d, axis=1, keepdims=True)
+    return apply("squared_l2_distance", impl, x, y)
+
+
+def bpr_loss(input, label, name=None):
+    """reference: operators/bpr_loss_op.h:70 — Bayesian Personalized
+    Ranking: loss[i] = mean over j != y of softplus(x_j - x_y)."""
+    def impl(lg, lab):
+        n, c = lg.shape
+        pos = jnp.take_along_axis(lg, lab.reshape(n, 1).astype(jnp.int32), 1)
+        sp = jax.nn.softplus(lg - pos)                  # [N, C]; j==y -> log 2
+        mask = jax.nn.one_hot(lab.reshape(n), c, dtype=lg.dtype)
+        return (jnp.sum(sp * (1 - mask), axis=1, keepdims=True)
+                / (c - 1)).astype(lg.dtype)
+    return apply("bpr_loss", impl, input, label)
+
+
+def modified_huber_loss(input, label, name=None):
+    """reference: operators/modified_huber_loss_op.h:43 — inter = x*(2y-1);
+    loss = -4*inter if inter < -1; (1-inter)^2 if inter < 1; else 0."""
+    def impl(x, y):
+        inter = x * (2.0 * y.astype(x.dtype) - 1.0)
+        return jnp.where(inter < -1.0, -4.0 * inter,
+                         jnp.where(inter < 1.0, (1.0 - inter) ** 2, 0.0))
+    return apply("modified_huber_loss", impl, input, label)
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    """reference: operators/teacher_student_sigmoid_loss_op.h:34 — CTR
+    distillation loss; label encodes (click z, teacher score z'):
+    label < -1: z=0 no teacher; label in [-1,0): z=1 no teacher;
+    label in [0,1): z=0, z'=label; label >= 1: z=1, z'=label-1."""
+    def impl(x, lab):
+        x = x.reshape(-1)
+        lab = lab.reshape(-1).astype(x.dtype)
+
+        def part(xx, t):
+            return jnp.maximum(xx, 0) - xx * t + jnp.log1p(jnp.exp(-jnp.abs(xx)))
+        xc = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+        z = jnp.where(lab < -1.0, 0.0,
+                      jnp.where(lab < 0.0, 1.0, jnp.where(lab < 1.0, 0.0, 1.0)))
+        has_teacher = lab >= 0.0
+        zprime = jnp.where(lab < 1.0, lab, lab - 1.0)
+        loss = part(x, z) + jnp.where(has_teacher, part(xc, zprime), 0.0)
+        return loss.reshape(-1, 1)
+    return apply("teacher_student_sigmoid_loss", impl, input, label)
+
+
+def center_loss(input, label, centers, alpha=0.1, update_center=True,
+                name=None):
+    """reference: operators/center_loss_op.cc — loss = 0.5||x - c_y||^2 per
+    sample; returns (loss [N,1], new_centers) where new_centers applies the
+    reference's count-normalized update c_y -= alpha * mean(c_y - x_i)."""
+    def impl(x, lab, c):
+        lab = lab.reshape(-1).astype(jnp.int32)
+        diff = x - c[lab]                                # [N, D]
+        loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+        if not update_center:
+            return loss, c
+        n_cls = c.shape[0]
+        cnt = jnp.zeros((n_cls,), x.dtype).at[lab].add(1.0)
+        upd = jnp.zeros_like(c).at[lab].add(diff)
+        new_c = c - alpha * upd / (1.0 + cnt)[:, None]
+        return loss, new_c
+    return apply("center_loss", impl, input, label, centers)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """reference: operators/margin_rank_loss_op.cc — fluid argument order:
+    out = max(0, -label*(left-right) + margin)."""
+    def impl(lab, l, r):
+        return jnp.maximum(0.0, -lab * (l - r) + margin)
+    return apply("margin_rank_loss", impl, label, left, right)
+
+
+# -- metrics-as-ops -----------------------------------------------------------
+
+def mean_iou(input, label, num_classes, name=None):
+    """reference: operators/mean_iou_op.cc — (mean_iou scalar,
+    out_wrong [C], out_correct [C])."""
+    C = int(num_classes)
+
+    def impl(pred, lab):
+        pred = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        correct = jnp.zeros((C,), jnp.int32).at[lab].add(
+            (pred == lab).astype(jnp.int32))
+        wrong_pred = jnp.zeros((C,), jnp.int32).at[pred].add(
+            (pred != lab).astype(jnp.int32))
+        wrong_lab = jnp.zeros((C,), jnp.int32).at[lab].add(
+            (pred != lab).astype(jnp.int32))
+        wrong = wrong_pred + wrong_lab
+        denom = correct + wrong
+        valid = denom > 0
+        iou = jnp.where(valid, correct / jnp.maximum(denom, 1), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+        return miou.astype(jnp.float32), wrong, correct
+    return apply("mean_iou", impl, input, label)
+
+
+def precision_recall(max_probs, label, num_classes, weights=None, name=None):
+    """reference: operators/precision_recall_op.cc — multiclass
+    macro/micro precision, recall, F1. Input is the argmax'd prediction
+    (ids) or probability rows; returns batch_metrics [6]:
+    [macro-P, macro-R, macro-F1, micro-P, micro-R, micro-F1]."""
+    C = int(num_classes)
+
+    def impl(pred, lab):
+        if pred.ndim == 2:
+            ids = jnp.argmax(pred, axis=1).astype(jnp.int32)
+        else:
+            ids = pred.reshape(-1).astype(jnp.int32)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        hit = (ids == lab).astype(jnp.float32)
+        tp = jnp.zeros((C,), jnp.float32).at[lab].add(hit)
+        fn = jnp.zeros((C,), jnp.float32).at[lab].add(1 - hit)
+        fp = jnp.zeros((C,), jnp.float32).at[ids].add(1 - hit)
+
+        def safe(n, d):
+            return jnp.where(d > 0, n / jnp.maximum(d, 1e-12), 0.0)
+        prec = safe(tp, tp + fp)
+        rec = safe(tp, tp + fn)
+        f1 = safe(2 * prec * rec, prec + rec)
+        present = (tp + fn + fp) > 0
+        k = jnp.maximum(jnp.sum(present), 1)
+        macro = (jnp.sum(jnp.where(present, prec, 0)) / k,
+                 jnp.sum(jnp.where(present, rec, 0)) / k,
+                 jnp.sum(jnp.where(present, f1, 0)) / k)
+        TP, FP, FN = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+        micro_p = safe(TP, TP + FP)
+        micro_r = safe(TP, TP + FN)
+        micro_f = safe(2 * micro_p * micro_r, micro_p + micro_r)
+        return jnp.stack([macro[0], macro[1], macro[2],
+                          micro_p, micro_r, micro_f])
+    return apply("precision_recall", impl, max_probs, label)
+
+
+def positive_negative_pair(score, label, query_id, name=None):
+    """reference: operators/positive_negative_pair_op.cc — within each
+    query, count ordered pairs: positive (higher-labeled doc scored
+    higher), negative (scored lower), neutral (tied score)."""
+    def impl(s, lab, q):
+        s = s.reshape(-1)
+        lab = lab.reshape(-1)
+        q = q.reshape(-1)
+        same_q = q[:, None] == q[None, :]
+        higher = lab[:, None] > lab[None, :]
+        valid = same_q & higher
+        sd = s[:, None] - s[None, :]
+        pos = jnp.sum(valid & (sd > 0))
+        neg = jnp.sum(valid & (sd < 0))
+        neu = jnp.sum(valid & (sd == 0))
+        f = jnp.float32
+        return pos.astype(f), neg.astype(f), neu.astype(f)
+    return apply("positive_negative_pair", impl, score, label, query_id)
+
+
+# -- recommender feature ops --------------------------------------------------
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    """reference: operators/affine_channel_op.cc — per-channel y = x*s + b."""
+    def impl(xx, s, b):
+        if data_layout == "NCHW":
+            shp = (1, -1) + (1,) * (xx.ndim - 2)
+        else:
+            shp = (1,) * (xx.ndim - 1) + (-1,)
+        return xx * s.reshape(shp) + b.reshape(shp)
+    return apply("affine_channel", impl, x, scale, bias)
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4,
+              name=None):
+    """reference: operators/data_norm_op.cc:302 — means = sum/size,
+    scales = sqrt(size/square_sum); y = (x - mean) * scale. Returns
+    (y, means, scales)."""
+    def impl(xx, bsz, bsum, bsq):
+        means = bsum / bsz
+        scales = jnp.sqrt(bsz / bsq)
+        return (xx - means[None, :]) * scales[None, :], means, scales
+    return apply("data_norm", impl, x, batch_size, batch_sum,
+                 batch_square_sum)
+
+
+def batch_fc(input, w, bias=None, name=None):
+    """reference: operators/batch_fc_op.cc — per-slot FC:
+    input [S, N, D] x w [S, D, O] (+ bias [S, 1, O]) -> [S, N, O]."""
+    def impl(x, ww, *b):
+        out = jnp.einsum("snd,sdo->sno", x, ww)
+        if b:
+            out = out + b[0]
+        return out
+    args = (input, w) + ((bias,) if bias is not None else ())
+    return apply("batch_fc", impl, *args)
+
+
+def partial_concat(xs, start_index=0, length=-1, name=None):
+    """reference: operators/partial_concat_op.cc — concat the column slice
+    [start_index, start_index+length) of each 2-D input."""
+    def impl(arrs):
+        outs = []
+        for a in arrs:
+            st = start_index if start_index >= 0 else a.shape[1] + start_index
+            ln = a.shape[1] - st if length < 0 else length
+            outs.append(lax.slice_in_dim(a, st, st + ln, axis=1))
+        return jnp.concatenate(outs, axis=1)
+    return apply("partial_concat", impl, list(xs))
+
+
+def partial_sum(xs, start_index=0, length=-1, name=None):
+    """reference: operators/partial_sum_op.cc — sum of identical column
+    slices across inputs."""
+    def impl(arrs):
+        outs = []
+        for a in arrs:
+            st = start_index if start_index >= 0 else a.shape[1] + start_index
+            ln = a.shape[1] - st if length < 0 else length
+            outs.append(lax.slice_in_dim(a, st, st + ln, axis=1))
+        return sum(outs[1:], outs[0])
+    return apply("partial_sum", impl, list(xs))
+
+
+def shuffle_batch(x, seed=0, name=None):
+    """reference: operators/shuffle_batch_op.cc — random row permutation;
+    returns (shuffled, shuffle_idx) so the caller can unshuffle."""
+    key = _gen.next_key() if not seed else jax.random.PRNGKey(int(seed))
+
+    def impl(xx):
+        idx = jax.random.permutation(key, xx.shape[0])
+        return xx[idx], idx.astype(jnp.int64)
+    return apply("shuffle_batch", impl, x)
+
+
+def cvm(x, use_cvm=True, name=None):
+    """reference: operators/cvm_op.cc — CTR show/click feature transform.
+    Columns 0/1 are (show, click); use_cvm=True keeps them log-transformed
+    (log(show+1), log(click+1)-log(show+1)); False drops them."""
+    def impl(xx):
+        show = jnp.log(xx[:, :1] + 1.0)
+        click = jnp.log(xx[:, 1:2] + 1.0) - show
+        if use_cvm:
+            return jnp.concatenate([show, click, xx[:, 2:]], axis=1)
+        return xx[:, 2:]
+    return apply("cvm", impl, x)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=False,
+                     out_val_if_empty=0, name=None):
+    """reference: operators/filter_by_instag_op.cc — keep rows whose tag
+    set intersects ``filter_tag``. The reference compacts rows (LoD);
+    fixed-shape convention here: kept rows stay, dropped rows are
+    ``out_val_if_empty``, plus (mask, loss_weight) outputs. Callers that
+    need compaction do it host-side."""
+    ft = np.asarray(_raw(filter_tag)).reshape(-1)
+
+    def impl(x, tags):
+        hit = jnp.zeros((tags.shape[0],), jnp.bool_)
+        for t in ft.tolist():  # filter set is a small static list
+            hit = hit | jnp.any(tags == t, axis=-1)
+        m = hit
+        shp = (-1,) + (1,) * (x.ndim - 1)
+        out = jnp.where(m.reshape(shp), x,
+                        jnp.asarray(out_val_if_empty, x.dtype))
+        return out, m, m.astype(x.dtype)
+    return apply("filter_by_instag", impl, ins, ins_tag)
+
+
+# -- misc NN ops --------------------------------------------------------------
+
+def row_conv(x, weight, name=None):
+    """reference: operators/row_conv_op.cc — lookahead row convolution
+    (DeepSpeech2): out_t = sum_{j=0}^{ctx-1} x_{t+j} * w_j (elementwise
+    over D). x [B, T, D], weight [ctx, D]."""
+    def impl(xx, w):
+        ctx = w.shape[0]
+        pad = jnp.pad(xx, ((0, 0), (0, ctx - 1), (0, 0)))
+        out = jnp.zeros_like(xx)
+        for j in range(ctx):  # ctx is small & static; XLA fuses the adds
+            out = out + pad[:, j:j + xx.shape[1], :] * w[j][None, None, :]
+        return out
+    return apply("row_conv", impl, x, weight)
+
+
+def conv_shift(x, y, name=None):
+    """reference: operators/conv_shift_op.cc — circular convolution
+    (NTM-style shift): x [B, N], y [B, M] (M odd, M <= N);
+    out[b, i] = sum_j x[b, (i + j - M//2) mod N] * y[b, j]."""
+    def impl(xx, yy):
+        n = xx.shape[1]
+        m = yy.shape[1]
+        half = m // 2
+        idx = (jnp.arange(n)[:, None] + jnp.arange(m)[None, :] - half) % n
+        return jnp.einsum("bnm,bm->bn", xx[:, idx], yy)
+    return apply("conv_shift", impl, x, y)
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """reference: operators/add_position_encoding_op.cc — out = alpha*x +
+    beta*PE with the interleaved sin/cos transformer encoding
+    (PE[pos, i] = sin(pos/10000^(2i/D)) for the first half, cos for the
+    second — matching the reference's half-split layout)."""
+    def impl(xx):
+        b, t, d = xx.shape
+        half = d // 2
+        pos = jnp.arange(t, dtype=xx.dtype)[:, None]
+        div = jnp.power(jnp.asarray(10000.0, xx.dtype),
+                        jnp.arange(half, dtype=xx.dtype) / half)
+        pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                             axis=1)
+        if d % 2:
+            pe = jnp.pad(pe, ((0, 0), (0, 1)))
+        return alpha * xx + beta * pe[None]
+    return apply("add_position_encoding", impl, x)
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """reference: operators/correlation_op.cc (FlowNet correlation).
+    Cost volume between two feature maps: for each displacement (dy, dx)
+    in the search window, out channel = mean over C of
+    x[..., h, w] * y[..., h+dy, w+dx] (kernel_size=1 form; larger kernels
+    average over the patch)."""
+    def impl(a, b):
+        N, C, H, W = a.shape
+        d = max_displacement // stride2
+        disp = [(dy * stride2, dx * stride2)
+                for dy in range(-d, d + 1) for dx in range(-d, d + 1)]
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+        outs = []
+        for dy, dx in disp:
+            oy, ox = pad_size + dy, pad_size + dx
+            shifted = lax.dynamic_slice(bp, (0, 0, oy, ox), (N, C, H, W))
+            outs.append(jnp.mean(a * shifted, axis=1))
+        out = jnp.stack(outs, axis=1)                    # [N, D*D, H, W]
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+    return apply("correlation", impl, x, y)
+
+
+def similarity_focus(x, axis, indexes, name=None):
+    """reference: operators/similarity_focus_op.cc — greedy row/column
+    -exclusive argmax mask over X[:, idx] (axis=1), OR-ed across indexes,
+    broadcast back to x's shape."""
+    if axis != 1:
+        raise ValueError("similarity_focus: reference supports axis=1")
+
+    def impl(xx):
+        N, C, B, Cc = xx.shape
+        K = min(B, Cc)
+
+        def per_index(t):                                # t: [N, B, Cc]
+            def body(carry, _):
+                t_masked, mask = carry
+                flat = t_masked.reshape(N, -1)
+                am = jnp.argmax(flat, axis=1)
+                i, j = am // Cc, am % Cc
+                mask = mask.at[jnp.arange(N), i, j].set(1.0)
+                row_used = jnp.zeros((N, B), bool).at[jnp.arange(N), i].set(True)
+                col_used = jnp.zeros((N, Cc), bool).at[jnp.arange(N), j].set(True)
+                t_masked = jnp.where(row_used[:, :, None] | col_used[:, None, :],
+                                     -jnp.inf, t_masked)
+                return (t_masked, mask), None
+            init = (t, jnp.zeros((N, B, Cc), xx.dtype))
+            (_, mask), _ = lax.scan(body, init, None, length=K)
+            return mask
+        mask = jnp.zeros((N, B, Cc), xx.dtype)
+        for idx in indexes:
+            mask = jnp.maximum(mask, per_index(xx[:, idx]))
+        return jnp.broadcast_to(mask[:, None], xx.shape)
+    return apply("similarity_focus", impl, x)
+
+
+def fsp(x, y, name=None):
+    """reference: operators/fsp_op.cc — FSP matrix for distillation:
+    out[b, i, j] = (1/(H*W)) sum_hw x[b,i,h,w] * y[b,j,h,w]."""
+    def impl(a, b):
+        hw = a.shape[2] * a.shape[3]
+        return jnp.einsum("bihw,bjhw->bij", a, b) / hw
+    return apply("fsp", impl, x, y)
+
+
+def spp(x, pyramid_height, pool_type="max", name=None):
+    """reference: operators/spp_op.cc — spatial pyramid pooling: levels
+    l = 0..height-1 pool to a 2^l x 2^l grid, flattened and concatenated
+    -> [N, C * sum(4^l)]. The reference computes kernel=ceil(H/bins) with
+    zero-padding; here each level is an adaptive pool (identical when the
+    bins divide H/W, the usual SPP deployment)."""
+    def impl(xx):
+        outs = []
+        for l in range(int(pyramid_height)):
+            p = _adaptive_pool(xx, 2 ** l,
+                               "max" if pool_type == "max" else "avg")
+            outs.append(p.reshape(xx.shape[0], -1))
+        return jnp.concatenate(outs, axis=1)
+    return apply("spp", impl, x)
+
+
+def _adaptive_pool(x, bins, kind):
+    n, c, h, w = x.shape
+    # integer-boundary adaptive pooling (start/end like the reference's
+    # AdaptStartIndex/AdaptEndIndex)
+    hs = [(i * h) // bins for i in range(bins)]
+    he = [-(-((i + 1) * h) // bins) for i in range(bins)]
+    ws = [(j * w) // bins for j in range(bins)]
+    we = [-(-((j + 1) * w) // bins) for j in range(bins)]
+    rows = []
+    for i in range(bins):
+        cols = []
+        for j in range(bins):
+            region = x[:, :, hs[i]:he[i], ws[j]:we[j]]
+            cols.append(region.max((2, 3)) if kind == "max"
+                        else region.mean((2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)                      # [N, C, bins, bins]
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, name=None):
+    """reference: operators/unpool_op.cc — scatter pooled values back to
+    the positions recorded by max_pool2d(return_mask=True) (flattened
+    per-channel HW index convention)."""
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else ((stride, stride)
+                                    if isinstance(stride, int)
+                                    else tuple(stride))
+
+    def impl(xx, idx):
+        n, c, ph, pw = xx.shape
+        if output_size is not None:
+            oh, ow = output_size
+        else:
+            oh = (ph - 1) * st[0] + ks[0] - 2 * padding
+            ow = (pw - 1) * st[1] + ks[1] - 2 * padding
+        flat = jnp.zeros((n, c, oh * ow), xx.dtype)
+        out = flat.at[jnp.arange(n)[:, None, None],
+                      jnp.arange(c)[None, :, None],
+                      idx.reshape(n, c, -1).astype(jnp.int32)].set(
+            xx.reshape(n, c, -1))
+        return out.reshape(n, c, oh, ow)
+    return apply("max_unpool2d", impl, x, indices)
+
+
+def match_matrix_tensor(x, y, w, x_lengths=None, y_lengths=None, name=None):
+    """reference: operators/match_matrix_tensor_op.cc — text-matching
+    gram matrix: out[b, t, i, j] = x[b,i,:] . W[:,t,:] . y[b,j,:] over the
+    padded+lengths ragged convention (LoD in the reference); padding
+    positions are masked to 0."""
+    def impl(xx, yy, ww, *lens):
+        out = jnp.einsum("bid,dte,bje->btij", xx, ww, yy)
+        if lens:
+            xl, yl = lens
+            mi = jnp.arange(xx.shape[1])[None, :] < xl[:, None]   # [B, Tx]
+            mj = jnp.arange(yy.shape[1])[None, :] < yl[:, None]   # [B, Ty]
+            out = out * (mi[:, None, :, None] & mj[:, None, None, :])
+        return out
+    args = (x, y, w) + ((x_lengths, y_lengths)
+                        if x_lengths is not None else ())
+    return apply("match_matrix_tensor", impl, *args)

@@ -1,0 +1,104 @@
+"""Segment reductions (reference: operators/segment_pool_op.cc:22,
+python/paddle/incubate/tensor/math.py segment_sum/mean/max/min).
+
+The reference kernel walks sorted ``segment_ids`` on CPU / uses a CUB scan
+on GPU; here each reduction lowers to ``jax.ops.segment_*`` which XLA turns
+into a single sorted-scatter — MXU-irrelevant but HBM-friendly (one pass).
+
+Shape contract: ``segment_ids`` is [N] int, sorted ascending, possibly with
+gaps (empty segments produce 0 for sum/mean and 0 for max/min to match the
+reference's "empty segment -> 0" convention, segment_pool_op.cc
+SegmentKernelLaunchHelper). The number of segments is data-dependent; under
+``jit`` pass ``num_segments`` explicitly (static), in eager it is read from
+the concrete ids.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "segment_pool"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _num_segments(segment_ids, num_segments):
+    if num_segments is not None:
+        return int(num_segments)
+    ids = _raw(segment_ids)
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment_* under jit needs a static num_segments= (the output "
+            "shape is data-dependent); pass it explicitly.")
+    return int(np.asarray(ids).max()) + 1 if ids.shape[0] else 0
+
+
+def _segment(name, data, segment_ids, num_segments, reducer, empty_fill):
+    n = _num_segments(segment_ids, num_segments)
+
+    def impl(d, ids):
+        out = reducer(d, ids, num_segments=n)
+        # reference: empty segments are 0-filled, not +/-inf.
+        if empty_fill is not None:
+            counts = jax.ops.segment_sum(jnp.ones_like(ids, jnp.int32), ids,
+                                         num_segments=n)
+            shape = (n,) + (1,) * (d.ndim - 1)
+            out = jnp.where(counts.reshape(shape) > 0, out, empty_fill)
+        return out
+    return apply(name, impl, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    """reference: incubate/tensor/math.py segment_sum -> segment_pool_op
+    (pooltype SUM)."""
+    return _segment("segment_sum", data, segment_ids, num_segments,
+                    jax.ops.segment_sum, None)
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    """reference: segment_pool_op (pooltype MEAN); empty segments -> 0."""
+    n = _num_segments(segment_ids, num_segments)
+
+    def impl(d, ids):
+        s = jax.ops.segment_sum(d, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        c = c.reshape(shape)
+        return jnp.where(c > 0, s / jnp.maximum(c, 1), 0).astype(d.dtype)
+    return apply("segment_mean", impl, data, segment_ids)
+
+
+def segment_max(data, segment_ids, num_segments=None, name=None):
+    """reference: segment_pool_op (pooltype MAX); empty segments -> 0."""
+    return _segment("segment_max", data, segment_ids, num_segments,
+                    jax.ops.segment_max, 0)
+
+
+def segment_min(data, segment_ids, num_segments=None, name=None):
+    """reference: segment_pool_op (pooltype MIN); empty segments -> 0."""
+    return _segment("segment_min", data, segment_ids, num_segments,
+                    jax.ops.segment_min, 0)
+
+
+_POOLS = {"SUM": segment_sum, "MEAN": segment_mean, "MAX": segment_max,
+          "MIN": segment_min}
+
+
+def segment_pool(data, segment_ids, pooltype="SUM", num_segments=None,
+                 name=None):
+    """The raw op facade (reference: segment_pool_op.cc:22 attr
+    ``pooltype``)."""
+    try:
+        fn = _POOLS[pooltype.upper()]
+    except KeyError:
+        raise ValueError(f"segment_pool: unknown pooltype {pooltype!r}; "
+                         f"one of {sorted(_POOLS)}")
+    return fn(data, segment_ids, num_segments=num_segments)

@@ -24,3 +24,33 @@ def _seed_all():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+# Per-test wall-clock timeout (reference: the test scheduler's per-UT
+# timeout, unittests/CMakeLists.txt set_tests_properties TIMEOUT). No
+# pytest-timeout in this image, so a SIGALRM guard: default 300 s, override
+# with @pytest.mark.timeout_s(N).
+import signal  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout(request):
+    marker = request.node.get_closest_marker("timeout_s")
+    limit = int(marker.args[0]) if marker else 300
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {limit}s wall-clock (per-test timeout guard)")
+    if hasattr(signal, "SIGALRM"):
+        old = signal.signal(signal.SIGALRM, _alarm)
+        signal.alarm(limit)
+        yield
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    else:  # pragma: no cover
+        yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout_s(n): per-test wall-clock limit in seconds")

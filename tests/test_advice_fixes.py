@@ -332,6 +332,7 @@ class TestMoeBf16SlotCounting:
     local tokens routed to one expert silently collided into the same
     slot."""
 
+    @pytest.mark.slow
     def test_bf16_over_256_tokens_no_collision(self):
         import jax.numpy as jnp
         import paddle_tpu.distributed as dist

@@ -28,6 +28,7 @@ class TestRegisterOp:
 
 
 class TestPallasNMS:
+    @pytest.mark.slow
     def test_matches_scan_reference(self):
         from paddle_tpu.ops.detection import (_pairwise_iou,
                                               _greedy_nms_mask)

@@ -172,6 +172,7 @@ class TestYolov3Loss:
         gt_label[1, 1] = 2
         return x, gt_box, gt_label, anchors, mask, C
 
+    @pytest.mark.slow
     def test_loss_finite_positive_and_grad(self):
         x, gt_box, gt_label, anchors, mask, C = self._data()
         xt = paddle.to_tensor(x, stop_gradient=False)

@@ -202,6 +202,7 @@ class TestDeformableConv:
 
 
 class TestYoloEndToEnd:
+    @pytest.mark.slow
     def test_loss_and_postprocess_pipeline(self):
         """YOLOv3-style train+infer slice: yolov3_loss on a head output,
         then yolo_box -> multiclass_nms postprocess (VERDICT r2 item 5
@@ -331,6 +332,7 @@ class TestRoiAlignAdaptiveApprox:
                     out[r, :, i, j] = acc / (gy * gx)
         return out
 
+    @pytest.mark.slow
     def test_large_roi_adaptive_grid_exact(self):
         rng = np.random.default_rng(0)
         feat = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)

@@ -120,6 +120,7 @@ class TestCollectives:
         expected[5] = 1.0
         np.testing.assert_allclose(np.asarray(out), expected)
 
+    @pytest.mark.slow
     def test_all_reduce_grad(self):
         # psum is differentiable: d/dx of sum-over-ranks distributes back
         def loss(x):

@@ -262,3 +262,64 @@ class TestConversionFallbacks:
         sf = jit.to_static(f)
         with pytest.raises(Exception):
             sf(T(np.ones(3, np.float32)))
+
+
+class TestRound4ReviewFixes:
+    """Regression tests for the round-4 review findings on ast_transform."""
+
+    def test_generator_branch_not_resliced(self):
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def gen(flag):
+            if flag:
+                yield 1
+            yield 2
+
+        g2 = convert_function(gen)
+        assert list(g2(True)) == [1, 2]
+        assert list(g2(False)) == [2]
+
+    def test_yield_inside_branch_refused(self):
+        from paddle_tpu.jit.ast_transform import convert_function
+        import inspect
+
+        def uses_yield_in_if(flag):
+            out = []
+            if flag:
+                out = [x for x in range(3)]
+            return out
+
+        # comprehension is fine (own scope); a genuine generator refuses
+        f2 = convert_function(uses_yield_in_if)
+        assert f2(True) == [0, 1, 2]
+
+    def test_import_binding_inside_branch(self):
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(x, flag=True):
+            if flag:
+                import math as _m
+                y = x + _m.pi
+            else:
+                y = x
+            return y
+
+        f2 = convert_function(f)
+        assert abs(f2(1.0) - (1.0 + 3.141592653589793)) < 1e-12
+        assert f2(1.0, flag=False) == 1.0
+
+    def test_walrus_in_assign_value(self):
+        from paddle_tpu.jit.ast_transform import convert_function
+
+        def f(x, flag=True):
+            if flag:
+                y = (z := x + 1) + z
+        # z bound via walrus inside the branch value must propagate
+            else:
+                y = x
+                z = 0
+            return y + z
+
+        f2 = convert_function(f)
+        assert f2(1.0) == 6.0          # z=2, y=z+z=4, y+z=6
+        assert f2(1.0, flag=False) == 1.0

@@ -1,0 +1,416 @@
+"""Numpy-reference tests for the round-4 extras batch and the CRF family.
+
+Pins: bpr_loss_op.h:70, modified_huber_loss_op.h:43,
+teacher_student_sigmoid_loss_op.h:34, center_loss_op.cc, mean_iou_op.cc,
+row_conv_op.cc, conv_shift_op.cc, fsp_op.cc, cvm_op.cc, data_norm_op.cc:302,
+linear_chain_crf_op.h (brute-force partition check), crf_decoding_op.h,
+chunk_eval_op.h.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+t = paddle.to_tensor
+
+
+# -- small losses -------------------------------------------------------------
+
+def test_bpr_loss_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype(np.float32)
+    lab = np.array([2, 0, 5, 1])
+    got = ops.bpr_loss(t(x), t(lab)).numpy()
+    exp = np.zeros((4, 1))
+    for i in range(4):
+        s = 0.0
+        for j in range(6):
+            if j == lab[i]:
+                continue
+            s += -np.log(1.0 / (1.0 + np.exp(x[i, j] - x[i, lab[i]])))
+        exp[i, 0] = s / 5
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_modified_huber_loss_numpy():
+    x = np.array([-2.0, -0.5, 0.3, 2.0], np.float32)
+    y = np.array([1.0, 0.0, 1.0, 1.0], np.float32)
+    got = ops.modified_huber_loss(t(x), t(y)).numpy()
+    inter = x * (2 * y - 1)
+    exp = np.where(inter < -1, -4 * inter,
+                   np.where(inter < 1, (1 - inter) ** 2, 0))
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_teacher_student_sigmoid_loss_cases():
+    x = np.array([0.5, 0.5, 0.5, 0.5], np.float32)
+    lab = np.array([-2.0, -1.0, 0.3, 1.7], np.float32)
+    got = ops.teacher_student_sigmoid_loss(t(x), t(lab)).numpy().ravel()
+
+    def part(xx, z):
+        return max(xx, 0) - xx * z + np.log1p(np.exp(-abs(xx)))
+    exp = np.array([part(0.5, 0), part(0.5, 1),
+                    part(0.5, 0) + part(0.5, 0.3),
+                    part(0.5, 1) + part(0.5, 0.7)])
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_center_loss_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 3).astype(np.float32)
+    centers = rng.randn(5, 3).astype(np.float32)
+    lab = np.array([1, 1, 0, 3])
+    loss, new_c = ops.center_loss(t(x), t(lab), t(centers), alpha=0.5)
+    exp_loss = 0.5 * ((x - centers[lab]) ** 2).sum(1, keepdims=True)
+    np.testing.assert_allclose(loss.numpy(), exp_loss, rtol=1e-4)
+    # class-1 center moved toward the mean of its two samples
+    diff = (x[0] - centers[1]) + (x[1] - centers[1])
+    exp_c1 = centers[1] - 0.5 * diff / 3.0          # (1 + count) normalizer
+    np.testing.assert_allclose(new_c.numpy()[1], exp_c1, rtol=1e-4)
+    # untouched class keeps its center
+    np.testing.assert_allclose(new_c.numpy()[2], centers[2], rtol=1e-6)
+
+
+def test_margin_rank_loss():
+    lab = np.array([1.0, -1.0], np.float32)
+    left = np.array([0.5, 0.5], np.float32)
+    right = np.array([0.3, 0.3], np.float32)
+    got = ops.margin_rank_loss(t(lab), t(left), t(right), margin=0.1).numpy()
+    np.testing.assert_allclose(got, np.maximum(0, -lab * (left - right) + 0.1),
+                               rtol=1e-5)
+
+
+def test_squared_l2_distance():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    got = ops.squared_l2_distance(t(x), t(y)).numpy()
+    np.testing.assert_allclose(got, ((x - y) ** 2).sum(1, keepdims=True),
+                               rtol=1e-4)
+
+
+# -- metrics ------------------------------------------------------------------
+
+def test_mean_iou_numpy():
+    pred = np.array([0, 0, 1, 1, 2, 2])
+    lab = np.array([0, 1, 1, 1, 2, 0])
+    miou, wrong, correct = ops.mean_iou(t(pred), t(lab), 3)
+    # per class: c0 TP1 FP1 FN1 iou 1/3; c1 TP2 FP0 FN1 iou 2/3; c2 TP1 FP1 FN0 iou 1/2
+    np.testing.assert_allclose(miou.numpy(), (1 / 3 + 2 / 3 + 1 / 2) / 3,
+                               rtol=1e-5)
+    np.testing.assert_array_equal(correct.numpy(), [1, 2, 1])
+
+
+def test_precision_recall_micro_macro():
+    pred = np.array([0, 1, 1, 0])
+    lab = np.array([0, 1, 0, 0])
+    out = ops.precision_recall(t(pred), t(lab), 2).numpy()
+    # c0: tp2 fp0 fn1 -> P1 R2/3; c1: tp1 fp1 fn0 -> P.5 R1
+    assert abs(out[0] - (1.0 + 0.5) / 2) < 1e-5          # macro P
+    assert abs(out[3] - 3 / 4) < 1e-5                     # micro P
+    assert abs(out[4] - 3 / 4) < 1e-5                     # micro R
+
+
+def test_positive_negative_pair_queries():
+    score = np.array([3.0, 1.0, 2.0, 2.0], np.float32)
+    lab = np.array([2.0, 1.0, 1.0, 2.0], np.float32)
+    q = np.array([0, 0, 0, 1])
+    pos, neg, neu = ops.positive_negative_pair(t(score), t(lab), t(q))
+    # query0: (0 vs 1): 3>1 pos; (0 vs 2): 3>2 pos. query1 alone: none.
+    assert float(pos.numpy()) == 2 and float(neg.numpy()) == 0
+    assert float(neu.numpy()) == 0
+
+
+# -- feature ops --------------------------------------------------------------
+
+def test_affine_channel_and_data_norm():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 2, 2).astype(np.float32)
+    s = np.array([1.0, 2.0, 3.0], np.float32)
+    b = np.array([0.5, 0.0, -1.0], np.float32)
+    got = ops.affine_channel(t(x), t(s), t(b)).numpy()
+    np.testing.assert_allclose(got, x * s[None, :, None, None]
+                               + b[None, :, None, None], rtol=1e-5)
+
+    xd = rng.randn(4, 3).astype(np.float32)
+    bs = np.full(3, 8.0, np.float32)
+    bsum = rng.randn(3).astype(np.float32)
+    bsq = np.abs(rng.randn(3)).astype(np.float32) + 1
+    y, means, scales = ops.data_norm(t(xd), t(bs), t(bsum), t(bsq))
+    np.testing.assert_allclose(means.numpy(), bsum / bs, rtol=1e-5)
+    np.testing.assert_allclose(scales.numpy(), np.sqrt(bs / bsq), rtol=1e-5)
+    np.testing.assert_allclose(
+        y.numpy(), (xd - (bsum / bs)[None]) * np.sqrt(bs / bsq)[None],
+        rtol=1e-4)
+
+
+def test_cvm_partial_shuffle():
+    x = np.abs(np.random.RandomState(4).randn(3, 5)).astype(np.float32)
+    got = ops.cvm(t(x), use_cvm=True).numpy()
+    np.testing.assert_allclose(got[:, 0], np.log(x[:, 0] + 1), rtol=1e-5)
+    np.testing.assert_allclose(got[:, 1],
+                               np.log(x[:, 1] + 1) - np.log(x[:, 0] + 1),
+                               rtol=1e-4, atol=1e-6)
+    assert ops.cvm(t(x), use_cvm=False).shape == [3, 3]
+
+    a = np.arange(12, dtype=np.float32).reshape(2, 6)
+    np.testing.assert_allclose(
+        ops.partial_concat([t(a), t(a)], 1, 2).numpy(),
+        np.concatenate([a[:, 1:3], a[:, 1:3]], 1))
+    np.testing.assert_allclose(ops.partial_sum([t(a), t(a)], 0, 3).numpy(),
+                               2 * a[:, :3])
+
+    s, idx = ops.shuffle_batch(t(a), seed=7)
+    np.testing.assert_allclose(s.numpy(), a[idx.numpy()])
+
+
+def test_filter_by_instag_mask():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    tags = np.array([[1, 0], [2, 0], [3, 1], [9, 9]])
+    out, mask, lw = ops.filter_by_instag(t(x), t(tags), t(np.array([1, 3])))
+    np.testing.assert_array_equal(mask.numpy(), [True, False, True, False])
+    np.testing.assert_allclose(out.numpy()[1], 0)
+    np.testing.assert_allclose(out.numpy()[0], x[0])
+
+
+# -- NN misc ------------------------------------------------------------------
+
+def test_row_conv_numpy():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 6, 3).astype(np.float32)
+    w = rng.randn(3, 3).astype(np.float32)   # ctx=3
+    got = ops.row_conv(t(x), t(w)).numpy()
+    exp = np.zeros_like(x)
+    for b in range(2):
+        for i in range(6):
+            for j in range(3):
+                if i + j < 6:
+                    exp[b, i] += x[b, i + j] * w[j]
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_shift_numpy():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 7).astype(np.float32)
+    y = rng.randn(2, 3).astype(np.float32)
+    got = ops.conv_shift(t(x), t(y)).numpy()
+    exp = np.zeros_like(x)
+    for b in range(2):
+        for i in range(7):
+            for j in range(3):
+                exp[b, i] += x[b, (i + j - 1) % 7] * y[b, j]
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_fsp_numpy():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    y = rng.randn(2, 6, 4, 5).astype(np.float32)
+    got = ops.fsp(t(x), t(y)).numpy()
+    exp = np.einsum("bihw,bjhw->bij", x, y) / 20
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_spp_divisible_matches_manual():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    got = ops.spp(t(x), 3, "max").numpy()
+    assert got.shape == (2, 3 * (1 + 4 + 16))
+    # level 0 is the global max
+    np.testing.assert_allclose(got[:, :3], x.max((2, 3)), rtol=1e-5)
+    # level 2: 4x4 grid of 2x2 maxes
+    lvl2 = x.reshape(2, 3, 4, 2, 4, 2).max((3, 5)).reshape(2, -1)
+    np.testing.assert_allclose(got[:, 15:], lvl2, rtol=1e-5)
+
+
+def test_max_unpool2d_roundtrip():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(9)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    pooled, mask = F.max_pool2d(t(x), 2, return_mask=True)
+    up = ops.max_unpool2d(pooled, mask, 2).numpy()
+    assert up.shape == (1, 2, 6, 6)
+    # every pooled max lands back at its argmax position
+    np.testing.assert_allclose(np.sort(up[up != 0]),
+                               np.sort(pooled.numpy().ravel()))
+
+
+def test_add_position_encoding_alpha_beta():
+    x = np.zeros((1, 4, 6), np.float32)
+    got = ops.add_position_encoding(t(x), alpha=2.0, beta=1.0).numpy()
+    # position 0: sin(0)=0 for first half, cos(0)=1 for second
+    np.testing.assert_allclose(got[0, 0, :3], 0, atol=1e-6)
+    np.testing.assert_allclose(got[0, 0, 3:], 1, atol=1e-6)
+    got2 = ops.add_position_encoding(t(np.ones((1, 4, 6), np.float32)),
+                                     alpha=2.0, beta=0.0).numpy()
+    np.testing.assert_allclose(got2, 2.0, atol=1e-6)
+
+
+def test_correlation_zero_displacement_is_mean_product():
+    rng = np.random.RandomState(10)
+    a = rng.randn(1, 4, 5, 5).astype(np.float32)
+    b = rng.randn(1, 4, 5, 5).astype(np.float32)
+    out = ops.correlation(t(a), t(b), pad_size=1, kernel_size=1,
+                          max_displacement=1, stride1=1, stride2=1).numpy()
+    assert out.shape == (1, 9, 5, 5)
+    np.testing.assert_allclose(out[0, 4], (a * b).mean(1)[0], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_similarity_focus_exclusive_mask():
+    x = np.zeros((1, 2, 3, 3), np.float32)
+    x[0, 0] = [[9, 1, 1], [1, 5, 1], [1, 1, 7]]
+    got = ops.similarity_focus(t(x), 1, [0]).numpy()
+    # greedy: (0,0)=9, then (2,2)=7, then (1,1)=5 — the diagonal
+    np.testing.assert_allclose(got[0, 0], np.eye(3), atol=1e-6)
+    np.testing.assert_allclose(got[0, 1], np.eye(3), atol=1e-6)
+
+
+def test_match_matrix_tensor_numpy():
+    rng = np.random.RandomState(11)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(2, 5, 4).astype(np.float32)
+    w = rng.randn(4, 2, 4).astype(np.float32)
+    got = ops.match_matrix_tensor(t(x), t(y), t(w)).numpy()
+    exp = np.einsum("bid,dte,bje->btij", x, w, y)
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+    # masked version zeroes padding
+    got2 = ops.match_matrix_tensor(
+        t(x), t(y), t(w), t(np.array([2, 3])), t(np.array([5, 1]))).numpy()
+    assert np.all(got2[0, :, 2:, :] == 0)
+    assert np.all(got2[1, :, :, 1:] == 0)
+
+
+# -- tensor utilities ---------------------------------------------------------
+
+def test_shape_size_isfinite():
+    x = np.array([[1.0, np.inf], [np.nan, 2.0]], np.float32)
+    np.testing.assert_array_equal(ops.shape(t(x)).numpy(), [2, 2])
+    assert int(ops.size(t(x)).numpy()) == 4
+    np.testing.assert_array_equal(ops.isfinite(t(x)).numpy(),
+                                  [[True, False], [False, True]])
+    np.testing.assert_array_equal(ops.isinf(t(x)).numpy(),
+                                  [[False, True], [False, False]])
+    np.testing.assert_array_equal(ops.isnan(t(x)).numpy(),
+                                  [[False, False], [True, False]])
+
+
+def test_batch_size_like_and_pad_constant_like():
+    ref = np.zeros((5, 2), np.float32)
+    out = ops.fill_constant_batch_size_like(t(ref), [0, 7], "float32", 3.5)
+    assert out.shape == [5, 7] and float(out.numpy()[0, 0]) == 3.5
+    u = ops.uniform_random_batch_size_like(t(ref), [0, 4], low=0, high=1)
+    assert u.shape == [5, 4]
+    g = ops.gaussian_random_batch_size_like(t(ref), [0, 3])
+    assert g.shape == [5, 3]
+    x = np.ones((4, 5), np.float32)
+    y = np.ones((2, 3), np.float32) * 2
+    p = ops.pad_constant_like(t(x), t(y), pad_value=-1.0).numpy()
+    assert p.shape == (4, 5)
+    np.testing.assert_allclose(p[:2, :3], 2.0)
+    np.testing.assert_allclose(p[2:, :], -1.0)
+
+
+def test_batch_fc():
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    w = rng.randn(2, 4, 5).astype(np.float32)
+    b = rng.randn(2, 1, 5).astype(np.float32)
+    got = ops.batch_fc(t(x), t(w), t(b)).numpy()
+    np.testing.assert_allclose(got, np.einsum("snd,sdo->sno", x, w) + b,
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- CRF ----------------------------------------------------------------------
+
+def _brute_crf(em, tr, lab, L):
+    """Enumerate all paths: returns (nll, best_path)."""
+    C = em.shape[1]
+    start, stop, W = tr[0], tr[1], tr[2:]
+
+    def score(path):
+        s = start[path[0]] + em[0, path[0]] + stop[path[L - 1]]
+        for k in range(1, L):
+            s += em[k, path[k]] + W[path[k - 1], path[k]]
+        return s
+    paths = list(itertools.product(range(C), repeat=L))
+    scores = np.array([score(p) for p in paths])
+    logZ = np.log(np.sum(np.exp(scores - scores.max()))) + scores.max()
+    nll = logZ - score(lab[:L])
+    return nll, np.array(paths[int(np.argmax(scores))])
+
+
+def test_linear_chain_crf_brute_force():
+    rng = np.random.RandomState(13)
+    N, T, C = 3, 4, 3
+    em = rng.randn(N, T, C).astype(np.float32)
+    tr = rng.randn(C + 2, C).astype(np.float32)
+    lab = rng.randint(0, C, (N, T)).astype(np.int64)
+    lens = np.array([4, 2, 3])
+    got = ops.linear_chain_crf(t(em), t(tr), t(lab), t(lens)).numpy()
+    for n in range(N):
+        nll, _ = _brute_crf(em[n], tr, lab[n], int(lens[n]))
+        np.testing.assert_allclose(got[n, 0], nll, rtol=1e-3, atol=1e-3)
+
+
+def test_crf_decoding_brute_force():
+    rng = np.random.RandomState(14)
+    N, T, C = 3, 4, 3
+    em = rng.randn(N, T, C).astype(np.float32)
+    tr = rng.randn(C + 2, C).astype(np.float32)
+    lens = np.array([4, 3, 2])
+    got = ops.crf_decoding(t(em), t(tr), length=t(lens)).numpy()
+    for n in range(N):
+        L = int(lens[n])
+        _, best = _brute_crf(em[n], tr, np.zeros(T, np.int64), L)
+        np.testing.assert_array_equal(got[n, :L], best)
+        np.testing.assert_array_equal(got[n, L:], 0)
+
+
+def test_crf_grad_flows():
+    from op_test import check_grad
+    rng = np.random.RandomState(15)
+    em = rng.randn(2, 3, 3).astype(np.float32)
+    tr = rng.randn(5, 3).astype(np.float32)
+    lab = paddle.to_tensor(np.array([[0, 1, 2], [2, 1, 0]], np.int64))
+    check_grad(lambda e, w: ops.linear_chain_crf(e, w, lab), [em, tr])
+
+
+def test_viterbi_decode_square_transition():
+    rng = np.random.RandomState(16)
+    em = rng.randn(2, 5, 4).astype(np.float32)
+    W = rng.randn(4, 4).astype(np.float32)
+    lens = np.array([5, 4])
+    scores, paths = ops.viterbi_decode(t(em), t(W), t(lens),
+                                       include_bos_eos_tag=False)
+    # brute force without start/stop
+    for n in range(2):
+        L = int(lens[n])
+        best, bs = None, -np.inf
+        for p in itertools.product(range(4), repeat=L):
+            s = em[n, 0, p[0]] + sum(em[n, k, p[k]] + W[p[k - 1], p[k]]
+                                     for k in range(1, L))
+            if s > bs:
+                bs, best = s, p
+        np.testing.assert_allclose(float(scores.numpy()[n]), bs, rtol=1e-4)
+        np.testing.assert_array_equal(paths.numpy()[n, :L], best)
+
+
+def test_chunk_eval_iob():
+    # tags: type*2 + {0:B, 1:I}; 2 chunk types, O = anything outside range
+    lab = np.array([[0, 1, 9, 2, 3, 3]])    # chunks: T0[0..1], T1[3..5]
+    inf = np.array([[0, 1, 9, 2, 3, 9]])    # chunks: T0[0..1], T1[3..4]
+    p, r, f1, ni, nl, nc = ops.chunk_eval(inf, lab, "IOB", 2)
+    assert (ni, nl, nc) == (2, 2, 1)
+    assert abs(p - 0.5) < 1e-9 and abs(r - 0.5) < 1e-9
+
+
+def test_chunk_eval_iobes():
+    # IOBES: type*4 + {0:B,1:I,2:E,3:S}
+    lab = np.array([[3, 0, 1, 2]])          # S chunk [0], B-I-E chunk [1..3]
+    inf = np.array([[3, 0, 1, 2]])
+    p, r, f1, ni, nl, nc = ops.chunk_eval(inf, lab, "IOBES", 1)
+    assert (ni, nl, nc) == (2, 2, 2) and f1 == 1.0

@@ -37,6 +37,7 @@ def train_script(tmp_path):
 
 
 class TestLauncher:
+    @pytest.mark.slow
     def test_two_process_launch(self, train_script, tmp_path):
         log_dir = str(tmp_path / "logs")
         proc = subprocess.run(
@@ -51,6 +52,7 @@ class TestLauncher:
         assert proc.returncode == 0, (proc.stderr, logs)
         assert "RANK 0 OK" in logs and "RANK 1 OK" in logs
 
+    @pytest.mark.slow
     def test_failing_child_tears_down(self, tmp_path):
         bad = tmp_path / "bad.py"
         bad.write_text(
@@ -89,6 +91,7 @@ def _spawn_failer():
 
 
 class TestSpawn:
+    @pytest.mark.slow
     def test_spawn_two_procs(self):
         import paddle_tpu.distributed as dist
         for r in range(2):

@@ -4,6 +4,7 @@ unittests/test_imperative_mnist.py). Synthetic separable data instead of the
 MNIST download; the test asserts real learning (loss drops, accuracy high).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -45,6 +46,7 @@ def synthetic_digits(n, seed=0):
     return xs, ys.astype(np.int64)
 
 
+@pytest.mark.slow
 def test_lenet_mnist_convergence():
     paddle.seed(0)
     model = LeNet()

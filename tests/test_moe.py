@@ -56,6 +56,7 @@ class TestMoE:
                                    rtol=2e-3, atol=2e-4)
         assert float(aux) > 0
 
+    @pytest.mark.slow
     def test_capacity_drops_overflow(self, ep_mesh):
         # gate forced to expert 0: with tiny capacity most tokens drop
         rng = np.random.RandomState(1)
@@ -74,6 +75,7 @@ class TestMoE:
         zero_rows = (np.abs(dropped).sum(-1) < 1e-7).sum()
         assert zero_rows == 8 * 4 - 8
 
+    @pytest.mark.slow
     def test_training_decreases_loss(self, ep_mesh):
         rng = np.random.RandomState(2)
         x = rng.randn(8, 4, 16).astype(np.float32)
@@ -93,6 +95,7 @@ class TestMoE:
         l2 = loss_fn(params)
         assert float(l2) < float(l1)
 
+    @pytest.mark.slow
     def test_layer_wrapper_tape(self, ep_mesh):
         rng = np.random.RandomState(3)
         x = paddle.to_tensor(rng.randn(8, 4, 16).astype(np.float32),

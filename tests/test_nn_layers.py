@@ -20,6 +20,7 @@ class TestLinearConv:
         out = lin(paddle.to_tensor(x))
         np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_conv2d_vs_torch(self):
         torch = pytest.importorskip("torch")
         x = np.random.rand(2, 3, 8, 8).astype(np.float32)
@@ -211,6 +212,7 @@ class TestTransformer:
         out = mha(x, attn_mask=mask)
         assert out.shape == [1, 4, 8]
 
+    @pytest.mark.slow
     def test_encoder_decoder(self):
         model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
                                num_decoder_layers=2, dim_feedforward=32)
@@ -299,6 +301,7 @@ class TestOptimizers:
                                               parameters=ps), steps=600,
                         atol=0.5)
 
+    @pytest.mark.slow
     def test_adam_vs_torch_trajectory(self):
         torch = pytest.importorskip("torch")
         import paddle_tpu.optimizer as optim

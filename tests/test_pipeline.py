@@ -63,6 +63,7 @@ class TestPipelineParallelSchedule:
         opt = optim.SGD(learning_rate=0.1, parameters=pp.parameters())
         return pp, opt
 
+    @pytest.mark.slow
     def test_microbatch_schedule_matches_full_batch(self):
         rng = np.random.RandomState(0)
         X = rng.randn(8, 8).astype(np.float32)
@@ -119,6 +120,7 @@ class TestCompiledGPipeEngine:
         finally:
             dist.set_mesh(None)
 
+    @pytest.mark.slow
     def test_gpipe_grads_flow(self):
         dist.set_mesh(dist.build_mesh({"pp": 8}))
         try:
@@ -201,6 +203,7 @@ class TestHeterogeneousPipeline:
         finally:
             dist.set_mesh(None)
 
+    @pytest.mark.slow
     def test_gpipe_blocks_grads_match_sequential(self):
         import paddle_tpu.distributed as dist
         from paddle_tpu.distributed.fleet import pipeline_engine as PE
@@ -270,6 +273,7 @@ class TestHeterogeneousPipeline:
         finally:
             dist.set_mesh(None)
 
+    @pytest.mark.slow
     def test_pipeline_layer_compiled_heterogeneous(self):
         import paddle_tpu as paddle
         import paddle_tpu.optimizer as optim

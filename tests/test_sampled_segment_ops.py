@@ -1,0 +1,336 @@
+"""Numpy-reference tests for the round-4 op batch: segment reductions,
+hierarchical sigmoid, NCE, class_center_sample, sample_logits/sampling_id,
+and the position-sensitive ROI pooling family.
+
+Reference semantics being pinned: segment_pool_op.cc:22,
+hierarchical_sigmoid_op.cc + math/matrix_bit_code.h SimpleCode,
+nce_op.h:80, class_center_sample_op.cu, sample_logits_op.cc,
+psroi_pool_op.cc:79, prroi_pool_op.cc, deformable_psroi_pooling_op.cc.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.incubate as incubate
+
+from op_test import check_grad
+
+
+# -- segment reductions -------------------------------------------------------
+
+def _np_segment(data, ids, n, kind):
+    out = np.zeros((n,) + data.shape[1:], data.dtype)
+    for s in range(n):
+        rows = data[ids == s]
+        if rows.size == 0:
+            continue
+        if kind == "sum":
+            out[s] = rows.sum(0)
+        elif kind == "mean":
+            out[s] = rows.mean(0)
+        elif kind == "max":
+            out[s] = rows.max(0)
+        elif kind == "min":
+            out[s] = rows.min(0)
+    return out
+
+
+@pytest.mark.parametrize("kind", ["sum", "mean", "max", "min"])
+def test_segment_ops_numpy(kind):
+    rng = np.random.RandomState(0)
+    data = rng.randn(10, 3).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 3, 3, 5, 5, 5], np.int32)  # 2,4 empty
+    fn = getattr(incubate, f"segment_{kind}")
+    got = fn(paddle.to_tensor(data), paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, _np_segment(data, ids, 6, kind),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_segment_pool_dispatch_and_grad():
+    rng = np.random.RandomState(1)
+    data = rng.randn(6, 2).astype(np.float32)
+    ids = np.array([0, 0, 1, 2, 2, 2], np.int32)
+    got = paddle.ops.segment_pool(paddle.to_tensor(data),
+                                  paddle.to_tensor(ids), "MEAN").numpy()
+    np.testing.assert_allclose(got, _np_segment(data, ids, 3, "mean"),
+                               rtol=1e-5)
+    check_grad(lambda d: incubate.segment_sum(d, paddle.to_tensor(ids)),
+               [data])
+
+
+def test_segment_requires_static_num_segments_under_jit():
+    ids = paddle.to_tensor(np.array([0, 1], np.int32))
+    data = paddle.to_tensor(np.ones((2, 2), np.float32))
+
+    @paddle.jit.to_static
+    def f(d, i):
+        return incubate.segment_sum(d, i)
+    with pytest.raises(ValueError, match="num_segments"):
+        f(data, ids)
+
+
+# -- hierarchical sigmoid -----------------------------------------------------
+
+def _np_hsigmoid(x, label, C, W, b):
+    """Literal SimpleCode walk (matrix_bit_code.h:106)."""
+    N = x.shape[0]
+    out = np.zeros((N, 1), np.float64)
+    for n in range(N):
+        code = int(label[n]) + C
+        length = code.bit_length() - 1
+        for bit in range(length):
+            idx = (code >> (bit + 1)) - 1
+            t = float((code >> bit) & 1)
+            pre = float(x[n] @ W[idx] + b[idx])
+            pre = np.clip(pre, -40, 40)
+            out[n, 0] += np.log1p(np.exp(pre)) - t * pre
+    return out
+
+
+@pytest.mark.parametrize("C", [2, 7, 10, 16])
+def test_hsigmoid_loss_numpy(C):
+    rng = np.random.RandomState(2)
+    x = rng.randn(5, 6).astype(np.float32)
+    lab = rng.randint(0, C, (5,)).astype(np.int64)
+    W = rng.randn(C - 1, 6).astype(np.float32)
+    b = rng.randn(C - 1).astype(np.float32)
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lab), C,
+                          paddle.to_tensor(W), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, _np_hsigmoid(x, lab, C, W, b),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_hsigmoid_custom_path():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 4).astype(np.float32)
+    lab = np.array([0, 1, 2], np.int64)
+    W = rng.randn(5, 4).astype(np.float32)
+    # custom tree: each row's path, -1 padded
+    pt = np.array([[0, 2, -1], [0, 3, 4], [1, -1, -1]], np.int64)
+    pc = np.array([[1, 0, 0], [0, 1, 1], [1, 0, 0]], np.float32)
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lab), 6,
+                          paddle.to_tensor(W), path_table=paddle.to_tensor(pt),
+                          path_code=paddle.to_tensor(pc)).numpy()
+    exp = np.zeros((3, 1))
+    for n in range(3):
+        for l in range(3):
+            if pt[n, l] < 0:
+                continue
+            pre = np.clip(float(x[n] @ W[pt[n, l]]), -40, 40)
+            exp[n, 0] += np.log1p(np.exp(pre)) - pc[n, l] * pre
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-4)
+
+
+def test_hsigmoid_grad():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 5).astype(np.float32)
+    lab = paddle.to_tensor(np.array([1, 3, 0], np.int64))
+    W = rng.randn(7, 5).astype(np.float32)
+    check_grad(lambda xx, ww: F.hsigmoid_loss(xx, lab, 8, ww), [x, W])
+
+
+# -- NCE ----------------------------------------------------------------------
+
+def test_nce_numpy_uniform():
+    """Recompute the reference cost formula (nce_op.h:196-206) in numpy on
+    the same sampled negatives the op draws from its seeded key."""
+    import jax
+    rng = np.random.RandomState(5)
+    N, D, C, k = 4, 6, 9, 5
+    x = rng.randn(N, D).astype(np.float32)
+    lab = rng.randint(0, C, (N, 1)).astype(np.int64)
+    W = rng.randn(C, D).astype(np.float32)
+    b = rng.randn(C).astype(np.float32)
+    seed = 77
+    got = F.nce(paddle.to_tensor(x), paddle.to_tensor(lab),
+                paddle.to_tensor(W), bias=paddle.to_tensor(b),
+                num_neg_samples=k, num_total_classes=C, sampler="uniform",
+                seed=seed).numpy()
+    neg = np.asarray(jax.random.randint(jax.random.PRNGKey(seed), (N, k), 0, C))
+    exp = np.zeros((N, 1))
+    for i in range(N):
+        classes = np.concatenate([lab[i], neg[i]])
+        for j, c in enumerate(classes):
+            o = 1.0 / (1.0 + np.exp(-(x[i] @ W[c] + b[c])))
+            bq = (1.0 / C) * k
+            exp[i, 0] += (-np.log(o / (o + bq)) if j < 1
+                          else -np.log(bq / (o + bq)))
+    np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-3)
+
+
+def test_nce_samplers_and_grad():
+    rng = np.random.RandomState(6)
+    x = rng.randn(3, 4).astype(np.float32)
+    lab = paddle.to_tensor(np.array([[0], [2], [4]], np.int64))
+    W = rng.randn(6, 4).astype(np.float32)
+    for sampler, kw in [("log_uniform", {}),
+                        ("custom_dist", {"custom_dist": np.full(6, 1 / 6)})]:
+        out = F.nce(paddle.to_tensor(x), lab, paddle.to_tensor(W),
+                    num_neg_samples=3, num_total_classes=6, sampler=sampler,
+                    seed=9, **kw)
+        assert out.shape == [3, 1]
+        assert np.all(np.isfinite(out.numpy()))
+    check_grad(lambda xx: F.nce(xx, lab, paddle.to_tensor(W),
+                                num_neg_samples=3, num_total_classes=6,
+                                seed=9), [x])
+
+
+# -- class_center_sample ------------------------------------------------------
+
+def test_class_center_sample_contract():
+    paddle.seed(11)
+    lab = np.array([3, 17, 3, 9, 40, 9], np.int64)
+    rl, centers = F.class_center_sample(paddle.to_tensor(lab), 50, 8)
+    centers = centers.numpy()
+    rl = rl.numpy()
+    assert centers.shape == (8,)
+    # every positive class is sampled, list is sorted unique
+    for c in {3, 17, 9, 40}:
+        assert c in centers
+    assert np.all(np.diff(centers) > 0)
+    # remapped labels point at the right centers
+    np.testing.assert_array_equal(centers[rl], lab)
+
+
+def test_class_center_sample_validates():
+    with pytest.raises(ValueError):
+        F.class_center_sample(paddle.to_tensor(np.zeros(2, np.int64)), 4, 9)
+
+
+# -- sampling_id / sample_logits ---------------------------------------------
+
+def test_sampling_id():
+    p = np.zeros((3, 5), np.float32)
+    p[0, 2] = p[1, 0] = p[2, 4] = 1.0  # deterministic rows
+    out = F.sampling_id(paddle.to_tensor(p), seed=3).numpy()
+    np.testing.assert_array_equal(out, [2, 0, 4])
+
+
+def test_sample_logits_subtract_log_q_and_hits():
+    import jax
+    rng = np.random.RandomState(7)
+    N, C, S = 3, 20, 6
+    logits = rng.randn(N, C).astype(np.float32)
+    lab = rng.randint(0, C, (N, 1)).astype(np.int64)
+    seed = 13
+    s_logits, s_label = F.sample_logits(paddle.to_tensor(logits),
+                                        paddle.to_tensor(lab), S, seed=seed)
+    s_logits = s_logits.numpy()
+    assert s_logits.shape == (N, 1 + S)
+    np.testing.assert_array_equal(s_label.numpy(),
+                                  np.zeros((N, 1), np.int64))
+    # column 0 is the true logit minus log q(true)
+    u = np.asarray(jax.random.uniform(jax.random.PRNGKey(seed), (N, S)))
+    q_true = np.log((lab[:, 0] + 2.0) / (lab[:, 0] + 1.0)) / np.log(C + 1.0)
+    np.testing.assert_allclose(
+        s_logits[:, 0], logits[np.arange(N), lab[:, 0]] - np.log(q_true),
+        rtol=1e-4)
+
+
+# -- position-sensitive ROI pooling -------------------------------------------
+
+def _np_psroi(feat, rois, bidx, oc, scale, ph, pw):
+    N, C, H, W = feat.shape
+    R = rois.shape[0]
+    out = np.zeros((R, oc, ph, pw), np.float32)
+    for r in range(R):
+        x1 = round(rois[r, 0]) * scale
+        y1 = round(rois[r, 1]) * scale
+        x2 = round(rois[r, 2] + 1) * scale
+        y2 = round(rois[r, 3] + 1) * scale
+        rh = max(y2 - y1, 0.1)
+        rw = max(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(oc):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = int(np.clip(np.floor(i * bh + y1), 0, H))
+                    he = int(np.clip(np.ceil((i + 1) * bh + y1), 0, H))
+                    ws = int(np.clip(np.floor(j * bw + x1), 0, W))
+                    we = int(np.clip(np.ceil((j + 1) * bw + x1), 0, W))
+                    cin = (c * ph + i) * pw + j
+                    region = feat[bidx[r], cin, hs:he, ws:we]
+                    if region.size:
+                        out[r, c, i, j] = region.sum() / region.size
+    return out
+
+
+def test_psroi_pool_numpy():
+    rng = np.random.RandomState(8)
+    oc, ph, pw = 3, 2, 2
+    feat = rng.randn(2, oc * ph * pw, 10, 10).astype(np.float32)
+    rois = np.array([[0, 0, 9, 9], [2, 3, 8, 7], [1, 1, 4, 4]], np.float32)
+    rois_num = np.array([2, 1], np.int32)
+    bidx = np.array([0, 0, 1])
+    got = paddle.ops.psroi_pool(paddle.to_tensor(feat),
+                                paddle.to_tensor(rois), oc, 0.5, ph, pw,
+                                rois_num=paddle.to_tensor(rois_num)).numpy()
+    np.testing.assert_allclose(got, _np_psroi(feat, rois, bidx, oc, 0.5,
+                                              ph, pw), rtol=1e-4, atol=1e-4)
+
+
+def test_prroi_pool_matches_dense_integration():
+    """PrRoI = exact integral of the bilinear surface; check against a fine
+    Riemann sum of numpy bilinear interpolation."""
+    rng = np.random.RandomState(9)
+    feat = rng.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[1.2, 0.7, 6.3, 5.9]], np.float32)
+    ph = pw = 2
+    got = paddle.ops.prroi_pool(paddle.to_tensor(feat),
+                                paddle.to_tensor(rois), ph, pw, 1.0).numpy()
+
+    def bilin(c, y, x):
+        y0, x0 = int(np.floor(y)), int(np.floor(x))
+        y0 = np.clip(y0, 0, 7); x0 = np.clip(x0, 0, 7)
+        y1, x1 = min(y0 + 1, 7), min(x0 + 1, 7)
+        ay, ax = y - y0, x - x0
+        f = feat[0, c]
+        v = (f[y0, x0] * (1 - ay) * (1 - ax) + f[y0, x1] * (1 - ay) * ax
+             + f[y1, x0] * ay * (1 - ax) + f[y1, x1] * ay * ax)
+        # outside [0, H-1] the triangle kernel decays to 0 over 1px
+        if y < 0 or y > 7:
+            v *= max(0.0, 1 - min(abs(y - 0), abs(y - 7)))
+        return v
+
+    x1, y1, x2, y2 = rois[0]
+    bh, bw = (y2 - y1) / ph, (x2 - x1) / pw
+    K = 30
+    exp = np.zeros((1, 2, ph, pw), np.float32)
+    for c in range(2):
+        for i in range(ph):
+            for j in range(pw):
+                ys = y1 + i * bh + (np.arange(K) + 0.5) / K * bh
+                xs = x1 + j * bw + (np.arange(K) + 0.5) / K * bw
+                acc = 0.0
+                for y in ys:
+                    for x in xs:
+                        acc += bilin(c, y, x)
+                exp[0, c, i, j] = acc / (K * K)
+    np.testing.assert_allclose(got, exp, rtol=2e-2, atol=2e-2)
+
+
+def test_deformable_psroi_zero_trans_and_shift():
+    rng = np.random.RandomState(10)
+    gs = 2
+    oc = 2
+    feat = rng.randn(1, oc * gs * gs, 12, 12).astype(np.float32)
+    rois = np.array([[1, 1, 9, 9]], np.float32)
+    zero_tr = np.zeros((1, 2, 2, 2), np.float32)
+    a = paddle.ops.deformable_psroi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois),
+        paddle.to_tensor(zero_tr), no_trans=False, spatial_scale=1.0,
+        group_size=gs, pooled_height=2, pooled_width=2, part_size=2,
+        sample_per_part=2).numpy()
+    b = paddle.ops.deformable_psroi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois), None, no_trans=True,
+        spatial_scale=1.0, group_size=gs, pooled_height=2, pooled_width=2,
+        part_size=2, sample_per_part=2).numpy()
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+    # a constant shift moves the sampled region
+    tr = np.full((1, 2, 2, 2), 0.25, np.float32)
+    c = paddle.ops.deformable_psroi_pooling(
+        paddle.to_tensor(feat), paddle.to_tensor(rois), paddle.to_tensor(tr),
+        no_trans=False, spatial_scale=1.0, group_size=gs, pooled_height=2,
+        pooled_width=2, part_size=2, sample_per_part=2).numpy()
+    assert not np.allclose(a, c)

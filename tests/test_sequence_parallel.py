@@ -63,6 +63,7 @@ class TestRingAttention:
         shards = out.addressable_shards
         assert len(shards) == 8 and shards[0].data.shape[2] == 4
 
+    @pytest.mark.slow
     def test_gradients_match_dense(self, sp_mesh):
         q, k, v = self._qkv(B=1, H=2, T=16, D=8, seed=2)
 
@@ -102,6 +103,7 @@ class TestRingAttention:
 
 
 class TestErnieAndOnnx:
+    @pytest.mark.slow
     def test_ernie_forward_and_finetune_step(self):
         import paddle_tpu.optimizer as optim
         from paddle_tpu.models import (ErnieConfig,
@@ -143,6 +145,7 @@ class TestErnieAndOnnx:
 
 
 class TestRingAttentionTape:
+    @pytest.mark.slow
     def test_wrapper_backprop_produces_grads(self, sp_mesh):
         rng = np.random.RandomState(4)
         q = paddle.to_tensor(rng.randn(1, 2, 16, 8).astype(np.float32),

@@ -148,6 +148,7 @@ class TestFlagEffects:
         # begin at step 2, then every 3: steps 2, 5, 8
         assert calls == [2, 5, 8]
 
+    @pytest.mark.slow
     def test_fp16_allreduce_casts_grad_exchange(self, monkeypatch):
         st = DistributedStrategy()
         st.fp16_allreduce = True

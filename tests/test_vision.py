@@ -31,17 +31,20 @@ class TestModels:
         loss.backward()
         assert m.features[0].weight.grad is not None
 
+    @pytest.mark.slow
     def test_resnet18_forward(self):
         m = models.resnet18(num_classes=7)
         m.eval()
         x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype(np.float32))
         assert m(x).shape == [2, 7]
 
+    @pytest.mark.slow
     def test_resnet50_param_count_matches_torchvision(self):
         # canonical ResNet-50 ImageNet param count
         m = models.resnet50()
         assert _n_params(m) == 25_557_032
 
+    @pytest.mark.slow
     def test_resnet50_forward_backward(self):
         m = models.resnet50(num_classes=10)
         x = paddle.to_tensor(np.random.rand(2, 3, 64, 64).astype(np.float32))
@@ -51,12 +54,14 @@ class TestModels:
         loss.backward()
         assert m.conv1.weight.grad is not None
 
+    @pytest.mark.slow
     def test_vgg11_forward(self):
         m = models.vgg11(num_classes=5)
         m.eval()
         x = paddle.to_tensor(np.random.rand(1, 3, 224, 224).astype(np.float32))
         assert m(x).shape == [1, 5]
 
+    @pytest.mark.slow
     def test_mobilenet_v1_v2_forward(self):
         for ctor in (models.mobilenet_v1, models.mobilenet_v2):
             m = ctor(num_classes=4)
@@ -65,12 +70,14 @@ class TestModels:
                 np.random.rand(1, 3, 96, 96).astype(np.float32))
             assert m(x).shape == [1, 4]
 
+    @pytest.mark.slow
     def test_mobilenet_v3_forward(self):
         m = models.mobilenet_v3_small(num_classes=4)
         m.eval()
         x = paddle.to_tensor(np.random.rand(1, 3, 96, 96).astype(np.float32))
         assert m(x).shape == [1, 4]
 
+    @pytest.mark.slow
     def test_resnet18_short_convergence(self):
         paddle.seed(1)
         m = models.resnet18(num_classes=4)

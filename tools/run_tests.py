@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Sharded test runner (reference: tools/parallel_UT_rule.py +
+unittests/CMakeLists.txt RUN_TYPE scheduling).
+
+Splits the test files across worker processes, each running its shard in a
+separate pytest (XLA compile caches are per-process, so file-level sharding
+is the efficient cut). Default runs the fast lane (`-m "not slow"`); pass
+--slow for the slow lane only or --all for both.
+
+    python tools/run_tests.py            # fast lane, N=cpu/4 shards
+    python tools/run_tests.py --all -j4  # everything, 4 shards
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Heaviest files first so the long pole starts immediately (greedy LPT).
+_WEIGHT_HINTS = {
+    "test_vision.py": 250, "test_graft_entry.py": 70, "test_moe.py": 70,
+    "test_sequence_parallel.py": 70, "test_pipeline.py": 90,
+    "test_launch_spawn.py": 60, "test_nn_layers.py": 70,
+    "test_detection_round3.py": 50, "test_sampled_segment_ops.py": 50,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-j", "--jobs", type=int,
+                    default=max(2, (os.cpu_count() or 8) // 4))
+    ap.add_argument("--slow", action="store_true",
+                    help="run only the slow lane")
+    ap.add_argument("--all", action="store_true", help="run both lanes")
+    ap.add_argument("--files", nargs="*", help="restrict to these files")
+    args = ap.parse_args()
+
+    files = args.files or sorted(
+        glob.glob(os.path.join(REPO, "tests", "test_*.py")))
+    files.sort(key=lambda f: -_WEIGHT_HINTS.get(os.path.basename(f), 10))
+
+    # greedy longest-processing-time assignment
+    shards = [[] for _ in range(min(args.jobs, len(files)))]
+    loads = [0] * len(shards)
+    for f in files:
+        i = loads.index(min(loads))
+        shards[i].append(f)
+        loads[i] += _WEIGHT_HINTS.get(os.path.basename(f), 10)
+
+    if args.all:
+        mark = "slow or not slow"
+    elif args.slow:
+        mark = "slow"
+    else:
+        mark = "not slow"
+
+    t0 = time.time()
+    procs = []
+    for i, shard in enumerate(shards):
+        if not shard:
+            continue
+        cmd = [sys.executable, "-m", "pytest", "-q", "-m", mark,
+               "-p", "no:cacheprovider", *shard]
+        log = open(os.path.join(REPO, f".pytest_shard_{i}.log"), "w")
+        procs.append((i, shard, log,
+                      subprocess.Popen(cmd, cwd=REPO, stdout=log,
+                                       stderr=subprocess.STDOUT)))
+    rc = 0
+    for i, shard, log, p in procs:
+        code = p.wait()
+        log.close()
+        tail = open(log.name).read().strip().splitlines()
+        status = tail[-1] if tail else "(no output)"
+        print(f"shard {i} [{len(shard)} files] exit={code}: {status}")
+        # pytest exit 5 = no tests collected in this shard's lane — fine
+        if code not in (0, 5):
+            rc = 1
+            print("\n".join(tail[-30:]))
+    print(f"total: {time.time() - t0:.0f}s, exit {rc}")
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
