@@ -15,14 +15,10 @@ import jax.numpy as jnp
 
 from ...core import generator as _gen
 from ...core.tensor import Tensor
-from ...ops.dispatch import apply
+from ...ops.dispatch import apply, raw as _raw
 
 __all__ = ["hsigmoid_loss", "hierarchical_sigmoid", "nce",
            "class_center_sample", "sampling_id", "sample_logits"]
-
-
-def _raw(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 # -- hierarchical sigmoid -----------------------------------------------------
@@ -193,6 +189,14 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     if S > C:
         raise ValueError(f"class_center_sample: num_samples={S} > "
                          f"num_classes={C}")
+    lab_raw = _raw(label)
+    if not isinstance(lab_raw, jax.core.Tracer):
+        npos = int(np.unique(np.asarray(lab_raw)).size)
+        if npos > S:
+            raise ValueError(
+                f"class_center_sample: batch holds {npos} distinct positive "
+                f"classes but num_samples={S}; every positive center must "
+                f"fit (reference enforces the same)")
     key = _gen.next_key()
 
     def impl(lab):
@@ -224,9 +228,15 @@ def sample_logits(logits, label, num_samples, uniq=True,
                   remove_accidental_hits=True, seed=0, name=None):
     """reference: operators/sample_logits_op.cc — sampled-softmax
     preparation: Samples = [true | log-uniform negatives], sampled logits
-    adjusted by -log(P(class)) (subtract-log-q), accidental hits masked to
+    adjusted by -log(q(class)) (subtract-log-q), accidental hits masked to
     -1e20. Returns (sampled_logits [N, T+S], sampled_label [N, T] — the
     in-sample positions of the true classes, i.e. arange(T)).
+
+    ``uniq=True`` (default, like the reference's unique sampler) draws the
+    negatives *without replacement* per row via Gumbel top-k over the
+    log-uniform weights; the subtract-log-q correction then uses the
+    without-replacement inclusion probability q = 1 - (1-p)^S. uniq=False
+    is S independent draws with q = p.
     """
     S = int(num_samples)
     key = _gen.next_key() if not seed else jax.random.PRNGKey(int(seed))
@@ -235,9 +245,20 @@ def sample_logits(logits, label, num_samples, uniq=True,
         n, C = lg.shape
         lab = lab.reshape(n, -1).astype(jnp.int32)              # [N, T]
         T = lab.shape[1]
-        neg, _ = _sample_classes(key, (n, S), C, "log_uniform")
+        if uniq:
+            # Gumbel top-k = weighted sampling without replacement
+            logp = jnp.log(_log_uniform_prob(jnp.arange(C), C))  # [C]
+            g = jax.random.gumbel(key, (n, C))
+            _, neg = jax.lax.top_k(logp[None, :] + g, S)         # [N, S]
+            neg = neg.astype(jnp.int32)
+        else:
+            neg, _ = _sample_classes(key, (n, S), C, "log_uniform")
         classes = jnp.concatenate([lab, neg], axis=1)           # [N, T+S]
-        q = _log_uniform_prob(classes, C)
+        p = _log_uniform_prob(classes, C)
+        if uniq:
+            q = -jnp.expm1(S * jnp.log1p(-p))   # P(class in top-k sample)
+        else:
+            q = p
         s_logits = jnp.take_along_axis(lg, classes, axis=1) - jnp.log(q)
         if remove_accidental_hits:
             hit = (neg[:, :, None] == lab[:, None, :]).any(-1)  # [N, S]

@@ -18,15 +18,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .dispatch import apply
+from .dispatch import apply, raw as _raw
 from ..core.tensor import Tensor
 
 __all__ = ["linear_chain_crf", "crf_decoding", "viterbi_decode",
            "chunk_eval"]
-
-
-def _raw(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def linear_chain_crf(emission, transition, label, length=None, name=None):
@@ -127,10 +123,14 @@ def crf_decoding(emission, transition, label=None, length=None, name=None):
 
 def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
-    """reference: python/paddle/text/viterbi_decode.py ViterbiDecoder —
-    square [C, C] transition; with include_bos_eos_tag the last-but-one
-    column is BOS and the last is EOS (reference convention). Returns
-    (scores [N], paths [N, T])."""
+    """Square-transition Viterbi (the paddle.text ViterbiDecoder API shape;
+    that module postdates the reference snapshot, so the BOS/EOS layout is
+    defined *here* and pinned by test_extras_crf_ops):
+
+    with ``include_bos_eos_tag=True``, tag ``C-2`` is BOS and tag ``C-1``
+    is EOS — row ``C-2`` of ``transition_params`` supplies the BOS->tag
+    start scores and column ``C-1`` the tag->EOS stop scores. With False,
+    no start/stop scores are added. Returns (scores [N], paths [N, T])."""
     def impl(em, tr, *maybe_len):
         N, T, C = em.shape
         lens = (maybe_len[0].astype(jnp.int32) if maybe_len

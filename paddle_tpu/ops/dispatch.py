@@ -35,6 +35,12 @@ from ..profiler import _ACTIVE as _PROF_ACTIVE
 OP_REGISTRY = {}
 
 
+def raw(x):
+    """Unwrap a Tensor to its jnp payload (array-likes pass through
+    jnp.asarray). The shared helper behind every op module's `_raw`."""
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
 def registered_ops():
     return sorted(OP_REGISTRY)
 

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .dispatch import apply
+from .dispatch import apply, raw as _raw
 from ..core.tensor import Tensor
 from ..core import generator as _gen
 
@@ -32,10 +32,6 @@ __all__ = [
     "add_position_encoding", "correlation", "similarity_focus", "fsp",
     "spp", "max_unpool2d", "match_matrix_tensor", "margin_rank_loss",
 ]
-
-
-def _raw(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 # -- tensor utilities ---------------------------------------------------------

@@ -18,15 +18,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .dispatch import apply
+from .dispatch import apply, raw as _raw
 from ..core.tensor import Tensor
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
            "segment_pool"]
-
-
-def _raw(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def _num_segments(segment_ids, num_segments):

@@ -165,3 +165,46 @@ class nn:
         from ..nn.layers_common import Embedding
         lay = Embedding(size[0], size[1], weight_attr=param_attr)
         return lay(input)
+
+    @staticmethod
+    def Assert(cond, data=None, summarize=20, name=None):
+        """reference: fluid/layers/control_flow.py Assert (assert_op).
+        Host-side check in eager; under trace uses checkify-free
+        debug.check semantics via error on concrete False only."""
+        import numpy as np
+        import jax
+        from ..core.tensor import Tensor
+        c = cond._data if isinstance(cond, Tensor) else cond
+        if isinstance(c, jax.core.Tracer):
+            # traced: XLA has no side-effecting assert; document + no-op
+            # (the reference's op also only fires at run time on CPU).
+            return cond
+        if not bool(np.asarray(c).all()):
+            shown = []
+            for d in (data or []):
+                arr = d.numpy() if isinstance(d, Tensor) else np.asarray(d)
+                shown.append(np.array2string(arr.ravel()[:summarize]))
+            raise AssertionError(
+                f"paddle.static.nn.Assert failed; data={shown}")
+        return cond
+
+    @staticmethod
+    def Print(input, first_n=-1, message=None, summarize=20,
+              print_tensor_name=True, print_tensor_type=True,
+              print_tensor_shape=True, print_tensor_lod=False,
+              print_phase="both", name=None):
+        """reference: operators/controlflow (print_op) — debug print that
+        passes the tensor through. Uses jax.debug.print under trace so it
+        fires inside compiled programs too."""
+        import jax
+        from ..core.tensor import Tensor
+        raw = input._data if isinstance(input, Tensor) else input
+        prefix = message or (name or "var")
+        if isinstance(raw, jax.core.Tracer):
+            jax.debug.print(prefix + ": {x}", x=raw)
+        else:
+            head = " ".join(str(v) for v in
+                            __import__("numpy").asarray(raw).ravel()[:summarize])
+            shp = f" shape={tuple(raw.shape)}" if print_tensor_shape else ""
+            print(f"{prefix}{shp}: {head}")
+        return input

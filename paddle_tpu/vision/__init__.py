@@ -8,6 +8,8 @@ from .models import (  # noqa: F401
     MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2,
     mobilenet_v3_large, mobilenet_v3_small,
 )
+from .image import (image_load, image_decode, read_file,  # noqa: F401
+                    decode_jpeg)
 
 
 def set_image_backend(backend):

@@ -1,0 +1,52 @@
+"""Image file IO ops (reference: operators/read_file_op.cc,
+operators/decode_jpeg_op.cc — the reference decodes via nvjpeg on GPU;
+host-side PIL/numpy is the TPU-era equivalent since decode feeds the input
+pipeline, not the accelerator).
+"""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.creation import to_tensor
+
+__all__ = ["image_load", "image_decode", "read_file", "decode_jpeg"]
+
+
+def read_file(path, name=None):
+    """reference: read_file_op — file bytes as a uint8 tensor."""
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return to_tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: decode_jpeg_op — decode an encoded image byte tensor to
+    CHW uint8. ``mode``: unchanged | gray | rgb."""
+    from PIL import Image
+    raw = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    img = Image.open(_io.BytesIO(raw.tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(np.ascontiguousarray(arr))
+
+
+def image_load(path, backend=None):
+    """reference: vision/image.py image_load — HWC image (numpy backend)."""
+    from PIL import Image
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+def image_decode(x, mode="rgb"):
+    """Alias of :func:`decode_jpeg` under the vision namespace."""
+    return decode_jpeg(x, mode=mode)

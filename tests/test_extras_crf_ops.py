@@ -414,3 +414,23 @@ def test_chunk_eval_iobes():
     inf = np.array([[3, 0, 1, 2]])
     p, r, f1, ni, nl, nc = ops.chunk_eval(inf, lab, "IOBES", 1)
     assert (ni, nl, nc) == (2, 2, 2) and f1 == 1.0
+
+
+def test_viterbi_decode_bos_eos_convention():
+    """Pins the documented layout: row C-2 = BOS->tag, col C-1 = tag->EOS."""
+    rng = np.random.RandomState(17)
+    C = 4
+    em = rng.randn(1, 3, C).astype(np.float32)
+    W = rng.randn(C, C).astype(np.float32)
+    lens = np.array([3])
+    scores, paths = ops.viterbi_decode(t(em), t(W), t(lens),
+                                       include_bos_eos_tag=True)
+    start, stop = W[C - 2], W[:, C - 1]
+    best, bs = None, -np.inf
+    for p in itertools.product(range(C), repeat=3):
+        s = (start[p[0]] + em[0, 0, p[0]] + stop[p[2]]
+             + sum(em[0, k, p[k]] + W[p[k - 1], p[k]] for k in range(1, 3)))
+        if s > bs:
+            bs, best = s, p
+    np.testing.assert_allclose(float(scores.numpy()[0]), bs, rtol=1e-4)
+    np.testing.assert_array_equal(paths.numpy()[0], best)

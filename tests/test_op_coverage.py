@@ -1,0 +1,59 @@
+"""The op-coverage audit is CI: every reference catalog op must map to an
+implementation / absorption / ADR with import-checked targets (VERDICT r3
+item 6)."""
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_coverage_audit_no_blanks():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "op_coverage.py"),
+         "--check"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "blanks=0" in r.stdout
+
+
+def test_coverage_doc_exists_and_counts():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(repo, "docs", "op_coverage.md")).read()
+    assert "| reference op | status | mapping |" in doc
+    # the >=470 bar from VERDICT r3 item 6
+    import re
+    m = re.search(r"Implemented \+ absorbed = (\d+) / (\d+)", doc)
+    assert m and int(m.group(1)) >= 470, m.group(0) if m else doc[:200]
+
+
+def test_static_assert_and_print():
+    import paddle_tpu.static as st
+    t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    assert st.nn.Assert(paddle.to_tensor(True)) is not None
+    try:
+        st.nn.Assert(paddle.to_tensor(False), data=[t])
+        assert False, "Assert(False) must raise"
+    except AssertionError:
+        pass
+    out = st.nn.Print(t, message="dbg")
+    np.testing.assert_allclose(out.numpy(), t.numpy())
+
+
+def test_image_io_roundtrip(tmp_path):
+    from PIL import Image
+    arr = (np.random.RandomState(0).rand(8, 10, 3) * 255).astype(np.uint8)
+    p = tmp_path / "x.png"
+    Image.fromarray(arr).save(p)
+    raw = paddle.vision.read_file(str(p))
+    assert raw.dtype == "uint8" and raw.ndim == 1
+    img = paddle.vision.decode_jpeg(raw, mode="rgb")
+    assert img.shape == [3, 8, 10]
+    np.testing.assert_array_equal(np.transpose(img.numpy(), (1, 2, 0)), arr)
+    hwc = paddle.vision.image_load(str(p))
+    np.testing.assert_array_equal(hwc, arr)

@@ -215,7 +215,8 @@ def test_sample_logits_subtract_log_q_and_hits():
     lab = rng.randint(0, C, (N, 1)).astype(np.int64)
     seed = 13
     s_logits, s_label = F.sample_logits(paddle.to_tensor(logits),
-                                        paddle.to_tensor(lab), S, seed=seed)
+                                        paddle.to_tensor(lab), S, uniq=False,
+                                        seed=seed)
     s_logits = s_logits.numpy()
     assert s_logits.shape == (N, 1 + S)
     np.testing.assert_array_equal(s_label.numpy(),
@@ -334,3 +335,29 @@ def test_deformable_psroi_zero_trans_and_shift():
         no_trans=False, spatial_scale=1.0, group_size=gs, pooled_height=2,
         pooled_width=2, part_size=2, sample_per_part=2).numpy()
     assert not np.allclose(a, c)
+
+
+def test_class_center_sample_rejects_too_many_positives():
+    lab = np.arange(10, dtype=np.int64)     # 10 distinct positives
+    with pytest.raises(ValueError, match="distinct positive"):
+        F.class_center_sample(paddle.to_tensor(lab), 50, 8)
+
+
+def test_sample_logits_uniq_draws_distinct_negatives():
+    rng = np.random.RandomState(18)
+    N, C, S = 4, 12, 10
+    logits = rng.randn(N, C).astype(np.float32)
+    lab = rng.randint(0, C, (N, 1)).astype(np.int64)
+    s_logits, _ = F.sample_logits(paddle.to_tensor(logits),
+                                  paddle.to_tensor(lab), S, uniq=True,
+                                  remove_accidental_hits=False, seed=4)
+    assert s_logits.shape == [N, 1 + S]
+    # with replacement, 10 draws from 12 classes would collide w.h.p.;
+    # uniq must not: recover the sampled classes from the adjusted logits
+    import jax, jax.numpy as jnp
+    logp = np.log(np.log((np.arange(C) + 2) / (np.arange(C) + 1))
+                  / np.log(C + 1))
+    g = np.asarray(jax.random.gumbel(jax.random.PRNGKey(4), (N, C)))
+    neg = np.argsort(-(logp[None] + g), axis=1)[:, :S]
+    for row in neg:
+        assert len(set(row.tolist())) == S

@@ -1,0 +1,495 @@
+#!/usr/bin/env python
+"""Forward-operator coverage audit vs the reference catalog.
+
+``tools/op_catalog.txt`` is the list of forward op types extracted from the
+reference's registration macros (REGISTER_OPERATOR / REGISTER_OP_WITHOUT_
+GRADIENT / kernel+version registrations / FOR_EACH_ACTIVATION_OP) plus
+``*_op.cc`` basenames, grad ops excluded — the same extraction SURVEY
+Appendix A describes (518 entries).
+
+Every catalog op must resolve to exactly one status:
+
+- ``impl``      — a public API in this framework implements the capability;
+                  the mapping target is import-checked, so the doc can't rot.
+- ``absorbed``  — the mechanism is XLA/JAX's job (fusion passes, stream
+                  sync, buffer coalescing); nothing framework-side remains.
+- ``adr``       — deliberately out of scope, with a written ADR.
+- ``na``        — meaningless off-CUDA/Ascend/MKLDNN or engine-specific.
+
+Run:  python tools/op_coverage.py          # regenerates docs/op_coverage.md
+      python tools/op_coverage.py --check  # CI: fail on blanks/bad targets
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# -- status tables ------------------------------------------------------------
+
+# ops whose name auto-resolves against these namespaces (tried in order)
+NAMESPACES = [
+    ("paddle", "paddle_tpu"),
+    ("ops", "paddle_tpu.ops"),
+    ("F", "paddle_tpu.nn.functional"),
+    ("nn", "paddle_tpu.nn"),
+    ("dist", "paddle_tpu.distributed"),
+    ("static.nn", "paddle_tpu.static.nn"),
+    ("static", "paddle_tpu.static"),
+    ("opt", "paddle_tpu.optimizer"),
+    ("amp", "paddle_tpu.amp"),
+    ("quant", "paddle_tpu.quantization"),
+    ("io", "paddle_tpu.io"),
+    ("incubate", "paddle_tpu.incubate"),
+    ("metric", "paddle_tpu.metric"),
+    ("vision", "paddle_tpu.vision"),
+    ("text", "paddle_tpu.text"),
+]
+
+# name rewrites applied before auto-resolution (reference name -> ours)
+ALIASES = {
+    "arg_max": "argmax", "arg_min": "argmin",
+    "reduce_sum": "sum", "reduce_mean": "mean", "reduce_max": "max",
+    "reduce_min": "min", "reduce_prod": "prod", "reduce_all": "all",
+    "reduce_any": "any",
+    "elementwise_add": "add", "elementwise_sub": "subtract",
+    "elementwise_mul": "multiply", "elementwise_div": "divide",
+    "elementwise_max": "maximum", "elementwise_min": "minimum",
+    "elementwise_mod": "mod", "elementwise_pow": "pow",
+    "elementwise_floordiv": "floor_divide",
+    "top_k": "topk", "top_k_v2": "topk",
+    "fill_any_like": "full_like", "fill_constant": "full",
+    "fill_zeros_like": "zeros_like", "fill": "full",
+    "uniform_random": "uniform", "gaussian_random": "normal",
+    "truncated_gaussian_random": "truncated_normal",
+    "grid_sampler": "grid_sample",
+    "lookup_table": "embedding", "lookup_table_v2": "embedding",
+    "tril_triu": "tril", "where_index": "nonzero",
+    "hard_sigmoid": "hardsigmoid", "hard_swish": "hardswish",
+    "hard_shrink": "hardshrink", "soft_shrink": "softshrink",
+    "tanh_shrink": "tanhshrink", "logsigmoid": "log_sigmoid",
+    "depthwise_conv2d": "conv2d",
+    "depthwise_conv2d_transpose": "conv2d_transpose",
+    "sigmoid_cross_entropy_with_logits": "binary_cross_entropy_with_logits",
+    "range": "arange", "isfinite_op": "isfinite",
+    "brelu": "hardtanh", "softshrink": "softshrink",
+    "bilinear_tensor_product": "bilinear",
+    "margin_rank_loss": "margin_rank_loss",
+    "smooth_l1_loss": "smooth_l1_loss",
+    "unpool": "max_unpool2d",
+    "pool_with_index": "max_pool2d",
+    "max_pool2d_with_index": "max_pool2d",
+    "max_pool3d_with_index": "max_pool3d",
+    "pad2d": "pad", "pad3d": "pad",
+    "crop_tensor": "crop",
+    "lrn": "local_response_norm",
+    "thresholded_relu": "thresholded_relu",
+    "kldiv_loss": "kl_div",
+    "log_loss": "log_loss",
+    "sampling_id": "sampling_id",
+    "hierarchical_sigmoid": "hsigmoid_loss",
+    "spectral_norm": "SpectralNorm",
+    "sync_batch_norm": "SyncBatchNorm",
+    "inplace_abn": "SyncBatchNorm",
+    "squared_l2_distance": "squared_l2_distance",
+    "gru": "GRU", "gru_unit": "GRUCell", "multi_gru": "GRU",
+    "lstm": "LSTM", "lstm_unit": "LSTMCell", "lstmp": "LSTM",
+    "cudnn_lstm": "LSTM", "rnn": "RNN", "recurrent": "RNN",
+    "memcpy": "assign", "minus": "subtract",
+    "seed": "seed",
+    "one_hot": "one_hot", "one_hot_v2": "one_hot",
+}
+
+# explicit "impl" mappings that an attribute probe can't find (methods,
+# classes with different names, multi-step capabilities)
+MANUAL_IMPL = {
+    # optimizers-as-ops -> optimizer classes (apply-gradients kernels)
+    "adadelta": "paddle_tpu.optimizer:Adadelta",
+    "adagrad": "paddle_tpu.optimizer:Adagrad",
+    "adam": "paddle_tpu.optimizer:Adam",
+    "adamax": "paddle_tpu.optimizer:Adamax",
+    "ftrl": "paddle_tpu.optimizer:Ftrl",
+    "lamb": "paddle_tpu.optimizer:Lamb",
+    "lars_momentum": "paddle_tpu.optimizer:LarsMomentum",
+    "momentum": "paddle_tpu.optimizer:Momentum",
+    "rmsprop": "paddle_tpu.optimizer:RMSProp",
+    "sgd": "paddle_tpu.optimizer:SGD",
+    "decayed_adagrad": "paddle_tpu.optimizer:Adagrad",
+    "proximal_adagrad": "paddle_tpu.optimizer:Adagrad",
+    "proximal_gd": "paddle_tpu.optimizer:SGD",
+    "dpsgd": "paddle_tpu.optimizer:SGD",
+    "average_accumulates": "paddle_tpu.optimizer:ExponentialMovingAverage",
+    # collectives: c_* ring ops -> mesh collective functions
+    "allreduce": "paddle_tpu.distributed:all_reduce",
+    "alltoall": "paddle_tpu.distributed:alltoall",
+    "barrier": "paddle_tpu.distributed:barrier",
+    "broadcast": "paddle_tpu.distributed:broadcast",
+    "c_allgather": "paddle_tpu.distributed:all_gather",
+    "c_allreduce_max": "paddle_tpu.distributed:all_reduce",
+    "c_allreduce_min": "paddle_tpu.distributed:all_reduce",
+    "c_allreduce_prod": "paddle_tpu.distributed:all_reduce",
+    "c_allreduce_sum": "paddle_tpu.distributed:all_reduce",
+    "c_broadcast": "paddle_tpu.distributed:broadcast",
+    "c_concat": "paddle_tpu.distributed:all_gather",
+    "c_embedding": "paddle_tpu.distributed.fleet:VocabParallelEmbedding",
+    "c_identity": "paddle_tpu.distributed:replicate_tensor",
+    "c_reduce_max": "paddle_tpu.distributed:reduce",
+    "c_reduce_min": "paddle_tpu.distributed:reduce",
+    "c_reduce_prod": "paddle_tpu.distributed:reduce",
+    "c_reduce_sum": "paddle_tpu.distributed:reduce",
+    "c_reducescatter": "paddle_tpu.distributed:reduce_scatter",
+    "c_scatter": "paddle_tpu.distributed:scatter",
+    "c_split": "paddle_tpu.distributed:split",
+    "recv_v2": "paddle_tpu.distributed:recv",
+    "send_v2": "paddle_tpu.distributed:send",
+    "shard_index": "paddle_tpu.ops:shard_index",
+    # program-structure ops -> executor / control-flow machinery
+    "feed": "paddle_tpu.static:Executor",
+    "fetch": "paddle_tpu.static:Executor",
+    "conditional_block": "paddle_tpu.ops:cond",
+    "conditional_block_infer": "paddle_tpu.ops:cond",
+    "while": "paddle_tpu.ops:while_loop",
+    "select_input": "paddle_tpu.ops:case",
+    "select_output": "paddle_tpu.ops:case",
+    "assert": "paddle_tpu.static:nn.Assert",
+    "print": "paddle_tpu.static:nn.Print",
+    "py_func": "paddle_tpu.ops.custom:register_op",
+    "py_layer": "paddle_tpu.autograd:PyLayer",
+    "run_program": "paddle_tpu.jit:to_static",
+    "write_to_array": "paddle_tpu.ops:array_write",
+    "read_from_array": "paddle_tpu.ops:array_read",
+    "lod_array_length": "paddle_tpu.ops:array_length",
+    "tensor_array_to_tensor": "paddle_tpu.ops:stack",
+    "increment": "paddle_tpu.ops:increment",
+    "is_empty": "paddle_tpu.ops:is_empty",
+    # LoD plumbing -> padded+lengths sequence ops
+    "array_to_lod_tensor": "paddle_tpu.ops:sequence_unpad",
+    "lod_tensor_to_array": "paddle_tpu.ops:sequence_pad",
+    "lod_reset": "paddle_tpu.ops:sequence_pad",
+    "lod_rank_table": "paddle_tpu.ops:argsort",
+    "max_sequence_len": "paddle_tpu.ops:sequence_mask",
+    "merge_lod_tensor": "paddle_tpu.ops:multiplex",
+    "merge_lod_tensor_infer": "paddle_tpu.ops:multiplex",
+    "split_lod_tensor": "paddle_tpu.ops:masked_select",
+    "reorder_lod_tensor_by_rank": "paddle_tpu.ops:index_select",
+    "shrink_rnn_memory": "paddle_tpu.ops:sequence_slice",
+    "rnn_memory_helper": "paddle_tpu.ops:assign",
+    "sequence_reshape": "paddle_tpu.ops:reshape",
+    "sequence_scatter": "paddle_tpu.ops:scatter_nd_add",
+    "im2sequence": "paddle_tpu.ops:im2sequence",
+    # IO / persistence
+    "load": "paddle_tpu:load",
+    "save": "paddle_tpu:save",
+    "load_combine": "paddle_tpu:load",
+    "save_combine": "paddle_tpu:save",
+    "read": "paddle_tpu.io:DataLoader",
+    "read_file": "paddle_tpu.vision:read_file",
+    "decode_jpeg": "paddle_tpu.vision:decode_jpeg",
+    "create_custom_reader": "paddle_tpu.io:IterableDataset",
+    "create_py_reader": "paddle_tpu.io:DataLoader",
+    "create_double_buffer_reader": "paddle_tpu.io:DataLoader",
+    # AMP ops -> GradScaler internals
+    "check_finite_and_unscale": "paddle_tpu.amp:GradScaler",
+    "update_loss_scaling": "paddle_tpu.amp:GradScaler",
+    # quantization op family -> quantization module
+    "quantize": "paddle_tpu.quantization:quant_dequant_with_scale",
+    "dequantize": "paddle_tpu.quantization:quant_dequant_with_scale",
+    "requantize": "paddle_tpu.quantization:quant_dequant_with_scale",
+    "dequantize_abs_max": "paddle_tpu.quantization:fake_quantize_abs_max",
+    "dequantize_log": "paddle_tpu.quantization:quant_dequant_with_scale",
+    "fake_quantize_abs_max": "paddle_tpu.quantization:fake_quantize_abs_max",
+    "fake_quantize_dequantize_abs_max":
+        "paddle_tpu.quantization:fake_quantize_abs_max",
+    "fake_quantize_moving_average_abs_max":
+        "paddle_tpu.quantization:MovingAverageAbsMaxObserver",
+    "fake_quantize_range_abs_max":
+        "paddle_tpu.quantization:MovingAverageAbsMaxObserver",
+    "fake_dequantize_max_abs": "paddle_tpu.quantization:fake_quantize_abs_max",
+    "fake_channel_wise_quantize_abs_max":
+        "paddle_tpu.quantization:fake_channel_wise_quantize_abs_max",
+    "fake_channel_wise_dequantize_max_abs":
+        "paddle_tpu.quantization:fake_channel_wise_quantize_abs_max",
+    "moving_average_abs_max_scale":
+        "paddle_tpu.quantization:MovingAverageAbsMaxObserver",
+    # losses/metrics with different spellings
+    "accuracy": "paddle_tpu.metric:Accuracy",
+    "auc": "paddle_tpu.metric:Auc",
+    "precision_recall": "paddle_tpu.ops:precision_recall",
+    "cross_entropy": "paddle_tpu.nn.functional:cross_entropy",
+    "cross_entropy2": "paddle_tpu.nn.functional:cross_entropy",
+    "softmax_with_cross_entropy": "paddle_tpu.nn.functional:cross_entropy",
+    "bce_loss": "paddle_tpu.nn.functional:binary_cross_entropy",
+    "huber_loss": "paddle_tpu.nn.functional:huber_loss",
+    "warpctc": "paddle_tpu.nn.functional:warpctc",
+    "nce": "paddle_tpu.nn.functional:nce",
+    "sample_logits": "paddle_tpu.nn.functional:sample_logits",
+    "linear_chain_crf": "paddle_tpu.ops:linear_chain_crf",
+    "crf_decoding": "paddle_tpu.ops:crf_decoding",
+    "chunk_eval": "paddle_tpu.ops:chunk_eval",
+    # interp family -> interpolate(mode=...)
+    "bilinear_interp": "paddle_tpu.nn.functional:interpolate",
+    "bilinear_interp_v2": "paddle_tpu.nn.functional:interpolate",
+    "bicubic_interp": "paddle_tpu.nn.functional:interpolate",
+    "bicubic_interp_v2": "paddle_tpu.nn.functional:interpolate",
+    "linear_interp": "paddle_tpu.nn.functional:interpolate",
+    "linear_interp_v2": "paddle_tpu.nn.functional:interpolate",
+    "nearest_interp": "paddle_tpu.nn.functional:interpolate",
+    "nearest_interp_v2": "paddle_tpu.nn.functional:interpolate",
+    "trilinear_interp": "paddle_tpu.nn.functional:interpolate",
+    "trilinear_interp_v2": "paddle_tpu.nn.functional:interpolate",
+    # misc renamed
+    "fc": "paddle_tpu.static:nn.fc",
+    "mul": "paddle_tpu.ops:matmul",
+    "pool": "paddle_tpu.nn.functional:max_pool2d",
+    "unique_with_counts": "paddle_tpu.ops:unique",
+    "cos_sim": "paddle_tpu.ops:cos_sim",
+    "fill_constant_batch_size_like":
+        "paddle_tpu.ops:fill_constant_batch_size_like",
+    "uniform_random_batch_size_like":
+        "paddle_tpu.ops:uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like":
+        "paddle_tpu.ops:gaussian_random_batch_size_like",
+    "assign_value": "paddle_tpu.ops:assign_value",
+    "set_value": "paddle_tpu.core.tensor:Tensor.set_value",
+    "random_crop": "paddle_tpu.vision.transforms:RandomCrop",
+    "prroi_pool": "paddle_tpu.ops:prroi_pool",
+    "psroi_pool": "paddle_tpu.ops:psroi_pool",
+    "deformable_psroi_pooling": "paddle_tpu.ops:deformable_psroi_pooling",
+    "deformable_conv": "paddle_tpu.nn.functional:deformable_conv",
+    "deformable_conv_v1": "paddle_tpu.nn.functional:deformable_conv",
+    "segment_pool": "paddle_tpu.incubate:segment_pool",
+    "class_center_sample": "paddle_tpu.nn.functional:class_center_sample",
+    "partial_concat": "paddle_tpu.ops:partial_concat",
+    "partial_sum": "paddle_tpu.ops:partial_sum",
+    "pad_constant_like": "paddle_tpu.ops:pad_constant_like",
+    "batch_fc": "paddle_tpu.ops:batch_fc",
+    "data_norm": "paddle_tpu.ops:data_norm",
+    "affine_channel": "paddle_tpu.ops:affine_channel",
+    "shuffle_batch": "paddle_tpu.ops:shuffle_batch",
+    "shuffle_channel": "paddle_tpu.ops:shuffle_channel",
+    "cvm": "paddle_tpu.ops:cvm",
+    "filter_by_instag": "paddle_tpu.ops:filter_by_instag",
+    "row_conv": "paddle_tpu.ops:row_conv",
+    "conv_shift": "paddle_tpu.ops:conv_shift",
+    "add_position_encoding": "paddle_tpu.ops:add_position_encoding",
+    "correlation": "paddle_tpu.ops:correlation",
+    "similarity_focus": "paddle_tpu.ops:similarity_focus",
+    "fsp": "paddle_tpu.ops:fsp",
+    "spp": "paddle_tpu.ops:spp",
+    "match_matrix_tensor": "paddle_tpu.ops:match_matrix_tensor",
+    "mean_iou": "paddle_tpu.ops:mean_iou",
+    "positive_negative_pair": "paddle_tpu.ops:positive_negative_pair",
+    "bpr_loss": "paddle_tpu.ops:bpr_loss",
+    "modified_huber_loss": "paddle_tpu.ops:modified_huber_loss",
+    "teacher_student_sigmoid_loss":
+        "paddle_tpu.ops:teacher_student_sigmoid_loss",
+    "center_loss": "paddle_tpu.ops:center_loss",
+    "sequence_topk_avg_pooling": "paddle_tpu.ops:sequence_pool",
+    "edit_distance": "paddle_tpu.ops:edit_distance",
+    "ctc_align": "paddle_tpu.ops:ctc_align",
+    "temporal_shift": "paddle_tpu.nn.functional:temporal_shift",
+    "sampling_id": "paddle_tpu.nn.functional:sampling_id",
+    "multiclass_nms2": "paddle_tpu.ops:multiclass_nms",
+    "multiclass_nms3": "paddle_tpu.ops:multiclass_nms",
+    "locality_aware_nms": "paddle_tpu.ops:matrix_nms",
+    "label_smooth": "paddle_tpu.nn.functional:label_smooth",
+    "get_tensor_from_selected_rows":
+        "paddle_tpu.distributed.fleet:ShardedEmbedding",
+    "merge_selected_rows":
+        "paddle_tpu.distributed.fleet:sparse_row_update",
+    "clip_by_norm": "paddle_tpu.ops:clip_by_norm",
+    "coalesce_tensor": "paddle_tpu.distributed.sharding:group_sharded_parallel",
+}
+
+# XLA/JAX absorb these mechanisms entirely (SURVEY §2 "absorbed" rows)
+ABSORBED = {
+    # stream/ordering ops: XLA's async runtime orders collectives/compute
+    "c_sync_calc_stream": "XLA async dispatch orders compute",
+    "c_sync_comm_stream": "XLA async dispatch orders collectives",
+    "c_wait_comm": "XLA token-threaded collectives",
+    "c_wait_compute": "XLA token-threaded collectives",
+    "c_comm_init": "jax.distributed.initialize",
+    "c_comm_init_all": "jax.distributed.initialize",
+    "c_gen_nccl_id": "jax.distributed bootstrap",
+    "gen_nccl_id": "jax.distributed bootstrap",
+    # fused/inference-engine ops: XLA fusion emits these automatically
+    "attention_lstm": "XLA fusion of the unfused graph",
+    "conv_fusion": "XLA conv+bias+act fusion",
+    "fusion_conv_inception": "XLA fusion",
+    "fused_bn_activation": "XLA fusion",
+    "fused_bn_add_activation": "XLA fusion",
+    "fused_elemwise_activation": "XLA elementwise fusion",
+    "fused_embedding_eltwise_layernorm": "XLA fusion",
+    "fused_embedding_fc_lstm": "XLA fusion",
+    "fused_embedding_seq_pool": "XLA gather+reduce fusion",
+    "fused_fc_elementwise_layernorm": "XLA fusion",
+    "fusion_group": "XLA fusion pass (this op IS a fusion pass product)",
+    "fusion_gru": "XLA fusion of the scan",
+    "fusion_lstm": "XLA fusion of the scan",
+    "fusion_repeated_fc_relu": "XLA fusion",
+    "fusion_seqconv_eltadd_relu": "XLA fusion",
+    "fusion_seqexpand_concat_fc": "XLA fusion",
+    "fusion_seqpool_concat": "XLA fusion",
+    "fusion_seqpool_cvm_concat": "XLA fusion",
+    "fusion_squared_mat_sub": "XLA fusion",
+    "fusion_transpose_flatten_concat": "XLA layout assignment",
+    "multihead_matmul": "XLA attention fusion",
+    "skip_layernorm": "XLA fusion",
+    "squared_l2_norm": "XLA fusion of square+reduce",
+    # program plumbing with no XLA counterpart needed
+    "delete_var": "XLA buffer liveness / donation",
+    "get_places": "jax.devices()",
+    "enqueue": "io prefetch thread (io/__init__.py)",
+    "dequeue": "io prefetch thread",
+    "queue_generator": "io prefetch thread",
+    "marker": "jax.profiler.TraceAnnotation",
+    "copy_cross_scope": "functional scoping (no Scope tree)",
+    "alloc_float_status": "float-status registers are an Ascend mechanism;"
+                          " NaN checks via FLAGS_check_nan_inf in dispatch",
+}
+
+# decided out of scope with a written ADR
+ADR = {
+    # docs/adr/0001-parameter-server.md: brpc PS replaced by sharded tables
+    **{k: "docs/adr/0001-parameter-server.md" for k in [
+        "distributed_lookup_table", "fake_init", "fetch_barrier",
+        "heter_listen_and_serv", "listen_and_serv", "send", "send_and_recv",
+        "send_barrier", "pull_box_sparse", "pull_box_extended_sparse",
+        "push_box_sparse", "push_box_extended_sparse", "pull_sparse",
+        "pull_sparse_v2", "push_sparse", "push_sparse_v2", "push_dense",
+        "tdm_child", "tdm_sampler", "pyramid_hash", "hash",
+        "rank_attention", "lookup_table_dequant",
+        "create_ctr_reader",
+    ]},
+    # docs/adr/0002-dgc.md: top-k grad compression is ICI-pointless
+    "dgc": "docs/adr/0002-dgc.md",
+    "dgc_clip_by_norm": "docs/adr/0002-dgc.md",
+    "dgc_momentum": "docs/adr/0002-dgc.md",
+    # docs/adr/0003-lod-niche-ops.md (this round): LoD-era text-matching
+    "var_conv_2d": "docs/adr/0003-lod-niche-ops.md",
+    "tree_conv": "docs/adr/0003-lod-niche-ops.md",
+    "detection_map": "docs/adr/0003-lod-niche-ops.md",
+    "bilateral_slice": "docs/adr/0003-lod-niche-ops.md",
+    "roi_perspective_transform": "docs/adr/0003-lod-niche-ops.md",
+    "retinanet_detection_output": "docs/adr/0003-lod-niche-ops.md",
+    "retinanet_target_assign": "docs/adr/0003-lod-niche-ops.md",
+    "rpn_target_assign": "docs/adr/0003-lod-niche-ops.md",
+    "generate_proposal_labels": "docs/adr/0003-lod-niche-ops.md",
+    "generate_mask_labels": "docs/adr/0003-lod-niche-ops.md",
+    "mine_hard_examples": "docs/adr/0003-lod-niche-ops.md",
+}
+
+# no meaning off the reference's backends / engines
+NA = {
+    "ascend_trigger": "Ascend backend",
+    "c_comm_init_hccl": "Ascend HCCL",
+    "c_gen_hccl_id": "Ascend HCCL",
+    "c_gen_bkcl_id": "Kunlun BKCL",
+    "gen_hccl_id": "Ascend HCCL",
+    "gen_bkcl_id": "Kunlun BKCL",
+    "dlnne_engine": "NNE inference engine",
+    "lite_engine": "Paddle-Lite engine",
+    "tensorrt_engine": "TensorRT engine",
+}
+
+
+def resolve(name):
+    if name in MANUAL_IMPL:
+        return "impl", MANUAL_IMPL[name]
+    if name in ABSORBED:
+        return "absorbed", ABSORBED[name]
+    if name in ADR:
+        return "adr", ADR[name]
+    if name in NA:
+        return "na", NA[name]
+    cands = [name]
+    if name in ALIASES:
+        cands.append(ALIASES[name])
+    if name.endswith("_v2"):
+        cands.append(name[:-3])
+        if name[:-3] in ALIASES:
+            cands.append(ALIASES[name[:-3]])
+    elif name.endswith("2") and not name.endswith("v2"):
+        cands.append(name[:-1])
+    for c in cands:
+        for label, modname in NAMESPACES:
+            try:
+                mod = importlib.import_module(modname)
+            except ImportError:
+                continue
+            if hasattr(mod, c):
+                return "impl", f"{modname}:{c}"
+    return None, None
+
+
+def check_target(target):
+    """impl targets must import (module:attr[.attr])."""
+    modname, _, attr = target.partition(":")
+    try:
+        mod = importlib.import_module(modname)
+    except ImportError:
+        return False
+    obj = mod
+    for part in attr.split("."):
+        if not hasattr(obj, part):
+            return False
+        obj = getattr(obj, part)
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args()
+
+    names = [l.strip() for l in
+             open(os.path.join(REPO, "tools", "op_catalog.txt"))
+             if l.strip()]
+    rows, blanks, bad = [], [], []
+    counts = {"impl": 0, "absorbed": 0, "adr": 0, "na": 0}
+    for n in names:
+        status, target = resolve(n)
+        if status is None:
+            blanks.append(n)
+            rows.append((n, "BLANK", ""))
+            continue
+        if status == "impl" and not check_target(target):
+            bad.append((n, target))
+        counts[status] += 1
+        rows.append((n, status, target))
+
+    out = os.path.join(REPO, "docs", "op_coverage.md")
+    with open(out, "w") as f:
+        f.write("# Forward-operator coverage vs the reference catalog\n\n")
+        f.write("Generated by `python tools/op_coverage.py` from "
+                "`tools/op_catalog.txt` (extracted from the reference's "
+                "registration macros; see SURVEY Appendix A).\n\n")
+        total = len(names)
+        f.write(f"**{total} catalog ops**: {counts['impl']} implemented, "
+                f"{counts['absorbed']} absorbed by XLA/JAX, "
+                f"{counts['adr']} ADR'd out of scope, {counts['na']} n/a "
+                f"(other-backend/engine), {len(blanks)} blank.\n\n")
+        f.write(f"Implemented + absorbed = "
+                f"{counts['impl'] + counts['absorbed']} / "
+                f"{total - counts['na']} TPU-meaningful ops.\n\n")
+        f.write("| reference op | status | mapping |\n|---|---|---|\n")
+        for n, s, tgt in rows:
+            f.write(f"| `{n}` | {s} | {tgt} |\n")
+    print(f"wrote {out}")
+    print(f"{len(names)} ops: {counts} blanks={len(blanks)}")
+    if blanks:
+        print("BLANK:", " ".join(blanks))
+    if bad:
+        print("BAD TARGETS:")
+        for n, tgt in bad:
+            print(f"  {n} -> {tgt}")
+    if args.check and (blanks or bad):
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
