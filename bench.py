@@ -113,7 +113,10 @@ def bench_bert(on_tpu: bool):
 
     if on_tpu:
         cfg = BertConfig()              # base: 12L, 768h
-        batch, seq, steps = 64, 128, 10
+        # B=256: the 6ND MFU plateau (docs/perf_notes.md "BERT") and the
+        # per-step dispatch cost (~10 ms for ~600 buffers through the
+        # axon tunnel, measured) amortizes to ~2.5% of the step
+        batch, seq, steps = 256, 128, 6
     else:
         cfg = BertConfig(vocab_size=1000, hidden_size=64, num_layers=2,
                          num_heads=2, intermediate_size=128,

@@ -307,8 +307,14 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         return _run("c_allreduce", tensor,
                     lambda x: _raw_allreduce(x, op, g, axes))
     if jax.process_count() > 1:
-        return _run("c_allreduce", tensor,
-                    lambda x: _eager_multiprocess_reduce(x, op))
+        # host-level path (see broadcast): keep multihost_utils outside
+        # the op funnel's jit
+        raw = tensor._data if isinstance(tensor, Tensor) else tensor
+        out = _eager_multiprocess_reduce(raw, op)
+        if isinstance(tensor, Tensor):
+            tensor._swap_payload(Tensor(jnp.asarray(out)))
+            return tensor
+        return out
     return tensor  # world of one
 
 
@@ -337,9 +343,17 @@ def broadcast(tensor, src, group=None, sync_op=True):
         return _run("c_broadcast", tensor,
                     lambda x: _raw_broadcast(x, src_in_group, g, axes))
     if jax.process_count() > 1:
+        # host-level collective: multihost_utils drives its own pjit and
+        # must NOT run inside the eager op funnel's jit (a traced input
+        # would hit TracerArrayConversionError)
         from jax.experimental import multihost_utils
-        return _run("c_broadcast", tensor,
-                    lambda x: multihost_utils.broadcast_one_to_all(x))
+        raw = tensor._data if isinstance(tensor, Tensor) else tensor
+        out = multihost_utils.broadcast_one_to_all(
+            raw, is_source=jax.process_index() == int(src))
+        if isinstance(tensor, Tensor):
+            tensor._swap_payload(Tensor(jnp.asarray(out)))
+            return tensor
+        return out
     return tensor
 
 

@@ -24,12 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .dispatch import apply
+from .dispatch import apply, raw as _raw
 from ..core.tensor import Tensor
-
-
-def _raw(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):

@@ -114,6 +114,11 @@ class Optimizer:
     def _lr_dtype(self):
         return jnp.float32
 
+    # True only when _update is purely elementwise on (p, g, state) so it
+    # may run on a coalesced flat buffer (Model.train_loop); optimizers
+    # with cross-element terms (LAMB/LARS trust ratios) must stay False.
+    _elementwise_update = False
+
     def _param_update_ctx(self, params):
         """Per-param static context threaded into the fused update (hashable;
         part of the jit key). Subclasses override — e.g. AdamW returns
@@ -206,6 +211,8 @@ class Optimizer:
 class SGD(Optimizer):
     """reference: operators/optimizers/sgd_op.cc."""
 
+    _elementwise_update = True
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
@@ -216,6 +223,8 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     """reference: operators/optimizers/momentum_op.cc (use_nesterov attr)."""
+
+    _elementwise_update = True
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
@@ -239,6 +248,8 @@ class Momentum(Optimizer):
 
 class Adam(Optimizer):
     """reference: operators/optimizers/adam_op.cc (bias-corrected)."""
+
+    _elementwise_update = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,

@@ -1,0 +1,106 @@
+"""Model.train_batches (compiled K-step scan) and Model.train_loop
+(coalesced flat-buffer steps) must be numerically identical to K
+sequential train_batch calls — params, optimizer state, and BN running
+statistics included (the state-effect threading is the risky part).
+
+Reference analogs: fluid Executor owning the whole train loop;
+operators/coalesce_tensor_op.cc + the fused optimizer family.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as optim
+
+
+def _build(opt_kind):
+    paddle.seed(7)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.BatchNorm1D(16),
+        paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    if opt_kind == "momentum":
+        opt = optim.Momentum(learning_rate=1e-2, momentum=0.9,
+                             parameters=net.parameters(), weight_decay=1e-3,
+                             grad_clip=paddle.ClipGradByGlobalNorm(0.5))
+    elif opt_kind == "adamw":
+        opt = optim.AdamW(learning_rate=1e-2, parameters=net.parameters(),
+                          weight_decay=0.05,
+                          apply_decay_param_fun=lambda n: "weight" in n)
+    else:
+        opt = optim.Lamb(learning_rate=1e-2, parameters=net.parameters())
+    m = paddle.Model(net)
+    m.prepare(opt, paddle.nn.CrossEntropyLoss())
+    return m, net
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (rng.randn(4, 8, 8).astype(np.float32),
+            rng.randint(0, 4, (4, 8)).astype(np.int64))
+
+
+def _reference_losses(opt_kind, xs, ys):
+    m, net = _build(opt_kind)
+    paddle.seed(123)
+    losses = [m.train_batch([paddle.to_tensor(xs[k])],
+                            [paddle.to_tensor(ys[k])])[0]
+              for k in range(len(xs))]
+    return losses, net
+
+
+def _assert_state_equal(net1, net2):
+    for p1, p2 in zip(net1.parameters(), net2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+    s1 = {k: v.numpy() for k, v in net1.state_dict().items()}
+    s2 = {k: v.numpy() for k, v in net2.state_dict().items()}
+    for k in s1:
+        np.testing.assert_allclose(s1[k], s2[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+@pytest.mark.parametrize("opt_kind", ["momentum", "adamw"])
+def test_train_batches_scan_equivalence(opt_kind):
+    xs, ys = _data()
+    ref, net1 = _reference_losses(opt_kind, xs, ys)
+    m2, net2 = _build(opt_kind)
+    paddle.seed(123)
+    got = m2.train_batches([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-5)
+    _assert_state_equal(net1, net2)
+
+
+@pytest.mark.parametrize("opt_kind", ["momentum", "adamw"])
+def test_train_loop_fused_equivalence(opt_kind):
+    xs, ys = _data()
+    ref, net1 = _reference_losses(opt_kind, xs, ys)
+    m2, net2 = _build(opt_kind)
+    paddle.seed(123)
+    got = m2.train_loop([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+    assert m2._fused_loop is not None, "fused path must engage"
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-5)
+    _assert_state_equal(net1, net2)
+
+
+def test_train_loop_falls_back_for_lamb():
+    """LAMB's per-param trust ratio is not elementwise on a flat buffer;
+    the loop must fall back to per-step train_batch, not silently fuse."""
+    xs, ys = _data()
+    ref, net1 = _reference_losses("lamb", xs, ys)
+    m2, net2 = _build("lamb")
+    paddle.seed(123)
+    got = m2.train_loop([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+    assert m2._fused_loop is None
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-5)
+    _assert_state_equal(net1, net2)
+
+
+def test_train_batches_rejects_metrics():
+    m, _ = _build("momentum")
+    m.prepare(m._optimizer, paddle.nn.CrossEntropyLoss(),
+              metrics=paddle.metric.Accuracy())
+    xs, ys = _data()
+    with pytest.raises(ValueError):
+        m.train_batches([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+    with pytest.raises(ValueError):
+        m.train_loop([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
