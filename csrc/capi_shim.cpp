@@ -1,0 +1,249 @@
+/* C shim over the Python Predictor (see paddle_tpu_capi.h).
+ *
+ * Embeds CPython (Py_InitializeEx) and drives
+ * paddle_tpu.inference.Predictor through a tiny helper module defined
+ * inline.  Input buffers cross zero-copy via memoryview -> np.frombuffer;
+ * outputs are held as contiguous numpy arrays and exported through the
+ * buffer protocol, so the caller reads the runtime's own memory.
+ *
+ * reference parity target: inference/capi_exp/pd_inference_api.h
+ * (PD_PredictorCreate / PD_PredictorRun / PD_TensorData...).
+ */
+#include "paddle_tpu_capi.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+static std::string g_last_error;
+
+static void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_last_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+static const char* kHelperSrc = R"PY(
+import os
+if os.environ.get("PTC_FORCE_CPU"):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int32): 1,
+                np.dtype(np.int64): 2}
+
+
+def create(prefix):
+    import jax
+    # deployment default: let jax pick; CPU hosts serve artifacts too
+    from paddle_tpu.inference import Config, create_predictor
+    return create_predictor(Config(prefix))
+
+
+def run(pred, views, shapes, dtypes):
+    xs = []
+    for mv, shp, dt in zip(views, shapes, dtypes):
+        a = np.frombuffer(mv, dtype=_DTYPES[int(dt)]).reshape(shp)
+        xs.append(a)
+    outs = pred.run(xs)
+    keep = []
+    for o in outs:
+        a = np.ascontiguousarray(np.asarray(o))
+        if a.dtype not in _DTYPE_CODES:
+            a = np.ascontiguousarray(a, np.float32)
+        keep.append(a)
+    return keep
+
+
+def out_dtype_code(a):
+    return _DTYPE_CODES[a.dtype]
+)PY";
+
+struct PTC_Predictor {
+  PyObject* helper;   // module dict holding create/run
+  PyObject* pred;     // the python Predictor
+  PyObject* outputs;  // list of contiguous numpy arrays from last run
+  std::vector<std::vector<int64_t>> out_shapes;
+  std::vector<Py_buffer> out_views;  // live buffer views into outputs
+};
+
+static bool g_py_owner = false;
+static PyThreadState* g_saved_ts = nullptr;
+
+static void release_out_views(PTC_Predictor* p) {
+  for (auto& v : p->out_views) PyBuffer_Release(&v);
+  p->out_views.clear();
+}
+
+extern "C" PTC_Predictor* PTC_PredictorCreate(const char* model_prefix) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_py_owner = true;
+    g_saved_ts = PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PTC_Predictor* p = nullptr;
+  PyObject* mod = nullptr;
+  PyObject* pred = nullptr;
+  do {
+    mod = PyModule_New("_ptc_helper");
+    if (!mod) break;
+    PyObject* globals = PyModule_GetDict(mod);
+    PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+    PyObject* r = PyRun_String(kHelperSrc, Py_file_input, globals, globals);
+    if (!r) break;
+    Py_DECREF(r);
+    PyObject* create = PyDict_GetItemString(globals, "create");
+    pred = PyObject_CallFunction(create, "s", model_prefix);
+    if (!pred) break;
+    p = new PTC_Predictor();
+    p->helper = mod;
+    p->pred = pred;
+    p->outputs = nullptr;
+    mod = nullptr;
+    pred = nullptr;
+  } while (false);
+  if (!p) set_err_from_python();
+  Py_XDECREF(mod);
+  Py_XDECREF(pred);
+  PyGILState_Release(gil);
+  return p;
+}
+
+extern "C" int PTC_GetNumInputs(PTC_Predictor* p) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n = -1;
+  PyObject* names = PyObject_CallMethod(p->pred, "get_input_names", nullptr);
+  if (names) {
+    n = static_cast<int>(PyList_Size(names));
+    Py_DECREF(names);
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+extern "C" int PTC_Run(PTC_Predictor* p, const void* const* inputs,
+                       const int64_t* const* shapes, const int* ndims,
+                       const int* dtypes, int n_inputs) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* views = PyList_New(n_inputs);
+  PyObject* shp_list = PyList_New(n_inputs);
+  PyObject* dt_list = PyList_New(n_inputs);
+  do {
+    if (!views || !shp_list || !dt_list) break;
+    bool ok = true;
+    for (int i = 0; i < n_inputs; ++i) {
+      int64_t elems = 1;
+      PyObject* shp = PyTuple_New(ndims[i]);
+      for (int d = 0; d < ndims[i]; ++d) {
+        elems *= shapes[i][d];
+        PyTuple_SET_ITEM(shp, d, PyLong_FromLongLong(shapes[i][d]));
+      }
+      int esize = dtypes[i] == PTC_FLOAT32 ? 4
+                  : dtypes[i] == PTC_INT32 ? 4 : 8;
+      PyObject* mv = PyMemoryView_FromMemory(
+          const_cast<char*>(static_cast<const char*>(inputs[i])),
+          elems * esize, PyBUF_READ);
+      if (!mv) { Py_DECREF(shp); ok = false; break; }
+      PyList_SET_ITEM(views, i, mv);
+      PyList_SET_ITEM(shp_list, i, shp);
+      PyList_SET_ITEM(dt_list, i, PyLong_FromLong(dtypes[i]));
+    }
+    if (!ok) break;
+    PyObject* globals = PyModule_GetDict(p->helper);
+    PyObject* runfn = PyDict_GetItemString(globals, "run");
+    PyObject* outs = PyObject_CallFunctionObjArgs(
+        runfn, p->pred, views, shp_list, dt_list, nullptr);
+    if (!outs) break;
+    release_out_views(p);
+    Py_XDECREF(p->outputs);
+    p->outputs = outs;
+    Py_ssize_t n = PyList_Size(outs);
+    p->out_shapes.assign(n, {});
+    p->out_views.assign(n, Py_buffer{});
+    bool view_ok = true;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* a = PyList_GetItem(outs, i);
+      if (PyObject_GetBuffer(a, &p->out_views[i],
+                             PyBUF_CONTIG_RO | PyBUF_FORMAT) != 0) {
+        view_ok = false;
+        break;
+      }
+      auto& vw = p->out_views[i];
+      p->out_shapes[i].assign(vw.shape, vw.shape + vw.ndim);
+    }
+    if (!view_ok) break;
+    rc = 0;
+  } while (false);
+  if (rc != 0) set_err_from_python();
+  Py_XDECREF(views);
+  Py_XDECREF(shp_list);
+  Py_XDECREF(dt_list);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+extern "C" int PTC_GetNumOutputs(PTC_Predictor* p) {
+  return p->outputs ? static_cast<int>(p->out_shapes.size()) : 0;
+}
+
+extern "C" int PTC_GetOutputNumDims(PTC_Predictor* p, int i) {
+  return static_cast<int>(p->out_shapes[i].size());
+}
+
+extern "C" const int64_t* PTC_GetOutputShape(PTC_Predictor* p, int i) {
+  return p->out_shapes[i].data();
+}
+
+extern "C" int PTC_GetOutputDType(PTC_Predictor* p, int i) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* globals = PyModule_GetDict(p->helper);
+  PyObject* fn = PyDict_GetItemString(globals, "out_dtype_code");
+  PyObject* a = PyList_GetItem(p->outputs, i);
+  PyObject* code = PyObject_CallFunctionObjArgs(fn, a, nullptr);
+  int out = -1;
+  if (code) {
+    out = static_cast<int>(PyLong_AsLong(code));
+    Py_DECREF(code);
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return out;
+}
+
+extern "C" const void* PTC_GetOutputData(PTC_Predictor* p, int i) {
+  return p->out_views[i].buf;
+}
+
+extern "C" void PTC_PredictorDestroy(PTC_Predictor* p) {
+  if (!p) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  release_out_views(p);
+  Py_XDECREF(p->outputs);
+  Py_XDECREF(p->pred);
+  Py_XDECREF(p->helper);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+extern "C" const char* PTC_LastError(void) { return g_last_error.c_str(); }

@@ -1,0 +1,65 @@
+/* Smoke driver for the C inference API (paddle_tpu_capi.h): loads an
+ * artifact, runs one float32 batch, prints outputs for the test harness
+ * to compare against the Python predictor.
+ *
+ *   ./capi_smoke <model_prefix> <n> <d>   (input = n*d counter values)
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <prefix> <n> <d>\n", argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  int n = atoi(argv[2]);
+  int d = atoi(argv[3]);
+
+  PTC_Predictor* p = PTC_PredictorCreate(prefix);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", PTC_LastError());
+    return 1;
+  }
+  printf("n_inputs %d\n", PTC_GetNumInputs(p));
+
+  float* x = (float*)malloc(sizeof(float) * n * d);
+  for (int i = 0; i < n * d; ++i) x[i] = (float)(i % 7) * 0.25f - 0.5f;
+  int64_t shape[2] = {n, d};
+  const void* inputs[1] = {x};
+  const int64_t* shapes[1] = {shape};
+  int ndims[1] = {2};
+  int dtypes[1] = {PTC_FLOAT32};
+  if (PTC_Run(p, inputs, shapes, ndims, dtypes, 1) != 0) {
+    fprintf(stderr, "run failed: %s\n", PTC_LastError());
+    return 1;
+  }
+  int nout = PTC_GetNumOutputs(p);
+  printf("n_outputs %d\n", nout);
+  for (int i = 0; i < nout; ++i) {
+    int nd = PTC_GetOutputNumDims(p, i);
+    const int64_t* s = PTC_GetOutputShape(p, i);
+    printf("out %d dtype %d shape", i, PTC_GetOutputDType(p, i));
+    long total = 1;
+    for (int k = 0; k < nd; ++k) {
+      printf(" %lld", (long long)s[k]);
+      total *= (long)s[k];
+    }
+    printf("\ndata");
+    const float* data = (const float*)PTC_GetOutputData(p, i);
+    for (long k = 0; k < total; ++k) printf(" %.6f", data[k]);
+    printf("\n");
+  }
+  /* second run with the same buffers must work (handle reuse) */
+  if (PTC_Run(p, inputs, shapes, ndims, dtypes, 1) != 0) {
+    fprintf(stderr, "rerun failed: %s\n", PTC_LastError());
+    return 1;
+  }
+  printf("rerun ok\n");
+  free(x);
+  PTC_PredictorDestroy(p);
+  printf("done\n");
+  return 0;
+}

@@ -1,0 +1,63 @@
+/* C inference API over paddle_tpu's StableHLO deployment artifacts.
+ *
+ * The reference ships a C API over its C++ AnalysisPredictor
+ * (reference: paddle/fluid/inference/capi_exp/pd_inference_api.h,
+ * go/paddle/predictor.go builds on it). Here the runtime that executes
+ * the artifact is XLA reached through the Python package, so this shim
+ * embeds a CPython interpreter and exposes the same create/run/fetch
+ * surface as plain C — callable from C, Go (cgo), or R (.C/Rcpp) without
+ * any Python on the caller's side.
+ *
+ * Threading: calls take the GIL internally; the API is safe to call from
+ * one thread at a time.  Dtypes: float32 (0), int32 (1), int64 (2).
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PTC_Predictor PTC_Predictor;
+
+typedef enum {
+  PTC_FLOAT32 = 0,
+  PTC_INT32 = 1,
+  PTC_INT64 = 2,
+} PTC_DType;
+
+/* Load the artifact pair <prefix>.pdmodel / <prefix>.pdiparams.
+ * Returns NULL on failure (see PTC_LastError). */
+PTC_Predictor* PTC_PredictorCreate(const char* model_prefix);
+
+int PTC_GetNumInputs(PTC_Predictor* p);
+
+/* Run with n_inputs host buffers (zero-copy into the runtime: the
+ * buffers are wrapped, not copied; they must stay alive for the call).
+ * shapes[i] has ndims[i] dims; dtypes[i] is a PTC_DType.
+ * Returns 0 on success, -1 on error. */
+int PTC_Run(PTC_Predictor* p, const void* const* inputs,
+            const int64_t* const* shapes, const int* ndims,
+            const int* dtypes, int n_inputs);
+
+int PTC_GetNumOutputs(PTC_Predictor* p);
+int PTC_GetOutputNumDims(PTC_Predictor* p, int i);
+/* Pointer to the i-th output's dims (valid until the next Run). */
+const int64_t* PTC_GetOutputShape(PTC_Predictor* p, int i);
+int PTC_GetOutputDType(PTC_Predictor* p, int i);
+/* Zero-copy pointer into the i-th output's host buffer (valid until the
+ * next Run / destroy). */
+const void* PTC_GetOutputData(PTC_Predictor* p, int i);
+
+void PTC_PredictorDestroy(PTC_Predictor* p);
+
+/* Last error message (thread-local not guaranteed; single-caller API). */
+const char* PTC_LastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

@@ -28,6 +28,8 @@ from .topology import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from .dataset import (DatasetBase, InMemoryDataset,  # noqa: F401
+                      QueueDataset)
 from .sharding import group_sharded_parallel  # noqa: F401
 
 

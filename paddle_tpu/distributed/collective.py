@@ -312,7 +312,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         raw = tensor._data if isinstance(tensor, Tensor) else tensor
         out = _eager_multiprocess_reduce(raw, op)
         if isinstance(tensor, Tensor):
+            # see broadcast: untaped host-level mutation -> version bump
             tensor._swap_payload(Tensor(jnp.asarray(out)))
+            tensor._inplace_version += 1
             return tensor
         return out
     return tensor  # world of one
@@ -351,7 +353,10 @@ def broadcast(tensor, src, group=None, sync_op=True):
         out = multihost_utils.broadcast_one_to_all(
             raw, is_source=jax.process_index() == int(src))
         if isinstance(tensor, Tensor):
+            # raw, untaped replacement (the host collective cannot be
+            # tape-recorded): bump the version so stale-grad guards fire
             tensor._swap_payload(Tensor(jnp.asarray(out)))
+            tensor._inplace_version += 1
             return tensor
         return out
     return tensor

@@ -58,3 +58,114 @@ def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
         blob = pickle.load(f)
     return _from_saved(blob, return_tensor=not return_numpy)
+
+
+# -- reference-format checkpoint interop --------------------------------------
+#
+# The reference's paddle.save writes a pickled dict of numpy arrays with two
+# metadata conventions (python/paddle/framework/io.py:672 +
+# fluid/io.py:1714):
+#   - "StructuredToParameterName@@": structured-name -> internal param name
+#   - "UnpackBigParamInfor@@": >2^30-element params split into "<k>@@.<i>"
+#     slices for pickle protocol 2/3
+# and paddle 2.1 sometimes stored VarBase entries as (name, ndarray) tuples
+# (io.py:327). These readers/writers speak that format so reference zoo
+# checkpoints load here (`pretrained="/path/x.pdparams"`) and trained
+# paddle_tpu weights can be shipped back.
+
+_NAME_TABLE_KEY = "StructuredToParameterName@@"
+_UNPACK_KEY = "UnpackBigParamInfor@@"
+
+
+def load_reference_state_dict(path):
+    """Read a reference-format ``.pdparams`` pickle into a plain
+    {structured_name: np.ndarray} dict."""
+    with open(path, "rb") as f:
+        blob = pickle.load(f, encoding="latin1")
+    if not isinstance(blob, dict):
+        raise ValueError(
+            f"{path}: expected a pickled state_dict, got {type(blob)}")
+    blob = dict(blob)
+    blob.pop(_NAME_TABLE_KEY, None)
+    # reassemble chunked big params
+    unpack = blob.pop(_UNPACK_KEY, None)
+    if unpack:
+        for key, info in unpack.items():
+            slices = [blob.pop(part) for part in info["slices"]]
+            blob[key] = np.concatenate(slices).reshape(info["OriginShape"])
+    out = {}
+    for k, v in blob.items():
+        if isinstance(v, tuple) and len(v) == 2 and isinstance(
+                v[1], np.ndarray):
+            v = v[1]  # paddle-2.1 (tensor.name, ndarray) form
+        if not isinstance(v, np.ndarray):
+            raise ValueError(f"{path}: entry {k!r} is {type(v)}, "
+                             "not an ndarray")
+        out[k] = v
+    return out
+
+
+def save_reference_state_dict(state_dict, path, protocol=4,
+                              _max_elements=None):
+    """Write a reference-format ``.pdparams`` (the exporter direction:
+    paddle_tpu weights usable by the reference's paddle.load)."""
+    save_dict = {}
+    name_table = {}
+    for k, v in state_dict.items():
+        arr = np.asarray(v._data if isinstance(v, Tensor) else v)
+        save_dict[k] = arr
+        name_table[k] = getattr(v, "name", None) or k
+    if 1 < protocol < 4:
+        unpack = {}
+        for k in list(save_dict):
+            v = save_dict[k]
+            max_el = _max_elements or int((2 ** 30 - 1) / v.dtype.itemsize)
+            if v.size > max_el:
+                import math
+                unpack[k] = {"OriginShape": v.shape, "slices": []}
+                flat = save_dict.pop(k).ravel()
+                for i in range(int(math.ceil(v.size / max_el))):
+                    part = f"{k}@@.{i}"
+                    unpack[k]["slices"].append(part)
+                    save_dict[part] = flat[i * max_el:(i + 1) * max_el]
+        if unpack:
+            save_dict[_UNPACK_KEY] = unpack
+    save_dict[_NAME_TABLE_KEY] = name_table
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(save_dict, f, protocol=protocol)
+
+
+def convert_reference_checkpoint(path, model, strict=True, renames=None):
+    """Load a reference-format checkpoint into a paddle_tpu Layer.
+
+    Vision-zoo structured names match this framework's layers one-to-one
+    (both sides mirror the reference's module tree), so the default map is
+    identity; ``renames`` patches exceptions ({ref_name: our_name}).
+    Returns (missing, unexpected) name lists; with ``strict`` a mismatch
+    or any shape conflict raises.
+    """
+    src = load_reference_state_dict(path)
+    if renames:
+        for old, new in renames.items():
+            if old in src:
+                src[new] = src.pop(old)
+    tgt = model.state_dict()
+    missing = [k for k in tgt if k not in src]
+    unexpected = [k for k in src if k not in tgt]
+    if strict and (missing or unexpected):
+        raise ValueError(
+            f"convert_reference_checkpoint: missing={missing[:5]}... "
+            f"unexpected={unexpected[:5]}... (strict=True)")
+    for k, arr in src.items():
+        if k not in tgt:
+            continue
+        want = tuple(tgt[k].shape)
+        if tuple(arr.shape) != want:
+            raise ValueError(
+                f"convert_reference_checkpoint: {k} shape {arr.shape} != "
+                f"model {want}")
+    model.set_state_dict({k: v for k, v in src.items() if k in tgt})
+    return missing, unexpected

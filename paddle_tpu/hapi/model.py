@@ -154,6 +154,56 @@ class Model:
                 "state": state, "trainable": trainable, "t_pos": t_pos,
                 "fixed_pos": fixed_pos}
 
+    def _prepare_multi_step(self, name, inputs, labels):
+        """Shared preamble of train_batches/train_loop: normalize stacked
+        inputs, (re)build the compiled step for the per-step signature,
+        init optimizer state, reject configurations the multi-step paths
+        cannot honor, and make sure effect metadata exists."""
+        if self._metrics:
+            raise ValueError(
+                f"{name}: detach metrics (prepare(..., metrics=None)); "
+                "per-step predictions are not materialized")
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        xs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+              for i in inputs]
+        ys = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+              for l in labels]
+        K = int(xs[0].shape[0])
+        # per-step signature drives the same compiled-step cache
+        sig = (tuple((tuple(r.shape[1:]), str(r.dtype)) for r in xs + ys),
+               False)
+        if self._train_step_fn is None or self._train_sig != sig:
+            self.network.train()
+            self._train_step_fn = self._build_train_step(sig)
+            self._train_sig = sig
+        ts = self._train_step_fn
+        opt = self._optimizer
+        if any(p._grad is not None for p in ts["trainable"]):
+            raise RuntimeError(
+                f"{name}: pending accumulated gradients from "
+                "train_batch(update=False); finish the accumulation window "
+                "with train_batch(update=True) first")
+        for p in ts["trainable"]:
+            if stable_uid(p) not in opt._state:
+                opt._state[stable_uid(p)] = opt._init_state(p)
+        opt._accumulators_built = True
+        if "effect_holders" not in ts["meta"]:
+            # one abstract evaluation populates meta (no compile)
+            opt_states = [opt._state[stable_uid(p)] for p in ts["trainable"]]
+            sds = lambda r: jax.ShapeDtypeStruct(r.shape, r.dtype)
+            jax.eval_shape(
+                ts["raw_step"],
+                [sds(p._data) for p in ts["trainable"]],
+                [sds(ts["state"][i]._data) for i in ts["fixed_pos"]],
+                jax.tree_util.tree_map(sds, opt_states),
+                [sds(x[0]) for x in xs], [sds(y[0]) for y in ys],
+                jax.ShapeDtypeStruct((2,), np.uint32),
+                jax.ShapeDtypeStruct((), np.float32),
+                jax.ShapeDtypeStruct((), np.float32))
+        return ts, opt, xs, ys, K
+
     def train_batches(self, inputs, labels=None):
         """Run K fused train steps in ONE compiled program.
 
@@ -174,46 +224,14 @@ class Model:
         metrics are attached (per-step predictions are not materialized).
         Returns the list of K losses.
         """
-        if self._metrics:
-            raise ValueError(
-                "train_batches: detach metrics (prepare(..., metrics=None));"
-                " per-step predictions are not materialized in the scan")
-        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        labels = labels if isinstance(labels, (list, tuple)) else (
-            [labels] if labels is not None else [])
-        xs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
-              for i in inputs]
-        ys = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
-              for l in labels]
-        K = int(xs[0].shape[0])
-        # per-step signature drives the same compiled-step cache
-        sig = (tuple((tuple(r.shape[1:]), str(r.dtype)) for r in xs + ys),
-               False)
-        if self._train_step_fn is None or self._train_sig != sig:
-            self.network.train()
-            self._train_step_fn = self._build_train_step(sig)
-            self._train_sig = sig
-        ts = self._train_step_fn
-        opt = self._optimizer
-        for p in ts["trainable"]:
-            if stable_uid(p) not in opt._state:
-                opt._state[stable_uid(p)] = opt._init_state(p)
-        opt._accumulators_built = True
+        ts, opt, xs, ys, K = self._prepare_multi_step(
+            "train_batches", inputs, labels)
         opt_states = [opt._state[stable_uid(p)] for p in ts["trainable"]]
         train_raws = [p._data for p in ts["trainable"]]
         fixed_raws = [ts["state"][i]._data for i in ts["fixed_pos"]]
         keys = jnp.stack([_gen.next_key() for _ in range(K)])
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         step0 = jnp.asarray(opt._global_step + 1, jnp.float32)
-
-        if "effect_holders" not in ts["meta"]:
-            # one abstract evaluation populates meta (no compile)
-            sds = lambda r: jax.ShapeDtypeStruct(r.shape, r.dtype)
-            jax.eval_shape(ts["raw_step"], [sds(r) for r in train_raws],
-                           [sds(r) for r in fixed_raws],
-                           jax.tree_util.tree_map(sds, opt_states),
-                           [sds(x[0]) for x in xs], [sds(y[0]) for y in ys],
-                           sds(keys[0]), sds(lr), sds(step0))
         eff_idx = _effect_fixed_indices(ts)
         if eff_idx is None:
             raise ValueError(
@@ -288,29 +306,8 @@ class Model:
         trust ratios, non-global-norm clips, multi_precision masters).
         Returns the list of K losses.
         """
-        if self._metrics:
-            raise ValueError(
-                "train_loop: detach metrics (prepare(..., metrics=None))")
-        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        labels = labels if isinstance(labels, (list, tuple)) else (
-            [labels] if labels is not None else [])
-        xs = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
-              for i in inputs]
-        ys = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
-              for l in labels]
-        K = int(xs[0].shape[0])
-        sig = (tuple((tuple(r.shape[1:]), str(r.dtype)) for r in xs + ys),
-               False)
-        if self._train_step_fn is None or self._train_sig != sig:
-            self.network.train()
-            self._train_step_fn = self._build_train_step(sig)
-            self._train_sig = sig
-        ts = self._train_step_fn
-        opt = self._optimizer
-        for p in ts["trainable"]:
-            if stable_uid(p) not in opt._state:
-                opt._state[stable_uid(p)] = opt._init_state(p)
-        opt._accumulators_built = True
+        ts, opt, xs, ys, K = self._prepare_multi_step(
+            "train_loop", inputs, labels)
 
         fused = self._build_fused_loop(ts)
         if fused is None:
@@ -325,18 +322,6 @@ class Model:
         train_raws = [p._data for p in ts["trainable"]]
         states = [opt._state[stable_uid(p)] for p in ts["trainable"]]
         fixed = [ts["state"][i]._data for i in ts["fixed_pos"]]
-        if "effect_holders" not in ts["meta"]:
-            sds = lambda r: jax.ShapeDtypeStruct(r.shape, r.dtype)
-            jax.eval_shape(ts["raw_step"], [sds(r) for r in train_raws],
-                           [sds(r) for r in fixed],
-                           jax.tree_util.tree_map(sds, states),
-                           [sds(x[0]) for x in xs], [sds(y[0]) for y in ys],
-                           jax.ShapeDtypeStruct((2,), np.uint32),
-                           jax.ShapeDtypeStruct((), np.float32),
-                           jax.ShapeDtypeStruct((), np.float32))
-            # effect positions depend on meta discovered by the trace
-            fused = self._build_fused_loop(ts, rebuild=True)
-            pack, unpack_back, fused_fn, eff_fixed_idx = fused
         flat_ps, flat_sts = pack(train_raws, states)
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         losses = []
@@ -352,11 +337,10 @@ class Model:
         unpack_back(flat_ps, flat_sts, fixed)
         return [float(np.asarray(l)) for l in losses]
 
-    def _build_fused_loop(self, ts, rebuild=False):
+    def _build_fused_loop(self, ts):
         """Coalesced-buffer step builder; returns None when the optimizer
         or clip configuration is not elementwise-safe on flat buffers."""
-        if not rebuild and getattr(self, "_fused_loop_key", None) == \
-                self._train_sig:
+        if getattr(self, "_fused_loop_key", None) == self._train_sig:
             return self._fused_loop
         from ..nn.clip import ClipGradByGlobalNorm, _clips
         opt = self._optimizer

@@ -1,0 +1,44 @@
+"""Build helper for the C inference API (csrc/capi_shim.cpp).
+
+The reference ships a prebuilt C library (inference/capi_exp); here the
+shim builds on first use with the system toolchain, like the shm ring
+(core/shm_ring.py). ``build_capi()`` returns the path to
+``libpaddle_tpu_capi.so`` (and the header lives at csrc/paddle_tpu_capi.h
+for callers to #include).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+
+
+def _python_link_flags():
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    return [f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+            f"-Wl,-rpath,{libdir}"]
+
+
+def build_capi(build_dir: str | None = None) -> str:
+    """Compile (if stale) and return the path of libpaddle_tpu_capi.so."""
+    build_dir = build_dir or os.path.join(_CSRC, "build")
+    os.makedirs(build_dir, exist_ok=True)
+    src = os.path.join(_CSRC, "capi_shim.cpp")
+    out = os.path.join(build_dir, "libpaddle_tpu_capi.so")
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            f"-I{_CSRC}", "-o", out, src] + _python_link_flags())
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+def header_path() -> str:
+    return os.path.join(_CSRC, "paddle_tpu_capi.h")

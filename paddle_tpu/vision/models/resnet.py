@@ -140,9 +140,16 @@ class ResNet(Layer):
 def _resnet(depth, pretrained=False, **kwargs):
     model = ResNet(depth=depth, **kwargs)
     if pretrained:
-        raise RuntimeError(
-            "pretrained weights require network access; load a local "
-            "state_dict with model.set_state_dict instead")
+        from ...framework_io import convert_reference_checkpoint
+        if not isinstance(pretrained, str):
+            # no egress in this environment: the producer for local files
+            # is tools/convert_reference_checkpoint.py (reference-format
+            # .pdparams in, verified load here)
+            raise RuntimeError(
+                "pretrained=True needs network access; pass "
+                "pretrained='/path/to/resnet.pdparams' (reference-format "
+                "checkpoint — see tools/convert_reference_checkpoint.py)")
+        convert_reference_checkpoint(pretrained, model)
     return model
 
 

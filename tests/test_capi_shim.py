@@ -1,0 +1,71 @@
+"""End-to-end C API test: export an artifact, build the shim + the C
+smoke driver, run the driver as a plain native binary (no Python on its
+command line), and compare its printed outputs against the Python
+Predictor (reference parity: capi_exp + go/paddle/predictor.go usage)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.inference.capi import build_capi, header_path
+from paddle_tpu.static import InputSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _export(tmp_path, n, d):
+    paddle.seed(3)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(d, 3)
+
+        def forward(self, x):
+            return nn.functional.softmax(self.fc(x), axis=-1)
+
+    net = Net()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([n, d], "float32", "x")])
+    return prefix
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(300)
+def test_c_driver_matches_python(tmp_path):
+    n, d = 2, 4
+    prefix = _export(tmp_path, n, d)
+
+    so = build_capi()
+    exe = str(tmp_path / "capi_smoke")
+    subprocess.run(
+        ["gcc", "-O2", os.path.join(REPO, "csrc", "capi_smoke.c"),
+         "-I", os.path.dirname(header_path()), "-o", exe,
+         so],
+        check=True, capture_output=True, text=True)
+
+    env = {**os.environ,
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "PTC_FORCE_CPU": "1"}
+    r = subprocess.run([exe, prefix, str(n), str(d)], capture_output=True,
+                       text=True, timeout=240, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    lines = r.stdout.strip().splitlines()
+    assert "n_inputs 1" in lines[0]
+    assert "rerun ok" in r.stdout and "done" in r.stdout
+
+    # parse the printed output tensor
+    data_line = next(l for l in lines if l.startswith("data"))
+    got = np.array([float(v) for v in data_line.split()[1:]],
+                   np.float32).reshape(n, 3)
+
+    # python-side reference on the same deterministic input
+    x = ((np.arange(n * d) % 7) * 0.25 - 0.5).astype(np.float32).reshape(n, d)
+    pred = create_predictor(Config(prefix))
+    ref = pred.run([x])[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

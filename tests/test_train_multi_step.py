@@ -104,3 +104,16 @@ def test_train_batches_rejects_metrics():
         m.train_batches([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
     with pytest.raises(ValueError):
         m.train_loop([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+
+
+def test_multi_step_rejects_pending_accumulated_grads():
+    """train_batch(update=False) leaves carried grads; the multi-step
+    paths must refuse rather than silently drop them."""
+    xs, ys = _data()
+    m, _ = _build("momentum")
+    m.train_batch([paddle.to_tensor(xs[0])], [paddle.to_tensor(ys[0])],
+                  update=False)
+    with pytest.raises(RuntimeError, match="pending accumulated"):
+        m.train_batches([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+    with pytest.raises(RuntimeError, match="pending accumulated"):
+        m.train_loop([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])

@@ -190,6 +190,7 @@ MANUAL_IMPL = {
     "read_file": "paddle_tpu.vision:read_file",
     "decode_jpeg": "paddle_tpu.vision:decode_jpeg",
     "create_custom_reader": "paddle_tpu.io:IterableDataset",
+    "create_ctr_reader": "paddle_tpu.distributed:InMemoryDataset",
     "create_py_reader": "paddle_tpu.io:DataLoader",
     "create_double_buffer_reader": "paddle_tpu.io:DataLoader",
     # AMP ops -> GradScaler internals
@@ -302,7 +303,7 @@ MANUAL_IMPL = {
     "merge_selected_rows":
         "paddle_tpu.distributed.fleet:sparse_row_update",
     "clip_by_norm": "paddle_tpu.ops:clip_by_norm",
-    "coalesce_tensor": "paddle_tpu.distributed.sharding:group_sharded_parallel",
+    "coalesce_tensor": "paddle_tpu.hapi.model:Model.train_loop",
 }
 
 # XLA/JAX absorb these mechanisms entirely (SURVEY §2 "absorbed" rows)
@@ -363,7 +364,6 @@ ADR = {
         "pull_sparse_v2", "push_sparse", "push_sparse_v2", "push_dense",
         "tdm_child", "tdm_sampler", "pyramid_hash", "hash",
         "rank_attention", "lookup_table_dequant",
-        "create_ctr_reader",
     ]},
     # docs/adr/0002-dgc.md: top-k grad compression is ICI-pointless
     "dgc": "docs/adr/0002-dgc.md",

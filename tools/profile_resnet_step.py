@@ -14,7 +14,7 @@ section). Run on the TPU host:
 (two processes: tensorflow's protobuf clashes with the axon plugin's.)
 """
 
-import glob, os, time
+import os
 import numpy as np
 import jax, jax.numpy as jnp
 
