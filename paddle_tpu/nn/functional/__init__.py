@@ -24,4 +24,5 @@ from .common import (linear, dropout, dropout2d, dropout3d, alpha_dropout,  # no
                      sequence_mask, diag_embed, unfold, npair_loss)
 from .sampled import (hsigmoid_loss, hierarchical_sigmoid, nce,  # noqa: F401
                       class_center_sample, sampling_id, sample_logits)
+from ...ops.pallas_attention import flash_attention  # noqa: F401
 from ...ops.manipulation import pixel_shuffle, pixel_unshuffle  # noqa: F401

@@ -33,6 +33,7 @@ from .segment import (segment_sum, segment_mean, segment_max,  # noqa: F401
 from .extras import *  # noqa: F401,F403
 from .crf import (linear_chain_crf, crf_decoding, viterbi_decode,  # noqa: F401
                   chunk_eval)
+from .pallas_attention import flash_attention  # noqa: F401
 from .sequence import (sequence_mask, sequence_pad, sequence_unpad,  # noqa: F401
                        sequence_pool, sequence_first_step,
                        sequence_last_step, sequence_softmax,

@@ -1,0 +1,317 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference computes attention as unfused matmul/softmax/matmul over
+materialized [B, H, S, S] score tensors (multihead_matmul fusion only at
+inference). The TPU-native hot path keeps scores block-resident in VMEM
+with the online-softmax recurrence (Dao et al.) — O(S) memory instead of
+O(S²) HBM traffic, which is what makes long-sequence training fit at all
+(the ring-attention sequence parallelism in fleet/sequence_parallel.py
+shards S *across* chips; this kernel is the per-chip inner loop story).
+
+Kernel shape: grid (B*H, S_q/block_q); each program holds one q block and
+its running (acc, m, l) statistics in VMEM/registers while scanning k/v
+blocks with ``lax.fori_loop``. Causal masking and tail padding are mask
+arithmetic inside the score block — shapes stay static.
+
+Runs in interpret mode off-TPU so tests are hardware-independent
+(ops/custom.py register_pallas_op convention).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import apply
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, causal,
+               block_q, block_k, seq_len, kv_len):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale                 # [bq, D]
+    d = q.shape[-1]
+    q_idx = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    n_k = kv_len // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        k_idx = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+        mask = k_idx < seq_len                               # tail padding
+        if causal:
+            mask = mask & (q_idx >= k_idx)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # early exit: k blocks entirely above the diagonal contribute
+        # nothing — trip count becomes data-independent-per-program
+        # ceil(((qi+1)*block_q) / block_k), halving work on average
+        n_k = jnp.minimum(n_k, (qi * block_q + block_q + block_k - 1)
+                          // block_k)
+    acc, m, l = lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+    # fully-masked rows (padding queries) have l == 0
+    out = acc / jnp.maximum(l, 1e-30)[:, None]
+    o_ref[0] = out.astype(o_ref.dtype)
+    if lse_ref is not None:
+        # logsumexp of the score rows: backward recomputes P from it
+        # (shape [1, 1, bq]: TPU block rule needs the last two dims
+        # (sublane, lane)-aligned, so the row stats ride a lane axis)
+        lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30))).astype(jnp.float32)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, *, scale=None, block_q=128,
+                    block_k=128, name=None):
+    """Memory-efficient exact attention (paddle's flash_attention API:
+    same positional order ``(q, k, v, dropout, causal, return_softmax)``
+    and the same ``(out, softmax)`` tuple return, so positionally-ported
+    reference code keeps its meaning).
+
+    query/key/value: [batch, seq, num_heads, head_dim]. Returns
+    ``(out [batch, seq, num_heads, head_dim], None)`` — the attention
+    probabilities are never materialized (that is the point of the
+    kernel), so ``return_softmax=True`` raises, as does ``dropout > 0``
+    (attention-prob dropout needs the dense path).
+
+    The sequence is padded to the block size internally; padded keys are
+    masked, padded query rows are sliced away.
+    """
+    if dropout:
+        raise ValueError("flash_attention: dropout inside the fused kernel "
+                         "is unsupported (use the dense path for "
+                         "attention-prob dropout)")
+    if return_softmax:
+        raise ValueError("flash_attention: the probability matrix is never "
+                         "materialized; return_softmax is unsupported")
+
+    def impl(q, kk, vv):
+        b, s, h, d = q.shape
+        skv = kk.shape[1]
+        sc = scale if scale is not None else 1.0 / np.sqrt(d)
+        bq = min(block_q, max(16, s))
+        bk = min(block_k, max(16, skv))
+        s_pad = -(-s // bq) * bq
+        kv_pad = -(-skv // bk) * bk
+
+        def to_bh(x, pad_to):
+            x = jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+            if pad_to != x.shape[1]:
+                x = jnp.pad(x, ((0, 0), (0, pad_to - x.shape[1]), (0, 0)))
+            return x
+        qb = to_bh(q, s_pad)
+        kb = to_bh(kk, kv_pad)
+        vb = to_bh(vv, kv_pad)
+        on_tpu = jax.devices()[0].platform == "tpu"
+        # real kv length for the padding mask: padded keys sit at
+        # index >= skv
+        out = _fa_core(qb, kb, vb, causal, sc, bq, bk, not on_tpu, skv)
+        out = out[:, :s, :].reshape(b, h, s, d)
+        return jnp.moveaxis(out, 1, 2)
+    return apply("flash_attention", impl, query, key, value), None
+
+
+# -- backward kernels (FlashAttention-style recomputation) --------------------
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, *, scale, causal, block_q, block_k, seq_len,
+                      kv_len):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)                        # [bq, D]
+    lse = lse_ref[0, 0].astype(jnp.float32)                   # [bq]
+    delta = delta_ref[0, 0].astype(jnp.float32)               # [bq]
+    q_idx = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0)
+    n_k = kv_len // block_k
+    if causal:
+        n_k = jnp.minimum(n_k, (qi * block_q + block_q + block_k - 1)
+                          // block_k)
+
+    def body(j, dq):
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        k_idx = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+        mask = k_idx < seq_len
+        if causal:
+            mask = mask & (q_idx >= k_idx)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)   # [bq, bk]
+        dp = lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    dq = lax.fori_loop(0, n_k,
+                       body, jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                       seq_len, q_len):
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    kblk = k_ref[0].astype(jnp.float32)                       # [bk, D]
+    vblk = v_ref[0].astype(jnp.float32)
+    k_idx = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1)
+    n_q = q_len // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) \
+            * scale
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        delta = delta_ref[0, 0,
+                          pl.ds(i * block_q, block_q)].astype(jnp.float32)
+        s = lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        q_idx = i * block_q + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+        mask = k_idx < seq_len
+        if causal:
+            mask = mask & (q_idx >= k_idx)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv2 = dv + lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk2 = dk + lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        return dk2, dv2
+    if causal:
+        # q blocks entirely above this k block see it masked; start there
+        i0 = (ki * block_k) // block_q
+    else:
+        i0 = 0
+    zero = jnp.zeros((block_k, kblk.shape[-1]), jnp.float32)
+    dk, dv = lax.fori_loop(i0, n_q, body, (zero, zero))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
+    """Forward kernel call; also emits the [bh, 1, S] f32 logsumexp rows
+    (1/(2·D) of the output bytes — cheap enough to pay on inference
+    too, so there is a single forward kernel to maintain)."""
+    import jax.experimental.pallas as pl
+
+    bh, s_pad, d = qb.shape
+    kv_pad = kb.shape[1]
+    kernel = functools.partial(
+        _fa_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk,
+        seq_len=true_kv, kv_len=kv_pad)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, s_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kv_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, kv_pad, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i))],
+        out_shape=[jax.ShapeDtypeStruct((bh, s_pad, d), qb.dtype),
+                   jax.ShapeDtypeStruct((bh, 1, s_pad), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _fa_core(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
+    out, _ = _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret,
+                              true_kv)
+    return out
+
+
+def _fa_core_fwd(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
+    out, lse = _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret,
+                                true_kv)
+    return out, (qb, kb, vb, out, lse)
+
+
+def _fa_core_bwd(causal, sc, bq, bk, interpret, true_kv, res, do):
+    import jax.experimental.pallas as pl
+
+    qb, kb, vb, out, lse = res
+    bh, s_pad, d = qb.shape
+    kv_pad = kb.shape[1]
+    # delta = rowsum(dO * O) — the softmax-jacobian correction term
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, None, :]                      # [bh, 1, s_pad]
+
+    dq_kernel = functools.partial(
+        _fa_bwd_dq_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk,
+        seq_len=true_kv, kv_len=kv_pad)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, s_pad // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kv_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, kv_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), qb.dtype),
+        interpret=interpret,
+    )(qb, kb, vb, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _fa_bwd_dkv_kernel, scale=sc, causal=causal, block_q=bq,
+        block_k=bk, seq_len=true_kv, q_len=s_pad)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, kv_pad // bk),
+        in_specs=[
+            pl.BlockSpec((1, s_pad, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, s_pad, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, s_pad), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, s_pad), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+                   pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, kv_pad, d), kb.dtype),
+                   jax.ShapeDtypeStruct((bh, kv_pad, d), vb.dtype)],
+        interpret=interpret,
+    )(qb, kb, vb, do, lse, delta)
+    return dq, dk, dv
+
+
+_fa_core.defvjp(_fa_core_fwd, _fa_core_bwd)

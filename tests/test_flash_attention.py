@@ -1,0 +1,120 @@
+"""Flash-attention Pallas kernel (ops/pallas_attention.py): exact
+equivalence with dense attention — forward and all three gradients,
+causal and full, including non-block-multiple sequence lengths (tail
+padding) and cross-attention (kv length != q length). Runs in interpret
+mode on CPU; the TPU-compiled path is numerics-checked by the bench
+probes (docs/perf_notes.md round 4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _dense(q, k, v, causal, scale):
+    qd = jnp.moveaxis(q, 2, 1)
+    kd = jnp.moveaxis(k, 2, 1)
+    vd = jnp.moveaxis(v, 2, 1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qd, kd) * scale
+    if causal:
+        Sq, Sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.moveaxis(jnp.einsum("bhqk,bhkd->bhqd", p, vd), 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("S", [128, 200])
+def test_forward_matches_dense(causal, S):
+    rng = np.random.RandomState(0)
+    B, H, D = 2, 4, 64
+    q = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+    k = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+    v = rng.randn(B, S, H, D).astype(np.float32)
+    out, _ = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                               paddle.to_tensor(v), causal=causal)
+    out = out.numpy()
+    ref = np.asarray(_dense(q, k, v, causal, 1 / np.sqrt(D)))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_dense(causal):
+    rng = np.random.RandomState(1)
+    B, S, H, D = 1, 96, 2, 32
+    q = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+    k = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+    v = rng.randn(B, S, H, D).astype(np.float32)
+    qt, kt, vt = map(paddle.to_tensor, (q, k, v))
+    for t in (qt, kt, vt):
+        t.stop_gradient = False
+    out, _ = F.flash_attention(qt, kt, vt, causal=causal)
+    (out * out).sum().backward()
+
+    def loss(q, k, v):
+        o = _dense(q, k, v, causal, 1 / np.sqrt(D))
+        return jnp.sum(o * o)
+    gq, gk, gv = jax.grad(loss, (0, 1, 2))(q, k, v)
+    for got, want in [(qt.grad, gq), (kt.grad, gk), (vt.grad, gv)]:
+        got, want = np.asarray(got.numpy()), np.asarray(want)
+        rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+        assert rel < 1e-4, rel
+
+
+def test_cross_attention_kv_length():
+    rng = np.random.RandomState(2)
+    B, Sq, Skv, H, D = 2, 64, 160, 2, 32
+    q = rng.randn(B, Sq, H, D).astype(np.float32)
+    k = rng.randn(B, Skv, H, D).astype(np.float32)
+    v = rng.randn(B, Skv, H, D).astype(np.float32)
+    out, _ = F.flash_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                               paddle.to_tensor(v))
+    out = out.numpy()
+    ref = np.asarray(_dense(q, k, v, False, 1 / np.sqrt(D)))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=2e-5)
+
+
+def test_dropout_rejected_and_scale():
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(1, 32, 2, 16).astype(np.float32))
+    with pytest.raises(ValueError, match="dropout"):
+        F.flash_attention(x, x, x, dropout=0.1)
+    with pytest.raises(ValueError, match="return_softmax"):
+        F.flash_attention(x, x, x, return_softmax=True)
+    # custom scale honored
+    out1, _ = F.flash_attention(x, x, x, scale=0.5)
+    out1 = out1.numpy()
+    ref = np.asarray(_dense(x.numpy(), x.numpy(), x.numpy(), False, 0.5))
+    np.testing.assert_allclose(out1, ref, rtol=1e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("bq,bk", [(32, 48), (48, 32), (16, 128)])
+def test_block_size_boundaries_causal(bq, bk):
+    """The causal early-exit arithmetic (n_k ceil and the dkv start
+    block) under block_q != block_k — fwd and grads."""
+    rng = np.random.RandomState(4)
+    B, S, H, D = 1, 160, 2, 32
+    q = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+    k = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+    v = rng.randn(B, S, H, D).astype(np.float32)
+    qt, kt, vt = map(paddle.to_tensor, (q, k, v))
+    for t in (qt, kt, vt):
+        t.stop_gradient = False
+    out, _ = F.flash_attention(qt, kt, vt, causal=True, block_q=bq,
+                               block_k=bk)
+    ref = np.asarray(_dense(q, k, v, True, 1 / np.sqrt(D)))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=2e-5)
+    (out * out).sum().backward()
+
+    def loss(q, k, v):
+        o = _dense(q, k, v, True, 1 / np.sqrt(D))
+        return jnp.sum(o * o)
+    gq, gk, gv = jax.grad(loss, (0, 1, 2))(q, k, v)
+    for got, want in [(qt.grad, gq), (kt.grad, gk), (vt.grad, gv)]:
+        got, want = np.asarray(got.numpy()), np.asarray(want)
+        rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-9)
+        assert rel < 1e-4, rel
