@@ -191,7 +191,14 @@ extern "C" int PTC_Run(PTC_Predictor* p, const void* const* inputs,
       auto& vw = p->out_views[i];
       p->out_shapes[i].assign(vw.shape, vw.shape + vw.ndim);
     }
-    if (!view_ok) break;
+    if (!view_ok) {
+      // a partially view-acquired output set must not look valid to the
+      // getters: roll back to "no outputs" so they error cleanly
+      release_out_views(p);
+      p->out_shapes.clear();
+      Py_CLEAR(p->outputs);
+      break;
+    }
     rc = 0;
   } while (false);
   if (rc != 0) set_err_from_python();
@@ -206,15 +213,30 @@ extern "C" int PTC_GetNumOutputs(PTC_Predictor* p) {
   return p->outputs ? static_cast<int>(p->out_shapes.size()) : 0;
 }
 
+// output getters are only valid after a successful PTC_Run and for
+// 0 <= i < PTC_GetNumOutputs; an embedding caller can easily violate
+// either, so fail with an error instead of dereferencing null
+static bool out_index_ok(PTC_Predictor* p, int i) {
+  if (p->outputs && i >= 0 &&
+      i < static_cast<int>(p->out_shapes.size()))
+    return true;
+  g_last_error = p->outputs ? "output index out of range"
+                            : "no outputs: call PTC_Run first";
+  return false;
+}
+
 extern "C" int PTC_GetOutputNumDims(PTC_Predictor* p, int i) {
+  if (!out_index_ok(p, i)) return -1;
   return static_cast<int>(p->out_shapes[i].size());
 }
 
 extern "C" const int64_t* PTC_GetOutputShape(PTC_Predictor* p, int i) {
+  if (!out_index_ok(p, i)) return nullptr;
   return p->out_shapes[i].data();
 }
 
 extern "C" int PTC_GetOutputDType(PTC_Predictor* p, int i) {
+  if (!out_index_ok(p, i)) return -1;
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* globals = PyModule_GetDict(p->helper);
   PyObject* fn = PyDict_GetItemString(globals, "out_dtype_code");
@@ -232,6 +254,7 @@ extern "C" int PTC_GetOutputDType(PTC_Predictor* p, int i) {
 }
 
 extern "C" const void* PTC_GetOutputData(PTC_Predictor* p, int i) {
+  if (!out_index_ok(p, i)) return nullptr;
   return p->out_views[i].buf;
 }
 

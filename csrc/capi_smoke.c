@@ -25,6 +25,14 @@ int main(int argc, char** argv) {
   }
   printf("n_inputs %d\n", PTC_GetNumInputs(p));
 
+  /* output getters before any PTC_Run must fail cleanly, not crash */
+  if (PTC_GetOutputNumDims(p, 0) != -1 || PTC_GetOutputShape(p, 0) ||
+      PTC_GetOutputData(p, 0) || PTC_GetOutputDType(p, 0) != -1) {
+    fprintf(stderr, "pre-run output getters did not error\n");
+    return 1;
+  }
+  printf("prerun guard ok (%s)\n", PTC_LastError());
+
   float* x = (float*)malloc(sizeof(float) * n * d);
   for (int i = 0; i < n * d; ++i) x[i] = (float)(i % 7) * 0.25f - 0.5f;
   int64_t shape[2] = {n, d};
@@ -58,6 +66,13 @@ int main(int argc, char** argv) {
     return 1;
   }
   printf("rerun ok\n");
+  /* out-of-range index must fail cleanly too */
+  if (PTC_GetOutputNumDims(p, nout) != -1 ||
+      PTC_GetOutputData(p, -1) != NULL) {
+    fprintf(stderr, "out-of-range output getters did not error\n");
+    return 1;
+  }
+  printf("bounds guard ok\n");
   free(x);
   PTC_PredictorDestroy(p);
   printf("done\n");

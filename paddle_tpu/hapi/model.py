@@ -54,15 +54,28 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self.stop_training = False
+        self._eval_fns_max = 64         # LRU bound (cf. dispatch cache)
+        self._invalidate_compiled()
+
+    def _invalidate_compiled(self):
+        """Drop every compiled program. The step/loop closures capture the
+        optimizer's update rule, clip/decay vectors and its _state dict;
+        the eval programs capture the loss; all of them capture parameter
+        objects — any of prepare()/load() invalidates them or a stale
+        program keeps running with the old configuration."""
         self._train_step_fn = None
         self._train_sig = None
+        self._fused_loop_key = None
+        self._fused_loop = None
+        self._multi_step_key = None
+        self._multi_step_fn = None
         from collections import OrderedDict
         self._eval_fns = OrderedDict()  # (sig, mode) -> compiled program
-        self._eval_fns_max = 64         # LRU bound (cf. dispatch cache)
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
+        self._invalidate_compiled()
         if metrics is None:
             self._metrics = []
         elif isinstance(metrics, Metric):
@@ -759,8 +772,8 @@ class Model:
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         state = framework_io.load(path + ".pdparams")
         self.network.set_state_dict(state)
-        # retire any compiled step referencing old param objects' values
-        self._train_step_fn = None
+        # retire every compiled program referencing old param objects
+        self._invalidate_compiled()
         import os
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + ".pdopt"):

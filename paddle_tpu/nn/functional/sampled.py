@@ -48,9 +48,10 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
             lab = lab.reshape(-1).astype(jnp.int32)
             code = lab + C
             bits = jnp.arange(max_len, dtype=jnp.int32)
-            # length = floor(log2(code)): number of path edges.
-            length = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(jnp.int32)
-            valid = bits[None, :] < length[:, None]              # [N, L]
+            # bit b is a path edge iff b < floor(log2(code)), i.e. iff the
+            # code still has set bits above position b — pure integer test
+            # (float32 log2 is off-by-one near powers of two past 2^24)
+            valid = (code[:, None] >> (bits[None, :] + 1)) > 0   # [N, L]
             idx = jnp.where(valid, (code[:, None] >> (bits[None, :] + 1)) - 1, 0)
             t = ((code[:, None] >> bits[None, :]) & 1).astype(x.dtype)
             pre = jnp.einsum("nd,nld->nl", x, w[idx])            # [N, L]
@@ -88,22 +89,25 @@ def hierarchical_sigmoid(input, label, num_classes, weight, bias=None,
 
 # -- NCE ----------------------------------------------------------------------
 
-def _log_uniform_prob(c, num_classes):
+def _log_uniform_prob(c, range_max):
+    """P(c) under LogUniformSampler(range_max): support [0, range_max-1],
+    normalised by log(range_max + 1)."""
     cf = c.astype(jnp.float32)
-    return jnp.log((cf + 2.0) / (cf + 1.0)) / np.log(num_classes + 1.0)
+    return jnp.log((cf + 2.0) / (cf + 1.0)) / np.log(range_max + 1.0)
 
 
-def _sample_classes(key, shape, num_classes, sampler):
+def _sample_classes(key, shape, num_classes, sampler, range_max=None):
     if sampler == "uniform":
         s = jax.random.randint(key, shape, 0, num_classes)
         p = jnp.full(shape, 1.0 / num_classes, jnp.float32)
         return s, p
     if sampler == "log_uniform":
+        r = num_classes if range_max is None else range_max
         u = jax.random.uniform(key, shape)
         s = jnp.clip(
-            jnp.exp(u * np.log(num_classes + 1.0)).astype(jnp.int32) - 1,
-            0, num_classes - 1)
-        return s, _log_uniform_prob(s, num_classes)
+            jnp.exp(u * np.log(r + 1.0)).astype(jnp.int32) - 1,
+            0, r - 1)
+        return s, _log_uniform_prob(s, r)
     raise ValueError(f"nce: unknown sampler {sampler!r} "
                      "(uniform | log_uniform | custom_dist)")
 
@@ -141,14 +145,18 @@ def nce(input, label, weight, bias=None, num_neg_samples=10,
                                          shape=(x.shape[0], k))
             neg_p = probs[neg]
         else:
-            neg, neg_p = _sample_classes(key, (x.shape[0], k), C, sampler)
+            # nce_op.h constructs LogUniformSampler(num_total_classes - 1):
+            # support [0, C-2], normalised by log(C) — NOT the
+            # sample_logits sampler's LogUniformSampler(C)
+            neg, neg_p = _sample_classes(key, (x.shape[0], k), C, sampler,
+                                         range_max=C - 1)
         classes = jnp.concatenate([lab, neg], axis=1)           # [N, T+k]
         if sampler == "custom_dist":
             p = probs[classes]
         elif sampler == "uniform":
             p = jnp.full(classes.shape, 1.0 / C, jnp.float32)
         else:
-            p = _log_uniform_prob(classes, C)
+            p = _log_uniform_prob(classes, C - 1)
         logits = jnp.einsum("nd,nsd->ns", x, w[classes])
         if b_vec is not None:
             logits = logits + b_vec.reshape(-1)[classes]

@@ -113,8 +113,12 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         b, s, h, d = q.shape
         skv = kk.shape[1]
         sc = scale if scale is not None else 1.0 / np.sqrt(d)
-        bq = min(block_q, max(16, s))
-        bk = min(block_k, max(16, skv))
+        # block shapes must stay multiples of the sublane tile (8 rows for
+        # f32, 16 for bf16) or Mosaic may fail to compile (odd seq lengths
+        # like 100); round to 16 so both dtypes are safe — the seq is
+        # padded up to the rounded block below, padded keys masked
+        bq = min(block_q, max(16, -(-s // 16) * 16))
+        bk = min(block_k, max(16, -(-skv // 16) * 16))
         s_pad = -(-s // bq) * bq
         kv_pad = -(-skv // bk) * bk
 

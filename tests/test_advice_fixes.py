@@ -368,3 +368,47 @@ class TestMoeBf16SlotCounting:
             assert (np.abs(got).sum(-1) < 1e-6).sum() == 0
         finally:
             dist.set_mesh(None)
+
+
+class TestRound5NceLogUniformRange:
+    """nce_op.h constructs LogUniformSampler(num_total_classes - 1):
+    probabilities normalised by log(C) with support [0, C-2] — not the
+    sample_logits sampler's LogUniformSampler(C) (round-5 advisor
+    finding, nn/functional/sampled.py)."""
+
+    def test_prob_normalisation_and_support(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.nn.functional.sampled import (
+            _log_uniform_prob, _sample_classes)
+        C = 50
+        # NCE sampler: probs over [0, C-2] sum to 1 under log(C) norm
+        p_nce = np.asarray(_log_uniform_prob(jnp.arange(C - 1), C - 1))
+        np.testing.assert_allclose(p_nce.sum(), 1.0, rtol=1e-6)
+        # sample_logits sampler keeps the full [0, C-1] support
+        p_sl = np.asarray(_log_uniform_prob(jnp.arange(C), C))
+        np.testing.assert_allclose(p_sl.sum(), 1.0, rtol=1e-6)
+        # and the two disagree (the old code used C for both)
+        assert abs(p_nce[0] - p_sl[0]) > 1e-4
+        # sampled negatives for NCE never include class C-1
+        key = jax.random.PRNGKey(0)
+        s, p = _sample_classes(key, (512,), C, "log_uniform",
+                               range_max=C - 1)
+        assert int(np.max(np.asarray(s))) <= C - 2
+        np.testing.assert_allclose(
+            np.asarray(p),
+            np.asarray(_log_uniform_prob(s, C - 1)), rtol=1e-6)
+
+    def test_nce_runs_and_matches_numpy_prob(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        N, D, C = 6, 8, 20
+        x = rng.randn(N, D).astype(np.float32)
+        lab = rng.randint(0, C, (N, 1)).astype(np.int64)
+        w = rng.randn(C, D).astype(np.float32)
+        out = F.nce(paddle.to_tensor(x), paddle.to_tensor(lab),
+                    paddle.to_tensor(w), num_total_classes=C,
+                    sampler="log_uniform", seed=7)
+        assert out.shape == [N, 1]
+        assert np.isfinite(out.numpy()).all()

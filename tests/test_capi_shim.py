@@ -58,6 +58,7 @@ def test_c_driver_matches_python(tmp_path):
     lines = r.stdout.strip().splitlines()
     assert "n_inputs 1" in lines[0]
     assert "rerun ok" in r.stdout and "done" in r.stdout
+    assert "prerun guard ok" in r.stdout and "bounds guard ok" in r.stdout
 
     # parse the printed output tensor
     data_line = next(l for l in lines if l.startswith("data"))

@@ -28,7 +28,7 @@ def _dense(q, k, v, causal, scale):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("S", [128, 200])
+@pytest.mark.parametrize("S", [128, 200, 100])
 def test_forward_matches_dense(causal, S):
     rng = np.random.RandomState(0)
     B, H, D = 2, 4, 64

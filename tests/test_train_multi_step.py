@@ -117,3 +117,52 @@ def test_multi_step_rejects_pending_accumulated_grads():
         m.train_batches([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
     with pytest.raises(RuntimeError, match="pending accumulated"):
         m.train_loop([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+
+
+def test_prepare_new_optimizer_invalidates_compiled_loops():
+    """prepare(new_optimizer) must invalidate the compiled step/loop
+    caches — they capture the old optimizer's update rule and write
+    updated moments into the OLD optimizer's _state (round-5 advisor
+    finding, hapi/model.py). Final-state comparison across the two paths
+    is deliberately loose: phase-1 fused-vs-sequential fp reassociation
+    noise (~1e-7, inside the pinned tolerance above) is chaotically
+    amplified by Adam over the second phase, so the pin here is the
+    mechanism: cleared caches, the NEW optimizer's state written with
+    the NEW rule's keys, and matching per-step losses."""
+    xs, ys = _data()
+
+    def run(use_loop):
+        m, net = _build("momentum")
+        opt1 = m._optimizer
+        paddle.seed(123)
+        if use_loop:
+            m.train_loop([paddle.to_tensor(xs)], [paddle.to_tensor(ys)])
+        else:
+            for k in range(len(xs)):
+                m.train_batch([paddle.to_tensor(xs[k])],
+                              [paddle.to_tensor(ys[k])])
+        opt2 = optim.Adam(learning_rate=1e-2, parameters=net.parameters())
+        m.prepare(opt2, paddle.nn.CrossEntropyLoss())
+        assert m._fused_loop is None and m._train_step_fn is None
+        paddle.seed(321)
+        if use_loop:
+            losses = m.train_loop([paddle.to_tensor(xs)],
+                                  [paddle.to_tensor(ys)])
+            assert m._fused_loop is not None, "fused path must re-engage"
+        else:
+            losses = [m.train_batch([paddle.to_tensor(xs[k])],
+                                    [paddle.to_tensor(ys[k])])[0]
+                      for k in range(len(xs))]
+        # Adam (not stale Momentum) ran, and wrote into the NEW
+        # optimizer's state
+        assert opt2._state, "new optimizer state empty — stale cache ran"
+        st = next(iter(opt2._state.values()))
+        assert set(st) == {"moment1", "moment2"}, st.keys()
+        assert opt2._global_step == len(xs)
+        n_before = opt1._global_step
+        assert n_before == len(xs)  # phase 1 only
+        return losses
+
+    ref = run(use_loop=False)
+    got = run(use_loop=True)
+    np.testing.assert_allclose(ref, got, rtol=1e-3, atol=1e-4)
