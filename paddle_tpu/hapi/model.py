@@ -71,6 +71,23 @@ class Model:
         self._multi_step_fn = None
         from collections import OrderedDict
         self._eval_fns = OrderedDict()  # (sig, mode) -> compiled program
+        # sig -> compiled train step; bounded LRU so size-bucketed
+        # multi-scale training (YOLO) switches buckets without recompiling
+        self._train_fns = OrderedDict()
+
+    def _get_train_step(self, sig):
+        ts = self._train_fns.get(sig)
+        if ts is None:
+            self.network.train()
+            ts = self._build_train_step(sig)
+            if len(self._train_fns) >= 16:
+                self._train_fns.popitem(last=False)
+            self._train_fns[sig] = ts
+        else:
+            self._train_fns.move_to_end(sig)
+        self._train_step_fn = ts
+        self._train_sig = sig
+        return ts
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -187,11 +204,7 @@ class Model:
         # per-step signature drives the same compiled-step cache
         sig = (tuple((tuple(r.shape[1:]), str(r.dtype)) for r in xs + ys),
                False)
-        if self._train_step_fn is None or self._train_sig != sig:
-            self.network.train()
-            self._train_step_fn = self._build_train_step(sig)
-            self._train_sig = sig
-        ts = self._train_step_fn
+        ts = self._get_train_step(sig)
         opt = self._optimizer
         if any(p._grad is not None for p in ts["trainable"]):
             raise RuntimeError(
@@ -526,11 +539,7 @@ class Model:
                   for l in labels]
         sig = (tuple((tuple(r.shape), str(r.dtype))
                      for r in x_raws + y_raws), bool(self._metrics))
-        if self._train_step_fn is None or self._train_sig != sig:
-            self.network.train()
-            self._train_step_fn = self._build_train_step(sig)
-            self._train_sig = sig
-        ts = self._train_step_fn
+        ts = self._get_train_step(sig)
         opt = self._optimizer
         for p in ts["trainable"]:
             if stable_uid(p) not in opt._state:

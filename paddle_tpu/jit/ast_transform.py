@@ -24,13 +24,34 @@ closures cannot rebind outer locals) and return them; names possibly
 undefined on entry travel as an ``_Undefined`` sentinel that raises a
 clear error on first use (reference: dygraph_to_static UndefinedVar).
 
+``for`` loops convert too (reference loop_transformer.py): ``for t in
+range(...)`` routes through ``__pt_for_range__`` (lax.while_loop when any
+bound is traced, plain python otherwise), ``for t in seq`` through
+``__pt_for_iter__`` (leading-axis iteration for tensors, the native
+protocol for other iterables). ``break``/``continue`` inside converted
+loops lower to per-loop flags (reference break_continue_transformer.py):
+``continue`` sets a jump flag that guards the rest of the iteration,
+``break`` additionally sets a sticky flag folded into the loop condition;
+both guards dispatch through ``__pt_if__`` so traced jump conditions
+become ``lax.cond``/masked state.
+
+List appends in loops (reference list_transformer.py list ->
+LoDTensorArray): with a STATIC trip count the loop runs the python
+protocol, appends unroll under tracing and a post-loop ``stack``/
+``concat`` gives the stacked-tensor result — the canonical reference
+patterns work unchanged. A *data-dependent* trip count cannot grow a
+python list under XLA's static-shape model (the reference's tensor-array
+relies on dynamic shapes); that case raises with guidance to preallocate
+(see ``_no_list_state``).
+
 Conversion is best-effort with a guaranteed fallback: any construct the
-pass cannot preserve exactly (``return``/``break``/``continue`` inside a
-converted branch, closures, unavailable source) leaves that node — or the
-whole function — untouched, so behaviour degrades to the pre-existing
-clear tracer error, never to silently-wrong code. ``convert_call``-style
-recursion is one level deep: calls to plain user functions are routed
-through ``__pt_call__`` which converts the callee's own if/while once.
+pass cannot preserve exactly (``return``/``yield`` inside a converted
+branch or loop, jumps escaping try/with, closures, unavailable source)
+leaves that node — or the whole function — untouched, so behaviour
+degrades to the pre-existing clear tracer error, never to silently-wrong
+code. ``convert_call``-style recursion is one level deep: calls to plain
+user functions are routed through ``__pt_call__`` which converts the
+callee's own if/while once.
 """
 from __future__ import annotations
 
@@ -133,6 +154,192 @@ def __pt_while__(cond_fn, body_fn, names, args):
     return tuple(state)
 
 
+def __pt_not__(x):
+    """``not x`` that survives traced booleans (guards emitted by the
+    break/continue lowering)."""
+    if _is_tensorish(x):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        raw = x._data if isinstance(x, Tensor) else x
+        return jnp.logical_not(raw)
+    return not x
+
+
+def __pt_loop_cond__(flag, test_thunk):
+    """Loop condition with a break flag: short-circuits the real test
+    away once a concrete break fired (python semantics: the test is not
+    re-evaluated after ``break``); under tracing both are evaluated and
+    combined with logical_and."""
+    if not _is_tensorish(flag):
+        if flag:
+            return False
+        return test_thunk()
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    t = test_thunk()
+    t = t._data if isinstance(t, Tensor) else t
+    f = flag._data if isinstance(flag, Tensor) else flag
+    return jnp.logical_and(jnp.logical_not(f), t)
+
+
+def _check_initialised(names, args, what):
+    for n, a in zip(names, args):
+        if isinstance(a, _Undefined):
+            raise NameError(
+                f"loop variable {n!r} must be initialised before a "
+                f"{what} (every name assigned in the loop body becomes "
+                f"part of the loop state)")
+
+
+def _no_list_state(names, args, what):
+    for n, a in zip(names, args):
+        if isinstance(a, (list, dict, set)):
+            raise TypeError(
+                f"{what}: loop-carried variable {n!r} is a Python "
+                f"{type(a).__name__}, which cannot grow across a "
+                f"data-dependent (tensor-bound) loop under XLA. Keep the "
+                f"trip count static (plain-int range) so appends unroll "
+                f"and stack, or preallocate a Tensor and update slices. "
+                f"(The reference's list->LoDTensorArray rewrite, "
+                f"list_transformer.py, relies on dynamic shapes that "
+                f"have no XLA equivalent — see the module docstring.)")
+
+
+def _concrete_flag(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        x = x._data
+    return bool(np.asarray(x))
+
+
+def __pt_for_range__(start, stop, step, tgt_idx, brk_idx, body_fn, names,
+                     args):
+    """``for target in range(...)`` harness (reference:
+    loop_transformer.py for->while rewrite). body_fn(i, *state) -> state;
+    the generated prologue rebinds the target from ``i`` each iteration,
+    so target rebinding inside the body does not affect iteration —
+    python semantics preserved."""
+    traced_bounds = any(_is_traced(v) for v in (start, stop, step))
+    # a tensor break flag needs the dynamic loop even with static bounds
+    dynamic = traced_bounds or (
+        brk_idx >= 0 and any(_is_traced(a) for a in args))
+    if dynamic:
+        from ..ops import control_flow
+        from ..core.tensor import Tensor
+        import jax.numpy as jnp
+        if isinstance(args[tgt_idx], _Undefined):
+            # the loop target needs no prior binding: the body prologue
+            # assigns it before any use; seed its carry slot with start
+            args = list(args)
+            args[tgt_idx] = start
+        _check_initialised(names, args, "tensor-bound for loop")
+        _no_list_state(names, args, "tensor-bound for loop")
+
+        def raw(v):
+            return v._data if isinstance(v, Tensor) else v
+        start_r = jnp.asarray(raw(start))
+        stop_r, step_r = raw(stop), raw(step)
+
+        def cond(i, *s):
+            ir = raw(i)
+            in_r = jnp.where(jnp.asarray(step_r) > 0,
+                             ir < stop_r, ir > stop_r)
+            if brk_idx >= 0:
+                in_r = jnp.logical_and(in_r,
+                                       jnp.logical_not(raw(s[brk_idx])))
+            return in_r
+
+        def body(i, *s):
+            out = body_fn(i, *s)
+            return [raw(i) + step_r] + list(out)
+
+        out = control_flow.while_loop(cond, body, [start_r] + list(args))
+        return tuple(out[1:])
+
+    def as_int(v):
+        from ..core.tensor import Tensor
+        if isinstance(v, Tensor) or hasattr(v, "shape"):
+            return int(np.asarray(v._data if isinstance(v, Tensor) else v))
+        return int(v)
+    state = list(args)
+    for i in range(as_int(start), as_int(stop), as_int(step)):
+        state = list(body_fn(i, *state))
+        if brk_idx >= 0:
+            flag = state[brk_idx]
+            if _is_traced(flag):
+                raise TypeError(
+                    "break on a traced tensor condition inside a "
+                    "static-bound loop whose state is untraced — "
+                    "initialise the loop-carried variables as tensors so "
+                    "the loop can lower to lax.while_loop")
+            if _concrete_flag(flag):
+                break
+    return tuple(state)
+
+
+def __pt_for_iter__(seq, tgt_idx, brk_idx, body_fn, names, args):
+    """``for target in seq`` harness. Tensor seq iterates its leading
+    axis (reference: loop_transformer + convert_operators len/getitem);
+    any other iterable (list, zip, dict, generator) runs the plain
+    python protocol with the lowered body."""
+    from ..core.tensor import Tensor
+    if isinstance(seq, Tensor) or _is_traced(seq) or (
+            hasattr(seq, "shape") and hasattr(seq, "dtype")):
+        n = int(seq.shape[0])
+        elem = lambda i: seq[i]
+        if _is_traced(seq) and brk_idx >= 0:
+            import jax.numpy as jnp
+            from ..ops import control_flow
+            if isinstance(args[tgt_idx], _Undefined) and n > 0:
+                args = list(args)
+                args[tgt_idx] = elem(0)
+            _check_initialised(names, args, "tensor-bound for loop")
+            _no_list_state(names, args, "tensor-bound for loop")
+            raw = lambda v: v._data if isinstance(v, Tensor) else v
+
+            def cond(i, *s):
+                in_r = raw(i) < n
+                return jnp.logical_and(
+                    in_r, jnp.logical_not(raw(s[brk_idx])))
+
+            def body(i, *s):
+                out = body_fn(elem(i), *s)
+                return [raw(i) + 1] + list(out)
+            out = control_flow.while_loop(
+                cond, body, [jnp.asarray(0)] + list(args))
+            return tuple(out[1:])
+        state = list(args)
+        for i in range(n):
+            state = list(body_fn(elem(i), *state))
+            if brk_idx >= 0:
+                flag = state[brk_idx]
+                if _is_traced(flag):
+                    raise TypeError(
+                        "break on a traced tensor condition while "
+                        "iterating a concrete tensor — pass the sequence "
+                        "as a traced input so the loop lowers to "
+                        "lax.while_loop")
+                if _concrete_flag(flag):
+                    break
+        return tuple(state)
+    state = list(args)
+    for v in seq:
+        state = list(body_fn(v, *state))
+        if brk_idx >= 0:
+            flag = state[brk_idx]
+            if _is_traced(flag):
+                raise TypeError(
+                    "break on a traced tensor condition while iterating a "
+                    "python sequence — the trip count is python-static "
+                    "but the break is data-dependent, which cannot be "
+                    "decided at trace time. Stack the sequence into a "
+                    "Tensor (so the loop lowers to lax.while_loop) or "
+                    "compute the break condition from concrete values")
+            if _concrete_flag(flag):
+                break
+    return tuple(state)
+
+
 _SKIP_MODULE_PREFIXES = ("paddle_tpu", "jax", "numpy", "builtins", "torch",
                          "flax", "optax")
 
@@ -165,6 +372,10 @@ _HELPERS = {
     "__pt_while__": __pt_while__,
     "__pt_args__": __pt_args__,
     "__pt_call__": __pt_call__,
+    "__pt_not__": __pt_not__,
+    "__pt_loop_cond__": __pt_loop_cond__,
+    "__pt_for_range__": __pt_for_range__,
+    "__pt_for_iter__": __pt_for_iter__,
 }
 
 
@@ -232,6 +443,40 @@ def _assigned_names(stmts) -> Set[str]:
 
         def visit_NamedExpr(self, node):
             targets(node.target)
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return {n for n in out if not n.startswith("__pt_")}
+
+
+_MUTATORS = {"append", "extend", "insert", "pop", "remove", "clear",
+             "add", "update", "setdefault"}
+
+
+def _mutated_containers(stmts) -> Set[str]:
+    """Names whose containers are mutated in place via method calls
+    (``xs.append(v)`` — reference list_transformer's list-op tracking).
+    These must join the loop state so the dynamic-loop guard can reject
+    python containers with a clear message instead of silently leaking a
+    traced element out of the loop body."""
+    out: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Call(self, node):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS
+                    and isinstance(f.value, ast.Name)):
+                out.add(f.value.id)
             self.generic_visit(node)
 
     v = V()
@@ -308,6 +553,38 @@ def _contains_yield(stmts) -> bool:
     return v.found
 
 
+def _contains_jump(stmts) -> bool:
+    """Break/Continue at any depth, excluding nested loops and function
+    scopes (those own their jumps)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_For(self, node):
+            pass
+
+        visit_AsyncFor = visit_While = visit_For
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
 def _contains_return(stmts) -> bool:
     """Return statements at any depth, excluding nested function scopes
     (a proper recursive visitor — ast.walk's flat BFS cannot prune)."""
@@ -330,6 +607,75 @@ def _contains_return(stmts) -> bool:
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+# ---------------------------------------------------------------------------
+# break/continue lowering (reference: break_continue_transformer.py)
+
+def _lower_jumps(stmts, jump_name, brk_name):
+    """Rewrite ``break``/``continue`` at this loop level into flag
+    assignments, guarding every statement that a jump would have skipped
+    with ``if __pt_not__(jump):``. Returns (new_stmts, has_break,
+    has_continue); raises _JumpLowerBail when the construct cannot be
+    lowered faithfully (jump inside try/with)."""
+    has = {"break": False, "continue": False}
+
+    def assign_true(name):
+        return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                          value=ast.Constant(value=True))
+
+    def rewrite(block):
+        """-> (new_block, may_jump)."""
+        out = []
+        for idx, s in enumerate(block):
+            if isinstance(s, ast.Break):
+                has["break"] = True
+                out.append(assign_true(jump_name))
+                out.append(assign_true(brk_name))
+                return out, True          # rest of the block unreachable
+            if isinstance(s, ast.Continue):
+                has["continue"] = True
+                out.append(assign_true(jump_name))
+                return out, True
+            if isinstance(s, ast.If):
+                nb, jb = rewrite(list(s.body))
+                no, jo = rewrite(list(s.orelse))
+                s = ast.If(test=s.test, body=nb, orelse=no)
+                out.append(s)
+                if jb or jo:
+                    rest, _ = rewrite(block[idx + 1:])
+                    if rest:
+                        out.append(ast.If(
+                            test=ast.Call(
+                                func=ast.Name(id="__pt_not__",
+                                              ctx=ast.Load()),
+                                args=[ast.Name(id=jump_name,
+                                               ctx=ast.Load())],
+                                keywords=[]),
+                            body=rest, orelse=[]))
+                    return out, True
+                continue
+            if isinstance(s, (ast.For, ast.While, ast.AsyncFor,
+                              ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                out.append(s)             # inner loops own their jumps
+                continue
+            if isinstance(s, (ast.Try, ast.With, ast.AsyncWith)) and \
+                    _has_escape([s], through_loops=False):
+                raise _JumpLowerBail()
+            if isinstance(s, getattr(ast, "Match", ())) and \
+                    _contains_jump([s]):
+                raise _JumpLowerBail()    # jumps inside match-cases are
+                                          # not analysed — bail cleanly
+            out.append(s)
+        return out, False
+
+    new_body, _ = rewrite(list(stmts))
+    return new_body, has["break"], has["continue"]
+
+
+class _JumpLowerBail(Exception):
+    pass
 
 
 # ---------------------------------------------------------------------------
@@ -388,17 +734,52 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         return [tdef, fdef, tail]
 
     # -- while --------------------------------------------------------------
+    @staticmethod
+    def _flag_init(name):
+        return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                          value=ast.Constant(value=False))
+
     def visit_While(self, node):
-        self.generic_visit(node)
         body = list(node.body)
-        if node.orelse or _has_escape(body, through_loops=False):
+        if node.orelse or _contains_return(body) or _contains_yield(body):
+            self.generic_visit(node)
             return node
-        names = sorted(_assigned_names(body))
+        uid = self._uid()
+        jname, kname = f"__ptj_{uid}", f"__ptb_{uid}"
+        try:
+            body, has_brk, has_cont = _lower_jumps(body, jname, kname)
+        except _JumpLowerBail:
+            self.generic_visit(node)
+            return node
+        if has_brk or has_cont:
+            body = [self._flag_init(jname)] + body   # per-iteration reset
+        node = ast.While(test=node.test, body=body, orelse=[])
+        self.generic_visit(node)     # convert nested ifs/loops + guards
+        # no late bail: every escape was either pre-checked (return/yield),
+        # lowered (break/continue) or bailed BEFORE mutation (_JumpLowerBail
+        # on try/with/match) — returning a half-lowered loop here would
+        # lose break semantics
+        body = list(node.body)
+        names = sorted(_assigned_names(body) | _mutated_containers(body))
         if not names:
             return node  # nothing evolves: not convertible, leave as-is
-        uid = self._uid()
         cname, bname = f"__pt_cond_{uid}", f"__pt_body_{uid}"
-        cdef = self._mkfn(cname, names, [ast.Return(value=node.test)])
+        if has_brk:
+            # cond = __pt_loop_cond__(brk, lambda: test): short-circuits
+            # after a concrete break, logical_and under tracing
+            test = ast.Call(
+                func=ast.Name(id="__pt_loop_cond__", ctx=ast.Load()),
+                args=[ast.Name(id=kname, ctx=ast.Load()),
+                      ast.Lambda(
+                          args=ast.arguments(
+                              posonlyargs=[], args=[], vararg=None,
+                              kwonlyargs=[], kw_defaults=[], kwarg=None,
+                              defaults=[]),
+                          body=node.test)],
+                keywords=[])
+        else:
+            test = node.test
+        cdef = self._mkfn(cname, names, [ast.Return(value=test)])
         ret = ast.Return(value=ast.Tuple(
             elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
             ctx=ast.Load()))
@@ -416,7 +797,97 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
                 elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
                 ctx=ast.Store())],
             value=call)
-        return [cdef, bdef, tail]
+        inits = ([self._flag_init(kname)] if has_brk else []) + \
+                ([self._flag_init(jname)] if (has_brk or has_cont) else [])
+        return inits + [cdef, bdef, tail]
+
+    # -- for ----------------------------------------------------------------
+    def visit_For(self, node):
+        body = list(node.body)
+        if (node.orelse or _contains_return(body) or _contains_yield(body)
+                or not isinstance(node.target, ast.Name)):
+            self.generic_visit(node)
+            return node
+        uid = self._uid()
+        jname, kname = f"__ptj_{uid}", f"__ptb_{uid}"
+        try:
+            body, has_brk, has_cont = _lower_jumps(body, jname, kname)
+        except _JumpLowerBail:
+            self.generic_visit(node)
+            return node
+        target = node.target.id
+        elem = f"__pt_elem_{uid}"
+        prologue = []
+        if has_brk or has_cont:
+            prologue.append(self._flag_init(jname))
+        prologue.append(ast.Assign(
+            targets=[ast.Name(id=target, ctx=ast.Store())],
+            value=ast.Name(id=elem, ctx=ast.Load())))
+        # recognise `range(...)` BEFORE visiting children — visit_Call
+        # would wrap it into __pt_call__(range, ...) and hide the pattern
+        it = node.iter
+        is_range = (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range" and not it.keywords
+                    and 1 <= len(it.args) <= 3
+                    and not any(isinstance(a, ast.Starred)
+                                for a in it.args))
+        if is_range:
+            if len(it.args) == 1:
+                rargs = [ast.Constant(value=0), it.args[0],
+                         ast.Constant(value=1)]
+            elif len(it.args) == 2:
+                rargs = [it.args[0], it.args[1], ast.Constant(value=1)]
+            else:
+                rargs = list(it.args)
+            # stash the bound expressions where generic_visit still
+            # converts them (nested calls etc.)
+            node.iter = ast.Tuple(elts=rargs, ctx=ast.Load())
+        node = ast.For(target=node.target, iter=node.iter,
+                       body=prologue + body, orelse=[])
+        self.generic_visit(node)     # convert nested ifs/loops + guards
+        # no late bail (see visit_While): the prologue and iter rewrite are
+        # already applied, so this node must complete its conversion
+        body = list(node.body)
+        names = sorted(_assigned_names(body) | _mutated_containers(body)
+                       | {target})
+        brk_idx = names.index(kname) if has_brk else -1
+        tgt_idx = names.index(target)
+        bname = f"__pt_forbody_{uid}"
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+            ctx=ast.Load()))
+        bdef = self._mkfn(bname, [elem] + names, body + [ret])
+        if is_range:
+            call = ast.Call(
+                func=ast.Name(id="__pt_for_range__", ctx=ast.Load()),
+                args=list(node.iter.elts) + [
+                    ast.Constant(value=tgt_idx),
+                    ast.Constant(value=brk_idx),
+                    ast.Name(id=bname, ctx=ast.Load()),
+                    ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                              ctx=ast.Load()),
+                    self._args_call(names)],
+                keywords=[])
+        else:
+            call = ast.Call(
+                func=ast.Name(id="__pt_for_iter__", ctx=ast.Load()),
+                args=[node.iter,
+                      ast.Constant(value=tgt_idx),
+                      ast.Constant(value=brk_idx),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                                ctx=ast.Load()),
+                      self._args_call(names)],
+                keywords=[])
+        tail = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())],
+            value=call)
+        inits = ([self._flag_init(kname)] if has_brk else []) + \
+                ([self._flag_init(jname)] if (has_brk or has_cont) else [])
+        return inits + [bdef, tail]
 
     # -- helpers ------------------------------------------------------------
     @staticmethod

@@ -181,3 +181,119 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         ll = l.reshape(-1, 1)
         return jnp.mean(jnp.any(topk == ll, axis=-1).astype(jnp.float32))
     return apply_raw("accuracy", impl, input, label)
+
+
+class DetectionMAP(Metric):
+    """VOC-style detection mAP (reference: fluid/metrics.py DetectionMAP
+    over operators/detection/detection_map_op.cc).
+
+    The reference accumulates matched TP/FP inside a CUDA/CPU op; per
+    docs/adr/0003 detection *evaluation* is host-side here — dets come
+    back from the fixed-shape multiclass_nms (ops/detection.py) and the
+    PR/AP bookkeeping is plain numpy.
+
+    update() takes per-batch ``(dets [N, K, 6] rows (label, score, x1,
+    y1, x2, y2) padded with label -1, counts [N], gt_box [N, B, 4] xyxy
+    zero-padded, gt_label [N, B], difficult [N, B] or None)``.
+    """
+
+    def __init__(self, class_num, overlap_threshold=0.5,
+                 evaluate_difficult=False, ap_version="integral",
+                 name="mAP"):
+        super().__init__()
+        if ap_version not in ("integral", "11point"):
+            raise ValueError(f"ap_version {ap_version!r} not in "
+                             "('integral', '11point')")
+        self.class_num = int(class_num)
+        self.thresh = float(overlap_threshold)
+        self.eval_difficult = bool(evaluate_difficult)
+        self.ap_version = ap_version
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, is_tp); gt count excl. difficult
+        self._scores = [[] for _ in range(self.class_num)]
+        self._npos = np.zeros(self.class_num, np.int64)
+
+    @staticmethod
+    def _iou(box, gts):
+        ix1 = np.maximum(box[0], gts[:, 0])
+        iy1 = np.maximum(box[1], gts[:, 1])
+        ix2 = np.minimum(box[2], gts[:, 2])
+        iy2 = np.minimum(box[3], gts[:, 3])
+        iw = np.maximum(ix2 - ix1, 0)
+        ih = np.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        a1 = (box[2] - box[0]) * (box[3] - box[1])
+        a2 = (gts[:, 2] - gts[:, 0]) * (gts[:, 3] - gts[:, 1])
+        return inter / np.maximum(a1 + a2 - inter, 1e-10)
+
+    def update(self, dets, counts, gt_box, gt_label, difficult=None):
+        dets, counts = _np(dets), _np(counts).astype(np.int64)
+        gt_box, gt_label = _np(gt_box), _np(gt_label).astype(np.int64)
+        difficult = (np.zeros_like(gt_label) if difficult is None
+                     else _np(difficult).astype(np.int64))
+        for n in range(dets.shape[0]):
+            valid_gt = (gt_box[n, :, 2] > gt_box[n, :, 0]) & \
+                       (gt_box[n, :, 3] > gt_box[n, :, 1])
+            g_box = gt_box[n][valid_gt]
+            g_lab = gt_label[n][valid_gt]
+            g_dif = difficult[n][valid_gt]
+            for c in range(self.class_num):
+                self._npos[c] += int(((g_lab == c) & ((g_dif == 0) |
+                                      self.eval_difficult)).sum())
+            d = dets[n, :counts[n]]
+            d = d[d[:, 0] >= 0]
+            order = np.argsort(-d[:, 1], kind="stable")
+            matched = np.zeros(len(g_box), bool)
+            for row in d[order]:
+                c = int(row[0])
+                if not (0 <= c < self.class_num):
+                    continue
+                cand = np.where(g_lab == c)[0]
+                if cand.size == 0:
+                    self._scores[c].append((row[1], 0))
+                    continue
+                ious = self._iou(row[2:6], g_box[cand])
+                j = int(np.argmax(ious))
+                gi = cand[j]
+                if ious[j] >= self.thresh:
+                    if g_dif[gi] and not self.eval_difficult:
+                        continue            # difficult match: ignore det
+                    if not matched[gi]:
+                        matched[gi] = True
+                        self._scores[c].append((row[1], 1))
+                    else:
+                        self._scores[c].append((row[1], 0))
+                else:
+                    self._scores[c].append((row[1], 0))
+
+    def accumulate(self):
+        aps = []
+        for c in range(self.class_num):
+            if self._npos[c] == 0:
+                continue
+            if not self._scores[c]:
+                aps.append(0.0)
+                continue
+            rec = np.asarray(self._scores[c], np.float64)
+            order = np.argsort(-rec[:, 0], kind="stable")
+            tp = np.cumsum(rec[order, 1])
+            fp = np.cumsum(1 - rec[order, 1])
+            recall = tp / self._npos[c]
+            precision = tp / np.maximum(tp + fp, 1e-10)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    mask = recall >= t
+                    ap += (precision[mask].max() if mask.any() else 0.0) / 11
+            else:
+                # integral AP: sum precision deltas at each new recall level
+                mrec = np.concatenate([[0], recall])
+                ap = float(np.sum((mrec[1:] - mrec[:-1]) * precision))
+            aps.append(float(ap))
+        return float(np.mean(aps)) if aps else 0.0
+
+    def name(self):
+        return self._name

@@ -212,3 +212,197 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """reference: vision/datasets/voc2012.py VOC2012 — segmentation pairs
+    straight out of the VOCtrainval tar (JPEGImages/*.jpg +
+    SegmentationClass/*.png, split lists under ImageSets/Segmentation).
+
+    Zero-egress environment: pass ``data_file`` (the
+    VOCtrainval_11-May-2012.tar path) explicitly. The reference's mode
+    quirk is kept for parity: 'train' reads the trainval list and 'test'
+    reads the train list (voc2012.py MODE_FLAG_MAP).
+    """
+
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    MODE_FLAG_MAP = {"train": "trainval", "test": "train", "valid": "val"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        mode = mode.lower()
+        if mode not in self.MODE_FLAG_MAP:
+            raise ValueError(f"mode should be 'train', 'valid' or 'test', "
+                             f"but got {mode}")
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.transform = transform
+        self.flag = self.MODE_FLAG_MAP[mode]
+        self.data_tar = tarfile.open(data_file)
+        self.name2mem = {m.name: m for m in self.data_tar.getmembers()}
+        set_member = self.name2mem[self.SET_FILE.format(self.flag)]
+        self.data, self.labels = [], []
+        for line in self.data_tar.extractfile(set_member):
+            name = line.strip().decode("utf-8")
+            if not name:
+                continue
+            self.data.append(self.DATA_FILE.format(name))
+            self.labels.append(self.LABEL_FILE.format(name))
+
+    def _decode(self, member_name):
+        import io as _io
+        raw = self.data_tar.extractfile(self.name2mem[member_name]).read()
+        if member_name.endswith(".npy"):
+            return np.load(_io.BytesIO(raw))
+        from PIL import Image
+        return np.array(Image.open(_io.BytesIO(raw)))
+
+    def __getitem__(self, idx):
+        img = self._decode(self.data[idx])
+        label = self._decode(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Flowers(Dataset):
+    """reference: vision/datasets/flowers.py Flowers — 102-category Oxford
+    flowers: images in a .tgz, labels + split indices in MATLAB .mat files.
+
+    Zero-egress environment: pass ``data_file``/``label_file``/
+    ``setid_file`` explicitly. The reference's train/test swap is kept for
+    parity ('train' uses the official tstid split because it is larger).
+    """
+
+    MODE_FLAG_MAP = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        mode = mode.lower()
+        if mode not in self.MODE_FLAG_MAP:
+            raise ValueError(f"mode should be 'train', 'valid' or 'test', "
+                             f"but got {mode}")
+        if data_file is None or label_file is None or setid_file is None:
+            _no_download(type(self).__name__)
+        self.transform = transform
+        import scipy.io as scio
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self.MODE_FLAG_MAP[mode]][0]
+        # extract once next to the archive, like the reference
+        self.data_path = data_file
+        for suffix in (".tgz", ".tar.gz", ".tar"):
+            if data_file.endswith(suffix):
+                self.data_path = data_file[:-len(suffix)] + "/"
+                break
+        if self.data_path != data_file and not os.path.exists(
+                os.path.join(self.data_path, ".extracted")):
+            os.makedirs(self.data_path, exist_ok=True)
+            with tarfile.open(data_file) as tf:
+                tf.extractall(self.data_path, filter="data")
+            with open(os.path.join(self.data_path, ".extracted"), "w"):
+                pass    # sentinel: skip re-extraction next construction
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([self.labels[index - 1]], np.int64)
+        for ext in ("jpg/image_%05d.jpg", "jpg/image_%05d.npy"):
+            path = os.path.join(self.data_path, ext % index)
+            if os.path.exists(path):
+                break
+        image = _default_loader(path)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+VOC_CLASSES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+
+class VOCDetection(Dataset):
+    """Pascal-VOC *detection* annotations out of a VOCdevkit directory —
+    the ingest side of the YOLOv3 workload (reference capability:
+    PaddleDetection's VOCDataSet feeding
+    fluid/operators/detection/yolov3_loss_op.cc; the base repo ships only
+    the segmentation reader, voc2012.py).
+
+    Returns ``(image HWC uint8, gt_box [M, 4] float32 xyxy pixels,
+    gt_label [M] int64, difficult [M] int64)`` per sample. Samples with
+    zero boxes are kept (empty arrays) — padding to fixed M is the
+    transform/collate layer's job (static shapes for the TPU).
+    """
+
+    def __init__(self, root, year="2012", mode="train", transform=None,
+                 classes=None, keep_difficult=True, image_set=None):
+        self.root = root
+        self.transform = transform
+        self.keep_difficult = keep_difficult
+        classes = classes or VOC_CLASSES
+        self.classes = tuple(classes)
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        base = os.path.join(root, f"VOC{year}")
+        if not os.path.isdir(base):
+            base = root     # already pointing inside VOCdevkit/VOCxxxx
+        self._img_dir = os.path.join(base, "JPEGImages")
+        self._ann_dir = os.path.join(base, "Annotations")
+        set_file = os.path.join(base, "ImageSets", "Main",
+                                (image_set or mode) + ".txt")
+        if os.path.exists(set_file):
+            with open(set_file) as f:
+                self.ids = [l.split()[0] for l in f if l.strip()]
+        else:                           # no split list: every annotation
+            self.ids = sorted(os.path.splitext(f)[0]
+                              for f in os.listdir(self._ann_dir)
+                              if f.endswith(".xml"))
+        if not self.ids:
+            raise ValueError(f"VOCDetection: no samples under {base}")
+
+    def _parse_ann(self, xml_path):
+        import xml.etree.ElementTree as ET
+        rootel = ET.parse(xml_path).getroot()
+        boxes, labels, difficult = [], [], []
+        for obj in rootel.iter("object"):
+            name = obj.find("name").text.strip().lower()
+            if name not in self.class_to_idx:
+                continue
+            diff = int((obj.find("difficult").text or 0)
+                       if obj.find("difficult") is not None else 0)
+            if diff and not self.keep_difficult:
+                continue
+            bb = obj.find("bndbox")
+            # VOC pixel indices are 1-based inclusive
+            box = [float(bb.find(k).text) - 1.0
+                   for k in ("xmin", "ymin", "xmax", "ymax")]
+            boxes.append(box)
+            labels.append(self.class_to_idx[name])
+            difficult.append(diff)
+        return (np.asarray(boxes, np.float32).reshape(-1, 4),
+                np.asarray(labels, np.int64),
+                np.asarray(difficult, np.int64))
+
+    def __getitem__(self, idx):
+        name = self.ids[idx]
+        for ext in (".jpg", ".npy", ".png"):
+            p = os.path.join(self._img_dir, name + ext)
+            if os.path.exists(p):
+                break
+        img = _default_loader(p)
+        boxes, labels, difficult = self._parse_ann(
+            os.path.join(self._ann_dir, name + ".xml"))
+        sample = (img, boxes, labels, difficult)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample
+
+    def __len__(self):
+        return len(self.ids)

@@ -7,3 +7,5 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (MobileNetV1, MobileNetV2, MobileNetV3Large,  # noqa: F401
                         MobileNetV3Small, mobilenet_v1, mobilenet_v2,
                         mobilenet_v3_large, mobilenet_v3_small)
+from .darknet import DarkNet, darknet53  # noqa: F401
+from .yolov3 import YOLOv3, YOLOv3Loss, yolov3_darknet53  # noqa: F401

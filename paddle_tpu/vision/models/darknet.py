@@ -1,0 +1,88 @@
+"""DarkNet-53 backbone for the YOLOv3 workload.
+
+Reference capability: PaddleDetection's darknet backbone feeding the base
+repo's detection op stack (fluid/operators/detection/yolov3_loss_op.cc,
+yolo_box_op.cc); the base repo's vision package ships no detection
+backbone, so this fills BASELINE workload 4's model side.
+
+TPU notes: plain Conv2D+BatchNorm2D+LeakyReLU composition in NCHW — XLA
+fuses conv+bn+activation; all convs are 1x1/3x3 with static shapes so the
+MXU tiles them directly. ``width_mult`` scales every channel count for
+CPU-sized test configs without changing the topology.
+"""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ...nn import Conv2D, BatchNorm2D, LeakyReLU, Sequential
+
+__all__ = ["ConvBNLayer", "DarkNet", "darknet53"]
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_ch, out_ch, kernel=3, stride=1, padding=None):
+        super().__init__()
+        if padding is None:
+            padding = (kernel - 1) // 2
+        self.conv = Conv2D(in_ch, out_ch, kernel, stride=stride,
+                           padding=padding, bias_attr=False)
+        self.bn = BatchNorm2D(out_ch)
+        self.act = LeakyReLU(0.1)
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class BasicBlock(Layer):
+    """1x1 squeeze + 3x3 expand with residual add (YOLOv3 paper fig. 1)."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.conv1 = ConvBNLayer(ch, ch // 2, kernel=1)
+        self.conv2 = ConvBNLayer(ch // 2, ch, kernel=3)
+
+    def forward(self, x):
+        return x + self.conv2(self.conv1(x))
+
+
+class DarkNet(Layer):
+    """53-layer config: stages of [1, 2, 8, 8, 4] residual blocks at
+    channels [64, 128, 256, 512, 1024]; returns the C3/C4/C5 pyramid
+    (stride 8/16/32 feature maps) the YOLO head consumes."""
+
+    _stage_blocks = {53: [1, 2, 8, 8, 4]}
+
+    def __init__(self, depth=53, width_mult=1.0, num_stages=5):
+        super().__init__()
+        if depth not in self._stage_blocks:
+            raise ValueError(f"DarkNet: unsupported depth {depth}")
+        blocks = self._stage_blocks[depth][:num_stages]
+
+        def ch(c):
+            return max(int(c * width_mult), 8)
+
+        self.stem = ConvBNLayer(3, ch(32), kernel=3)
+        self.stages = []
+        in_ch = ch(32)
+        for i, n in enumerate(blocks):
+            out_ch = ch(64 * (2 ** i))
+            stage = Sequential(
+                ConvBNLayer(in_ch, out_ch, kernel=3, stride=2),
+                *[BasicBlock(out_ch) for _ in range(n)])
+            self.add_sublayer(f"stage{i}", stage)
+            self.stages.append(stage)
+            in_ch = out_ch
+        self.out_channels = [ch(64 * (2 ** i))
+                             for i in range(max(len(blocks) - 3, 0),
+                                            len(blocks))]
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats[-3:]           # C3, C4, C5
+
+
+def darknet53(width_mult=1.0, **kwargs):
+    return DarkNet(depth=53, width_mult=width_mult, **kwargs)

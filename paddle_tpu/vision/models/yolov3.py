@@ -1,0 +1,183 @@
+"""YOLOv3 detector — BASELINE workload 4 (PaddleDetection YOLOv3/PP-YOLO
+over the base repo's fluid/operators/detection/yolov3_loss_op.cc,
+yolo_box_op.cc, multiclass_nms_op.cc).
+
+TPU-first design:
+
+- **Static shapes via size buckets.** The reference trains YOLO with
+  per-step random input sizes; under XLA each distinct shape is its own
+  compiled program, so multi-scale training here is a SMALL set of square
+  size buckets (default 320/416/608). Each bucket compiles once and is
+  reused; ``YOLOv3.train_step`` keys its jit cache on the input shape.
+- **Fixed box slots.** gt boxes are zero-padded to ``num_max_boxes``
+  (vision/transforms/det_transforms.py PadBox), w==h==0 marks an empty
+  slot — no ragged tensors anywhere.
+- **Loss on-device, decode+NMS at the edge.** The three-scale
+  yolov3_loss sum is one fused jit region; eval-time decode runs
+  yolo_box per scale + one multiclass_nms (Pallas/NMS under
+  ops/detection.py) with fixed keep_top_k output.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer_base import Layer
+from ...nn import Conv2D, BatchNorm2D, LeakyReLU, Upsample
+from ... import ops
+from ...core.tensor import Tensor
+from .darknet import ConvBNLayer, DarkNet
+
+__all__ = ["YOLOv3", "yolov3_darknet53"]
+
+# COCO anchor table (YOLOv3 paper); PaddleDetection yolov3 defaults
+DEFAULT_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                   116, 90, 156, 198, 373, 326]
+DEFAULT_ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+
+class YoloDetBlock(Layer):
+    """Five alternating 1x1/3x3 convs + the 3x3 'tip' (YOLOv3 fig. 3)."""
+
+    def __init__(self, in_ch, channel):
+        super().__init__()
+        self.conv0 = ConvBNLayer(in_ch, channel, kernel=1)
+        self.conv1 = ConvBNLayer(channel, channel * 2, kernel=3)
+        self.conv2 = ConvBNLayer(channel * 2, channel, kernel=1)
+        self.conv3 = ConvBNLayer(channel, channel * 2, kernel=3)
+        self.route = ConvBNLayer(channel * 2, channel, kernel=1)
+        self.tip = ConvBNLayer(channel, channel * 2, kernel=3)
+
+    def forward(self, x):
+        r = self.route(self.conv3(self.conv2(self.conv1(self.conv0(x)))))
+        return r, self.tip(r)
+
+
+class YOLOv3(Layer):
+    """Backbone + 3-scale FPN head + raw per-scale outputs.
+
+    forward(img [N,3,S,S]) -> [out_32, out_16, out_8], each
+    [N, A*(5+C), S/ds, S/ds]. ``loss``/``decode`` wrap the detection ops.
+    """
+
+    def __init__(self, num_classes=80, backbone=None, anchors=None,
+                 anchor_masks=None, ignore_thresh=0.7, width_mult=1.0,
+                 num_max_boxes=50):
+        super().__init__()
+        self.num_classes = int(num_classes)
+        self.anchors = list(anchors or DEFAULT_ANCHORS)
+        self.anchor_masks = [list(m) for m in
+                             (anchor_masks or DEFAULT_ANCHOR_MASKS)]
+        self.ignore_thresh = float(ignore_thresh)
+        self.num_max_boxes = int(num_max_boxes)
+        self.backbone = backbone or DarkNet(depth=53, width_mult=width_mult)
+        self.downsamples = [32, 16, 8]
+
+        in_chs = list(reversed(self.backbone.out_channels))  # C5, C4, C3
+        self.blocks, self.outs, self.routes = [], [], []
+        ch = None
+        for i, in_ch in enumerate(in_chs):
+            channel = max(int(512 * width_mult) // (2 ** i), 8)
+            total_in = in_ch + (ch if i else 0)
+            block = YoloDetBlock(total_in, channel)
+            a = len(self.anchor_masks[i])
+            out_conv = Conv2D(channel * 2, a * (5 + self.num_classes), 1)
+            self.add_sublayer(f"yolo_block{i}", block)
+            self.add_sublayer(f"yolo_out{i}", out_conv)
+            self.blocks.append(block)
+            self.outs.append(out_conv)
+            if i < len(in_chs) - 1:
+                route = ConvBNLayer(channel, channel // 2, kernel=1)
+                self.add_sublayer(f"route{i}", route)
+                self.routes.append(route)
+                ch = channel // 2
+        self.upsample = Upsample(scale_factor=2, mode="nearest")
+
+    def forward(self, x):
+        feats = self.backbone(x)            # [C3, C4, C5]
+        outs = []
+        route = None
+        for i, feat in enumerate(reversed(feats)):   # C5 -> C3
+            if i:
+                feat = ops.concat([route, feat], axis=1)
+            r, tip = self.blocks[i](feat)
+            outs.append(self.outs[i](tip))
+            if i < len(self.blocks) - 1:
+                route = self.upsample(self.routes[i](r))
+        return outs
+
+    # -- training ---------------------------------------------------------
+    def loss(self, outputs, gt_box, gt_label, gt_score=None):
+        """Sum of the three per-scale yolov3_loss terms, meaned over the
+        batch (reference: yolov3_loss_op.cc per scale + model-side sum)."""
+        total = None
+        for out, mask, ds in zip(outputs, self.anchor_masks,
+                                 self.downsamples):
+            l = ops.yolov3_loss(
+                out, gt_box, gt_label, anchors=self.anchors,
+                anchor_mask=mask, class_num=self.num_classes,
+                ignore_thresh=self.ignore_thresh, downsample_ratio=ds,
+                gt_score=gt_score)
+            l = ops.mean(l)
+            total = l if total is None else total + l
+        return total
+
+    # -- inference --------------------------------------------------------
+    def decode(self, outputs, img_size, conf_thresh=0.01, nms_thresh=0.45,
+               keep_top_k=100, nms_top_k=400):
+        """yolo_box per scale + one multiclass NMS. Returns (dets
+        [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2) padded with
+        label -1, counts [N])."""
+        boxes, scores = [], []
+        for out, mask, ds in zip(outputs, self.anchor_masks,
+                                 self.downsamples):
+            anchors = []
+            for i in mask:
+                anchors += [self.anchors[2 * i], self.anchors[2 * i + 1]]
+            b, s = ops.yolo_box(out, img_size, anchors=anchors,
+                                class_num=self.num_classes,
+                                conf_thresh=conf_thresh,
+                                downsample_ratio=ds)
+            boxes.append(b)
+            scores.append(ops.transpose(s, [0, 2, 1]))
+        all_boxes = ops.concat(boxes, axis=1)        # [N, M, 4]
+        all_scores = ops.concat(scores, axis=2)      # [N, C, M]
+        return ops.multiclass_nms(
+            all_boxes, all_scores, score_threshold=conf_thresh,
+            nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+            nms_threshold=nms_thresh, background_label=-1)
+
+
+class YOLOv3Loss(Layer):
+    """hapi-compatible loss head: ``loss(out32, out16, out8, gt_box,
+    gt_label)``. Plugs YOLOv3 into hapi Model.prepare()/train_batch — the
+    compiled-step cache there keys on input shape, so size-bucketed
+    multi-scale training compiles one program per bucket and reuses it
+    (the TPU answer to the reference's per-step random resize)."""
+
+    def __init__(self, model: "YOLOv3"):
+        super().__init__()
+        self._cfg = dict(
+            anchors=model.anchors, anchor_masks=model.anchor_masks,
+            num_classes=model.num_classes,
+            ignore_thresh=model.ignore_thresh,
+            downsamples=model.downsamples)
+
+    def forward(self, out32, out16, out8, gt_box, gt_label):
+        c = self._cfg
+        total = None
+        for out, mask, ds in zip([out32, out16, out8], c["anchor_masks"],
+                                 c["downsamples"]):
+            l = ops.mean(ops.yolov3_loss(
+                out, gt_box, gt_label, anchors=c["anchors"],
+                anchor_mask=mask, class_num=c["num_classes"],
+                ignore_thresh=c["ignore_thresh"], downsample_ratio=ds))
+            total = l if total is None else total + l
+        return total
+
+
+def yolov3_darknet53(num_classes=80, pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("yolov3_darknet53: no bundled weights in this "
+                         "environment; pass pretrained=<path> via "
+                         "framework_io.load instead")
+    return YOLOv3(num_classes=num_classes, **kwargs)

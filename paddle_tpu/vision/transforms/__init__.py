@@ -14,6 +14,10 @@ from .functional import (  # noqa: F401
     rotate, adjust_brightness, adjust_contrast, adjust_saturation, adjust_hue,
     to_grayscale,
 )
+from .det_transforms import (  # noqa: F401
+    DetCompose, ResizeImage, RandomFlipImage, NormalizeBox, BoxXYXY2XYWH,
+    PadBox, NormalizeImage, Permute,
+)
 
 
 class Compose:

@@ -1,0 +1,189 @@
+"""VOC2012 / Flowers / VOCDetection datasets + detection transforms on
+synthesized fixtures (reference: python/paddle/vision/datasets/voc2012.py,
+flowers.py; detection ingest = PaddleDetection VOCDataSet capability)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision.datasets import (VOC2012, Flowers, VOCDetection,
+                                        VOC_CLASSES)
+from paddle_tpu.vision.transforms import (
+    DetCompose, ResizeImage, RandomFlipImage, NormalizeBox, BoxXYXY2XYWH,
+    PadBox, NormalizeImage, Permute)
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _add(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def voc_tar(tmp_path):
+    rng = np.random.RandomState(0)
+    path = tmp_path / "VOCtrainval_tiny.tar"
+    with tarfile.open(path, "w") as tf:
+        names = ["2007_000032", "2007_000033", "2007_000039"]
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+             ("\n".join(names) + "\n").encode())
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+             (names[0] + "\n").encode())
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+             ("\n".join(names[:2]) + "\n").encode())
+        for n in names:
+            img = rng.randint(0, 255, (24, 32, 3), dtype=np.uint8)
+            seg = rng.randint(0, 21, (24, 32), dtype=np.uint8)
+            _add(tf, f"VOCdevkit/VOC2012/JPEGImages/{n}.jpg",
+                 _jpg_bytes(img))
+            _add(tf, f"VOCdevkit/VOC2012/SegmentationClass/{n}.png",
+                 _png_bytes(seg))
+    return str(path)
+
+
+def test_voc2012_modes_and_samples(voc_tar):
+    ds = VOC2012(data_file=voc_tar, mode="train")
+    assert len(ds) == 3           # trainval list, reference mode quirk
+    img, seg = ds[0]
+    assert img.shape == (24, 32, 3) and seg.shape == (24, 32)
+    assert seg.max() <= 20
+    assert len(VOC2012(data_file=voc_tar, mode="valid")) == 1
+    assert len(VOC2012(data_file=voc_tar, mode="test")) == 2
+    with pytest.raises(ValueError):
+        VOC2012(data_file=voc_tar, mode="bogus")
+    with pytest.raises(RuntimeError, match="download"):
+        VOC2012(data_file=None)
+    # transform applies to the image only
+    ds_t = VOC2012(data_file=voc_tar, mode="train",
+                   transform=lambda im: im.astype(np.float32) / 255.0)
+    img_t, _ = ds_t[1]
+    assert img_t.dtype == np.float32 and img_t.max() <= 1.0
+
+
+@pytest.fixture
+def flowers_files(tmp_path):
+    import scipy.io as scio
+    rng = np.random.RandomState(1)
+    n = 8
+    data_file = tmp_path / "102flowers.tgz"
+    with tarfile.open(data_file, "w:gz") as tf:
+        for i in range(1, n + 1):
+            img = rng.randint(0, 255, (20, 20, 3), dtype=np.uint8)
+            _add(tf, "jpg/image_%05d.jpg" % i, _jpg_bytes(img))
+    labels = rng.randint(1, 103, (1, n)).astype(np.uint8)
+    scio.savemat(tmp_path / "imagelabels.mat", {"labels": labels})
+    scio.savemat(tmp_path / "setid.mat", {
+        "tstid": np.arange(1, 6)[None], "trnid": np.array([[6, 7]]),
+        "valid": np.array([[8]])})
+    return (str(data_file), str(tmp_path / "imagelabels.mat"),
+            str(tmp_path / "setid.mat"), labels[0])
+
+
+def test_flowers_splits_and_labels(flowers_files):
+    data, lab, setid, labels = flowers_files
+    tr = Flowers(data_file=data, label_file=lab, setid_file=setid,
+                 mode="train")
+    assert len(tr) == 5            # reference swap: train = tstid
+    img, y = tr[2]
+    assert img.shape == (20, 20, 3)
+    assert y.dtype == np.int64 and y[0] == labels[3 - 1]  # index 3, 1-based
+    te = Flowers(data_file=data, label_file=lab, setid_file=setid,
+                 mode="test")
+    assert len(te) == 2
+    va = Flowers(data_file=data, label_file=lab, setid_file=setid,
+                 mode="valid", transform=lambda im: im[:10])
+    assert va[0][0].shape == (10, 20, 3)
+    with pytest.raises(RuntimeError, match="download"):
+        Flowers()
+
+
+def _write_voc_devkit(root, n=3):
+    rng = np.random.RandomState(2)
+    base = os.path.join(root, "VOC2012")
+    os.makedirs(os.path.join(base, "JPEGImages"))
+    os.makedirs(os.path.join(base, "Annotations"))
+    os.makedirs(os.path.join(base, "ImageSets", "Main"))
+    names = []
+    for i in range(n):
+        name = "im%04d" % i
+        names.append(name)
+        h, w = 40 + 8 * i, 60
+        img = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+        from PIL import Image
+        Image.fromarray(img).save(
+            os.path.join(base, "JPEGImages", name + ".jpg"))
+        objs = []
+        for b in range(i + 1):     # i+1 boxes
+            x1, y1 = 1 + 10 * b, 1 + 5 * b
+            cls = VOC_CLASSES[(i + b) % 20]
+            objs.append(f"""
+  <object><name>{cls}</name><difficult>{b % 2}</difficult>
+    <bndbox><xmin>{x1}</xmin><ymin>{y1}</ymin>
+            <xmax>{x1 + 12}</xmax><ymax>{y1 + 9}</ymax></bndbox>
+  </object>""")
+        xml = (f"<annotation><size><width>{w}</width><height>{h}</height>"
+               f"</size>{''.join(objs)}</annotation>")
+        with open(os.path.join(base, "Annotations", name + ".xml"), "w") as f:
+            f.write(xml)
+    with open(os.path.join(base, "ImageSets", "Main", "train.txt"),
+              "w") as f:
+        f.write("\n".join(names) + "\n")
+    return names
+
+
+def test_voc_detection_parse(tmp_path):
+    _write_voc_devkit(str(tmp_path))
+    ds = VOCDetection(str(tmp_path), mode="train")
+    assert len(ds) == 3
+    img, boxes, labels, diff = ds[2]
+    assert img.shape == (56, 60, 3)
+    assert boxes.shape == (3, 4) and labels.shape == (3,)
+    # 1-based inclusive -> 0-based: xmin 1 -> 0
+    np.testing.assert_allclose(boxes[0], [0, 0, 12, 9])
+    assert diff.tolist() == [0, 1, 0]
+    ds_nd = VOCDetection(str(tmp_path), mode="train", keep_difficult=False)
+    _, b2, _, d2 = ds_nd[2]
+    assert b2.shape == (2, 4) and (d2 == 0).all()
+
+
+def test_det_transform_pipeline(tmp_path):
+    _write_voc_devkit(str(tmp_path))
+    pipe = DetCompose([
+        ResizeImage(64),
+        RandomFlipImage(prob=1.0),
+        NormalizeBox(),
+        BoxXYXY2XYWH(),
+        PadBox(10),
+        NormalizeImage(),
+        Permute()])
+    ds = VOCDetection(str(tmp_path), mode="train", transform=pipe)
+    img, boxes, labels, diff = ds[1]
+    assert img.shape == (3, 64, 64) and img.dtype == np.float32
+    assert boxes.shape == (10, 4) and labels.shape == (10,)
+    # two real boxes, rest zero-padded (w==h==0 marks empty slot)
+    assert (boxes[:2, 2] > 0).all() and (boxes[2:] == 0).all()
+    assert (boxes >= 0).all() and (boxes <= 1).all()
+    # flip invariant: center-x mirrored, width/height preserved
+    raw = VOCDetection(str(tmp_path), mode="train")
+    img0, b0, l0, _ = raw[1]
+    h, w = img0.shape[:2]
+    scale = 64.0
+    exp_w = (b0[0, 2] - b0[0, 0]) * scale / w / scale
+    np.testing.assert_allclose(boxes[0, 2], exp_w, rtol=1e-5)
+    np.testing.assert_allclose(labels[:2], l0, atol=0)
