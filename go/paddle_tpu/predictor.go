@@ -1,0 +1,215 @@
+// Go client for the paddle_tpu C inference API (reference:
+// go/paddle/predictor.go over paddle_c_api.h; here over
+// csrc/paddle_tpu_capi.h — PTC_PredictorCreate / PTC_Run /
+// zero-copy output getters).
+//
+// Build: compile csrc/capi_shim.cpp into libpaddle_tpu_capi.so first
+// (python -c "from paddle_tpu.inference.capi import build_capi;
+// print(build_capi())"), then
+//
+//	CGO_CFLAGS="-I/path/to/repo/csrc" \
+//	CGO_LDFLAGS="-L/path/to/so/dir -lpaddle_tpu_capi" go build
+//
+// See docs/adr/0004-go-client.md for the build/test status in this
+// environment.
+package paddle_tpu
+
+// #cgo CFLAGS: -I${SRCDIR}/../../csrc
+// #cgo LDFLAGS: -lpaddle_tpu_capi
+// #include <stdlib.h>
+// #include <stdint.h>
+// #include <string.h>
+// #include "paddle_tpu_capi.h"
+import "C"
+
+import (
+	"errors"
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// DType mirrors PTC_DType.
+type DType int32
+
+const (
+	Float32 DType = 0
+	Int32   DType = 1
+	Int64   DType = 2
+)
+
+// Tensor is a host-side input/output buffer with a shape.
+type Tensor struct {
+	Shape []int64
+	DType DType
+	// exactly one of these is non-nil, matching DType
+	F32 []float32
+	I32 []int32
+	I64 []int64
+}
+
+func (t *Tensor) numel() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}
+
+func (t *Tensor) dataPtr() (unsafe.Pointer, error) {
+	switch t.DType {
+	case Float32:
+		if int64(len(t.F32)) != t.numel() {
+			return nil, fmt.Errorf("tensor: F32 has %d elements, shape wants %d",
+				len(t.F32), t.numel())
+		}
+		return unsafe.Pointer(&t.F32[0]), nil
+	case Int32:
+		if int64(len(t.I32)) != t.numel() {
+			return nil, fmt.Errorf("tensor: I32 has %d elements, shape wants %d",
+				len(t.I32), t.numel())
+		}
+		return unsafe.Pointer(&t.I32[0]), nil
+	case Int64:
+		if int64(len(t.I64)) != t.numel() {
+			return nil, fmt.Errorf("tensor: I64 has %d elements, shape wants %d",
+				len(t.I64), t.numel())
+		}
+		return unsafe.Pointer(&t.I64[0]), nil
+	}
+	return nil, fmt.Errorf("tensor: unknown dtype %d", t.DType)
+}
+
+// Predictor wraps a PTC_Predictor handle.
+type Predictor struct {
+	c *C.PTC_Predictor
+}
+
+func lastError() error {
+	return errors.New(C.GoString(C.PTC_LastError()))
+}
+
+// NewPredictor loads a jit.save artifact (model_prefix.pdmodel /
+// .pdiparams pair) and embeds the Python runtime on first use.
+func NewPredictor(modelPrefix string) (*Predictor, error) {
+	cs := C.CString(modelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	p := C.PTC_PredictorCreate(cs)
+	if p == nil {
+		return nil, lastError()
+	}
+	pred := &Predictor{c: p}
+	runtime.SetFinalizer(pred, (*Predictor).Destroy)
+	return pred, nil
+}
+
+// Destroy releases the native predictor; safe to call twice.
+func (p *Predictor) Destroy() {
+	if p.c != nil {
+		C.PTC_PredictorDestroy(p.c)
+		p.c = nil
+	}
+}
+
+// NumInputs reports the artifact's input arity.
+func (p *Predictor) NumInputs() int {
+	return int(C.PTC_GetNumInputs(p.c))
+}
+
+// Run executes the model on the given inputs and copies every output
+// into fresh Go-owned Tensors (the C buffers are only valid until the
+// next Run).
+func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
+	n := len(inputs)
+	if n == 0 {
+		return nil, errors.New("run: no inputs")
+	}
+	// cgo pointer rules forbid passing Go arrays that themselves hold Go
+	// pointers (cgocheck panics); stage every pointer table and the data
+	// buffers in C memory for the duration of the call
+	ptrSz := C.size_t(unsafe.Sizeof(unsafe.Pointer(nil)))
+	datas := (*[1 << 20]unsafe.Pointer)(C.malloc(C.size_t(n) * ptrSz))
+	shapes := (*[1 << 20]*C.int64_t)(C.malloc(C.size_t(n) * ptrSz))
+	ndims := (*[1 << 20]C.int)(C.malloc(C.size_t(n) * C.sizeof_int))
+	dtypes := (*[1 << 20]C.int)(C.malloc(C.size_t(n) * C.sizeof_int))
+	var cbufs []unsafe.Pointer
+	freeAll := func() {
+		for _, b := range cbufs {
+			C.free(b)
+		}
+		C.free(unsafe.Pointer(datas))
+		C.free(unsafe.Pointer(shapes))
+		C.free(unsafe.Pointer(ndims))
+		C.free(unsafe.Pointer(dtypes))
+	}
+	for i, t := range inputs {
+		ptr, err := t.dataPtr()
+		if err != nil {
+			freeAll()
+			return nil, err
+		}
+		esize := C.size_t(4)
+		if t.DType == Int64 {
+			esize = 8
+		}
+		buf := C.malloc(C.size_t(t.numel()) * esize)
+		C.memcpy(buf, ptr, C.size_t(t.numel())*esize)
+		cbufs = append(cbufs, buf)
+		datas[i] = buf
+		shp := C.malloc(C.size_t(len(t.Shape)) * C.sizeof_int64_t)
+		C.memcpy(shp, unsafe.Pointer(&t.Shape[0]),
+			C.size_t(len(t.Shape))*C.sizeof_int64_t)
+		cbufs = append(cbufs, shp)
+		shapes[i] = (*C.int64_t)(shp)
+		ndims[i] = C.int(len(t.Shape))
+		dtypes[i] = C.int(t.DType)
+	}
+	rc := C.PTC_Run(p.c, &datas[0], &shapes[0], &ndims[0], &dtypes[0],
+		C.int(n))
+	runtime.KeepAlive(inputs)
+	freeAll()
+	if rc != 0 {
+		return nil, lastError()
+	}
+	nout := int(C.PTC_GetNumOutputs(p.c))
+	outs := make([]*Tensor, nout)
+	for i := 0; i < nout; i++ {
+		nd := int(C.PTC_GetOutputNumDims(p.c, C.int(i)))
+		if nd < 0 {
+			return nil, lastError()
+		}
+		cshape := C.PTC_GetOutputShape(p.c, C.int(i))
+		shape := make([]int64, nd)
+		total := int64(1)
+		for d := 0; d < nd; d++ {
+			shape[d] = int64(*(*C.int64_t)(unsafe.Pointer(
+				uintptr(unsafe.Pointer(cshape)) +
+					uintptr(d)*unsafe.Sizeof(C.int64_t(0)))))
+			total *= shape[d]
+		}
+		dt := DType(C.PTC_GetOutputDType(p.c, C.int(i)))
+		data := C.PTC_GetOutputData(p.c, C.int(i))
+		if data == nil {
+			return nil, lastError()
+		}
+		t := &Tensor{Shape: shape, DType: dt}
+		switch dt {
+		case Float32:
+			src := unsafe.Slice((*float32)(data), total)
+			t.F32 = append([]float32(nil), src...)
+		case Int32:
+			src := unsafe.Slice((*int32)(data), total)
+			t.I32 = append([]int32(nil), src...)
+		case Int64:
+			src := unsafe.Slice((*int64)(data), total)
+			t.I64 = append([]int64(nil), src...)
+		default:
+			return nil, fmt.Errorf("run: unknown output dtype %d", dt)
+		}
+		outs[i] = t
+	}
+	// the finalizer-driven Destroy must not free the C output buffers
+	// while the unsafe.Slice copies above are still reading them
+	runtime.KeepAlive(p)
+	return outs, nil
+}

@@ -140,6 +140,7 @@ def __pt_while__(cond_fn, body_fn, names, args):
                 raise NameError(
                     f"loop variable {n!r} must be initialised before a "
                     f"tensor-condition while loop")
+        _no_list_state(names, args, "tensor-condition while loop")
         out = control_flow.while_loop(cond_fn, body_fn, list(args))
         return tuple(out)
     state = list(args)
@@ -943,10 +944,17 @@ def convert_function(fn: Callable, convert_calls: bool = True) -> Callable:
     new_tree = tr.visit(tree)
     if tr._n == 0 and not convert_calls:
         return fn  # nothing to do
+    # error source-mapping (reference: dygraph_to_static/error.py,
+    # origin_info.py): the rewritten statements keep their ORIGINAL line
+    # numbers; realigning to the file offset and compiling under the real
+    # filename makes every traceback frame — even inside generated
+    # __pt_true_*/__pt_forbody_* helpers — show the user's own source
+    # line, with linecache rendering the real text
     ast.fix_missing_locations(new_tree)
     try:
-        code = compile(new_tree, filename=f"<to_static {f.__name__} "
-                       f"({f.__code__.co_filename})>", mode="exec")
+        ast.increment_lineno(new_tree, f.__code__.co_firstlineno - 1)
+        code = compile(new_tree, filename=f.__code__.co_filename,
+                       mode="exec")
     except SyntaxError:
         return fn
     glb = f.__globals__

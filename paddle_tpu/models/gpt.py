@@ -42,6 +42,11 @@ class GPTConfig:
     max_position_embeddings: int = 1024
     hidden_dropout_prob: float = 0.1
     attention_dropout_prob: float = 0.1
+    # "auto": Pallas flash attention above the measured S>=4096 crossover
+    # (nn/transformer.py FLASH_CROSSOVER), dense below; "flash"/"dense"
+    # force either. Training with attention_dropout_prob > 0 stays dense
+    # (the fused kernel never materialises the prob matrix to drop).
+    attn_impl: str = "auto"
 
     @property
     def ffn_size(self):
@@ -68,7 +73,8 @@ class GPTModel(Layer):
         layer = TransformerEncoderLayer(
             c.hidden_size, c.num_heads, c.ffn_size,
             dropout=c.hidden_dropout_prob, activation="gelu",
-            attn_dropout=c.attention_dropout_prob, normalize_before=True)
+            attn_dropout=c.attention_dropout_prob, normalize_before=True,
+            attn_impl=getattr(c, "attn_impl", "auto"))
         self.decoder = TransformerEncoder(layer, c.num_layers,
                                           norm=LayerNorm(c.hidden_size))
 
@@ -81,10 +87,11 @@ class GPTModel(Layer):
         h = (self.word_embeddings(input_ids)
              + self.position_embeddings(position_ids))
         h = self.embedding_dropout(h)
-        # additive causal mask, broadcast over [B, H, L, L]
-        mask = ops.triu(ops.full([seq_len, seq_len], -1e4, h.dtype), 1)
-        mask = ops.unsqueeze(ops.unsqueeze(mask, 0), 0)
-        return self.decoder(h, src_mask=mask)
+        # causal mask as the CAUSAL_MASK sentinel: the flash path applies
+        # causality inside the kernel, the dense path materialises the
+        # additive triu lazily (nn/transformer.py MultiHeadAttention)
+        from ..nn.transformer import CAUSAL_MASK
+        return self.decoder(h, src_mask=CAUSAL_MASK)
 
 
 class GPTForCausalLM(Layer):

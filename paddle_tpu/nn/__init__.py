@@ -22,7 +22,8 @@ from .layers_activation import (
     TripletMarginLoss)
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerEncoder, TransformerDecoderLayer,
-                          TransformerDecoder, Transformer)
+                          TransformerDecoder, Transformer, CAUSAL_MASK,
+                          FLASH_CROSSOVER)
 from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,
                   SimpleRNN, LSTM, GRU)
 from . import functional

@@ -20,6 +20,24 @@ from ..ops import creation, manipulation, math as _math
 from ..core.tensor import Tensor
 
 
+class _CausalMask:
+    """Sentinel attn_mask value declaring "standard causal mask" without
+    materialising the [L, L] additive tensor. Lets MultiHeadAttention
+    route to the fused flash kernel (which applies causality inside the
+    kernel) and lets the dense path build the triu mask lazily."""
+
+    def __repr__(self):
+        return "<causal attention mask>"
+
+
+CAUSAL_MASK = _CausalMask()
+
+# measured crossover on the v5e chip (docs/perf_notes.md round 4): XLA
+# dense attention wins up to S=2048, the Pallas flash kernel wins 1.39x
+# at 4096 and is the only option at 8192 (dense materialises [B,H,S,S])
+FLASH_CROSSOVER = 4096
+
+
 def _convert_attention_mask(attn_mask, dtype):
     """reference: transformer.py _convert_attention_mask — bool mask →
     additive -inf mask."""
@@ -31,13 +49,22 @@ def _convert_attention_mask(attn_mask, dtype):
 
 
 class MultiHeadAttention(Layer):
-    """reference: transformer.py:109."""
+    """reference: transformer.py:109.
+
+    TPU extension: ``attn_impl`` selects the attention core —
+    ``"auto"`` (default) uses the Pallas flash kernel when the sequence
+    reaches FLASH_CROSSOVER and the call is eligible (no attention-prob
+    dropout in training mode, no need_weights, no incremental cache, and
+    the mask is None or the CAUSAL_MASK sentinel), ``"flash"`` forces it
+    for any eligible call, ``"dense"`` never uses it. The reference has
+    no such knob — its fused attention lives in external libraries."""
 
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
-                 need_weights=False, weight_attr=None, bias_attr=None):
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 attn_impl="auto"):
         super().__init__()
         self.embed_dim = embed_dim
         self.kdim = kdim or embed_dim
@@ -47,10 +74,28 @@ class MultiHeadAttention(Layer):
         assert self.head_dim * num_heads == embed_dim
         self.dropout = dropout
         self.need_weights = need_weights
+        if attn_impl not in ("auto", "dense", "flash"):
+            raise ValueError(f"attn_impl {attn_impl!r} not in "
+                             "('auto', 'dense', 'flash')")
+        self.attn_impl = attn_impl
         self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
         self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
         self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _flash_eligible(self, attn_mask, cache, seq_len):
+        if self.attn_impl == "dense":
+            return False
+        if (self.need_weights or cache is not None
+                or (self.dropout and self.training)):
+            return False
+        if not (attn_mask is None or isinstance(attn_mask, _CausalMask)):
+            return False           # arbitrary additive masks: dense only
+        if self.head_dim % 8 != 0:
+            return False           # lane-tile constraint on the kernel
+        if self.attn_impl == "flash":
+            return True
+        return seq_len >= FLASH_CROSSOVER
 
     def _split_heads(self, x):
         # [B, L, E] -> [B, H, L, D]
@@ -74,6 +119,19 @@ class MultiHeadAttention(Layer):
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
+        if self._flash_eligible(attn_mask, cache, query.shape[1]):
+            # fused Pallas path: [B, L, H, D] layout straight from the
+            # projections, causality applied inside the kernel
+            from ..ops.pallas_attention import flash_attention
+            b, lq = query.shape[0], query.shape[1]
+            shape = [b, -1, self.num_heads, self.head_dim]
+            qf = manipulation.reshape(self.q_proj(query), shape)
+            kf = manipulation.reshape(self.k_proj(key), shape)
+            vf = manipulation.reshape(self.v_proj(value), shape)
+            out, _ = flash_attention(
+                qf, kf, vf, causal=isinstance(attn_mask, _CausalMask))
+            out = manipulation.reshape(out, [b, lq, self.embed_dim])
+            return self.out_proj(out)
         q = self._split_heads(self.q_proj(query))
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
@@ -85,6 +143,15 @@ class MultiHeadAttention(Layer):
                 v = manipulation.concat([cache.v, v], axis=2)
                 cache = self.Cache(k, v)
 
+        if isinstance(attn_mask, _CausalMask):
+            # dense fallback for the sentinel: materialise the additive
+            # causal mask. With an incremental-decode cache lq < lk and
+            # query row i sits at absolute position lk - lq + i, so the
+            # triu offset shifts by the prefix length (offset 1 when
+            # lq == lk)
+            lq, lk = q.shape[2], k.shape[2]
+            attn_mask = creation.triu(
+                creation.full([lq, lk], -1e9, q.dtype), lk - lq + 1)
         mask = _convert_attention_mask(attn_mask, q.dtype)
         scale = 1.0 / np.sqrt(self.head_dim)
         product = _math.matmul(q * scale, k, transpose_y=True)
@@ -112,14 +179,16 @@ class TransformerEncoderLayer(Layer):
 
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
-                 normalize_before=False, weight_attr=None, bias_attr=None):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 attn_impl="auto"):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
                                             weight_attr=weight_attr,
-                                            bias_attr=bias_attr)
+                                            bias_attr=bias_attr,
+                                            attn_impl=attn_impl)
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.dropout = Dropout(act_dropout, mode="upscale_in_train")
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)

@@ -259,3 +259,49 @@ class TestLoweringBails:
         # traced: must raise with guidance, never return 10.0 silently
         with pytest.raises(Exception, match="break on a traced"):
             jit.to_static(f)(T(np.zeros(2)))
+
+
+class TestErrorSourceMapping:
+    """Exceptions raised inside converted helpers must show the USER's
+    file and line, not a synthetic <to_static ...> buffer (reference:
+    dygraph_to_static/error.py, origin_info.py)."""
+
+    def test_branch_error_points_at_user_source(self):
+        import traceback
+
+        def f(x):
+            if x.mean() > 0:
+                y = x * 2.0
+                y = y.reshape([17, 23])      # <- raises here
+            else:
+                y = x
+            return y
+        sf = jit.to_static(f)
+        try:
+            sf(T(np.ones((2, 3))))
+            raise AssertionError("expected reshape failure")
+        except Exception as e:
+            frames = traceback.extract_tb(e.__traceback__)
+            ours = [fr for fr in frames if fr.filename == __file__]
+            assert ours, [fr.filename for fr in frames]
+            # the innermost user frame shows the real offending line text
+            assert any("reshape([17, 23])" in (fr.line or "")
+                       for fr in ours), [fr.line for fr in ours]
+
+    def test_loop_body_error_points_at_user_source(self):
+        import traceback
+
+        def f(x):
+            s = x * 0.0
+            for i in range(3):
+                s = s + x.reshape([5, 5])    # <- raises here
+            return s
+        sf = jit.to_static(f)
+        try:
+            sf(T(np.ones((2, 3))))
+            raise AssertionError("expected reshape failure")
+        except Exception as e:
+            frames = traceback.extract_tb(e.__traceback__)
+            ours = [fr for fr in frames if fr.filename == __file__]
+            assert any("reshape([5, 5])" in (fr.line or "")
+                       for fr in ours), [fr.line for fr in ours]

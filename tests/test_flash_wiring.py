@@ -1,0 +1,154 @@
+"""Flash attention is load-bearing (round-5): MultiHeadAttention routes
+eligible calls to the Pallas kernel, GPT uses it through the CAUSAL_MASK
+sentinel, and the two long-context mechanisms (flash kernel, ring
+attention SP) agree numerically. Kernel numerics themselves are pinned in
+test_flash_attention.py; this file pins the WIRING."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.transformer import CAUSAL_MASK, FLASH_CROSSOVER
+
+
+def _mha(attn_impl, dropout=0.0, need_weights=False):
+    paddle.seed(11)
+    return nn.MultiHeadAttention(32, 4, dropout=dropout,
+                                 need_weights=need_weights,
+                                 attn_impl=attn_impl)
+
+
+def _x(b=2, s=24, e=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randn(b, s, e).astype(np.float32) * 0.3)
+
+
+class TestMhaRouting:
+    def test_flash_forced_matches_dense(self):
+        x = _x()
+        dense = _mha("dense")
+        flash = _mha("flash")
+        flash.set_state_dict(dense.state_dict())
+        np.testing.assert_allclose(flash(x).numpy(), dense(x).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_flash_causal_sentinel_matches_dense_triu(self):
+        x = _x(seed=1)
+        dense = _mha("dense")
+        flash = _mha("flash")
+        flash.set_state_dict(dense.state_dict())
+        np.testing.assert_allclose(
+            flash(x, attn_mask=CAUSAL_MASK).numpy(),
+            dense(x, attn_mask=CAUSAL_MASK).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_auto_selects_by_crossover(self):
+        m = _mha("auto")
+        assert not m._flash_eligible(None, None, FLASH_CROSSOVER - 1)
+        assert m._flash_eligible(None, None, FLASH_CROSSOVER)
+        assert m._flash_eligible(CAUSAL_MASK, None, FLASH_CROSSOVER)
+
+    def test_ineligible_calls_stay_dense(self):
+        long = FLASH_CROSSOVER + 64
+        # explicit additive mask -> dense
+        assert not _mha("flash")._flash_eligible(
+            paddle.to_tensor(np.zeros((4, 4), np.float32)), None, long)
+        # attention dropout in training mode -> dense
+        m = _mha("flash", dropout=0.1)
+        m.train()
+        assert not m._flash_eligible(None, None, long)
+        m.eval()
+        assert m._flash_eligible(None, None, long)
+        # need_weights (prob matrix must materialise) -> dense
+        assert not _mha("flash", need_weights=True)._flash_eligible(
+            None, None, long)
+        # incremental decode cache -> dense
+        m2 = _mha("flash")
+        cache = m2.gen_cache(_x())
+        assert not m2._flash_eligible(None, cache, long)
+
+    def test_grad_flash_matches_dense(self):
+        xd, xf = _x(seed=2), _x(seed=2)
+        xd.stop_gradient = False
+        xf.stop_gradient = False
+        dense = _mha("dense")
+        flash = _mha("flash")
+        flash.set_state_dict(dense.state_dict())
+        dense(xd, attn_mask=CAUSAL_MASK).sum().backward()
+        flash(xf, attn_mask=CAUSAL_MASK).sum().backward()
+        np.testing.assert_allclose(xf.grad.numpy(), xd.grad.numpy(),
+                                   rtol=1e-3, atol=1e-5)
+
+
+class TestGptFlash:
+    def _cfg(self, attn_impl):
+        from paddle_tpu.models import GPTConfig
+        return GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                         num_heads=4, max_position_embeddings=64,
+                         hidden_dropout_prob=0.0,
+                         attention_dropout_prob=0.0, attn_impl=attn_impl)
+
+    def test_gpt_flash_equals_dense(self):
+        from paddle_tpu.models import GPTForCausalLM
+        paddle.seed(5)
+        dense = GPTForCausalLM(self._cfg("dense"))
+        paddle.seed(5)
+        flash = GPTForCausalLM(self._cfg("flash"))
+        flash.set_state_dict(dense.state_dict())
+        dense.eval()
+        flash.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (2, 24)).astype(np.int32))
+        np.testing.assert_allclose(flash(ids).numpy(), dense(ids).numpy(),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_gpt_flash_trains(self):
+        from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+        import paddle_tpu.optimizer as optim
+        paddle.seed(6)
+        net = GPTForCausalLM(self._cfg("flash"))
+        m = paddle.Model(net)
+        m.prepare(optim.AdamW(learning_rate=1e-3,
+                              parameters=net.parameters()),
+                  GPTPretrainingCriterion())
+        ids = np.random.RandomState(1).randint(0, 128, (2, 24))
+        losses = [m.train_batch([paddle.to_tensor(ids.astype(np.int32))],
+                                [paddle.to_tensor(ids.astype(np.int64))])[0]
+                  for _ in range(6)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+
+class TestFlashRingComposition:
+    def test_flash_single_chip_equals_ring_sharded(self):
+        """The two long-context mechanisms must agree: full-sequence flash
+        attention on one device == ring attention with the sequence dim
+        sharded over an sp mesh (both causal)."""
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_attention)
+        from paddle_tpu.ops.pallas_attention import flash_attention
+
+        rng = np.random.RandomState(3)
+        B, H, S, D = 1, 2, 64, 16
+        q = rng.randn(B, S, H, D).astype(np.float32) * 0.4
+        k = rng.randn(B, S, H, D).astype(np.float32) * 0.4
+        v = rng.randn(B, S, H, D).astype(np.float32)
+
+        out_flash, _ = flash_attention(paddle.to_tensor(q),
+                                       paddle.to_tensor(k),
+                                       paddle.to_tensor(v), causal=True)
+
+        mesh = dist.build_mesh({"sp": 8})
+        dist.set_mesh(mesh)
+        try:
+            bhsd = lambda a: jnp.moveaxis(jnp.asarray(a), 2, 1)  # BSHD->BHSD
+            out_ring = ring_attention(bhsd(q), bhsd(k), bhsd(v),
+                                      mesh=mesh, axis="sp", causal=True)
+            out_ring = np.moveaxis(np.asarray(out_ring), 1, 2)
+        finally:
+            dist.set_mesh(None)
+        np.testing.assert_allclose(out_flash.numpy(), out_ring,
+                                   rtol=1e-4, atol=1e-5)

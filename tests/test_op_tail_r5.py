@@ -1,0 +1,428 @@
+"""Numpy-reference tests for the round-5 op tail (VERDICT weak-spot 1:
+impl ops with no numeric test). Discipline per the reference's
+op_test.py: every op checked against an independently-written numpy (or
+torch CPU oracle) implementation of the REFERENCE op's documented
+semantics; gradients via tests/op_test.py check_grad where meaningful.
+
+Part 1: activations, binary/comparison/logical elementwise, reductions,
+shape/indexing ops, loss functions, norm/vision functional ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as ops
+import paddle_tpu.nn.functional as F
+from op_test import check_output, check_grad
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def T(a):
+    return paddle.to_tensor(a)
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: operators/activation_op.cc kernels)
+
+def _np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+ACTIVATIONS = [
+    # (callable, numpy reference, input transform)
+    (paddle.acos, np.arccos, lambda x: np.clip(x, -0.99, 0.99)),
+    (paddle.cosh, np.cosh, None),
+    (paddle.sinh, np.sinh, None),
+    (paddle.reciprocal, lambda x: 1.0 / x, lambda x: x + 2.0),
+    (paddle.lgamma, lambda x: np.vectorize(__import__("math").lgamma)(x),
+     lambda x: np.abs(x) + 0.5),
+    (paddle.log10, np.log10, lambda x: np.abs(x) + 0.1),
+    (paddle.log2, np.log2, lambda x: np.abs(x) + 0.1),
+    (paddle.logsigmoid, lambda x: x - np.logaddexp(0, x), None),
+    (ops.brelu, lambda x: np.clip(x, -1.0, 1.0), None),
+    (ops.hard_shrink, lambda x: np.where(np.abs(x) > 0.5, x, 0.0), None),
+    (ops.hard_sigmoid, lambda x: np.clip(x / 6.0 + 0.5, 0, 1), None),
+    (ops.hard_swish, lambda x: x * np.clip(x + 3, 0, 6) / 6.0, None),
+    (ops.leaky_relu, lambda x: np.where(x >= 0, x, 0.01 * x), None),
+    (ops.relu6, lambda x: np.clip(x, 0, 6), None),
+    (ops.mish, lambda x: x * np.tanh(np.log1p(np.exp(x))), None),
+    (ops.silu, lambda x: x * _np_sigmoid(x), None),
+    (ops.swish, lambda x: x * _np_sigmoid(x), None),
+    (ops.selu, lambda x: 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * (np.exp(x) - 1)), None),
+    (ops.softplus, lambda x: np.logaddexp(0, x), None),
+    (ops.softshrink, lambda x: np.where(x > 0.5, x - 0.5,
+                                        np.where(x < -0.5, x + 0.5, 0.0)),
+     None),
+    (ops.softsign, lambda x: x / (1 + np.abs(x)), None),
+    (paddle.stanh, lambda x: 1.7159 * np.tanh(0.67 * x), None),
+    (paddle.soft_relu, lambda x: np.log1p(np.exp(np.clip(x, -40, 40))),
+     None),
+    (ops.tanh_shrink, lambda x: x - np.tanh(x), None),
+    (ops.thresholded_relu, lambda x: np.where(x > 1.0, x, 0.0), None),
+]
+
+
+@pytest.mark.parametrize("op_fn,np_fn,dom",
+                         ACTIVATIONS,
+                         ids=[a[0].__name__ for a in ACTIVATIONS])
+def test_activation_forward(op_fn, np_fn, dom):
+    x = _rng(1).randn(3, 5).astype(np.float32) * 2.0
+    if dom is not None:
+        x = dom(x).astype(np.float32)
+    check_output(op_fn, np_fn, [x], atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("op_fn", [ops.silu, ops.mish, ops.softplus,
+                                   ops.hard_swish, paddle.stanh])
+def test_activation_grad(op_fn):
+    x = _rng(2).randn(4, 3).astype(np.float32)
+    check_grad(op_fn, [x])
+
+
+def test_prelu_and_maxout():
+    x = _rng(3).randn(2, 4, 3, 3).astype(np.float32)
+    w = np.array([0.25, 0.1, 0.5, 0.9], np.float32)
+    got = ops.prelu(T(x), T(w)).numpy()
+    ref = np.where(x >= 0, x, x * w.reshape(1, 4, 1, 1))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # maxout: groups of channels reduced by max (maxout_op.cc)
+    got = ops.maxout(T(x), groups=2).numpy()
+    ref = x.reshape(2, 2, 2, 3, 3).max(axis=2)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_conj():
+    x = (_rng(4).randn(3, 2) + 1j * _rng(5).randn(3, 2)).astype(np.complex64)
+    np.testing.assert_allclose(paddle.conj(T(x)).numpy(), np.conj(x))
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary + comparisons + logicals
+
+BINARY = [
+    (ops.elementwise_add, np.add),
+    (ops.elementwise_sub, np.subtract),
+    (ops.elementwise_mul, np.multiply),
+    (ops.elementwise_div, np.divide),
+    (ops.elementwise_max, np.maximum),
+    (ops.elementwise_min, np.minimum),
+    (ops.elementwise_pow, np.power),
+    (ops.elementwise_mod, np.mod),
+    (ops.elementwise_floordiv, np.floor_divide),
+]
+
+
+@pytest.mark.parametrize("op_fn,np_fn", BINARY,
+                         ids=[b[0].__name__ for b in BINARY])
+def test_elementwise_binary(op_fn, np_fn):
+    r = _rng(6)
+    x = (r.rand(3, 4).astype(np.float32) + 0.5) * 2
+    y = (r.rand(3, 4).astype(np.float32) + 0.5)
+    check_output(op_fn, np_fn, [x, y], rtol=1e-5)
+    # broadcasting across a trailing axis
+    yb = (r.rand(4).astype(np.float32) + 0.5)
+    check_output(op_fn, np_fn, [x, yb], rtol=1e-5)
+
+
+def test_comparisons_and_logicals():
+    r = _rng(7)
+    x = r.randint(0, 3, (4, 5)).astype(np.float32)
+    y = r.randint(0, 3, (4, 5)).astype(np.float32)
+    np.testing.assert_array_equal(paddle.greater_equal(T(x), T(y)).numpy(),
+                                  x >= y)
+    np.testing.assert_array_equal(paddle.less_than(T(x), T(y)).numpy(),
+                                  x < y)
+    np.testing.assert_array_equal(paddle.not_equal(T(x), T(y)).numpy(),
+                                  x != y)
+    assert bool(paddle.equal_all(T(x), T(x)).numpy())
+    assert not bool(paddle.equal_all(T(x), T(x + 1)).numpy())
+    a = x > 1
+    b = y > 1
+    np.testing.assert_array_equal(paddle.logical_and(T(a), T(b)).numpy(),
+                                  a & b)
+    np.testing.assert_array_equal(paddle.logical_or(T(a), T(b)).numpy(),
+                                  a | b)
+    np.testing.assert_array_equal(paddle.logical_xor(T(a), T(b)).numpy(),
+                                  a ^ b)
+    np.testing.assert_array_equal(paddle.logical_not(T(a)).numpy(), ~a)
+
+
+def test_matmul_v2_and_dot_addmm_kron():
+    r = _rng(8)
+    a = r.randn(3, 4).astype(np.float32)
+    b = r.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(ops.matmul_v2(T(a), T(b)).numpy(), a @ b,
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        ops.matmul_v2(T(a), T(a), transpose_y=True).numpy(), a @ a.T,
+        rtol=1e-5)
+    v = r.randn(6).astype(np.float32)
+    w = r.randn(6).astype(np.float32)
+    np.testing.assert_allclose(paddle.dot(T(v), T(w)).numpy(), v @ w,
+                               rtol=1e-5)
+    inp = r.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.addmm(T(inp), T(a), T(b), beta=0.5, alpha=2.0).numpy(),
+        0.5 * inp + 2.0 * (a @ b), rtol=1e-5)
+    np.testing.assert_allclose(paddle.kron(T(a), T(b)).numpy(),
+                               np.kron(a, b), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# reductions + norms + arg ops
+
+def test_reductions_and_norms():
+    r = _rng(9)
+    x = r.randn(3, 4, 5).astype(np.float32)
+    b = x > 0
+    np.testing.assert_array_equal(ops.reduce_all(T(b), axis=1).numpy(),
+                                  b.all(axis=1))
+    np.testing.assert_array_equal(ops.reduce_any(T(b), axis=1).numpy(),
+                                  b.any(axis=1))
+    np.testing.assert_allclose(ops.reduce_max(T(x), axis=2).numpy(),
+                               x.max(axis=2), rtol=1e-6)
+    np.testing.assert_allclose(ops.reduce_min(T(x), axis=0).numpy(),
+                               x.min(axis=0), rtol=1e-6)
+    np.testing.assert_allclose(paddle.frobenius_norm(T(x[0])).numpy(),
+                               np.linalg.norm(x[0]), rtol=1e-5)
+    np.testing.assert_allclose(paddle.l1_norm(T(x)).numpy(),
+                               np.abs(x).sum(), rtol=1e-5)
+    np.testing.assert_array_equal(ops.arg_max(T(x), axis=1).numpy(),
+                                  x.argmax(axis=1))
+    np.testing.assert_array_equal(ops.arg_min(T(x), axis=-1).numpy(),
+                                  x.argmin(axis=-1))
+
+
+def test_clip_by_norm():
+    x = _rng(10).randn(4, 3).astype(np.float32) * 3
+    n = np.linalg.norm(x)
+    got = ops.clip_by_norm(T(x), 1.5).numpy()
+    np.testing.assert_allclose(got, x * 1.5 / n, rtol=1e-5)
+    small = x * 0.01
+    np.testing.assert_allclose(ops.clip_by_norm(T(small), 1e3).numpy(),
+                               small, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing ops
+
+def test_expand_family_and_fill_like():
+    r = _rng(11)
+    x = r.randn(1, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        ops.expand_v2(T(x), [4, 3]).numpy(), np.broadcast_to(x, (4, 3)))
+    y = r.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(paddle.expand_as(T(x), T(y)).numpy(),
+                               np.broadcast_to(x, (4, 3)))
+    np.testing.assert_allclose(ops.expand_as_v2(T(x), T(y)).numpy(),
+                               np.broadcast_to(x, (4, 3)))
+    np.testing.assert_allclose(paddle.full_like(T(y), 7.0).numpy(),
+                               np.full_like(y, 7.0))
+
+
+def test_meshgrid_unbind_unstack_diag_embed():
+    a = np.arange(3, dtype=np.float32)
+    b = np.arange(4, dtype=np.float32)
+    ga, gb = paddle.meshgrid(T(a), T(b))
+    ra, rb = np.meshgrid(a, b, indexing="ij")
+    np.testing.assert_array_equal(ga.numpy(), ra)
+    np.testing.assert_array_equal(gb.numpy(), rb)
+    x = _rng(12).randn(3, 4).astype(np.float32)
+    parts = paddle.unbind(T(x), axis=0)
+    assert len(parts) == 3
+    np.testing.assert_array_equal(parts[1].numpy(), x[1])
+    parts = paddle.unstack(T(x), axis=1)
+    assert len(parts) == 4
+    np.testing.assert_array_equal(parts[2].numpy(), x[:, 2])
+    v = _rng(13).randn(2, 3).astype(np.float32)
+    got = paddle.diag_embed(T(v)).numpy()
+    ref = np.zeros((2, 3, 3), np.float32)
+    for i in range(2):
+        ref[i] = np.diag(v[i])
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_strided_slice_index_sample_multiplex():
+    r = _rng(14)
+    x = r.randn(6, 8).astype(np.float32)
+    got = paddle.strided_slice(T(x), axes=[0, 1], starts=[1, 0],
+                               ends=[5, 8], strides=[2, 3]).numpy()
+    np.testing.assert_array_equal(got, x[1:5:2, 0:8:3])
+    idx = r.randint(0, 8, (6, 4)).astype(np.int64)
+    got = paddle.index_sample(T(x), T(idx)).numpy()
+    np.testing.assert_array_equal(got, np.take_along_axis(x, idx, axis=1))
+    ins = [r.randn(4, 3).astype(np.float32) for _ in range(3)]
+    sel = np.array([2, 0, 1, 2], np.int64).reshape(-1, 1)
+    got = paddle.multiplex([T(i) for i in ins], T(sel)).numpy()
+    ref = np.stack([ins[int(s)][j] for j, s in enumerate(sel[:, 0])])
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_scatter_nd_add_where_index_histogram():
+    x = np.zeros((4, 3), np.float32)
+    index = np.array([[1], [3], [1]], np.int64)
+    updates = np.ones((3, 3), np.float32)
+    got = paddle.scatter_nd_add(T(x), T(index), T(updates)).numpy()
+    ref = x.copy()
+    for i, u in zip(index[:, 0], updates):
+        ref[i] += u
+    np.testing.assert_array_equal(got, ref)
+    c = np.array([[True, False], [False, True]])
+    got = paddle.where_index(T(c)).numpy()
+    np.testing.assert_array_equal(got, np.argwhere(c))
+    data = np.array([0.0, 1.0, 1.5, 2.9, 3.0], np.float32)
+    got = paddle.histogram(T(data), bins=3, min=0, max=3).numpy()
+    np.testing.assert_array_equal(got, np.histogram(data, 3, (0, 3))[0])
+
+
+def test_space_depth_pixel_shuffle_shuffle_channel_unfold():
+    r = _rng(15)
+    x = r.randn(1, 2, 4, 4).astype(np.float32)
+    got = paddle.space_to_depth(T(x), 2).numpy()
+    assert got.shape == (1, 8, 2, 2)
+    # inverse relationship with pixel_shuffle (depth_to_space)
+    back = paddle.pixel_shuffle(T(got), 2).numpy()
+    assert back.shape == x.shape
+    xc = r.randn(1, 6, 2, 2).astype(np.float32)
+    got = ops.shuffle_channel(T(xc), 3).numpy()
+    ref = xc.reshape(1, 3, 2, 2, 2).transpose(0, 2, 1, 3, 4).reshape(xc.shape)
+    np.testing.assert_array_equal(got, ref)
+    # unfold == im2col (torch oracle)
+    import torch
+    xt = r.randn(2, 3, 6, 6).astype(np.float32)
+    got = paddle.unfold(T(xt), [2, 2], strides=2).numpy()
+    ref = torch.nn.functional.unfold(torch.from_numpy(xt), (2, 2),
+                                     stride=2).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_one_hot_is_empty_bernoulli_shapes():
+    lab = np.array([0, 2, 1], np.int64)
+    got = ops.one_hot_v2(T(lab), 4).numpy()
+    np.testing.assert_array_equal(got, np.eye(4, dtype=np.float32)[lab])
+    assert not bool(ops.is_empty(T(lab)).numpy())
+    assert bool(ops.is_empty(T(np.zeros((0, 3)))).numpy())
+
+
+# ---------------------------------------------------------------------------
+# losses
+
+def test_losses_numpy_refs():
+    r = _rng(16)
+    p = r.rand(6, 1).astype(np.float32) * 0.8 + 0.1
+    y = (r.rand(6, 1) > 0.5).astype(np.float32)
+    # log_loss (log_loss_op.cc)
+    ref = -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(F.log_loss(T(p), T(y)).numpy(), ref,
+                               rtol=1e-5)
+    # hinge_loss (hinge_loss_op.cc): max(1 - pred*(2y-1), 0)
+    pred = r.randn(6, 1).astype(np.float32)
+    ref = np.maximum(1 - pred * (2 * y - 1), 0)
+    np.testing.assert_allclose(F.hinge_loss(T(pred), T(y)).numpy(), ref,
+                               rtol=1e-5)
+    # kldiv_loss (kldiv_loss_op.cc): target * (log(target) - input)
+    x = np.log(r.rand(4, 5).astype(np.float32) + 0.1)
+    t = r.rand(4, 5).astype(np.float32) + 0.1
+    ref = (t * (np.log(t) - x)).mean()
+    np.testing.assert_allclose(
+        ops.kldiv_loss(T(x), T(t), reduction="mean").numpy(), ref,
+        rtol=1e-5)
+    # nll_loss (nll_loss_op.cc)
+    logp = np.log(r.rand(5, 3).astype(np.float32) + 0.05)
+    lab = r.randint(0, 3, (5,)).astype(np.int64)
+    ref = -logp[np.arange(5), lab].mean()
+    np.testing.assert_allclose(F.nll_loss(T(logp), T(lab)).numpy(), ref,
+                               rtol=1e-5)
+    # label_smooth (label_smooth_op.cc)
+    onehot = np.eye(4, dtype=np.float32)[r.randint(0, 4, (6,))]
+    ref = onehot * 0.9 + 0.1 / 4
+    np.testing.assert_allclose(F.label_smooth(T(onehot)).numpy(), ref,
+                               rtol=1e-5)
+    # sigmoid_focal_loss (sigmoid_focal_loss_op.cc semantics, v2 API)
+    logit = r.randn(6, 1).astype(np.float32)
+    lbl = (r.rand(6, 1) > 0.5).astype(np.float32)
+    pr = _np_sigmoid(logit)
+    ce = -lbl * np.log(pr) - (1 - lbl) * np.log(1 - pr)
+    pt = pr * lbl + (1 - pr) * (1 - lbl)
+    alpha_t = 0.25 * lbl + 0.75 * (1 - lbl)
+    ref = (alpha_t * (1 - pt) ** 2.0 * ce).sum()
+    np.testing.assert_allclose(
+        ops.sigmoid_focal_loss(T(logit), T(lbl)).numpy(), ref, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# norm / vision functional (torch CPU oracle where numpy is painful)
+
+def test_instance_norm_and_lrn():
+    import torch
+    r = _rng(17)
+    x = r.randn(2, 3, 4, 5).astype(np.float32)
+    got = F.instance_norm(T(x)).numpy()
+    ref = torch.nn.functional.instance_norm(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # lrn_op.cc: div = (k + alpha * sum)^beta — alpha NOT divided by
+    # size; torch divides by n, so scale its alpha up to compare
+    got = F.local_response_norm(T(x), size=3, alpha=1e-2, beta=0.75,
+                                k=1.0).numpy()
+    ref = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), 3, alpha=1e-2 * 3, beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_family():
+    import torch
+    r = _rng(18)
+    x = r.randn(1, 2, 5, 6, 6).astype(np.float32)
+    w = r.randn(4, 2, 3, 3, 3).astype(np.float32) * 0.2
+    b = r.randn(4).astype(np.float32)
+    got = F.conv3d(T(x), T(w), T(b), stride=2, padding=1).numpy()
+    ref = torch.nn.functional.conv3d(torch.from_numpy(x),
+                                     torch.from_numpy(w),
+                                     torch.from_numpy(b), stride=2,
+                                     padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    wt = r.randn(2, 3, 3, 3, 3).astype(np.float32) * 0.2
+    got = F.conv3d_transpose(T(x), T(wt), stride=2).numpy()
+    ref = torch.nn.functional.conv_transpose3d(torch.from_numpy(x),
+                                               torch.from_numpy(wt),
+                                               stride=2).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    got = F.max_pool3d(T(x), 2, stride=2).numpy()
+    ref = torch.nn.functional.max_pool3d(torch.from_numpy(x), 2,
+                                         stride=2).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_grid_sample_and_affine_grid():
+    import torch
+    r = _rng(19)
+    x = r.randn(2, 3, 5, 5).astype(np.float32)
+    grid = (r.rand(2, 4, 4, 2).astype(np.float32) * 2 - 1) * 0.9
+    got = ops.grid_sample(T(x), T(grid)).numpy()
+    ref = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), torch.from_numpy(grid), mode="bilinear",
+        padding_mode="zeros", align_corners=True).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    theta = r.randn(2, 2, 3).astype(np.float32)
+    got = ops.affine_grid(T(theta), [2, 3, 4, 5]).numpy()
+    ref = torch.nn.functional.affine_grid(
+        torch.from_numpy(theta), [2, 3, 4, 5], align_corners=True).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_temporal_shift():
+    # temporal_shift_op.cc: shift 1/4 channels fwd, 1/4 back along time
+    r = _rng(20)
+    N, TT, C, H, W = 2, 4, 8, 2, 2
+    x = r.randn(N * TT, C, H, W).astype(np.float32)
+    got = F.temporal_shift(T(x), seg_num=TT, shift_ratio=0.25).numpy()
+    xr = x.reshape(N, TT, C, H, W)
+    ref = np.zeros_like(xr)
+    c1 = C // 4
+    ref[:, :-1, :c1] = xr[:, 1:, :c1]              # shift left (future)
+    ref[:, 1:, c1:2 * c1] = xr[:, :-1, c1:2 * c1]  # shift right (past)
+    ref[:, :, 2 * c1:] = xr[:, :, 2 * c1:]
+    np.testing.assert_allclose(got, ref.reshape(x.shape), rtol=1e-6)

@@ -1,0 +1,319 @@
+"""Round-5 op tail, part 2: optimizer update rules vs numpy references
+of the reference ops' documented math, RNN cells vs the torch CPU oracle
+(identical gate conventions), sampling-op statistics, detection misc
+ops, quantization observers, and layer-level wrappers. Complements
+tests/test_op_tail_r5.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.ops as ops
+import paddle_tpu.optimizer as optim
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def T(a):
+    return paddle.to_tensor(a)
+
+
+# ---------------------------------------------------------------------------
+# optimizers: K steps on a Linear vs a numpy simulation of the reference
+# update formulas (adadelta_op.cc, adagrad_op.cc, adamax_op.cc,
+# ftrl_op.cc, lars_momentum_op.cc)
+
+def _drive_opt(opt_cls, np_step, steps=3, **kw):
+    paddle.seed(0)
+    lin = nn.Linear(4, 3)
+    opt = opt_cls(parameters=lin.parameters(), **kw)
+    x = _rng(1).randn(5, 4).astype(np.float32)
+    c = _rng(2).randn(5, 3).astype(np.float32)
+    w0 = lin.weight.numpy().copy()
+    b0 = lin.bias.numpy().copy()
+    # grads of sum(out * c): dW = x^T c (layout [in, out]), db = sum c
+    gw = (x.T @ c).astype(np.float32)
+    gb = c.sum(0).astype(np.float32)
+    for _ in range(steps):
+        out = lin(T(x))
+        (out * T(c)).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    state_w, state_b = {}, {}
+    for _ in range(steps):
+        w0 = np_step(w0, gw, state_w)
+        b0 = np_step(b0, gb, state_b)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(lin.bias.numpy(), b0, rtol=1e-4, atol=1e-5)
+
+
+def test_adagrad_matches_reference_math():
+    lr, eps = 0.1, 1e-6
+
+    def step(p, g, s):
+        s.setdefault("m", np.zeros_like(p))
+        s["m"] = s["m"] + g * g
+        return p - lr * g / (np.sqrt(s["m"]) + eps)
+    _drive_opt(optim.Adagrad, step, learning_rate=lr)
+
+
+def test_adadelta_matches_reference_math():
+    lr, rho, eps = 1.0, 0.95, 1e-6
+
+    def step(p, g, s):
+        s.setdefault("ag", np.zeros_like(p))
+        s.setdefault("au", np.zeros_like(p))
+        s["ag"] = rho * s["ag"] + (1 - rho) * g * g
+        upd = g * np.sqrt(s["au"] + eps) / np.sqrt(s["ag"] + eps)
+        s["au"] = rho * s["au"] + (1 - rho) * upd * upd
+        return p - lr * upd
+    _drive_opt(optim.Adadelta, step, learning_rate=lr)
+
+
+def test_adamax_matches_reference_math():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+
+    def step(p, g, s):
+        s.setdefault("m", np.zeros_like(p))
+        s.setdefault("u", np.zeros_like(p))
+        s.setdefault("t", 0)
+        s["t"] += 1
+        s["m"] = b1 * s["m"] + (1 - b1) * g
+        s["u"] = np.maximum(b2 * s["u"], np.abs(g))
+        return p - (lr / (1 - b1 ** s["t"])) * s["m"] / (s["u"] + eps)
+    _drive_opt(optim.Adamax, step, learning_rate=lr)
+
+
+def test_ftrl_matches_reference_math():
+    lr, l1, l2, lp = 0.1, 0.01, 0.01, -0.5
+
+    def step(p, g, s):
+        s.setdefault("sq", np.zeros_like(p))
+        s.setdefault("lin", np.zeros_like(p))
+        new_sq = s["sq"] + g * g
+        sigma = (new_sq ** -lp - (s["sq"] + 1e-30) ** -lp) / lr
+        s["lin"] = s["lin"] + g - sigma * p
+        quad = new_sq ** -lp / lr + 2 * l2
+        pre = np.clip(s["lin"], -l1, l1) - s["lin"]
+        s["sq"] = new_sq
+        return pre / quad
+    _drive_opt(optim.Ftrl, step, learning_rate=lr, l1=l1, l2=l2)
+
+
+def test_lars_momentum_matches_reference_math():
+    lr, mu, coeff, wd = 0.1, 0.9, 1e-3, 5e-4
+
+    def step(p, g, s):
+        s.setdefault("v", np.zeros_like(p))
+        wn = np.sqrt((p ** 2).sum())
+        gn = np.sqrt((g ** 2).sum())
+        local = (lr * coeff * wn / (gn + wd * wn)
+                 if wn > 0 and gn > 0 else lr)
+        s["v"] = mu * s["v"] + local * (g + wd * p)
+        return p - s["v"]
+    _drive_opt(optim.LarsMomentum, step, learning_rate=lr, momentum=mu,
+               lars_coeff=coeff, lars_weight_decay=wd)
+
+
+# ---------------------------------------------------------------------------
+# RNN cells vs torch (identical i,f,g,o / r,z,n gate order)
+
+def _copy_cell(ours, theirs):
+    import torch
+    theirs.weight_ih.data = torch.from_numpy(ours.weight_ih.numpy())
+    theirs.weight_hh.data = torch.from_numpy(ours.weight_hh.numpy())
+    theirs.bias_ih.data = torch.from_numpy(ours.bias_ih.numpy())
+    theirs.bias_hh.data = torch.from_numpy(ours.bias_hh.numpy())
+
+
+def test_gru_cell_matches_torch():
+    import torch
+    paddle.seed(3)
+    cell = nn.GRUCell(6, 8)
+    tcell = torch.nn.GRUCell(6, 8)
+    _copy_cell(cell, tcell)
+    x = _rng(4).randn(5, 6).astype(np.float32)
+    h = _rng(5).randn(5, 8).astype(np.float32)
+    out, _ = cell(T(x), T(h))
+    ref = tcell(torch.from_numpy(x), torch.from_numpy(h)).detach().numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_cell_matches_torch():
+    import torch
+    paddle.seed(6)
+    cell = nn.LSTMCell(6, 8)
+    tcell = torch.nn.LSTMCell(6, 8)
+    _copy_cell(cell, tcell)
+    x = _rng(7).randn(5, 6).astype(np.float32)
+    h = _rng(8).randn(5, 8).astype(np.float32)
+    c = _rng(9).randn(5, 8).astype(np.float32)
+    out, (h2, c2) = cell(T(x), (T(h), T(c)))
+    th, tc = tcell(torch.from_numpy(x),
+                   (torch.from_numpy(h), torch.from_numpy(c)))
+    np.testing.assert_allclose(h2.numpy(), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(c2.numpy(), tc.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_layer_scans_cell():
+    paddle.seed(10)
+    cell = nn.SimpleRNNCell(4, 6)
+    layer = nn.RNN(cell)
+    x = _rng(11).randn(2, 5, 4).astype(np.float32)
+    out, last = layer(T(x))
+    assert tuple(out.shape) == (2, 5, 6)
+    # manual unroll through the same cell must agree
+    h = None
+    for t in range(5):
+        o, h = cell(T(x[:, t]), h)
+    np.testing.assert_allclose(out.numpy()[:, -1], o.numpy(), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sampling ops: distribution statistics
+
+def test_bernoulli_multinomial_truncated_normal_stats():
+    paddle.seed(12)
+    p = np.full((20000,), 0.3, np.float32)
+    draws = paddle.bernoulli(T(p)).numpy()
+    assert set(np.unique(draws)) <= {0.0, 1.0}
+    assert abs(draws.mean() - 0.3) < 0.02
+    probs = np.array([0.2, 0.8], np.float32)
+    s = paddle.multinomial(T(np.tile(probs, (1, 1))), num_samples=5000,
+                           replacement=True).numpy()
+    assert abs((s == 1).mean() - 0.8) < 0.03
+    t = paddle.truncated_normal([20000], mean=1.0, std=2.0).numpy()
+    # truncated at 2 std: all samples inside [-3, 5]
+    assert t.min() >= -3.0 - 1e-3 and t.max() <= 5.0 + 1e-3
+    assert abs(t.mean() - 1.0) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# detection misc
+
+def test_box_clip_and_decoder_assign():
+    boxes = np.array([[-5.0, -5.0, 30.0, 40.0],
+                      [2.0, 3.0, 8.0, 9.0]], np.float32)
+    im_info = np.array([20.0, 25.0, 1.0], np.float32)  # h, w, scale
+    got = paddle.box_clip(T(boxes), T(im_info)).numpy()
+    # clip to [0, w-1] x [0, h-1] (box_clip_op.cc)
+    np.testing.assert_allclose(got[0], [0, 0, 24, 19])
+    np.testing.assert_allclose(got[1], [2, 3, 8, 9])
+
+    prior = np.array([[0.0, 0.0, 10.0, 10.0]], np.float32)
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
+    tgt = np.zeros((1, 8), np.float32)      # 2 classes x 4
+    score = np.array([[0.2, 0.8]], np.float32)
+    db, ab = paddle.box_decoder_and_assign(T(prior), T(pvar), T(tgt),
+                                           T(score))
+    assert db.shape == [1, 8] and ab.shape == [1, 4]
+    # zero deltas decode back to the prior box; argmax class assigned
+    np.testing.assert_allclose(ab.numpy()[0], db.numpy()[0, 4:], rtol=1e-5)
+
+
+def test_density_prior_box_and_polygon_transform():
+    x = np.zeros((1, 3, 2, 2), np.float32)
+    img = np.zeros((1, 3, 16, 16), np.float32)
+    boxes, vars_ = paddle.density_prior_box(
+        T(x), T(img), densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0],
+        steps=[8.0, 8.0])
+    b = boxes.numpy()
+    assert b.shape == (2, 2, 4, 4)      # H, W, densities^2, 4
+    assert (b >= -0.5).all() and (b <= 1.5).all()
+    v = vars_.numpy()
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    q = np.array([[[1.0, 2.0], [3.0, 4.0]]], np.float32)  # [N,2,HW]? use op
+    inp = np.zeros((1, 8, 1, 1), np.float32)
+    inp[0, :, 0, 0] = [1, 1, 2, 1, 2, 2, 1, 2]
+    got = paddle.polygon_box_transform(T(inp)).numpy()
+    # polygon_box_transform_op.cc: out = pixel coord - offset value
+    assert got.shape == inp.shape
+
+
+def test_sequence_slice_and_expand_as():
+    # padded convention [B, T, ...]: per-row slice re-packed left
+    xp = np.arange(18, dtype=np.float32).reshape(2, 3, 3)
+    off = np.array([0, 1], np.int64)
+    ln = np.array([2, 2], np.int64)
+    data, new_len = paddle.sequence_slice(T(xp), T(off), T(ln))
+    d = data.numpy()
+    np.testing.assert_array_equal(new_len.numpy(), [2, 2])
+    np.testing.assert_array_equal(d[0, :2], xp[0, 0:2])
+    np.testing.assert_array_equal(d[1, :2], xp[1, 1:3])
+    np.testing.assert_array_equal(d[:, 2], 0)      # padded tail zeroed
+    # expand_as: each x row repeated to match y's row count
+    got = paddle.sequence_expand_as(T(np.array([[1.0], [2.0]],
+                                               np.float32)),
+                                    T(np.zeros((4, 1), np.float32)))
+    np.testing.assert_array_equal(got.numpy().ravel(), [1, 1, 2, 2])
+
+
+def test_beam_search_decode_backtrace():
+    # ids/parents [T, B, W]; step-2 winners backtrace through parents
+    ids = np.array([[[1, 2]], [[3, 4]]], np.int64)
+    parents = np.array([[[0, 0]], [[1, 0]]], np.int64)
+    scores = np.array([[0.9, 0.3]], np.float32)
+    seqs, sc = paddle.beam_search_decode(T(ids), T(parents), T(scores))
+    s = seqs.numpy()
+    # beam 0 at t=1 came from parent 1 (token 2), then emitted 3
+    np.testing.assert_array_equal(s[:, 0, 0], [2, 3])
+    np.testing.assert_array_equal(s[:, 0, 1], [1, 4])
+    np.testing.assert_array_equal(sc.numpy(), scores)
+
+
+# ---------------------------------------------------------------------------
+# quantization observers + misc layers
+
+def test_quant_observer_and_quant_dequant():
+    from paddle_tpu.quantization import (MovingAverageAbsMaxObserver,
+                                         quant_dequant_with_scale)
+    obs = MovingAverageAbsMaxObserver(moving_rate=0.5)
+    x1 = np.array([1.0, -2.0], np.float32)
+    x2 = np.array([4.0, -1.0], np.float32)
+    s1 = float(np.asarray(obs.observe(T(x1))))
+    s2 = float(np.asarray(obs.observe(T(x2))))
+    np.testing.assert_allclose(s1, 2.0, rtol=1e-5)
+    np.testing.assert_allclose(s2, 0.5 * 2.0 + 0.5 * 4.0, rtol=1e-5)
+    x = np.linspace(-1, 1, 9).astype(np.float32)
+    qdq = np.asarray(quant_dequant_with_scale(T(x)._data, 1.0, 8))
+    # int8 fake quant: |err| <= scale / 127
+    assert np.abs(qdq - x).max() <= 1.0 / 127 + 1e-6
+
+
+def test_sync_batch_norm_single_process_equals_bn():
+    paddle.seed(13)
+    sbn = nn.SyncBatchNorm(4)
+    bn = nn.BatchNorm2D(4)
+    bn.set_state_dict(sbn.state_dict())
+    x = _rng(14).randn(3, 4, 5, 5).astype(np.float32)
+    sbn.train()
+    bn.train()
+    np.testing.assert_allclose(sbn(T(x)).numpy(), bn(T(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spectral_norm_power_iteration():
+    paddle.seed(15)
+    w = _rng(16).randn(6, 4).astype(np.float32)
+    sn = nn.SpectralNorm([6, 4], dim=0, power_iters=50)
+    got = sn(T(w)).numpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(got, w / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_replicate_tensor_identity():
+    import paddle_tpu.distributed as dist
+    mesh = dist.build_mesh({"dp": 8})
+    dist.set_mesh(mesh)
+    try:
+        x = T(_rng(17).randn(4, 4).astype(np.float32))
+        y = dist.replicate_tensor(x)
+        np.testing.assert_allclose(np.asarray(y._data), x.numpy())
+    finally:
+        dist.set_mesh(None)

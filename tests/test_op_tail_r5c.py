@@ -1,0 +1,103 @@
+"""Round-5 op tail, part 3: the word-boundary stragglers the tightened
+tools/op_coverage.py --check surfaced (asin/atan/tan/erf/imag, assign
+family incl. memcpy + rnn_memory_helper aliases, fill_constant, loss and
+norm functionals, reductions, reverse, gaussian_random, the nn.rnn
+module symbol, hierarchical_sigmoid alias)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.ops as ops
+from op_test import check_output
+
+
+def _rng(s=0):
+    return np.random.RandomState(s)
+
+
+def T(a):
+    return paddle.to_tensor(a)
+
+
+def test_trig_and_special():
+    x = (_rng(1).rand(3, 4).astype(np.float32) - 0.5) * 1.8
+    check_output(paddle.asin, np.arcsin, [x], rtol=1e-5)
+    check_output(paddle.atan, np.arctan, [x], rtol=1e-5)
+    check_output(paddle.tan, np.tan, [x], rtol=1e-5)
+    import math
+    check_output(paddle.erf, np.vectorize(math.erf), [x], rtol=1e-5)
+    z = (x + 1j * x[::-1]).astype(np.complex64)
+    np.testing.assert_allclose(paddle.imag(T(z)).numpy(), z.imag)
+
+
+def test_assign_family_and_fill_constant():
+    # assign is also the mapping for memcpy and rnn_memory_helper
+    x = _rng(2).randn(2, 3).astype(np.float32)
+    np.testing.assert_array_equal(paddle.assign(T(x)).numpy(), x)
+    got = ops.assign_value([2, 3], "float32",
+                           [float(v) for v in x.ravel()])
+    np.testing.assert_allclose(got.numpy(), x, rtol=1e-6)
+    np.testing.assert_array_equal(
+        ops.fill_constant([2, 2], 3.5, "float32").numpy(),
+        np.full((2, 2), 3.5, np.float32))
+
+
+def test_losses_and_norm_functionals():
+    r = _rng(3)
+    p = r.rand(5, 1).astype(np.float32) * 0.8 + 0.1
+    y = (r.rand(5, 1) > 0.5).astype(np.float32)
+    ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    np.testing.assert_allclose(
+        F.binary_cross_entropy(T(p), T(y)).numpy(), ref, rtol=1e-5)
+    x = r.randn(6).astype(np.float32) * 2
+    t = r.randn(6).astype(np.float32)
+    d = x - t
+    # huber_loss_op.cc is elementwise (no reduction attr)
+    ref = np.where(np.abs(d) <= 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+    np.testing.assert_allclose(
+        F.huber_loss(T(x), T(t), delta=1.0).numpy(), ref, rtol=1e-5)
+    ref = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5)
+    np.testing.assert_allclose(
+        ops.smooth_l1_loss(T(x), T(t), reduction="none").numpy(), ref,
+        rtol=1e-5)
+    # layer_norm / group_norm vs torch oracle
+    import torch
+    h = r.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        F.layer_norm(T(h), 6).numpy(),
+        torch.nn.functional.layer_norm(torch.from_numpy(h), (6,)).numpy(),
+        rtol=1e-4, atol=1e-5)
+    img = r.randn(2, 4, 3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        F.group_norm(T(img), 2).numpy(),
+        torch.nn.functional.group_norm(torch.from_numpy(img), 2).numpy(),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        ops.p_norm(T(h), p=3, axis=1).numpy(),
+        (np.abs(h) ** 3).sum(1) ** (1 / 3), rtol=1e-5)
+
+
+def test_reductions_reverse_random():
+    r = _rng(4)
+    x = r.rand(3, 4).astype(np.float32) + 0.5
+    np.testing.assert_allclose(ops.reduce_sum(T(x), axis=1).numpy(),
+                               x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(ops.reduce_prod(T(x), axis=0).numpy(),
+                               x.prod(0), rtol=1e-5)
+    np.testing.assert_array_equal(ops.reverse(T(x), axis=[1]).numpy(),
+                                  x[:, ::-1])
+    paddle.seed(5)
+    g = paddle.normal(mean=2.0, std=0.5, shape=[20000]).numpy()
+    assert abs(g.mean() - 2.0) < 0.02 and abs(g.std() - 0.5) < 0.02
+
+
+def test_rnn_module_and_hierarchical_sigmoid_alias():
+    from paddle_tpu.nn import rnn as rnn_module      # nn:rnn mapping
+    assert hasattr(rnn_module, "GRUCell")
+    r = _rng(6)
+    x = r.randn(4, 8).astype(np.float32)
+    lab = r.randint(0, 6, (4,)).astype(np.int64)
+    w = r.randn(5, 8).astype(np.float32)
+    out = F.hierarchical_sigmoid(T(x), T(lab), 6, T(w))
+    np.testing.assert_allclose(
+        out.numpy(), F.hsigmoid_loss(T(x), T(lab), 6, T(w)).numpy())
