@@ -1,28 +1,423 @@
-"""paddle.onnx API surface (reference: python/paddle/onnx/export.py —
-paddle.onnx.export via paddle2onnx).
+"""paddle.onnx.export (reference: python/paddle/onnx/export.py via
+paddle2onnx — there a Program→ONNX converter; here a jaxpr→ONNX one).
 
-TPU design: the portable deployment artifact here is StableHLO
-(`paddle_tpu.jit.save` → loadable by `paddle_tpu.inference.Predictor`, or
-by any PJRT runtime). ONNX is a CUDA/CPU-deployment interchange format;
-converting jaxpr→ONNX needs an external converter that is not part of
-this image, so `export` writes the StableHLO artifact and tells the
-caller exactly that, rather than failing obscurely.
+A REAL exporter, self-contained: no onnx python package exists in this
+image, so the ONNX ``ModelProto`` is serialized with a minimal protobuf
+wire-format writer (field numbers per the public onnx.proto, opset 13).
+The model's pure forward is traced to a jaxpr (same functionalization as
+``jit.save``); parameters become initializers, each supported primitive
+maps to an ONNX node, and unsupported primitives raise listing the op —
+partial coverage is explicit, never silently-wrong output.
+
+Supported primitive subset (covers MLP/conv/softmax-style inference
+graphs: LeNet, MLP heads, ResNet-style conv+BN folded at eval): dot
+products, elementwise arithmetic/min/max/pow, neg/exp/log/sqrt/rsqrt/
+abs/tanh/logistic/erf/sign/floor, comparisons + select_n, reductions
+(sum/max/min/mean via sum+div), reshape/transpose/broadcast/concat/
+slice/squeeze/pad, convert_element_type, conv_general_dilated (NCHW),
+reduce_window max (MaxPool) and add (AveragePool), iota (materialised),
+stop_gradient / copy (Identity).
+
+``tests/test_onnx_export.py`` replays the serialized file with an
+in-repo numpy interpreter (its own minimal protobuf reader) and checks
+the outputs equal the framework's — the strongest validation available
+without onnxruntime in the image.
 """
 from __future__ import annotations
 
+from typing import Dict, List, Optional
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """API-parity export. Writes the StableHLO artifact at ``path`` (the
-    same files jit.save produces) and raises if a true .onnx file was
-    demanded, with the supported alternative spelled out."""
-    from . import jit
+import numpy as np
 
-    if path.endswith(".onnx"):
-        raise NotImplementedError(
-            "ONNX serialization requires an external jax->ONNX converter "
-            "not bundled here; export the portable StableHLO artifact "
-            "instead: paddle_tpu.jit.save(layer, prefix) -> "
-            "paddle_tpu.inference.create_predictor runs it without any "
-            "model code")
-    jit.save(layer, path, input_spec=input_spec)
+__all__ = ["export"]
+
+# -- minimal protobuf writer --------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = b""
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _str_field(field: int, s: str) -> bytes:
+    return _len_field(field, s.encode("utf-8"))
+
+
+# ONNX TensorProto.DataType
+_DTYPES = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5, "int32": 6,
+           "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+           "uint32": 12, "uint64": 13, "bfloat16": 16}
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    dt = _DTYPES.get(str(arr.dtype))
+    if dt is None:
+        raise NotImplementedError(f"onnx export: dtype {arr.dtype}")
+    out = b""
+    for d in arr.shape:
+        out += _int_field(1, int(d))                 # dims
+    out += _int_field(2, dt)                         # data_type
+    out += _str_field(8, name)                       # name
+    out += _len_field(9, np.ascontiguousarray(arr).tobytes())  # raw_data
+    return out
+
+
+def _value_info(name: str, shape, dtype) -> bytes:
+    dt = _DTYPES.get(str(np.dtype(dtype)))
+    shp = b""
+    for d in shape:
+        shp += _len_field(1, _int_field(1, int(d)))  # dim { dim_value }
+    ttype = _int_field(1, dt) + _len_field(2, shp)   # elem_type, shape
+    typ = _len_field(1, ttype)                       # type { tensor_type }
+    return _str_field(1, name) + _len_field(2, typ)
+
+
+def _attr_int(name, v):
+    return _len_field(5, _str_field(1, name) + _tag(3, 0) + _varint(int(v))
+                      + _int_field(20, 2))           # type=INT
+
+
+def _attr_ints(name, vs):
+    body = _str_field(1, name)
+    for v in vs:
+        body += _tag(8, 0) + _varint(int(v) & ((1 << 64) - 1))
+    body += _int_field(20, 7)                        # type=INTS
+    return _len_field(5, body)
+
+
+def _node(op_type: str, inputs, outputs, attrs: bytes = b"",
+          name: str = "") -> bytes:
+    out = b""
+    for i in inputs:
+        out += _str_field(1, i)
+    for o in outputs:
+        out += _str_field(2, o)
+    if name:
+        out += _str_field(3, name)
+    out += _str_field(4, op_type)
+    out += attrs
+    return _len_field(1, out)  # GraphProto.node
+
+
+# -- jaxpr -> ONNX graph ------------------------------------------------------
+
+class _Graph:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.names: Dict[int, str] = {}
+        self._n = 0
+        self._const_cache: Dict[bytes, str] = {}
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def name_of(self, var):
+        from jax._src.core import Literal
+        if isinstance(var, Literal):
+            return self.add_const(np.asarray(var.val))
+        key = id(var)
+        if key not in self.names:
+            self.names[key] = self.fresh("v")
+        return self.names[key]
+
+    def add_const(self, arr: np.ndarray, hint="const"):
+        arr = np.asarray(arr)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        ck = arr.tobytes() + str(arr.dtype).encode() + str(arr.shape).encode()
+        if ck in self._const_cache:
+            return self._const_cache[ck]
+        nm = self.fresh(hint)
+        self.initializers.append(_tensor_proto(nm, arr))
+        self._const_cache[ck] = nm
+        return nm
+
+    def emit(self, op, ins, n_out=1, attrs=b""):
+        outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(_node(op, ins, outs, attrs))
+        return outs[0] if n_out == 1 else outs
+
+
+def _np_dtype_name(aval):
+    return str(np.dtype(aval.dtype))
+
+
+def _convert_eqn(g: _Graph, eqn):
+    prim = eqn.primitive.name
+    p = eqn.params
+    ins = [g.name_of(v) for v in eqn.invars]
+    avals_in = [v.aval for v in eqn.invars]
+    aval_out = eqn.outvars[0].aval if eqn.outvars else None
+
+    def out(name_or_names):
+        if isinstance(name_or_names, str):
+            g.names[id(eqn.outvars[0])] = name_or_names
+        else:
+            for v, nm in zip(eqn.outvars, name_or_names):
+                g.names[id(v)] = nm
+
+    simple = {"add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+              "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
+              "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+              "tanh": "Tanh", "logistic": "Sigmoid", "erf": "Erf",
+              "sign": "Sign", "floor": "Floor", "ceil": "Ceil"}
+    if prim in simple:
+        return out(g.emit(simple[prim], ins))
+    if prim == "rem":
+        # lax.rem is truncated (dividend-sign) remainder = ONNX fmod=1;
+        # fmod=0 would flip signs and is spec-invalid for floats
+        return out(g.emit("Mod", ins, attrs=_attr_int("fmod", 1)))
+    if prim == "rsqrt":
+        s = g.emit("Sqrt", ins)
+        return out(g.emit("Reciprocal", [s]))
+    if prim == "integer_pow":
+        e = g.add_const(np.asarray(float(p["y"]), np.float32))
+        return out(g.emit("Pow", [ins[0], e]))
+    if prim in ("stop_gradient", "copy"):
+        return out(g.emit("Identity", ins))
+    if prim == "convert_element_type":
+        dt = _DTYPES.get(str(np.dtype(p["new_dtype"])))
+        if dt is None:
+            raise NotImplementedError(
+                f"onnx export: cast to {p['new_dtype']}")
+        return out(g.emit("Cast", ins, attrs=_attr_int("to", dt)))
+    if prim in ("gt", "lt", "ge", "le", "eq", "ne"):
+        opm = {"gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+               "le": "LessOrEqual", "eq": "Equal", "ne": "Equal"}
+        r = g.emit(opm[prim], ins)
+        if prim == "ne":
+            r = g.emit("Not", [r])
+        return out(r)
+    if prim == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("onnx export: select_n with "
+                                      f"{len(ins) - 1} cases")
+        # select_n(pred, on_false, on_true); Where(cond, X, Y): X if cond
+        return out(g.emit("Where", [ins[0], ins[2], ins[1]]))
+    if prim in ("reduce_sum", "reduce_max", "reduce_min"):
+        axes = list(p["axes"])
+        opm = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+               "reduce_min": "ReduceMin"}
+        if prim == "reduce_sum":   # opset 13: axes as input
+            ax = g.add_const(np.asarray(axes, np.int64), "shape")
+            return out(g.emit("ReduceSum", [ins[0], ax],
+                              attrs=_attr_int("keepdims", 0)))
+        return out(g.emit(opm[prim], ins,
+                          attrs=_attr_ints("axes", axes)
+                          + _attr_int("keepdims", 0)))
+    if prim == "reshape":
+        shp = g.add_const(np.asarray(p["new_sizes"], np.int64), "shape")
+        return out(g.emit("Reshape", [ins[0], shp]))
+    if prim == "squeeze":
+        shp = g.add_const(np.asarray(aval_out.shape, np.int64), "shape")
+        return out(g.emit("Reshape", [ins[0], shp]))
+    if prim == "transpose":
+        return out(g.emit("Transpose", ins,
+                          attrs=_attr_ints("perm", p["permutation"])))
+    if prim == "broadcast_in_dim":
+        # reshape to put source dims in place, then Expand
+        inter = [1] * len(p["shape"])
+        for src, dst in enumerate(p["broadcast_dimensions"]):
+            inter[dst] = avals_in[0].shape[src]
+        rs = g.add_const(np.asarray(inter, np.int64), "shape")
+        r = g.emit("Reshape", [ins[0], rs])
+        es = g.add_const(np.asarray(p["shape"], np.int64), "shape")
+        return out(g.emit("Expand", [r, es]))
+    if prim == "concatenate":
+        return out(g.emit("Concat", ins,
+                          attrs=_attr_int("axis", p["dimension"])))
+    if prim == "slice":
+        if p.get("strides") is None:
+            strides = [1] * len(p["start_indices"])
+        else:
+            strides = list(p["strides"])
+        st = g.add_const(np.asarray(p["start_indices"], np.int64), "shape")
+        en = g.add_const(np.asarray(p["limit_indices"], np.int64), "shape")
+        ax = g.add_const(
+            np.arange(len(strides), dtype=np.int64), "shape")
+        sp = g.add_const(np.asarray(strides, np.int64), "shape")
+        return out(g.emit("Slice", [ins[0], st, en, ax, sp]))
+    if prim == "pad":
+        lo, hi, interior = zip(*p["padding_config"])
+        if any(i for i in interior):
+            raise NotImplementedError("onnx export: interior padding")
+        pads = g.add_const(np.asarray(list(lo) + list(hi), np.int64),
+                           "shape")
+        return out(g.emit("Pad", [ins[0], pads, ins[1]]))
+    if prim == "iota":
+        shape = p["shape"]
+        dim = p["dimension"]
+        arr = np.arange(shape[dim], dtype=np.dtype(p["dtype"]))
+        arr = np.broadcast_to(
+            arr.reshape([-1 if i == dim else 1
+                         for i in range(len(shape))]), shape).copy()
+        return out(g.add_const(arr, "iota"))
+    if prim == "dot_general":
+        ((lc, rc), (lb, rb)) = p["dimension_numbers"]
+        la, ra = avals_in
+        # numpy-style batched matmul: batch dims leading on both sides,
+        # contract lhs last with rhs first-after-batch
+        ok = (tuple(lb) == tuple(range(len(lb)))
+              and tuple(rb) == tuple(range(len(rb)))
+              and list(lc) == [la.ndim - 1]
+              and list(rc) == [len(rb)]
+              # exactly one free dim each side: more would make ONNX
+              # MatMul read the extra dims as batch dims and misalign
+              and la.ndim == len(lb) + 2
+              and ra.ndim == len(rb) + 2)
+        if not ok:
+            raise NotImplementedError(
+                f"onnx export: dot_general dims {p['dimension_numbers']}")
+        return out(g.emit("MatMul", ins))
+    if prim == "conv_general_dilated":
+        dn = p["dimension_numbers"]
+        if (dn.lhs_spec[0] != 0 or dn.lhs_spec[1] != 1
+                or dn.rhs_spec[0] != 0 or dn.rhs_spec[1] != 1):
+            raise NotImplementedError(
+                f"onnx export: conv layout {dn}")
+        lo = [a for a, _ in p["padding"]]
+        hi = [b for _, b in p["padding"]]
+        attrs = (_attr_ints("strides", p["window_strides"])
+                 + _attr_ints("pads", lo + hi)
+                 + _attr_ints("dilations", p["rhs_dilation"])
+                 + _attr_int("group", p["feature_group_count"]))
+        return out(g.emit("Conv", ins, attrs=attrs))
+    if prim == "reduce_window_max":
+        return out(_pool(g, ins, p, "MaxPool"))
+    if prim == "reduce_window_sum":
+        ap = _pool(g, ins, p, "AveragePool",
+                   extra=_attr_int("count_include_pad", 1))
+        n = int(np.prod([d for d in p["window_dimensions"] if d > 1]))
+        sc = g.add_const(np.asarray(float(n), np.float32))
+        return out(g.emit("Mul", [ap, sc]))
+    if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                "checkpoint"):
+        inner = p.get("jaxpr") or p.get("call_jaxpr") or p.get("fun_jaxpr")
+        if inner is None:
+            raise NotImplementedError(f"onnx export: opaque call {prim}")
+        closed = inner if hasattr(inner, "jaxpr") else None
+        jx = closed.jaxpr if closed is not None else inner
+        consts = closed.consts if closed is not None else []
+        for cv, c in zip(jx.constvars, consts):
+            g.names[id(cv)] = g.add_const(np.asarray(c), "const")
+        for iv, nm in zip(jx.invars, ins):
+            g.names[id(iv)] = nm
+        for sub in jx.eqns:
+            _convert_eqn(g, sub)
+        return out([g.name_of(v) for v in jx.outvars])
+    raise NotImplementedError(
+        f"onnx export: primitive {prim!r} has no ONNX mapping (see "
+        f"paddle_tpu/onnx.py for the supported subset; jit.save's "
+        f"StableHLO artifact covers the full op set)")
+
+
+def _pool(g, ins, p, op, extra=b""):
+    wd = p["window_dimensions"]
+    ws = p["window_strides"]
+    pad = p["padding"]
+    if wd[0] != 1 or wd[1] != 1:
+        raise NotImplementedError("onnx export: pooling over batch/chan")
+    lo = [a for a, _ in pad[2:]]
+    hi = [b for _, b in pad[2:]]
+    attrs = (_attr_ints("kernel_shape", wd[2:])
+             + _attr_ints("strides", ws[2:])
+             + _attr_ints("pads", lo + hi) + extra)
+    return g.emit(op, [ins[0]], attrs=attrs)
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Trace ``layer`` and write a real ``.onnx`` file (opset 13). For a
+    non-.onnx ``path`` this keeps the historical behaviour of writing the
+    StableHLO artifact via jit.save."""
+    from . import jit as _jit
+
+    if opset_version != 13:
+        raise ValueError(
+            "onnx.export emits opset-13 constructs (ReduceSum axes input, "
+            "GreaterOrEqual/LessOrEqual); declaring any other "
+            f"opset_version ({opset_version}) would produce an invalid "
+            "file")
+    if not path.endswith(".onnx"):
+        _jit.save(layer, path, input_spec=input_spec)
+        return path
+
+    import jax
+    from .jit.functionalize import build_pure
+    from .static import InputSpec
+    from .nn.layer_base import Layer
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in input_spec]
+    if isinstance(layer, Layer):
+        layer.eval()
+        fwd = layer.forward
+        fn = fwd._fn if hasattr(fwd, "_fn") else fwd
+        state = [p for _, p in layer.named_parameters()] + \
+                [b for _, b in layer.named_buffers()]
+    else:
+        fn, state = layer, []
+    pure, meta = build_pure(fn, state)
+    key = jax.random.PRNGKey(0)
+    param_raws = [p._data for p in state]
+
+    def infer(*input_raws):
+        return pure(param_raws, list(input_raws), key, None)
+
+    avals = [jax.ShapeDtypeStruct(
+        tuple(d if d is not None else 1 for d in s.shape), s.dtype)
+        for s in specs]
+    closed = jax.make_jaxpr(infer)(*avals)
+    n_out = meta["n_out"]
+
+    g = _Graph()
+    for cv, c in zip(closed.jaxpr.constvars, closed.consts):
+        g.names[id(cv)] = g.add_const(np.asarray(c), "param")
+    in_names = []
+    for i, iv in enumerate(closed.jaxpr.invars):
+        nm = f"input_{i}"
+        g.names[id(iv)] = nm
+        in_names.append(nm)
+    for eqn in closed.jaxpr.eqns:
+        _convert_eqn(g, eqn)
+    out_names = [g.name_of(v) for v in closed.jaxpr.outvars[:n_out]]
+
+    graph = b"".join(g.nodes)
+    graph += _str_field(2, "paddle_tpu")
+    graph += b"".join(_len_field(5, t) for t in g.initializers)
+    for nm, av in zip(in_names, avals):
+        graph += _len_field(11, _value_info(nm, av.shape, av.dtype))
+    for nm, ov in zip(out_names, closed.jaxpr.outvars[:n_out]):
+        graph += _len_field(12, _value_info(nm, ov.aval.shape,
+                                            ov.aval.dtype))
+
+    model = _int_field(1, 8)                         # ir_version
+    model += _str_field(2, "paddle_tpu")             # producer_name
+    model += _len_field(7, graph)                    # graph
+    model += _len_field(8, _int_field(2, opset_version))  # opset_import
+    with open(path, "wb") as f:
+        f.write(model)
     return path

@@ -208,3 +208,67 @@ class nn:
             shp = f" shape={tuple(raw.shape)}" if print_tensor_shape else ""
             print(f"{prefix}{shp}: {head}")
         return input
+
+    @staticmethod
+    def dynamic_rnn(step_fn, inputs, initial_states, lengths=None,
+                    name=None):
+        """Functional analog of the fluid-era ``DynamicRNN`` block API
+        (reference: fluid/layers/control_flow.py DynamicRNN — there an
+        imperative ``with drnn.block():`` that appends While ops over LoD
+        sequences; see also rnn.py StaticRNN).
+
+        The imperative block cannot be suspended into an XLA loop (a
+        python ``with`` body runs exactly once), so the TPU form takes
+        the step as a FUNCTION — the same translation the reference
+        itself later made with paddle.nn.RNN:
+
+            def step(x_t, h):                 # [B, D_in], states
+                h2 = some_layer(x_t, h)
+                return h2, h2                 # (output_t, new_states)
+            outs, last = static.nn.dynamic_rnn(step, x, h0, lengths)
+
+        ``inputs`` is batch-major [B, T, ...] (the repo-wide padded+
+        lengths convention replacing LoD, ops/sequence.py); ``lengths``
+        [B] masks the padded tail: outputs beyond a row's length are
+        zero and its final state stops updating there, matching
+        DynamicRNN's per-sequence early exit. Executes as a python loop
+        over the static T (UNROLLED under trace — the step is re-traced
+        per timestep; for long sequences prefer nn.RNN, which scans).
+        """
+        import jax
+        from ..core.tensor import Tensor
+        from .. import ops as _ops
+
+        is_tensor = lambda t: isinstance(t, Tensor)
+        states, state_td = jax.tree_util.tree_flatten(
+            initial_states, is_leaf=is_tensor)
+        T = int(inputs.shape[1])
+        outs = []
+        cur = list(states)
+        for t in range(T):
+            x_t = inputs[:, t]
+            st = jax.tree_util.tree_unflatten(state_td, cur)
+            o_t, new_st = step_fn(x_t, st)
+            new_flat, _ = jax.tree_util.tree_flatten(new_st,
+                                                     is_leaf=is_tensor)
+            if lengths is not None:
+                alive = _ops.cast(
+                    _ops.less_than(
+                        _ops.full([inputs.shape[0]], float(t), "float32"),
+                        _ops.cast(lengths, "float32")), o_t.dtype)
+                masks = {}      # one reshape per distinct rank
+
+                def m(rank):
+                    if rank not in masks:
+                        masks[rank] = _ops.reshape(
+                            alive, [-1] + [1] * (rank - 1))
+                    return masks[rank]
+                o_t = o_t * m(len(o_t.shape))
+                new_flat = [n * m(len(n.shape)) +
+                            c * (1.0 - m(len(n.shape)))
+                            for n, c in zip(new_flat, cur)]
+            cur = new_flat
+            outs.append(o_t)
+        stacked = _ops.stack(outs, axis=1)
+        last = jax.tree_util.tree_unflatten(state_td, cur)
+        return stacked, last

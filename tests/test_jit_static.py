@@ -279,3 +279,32 @@ class TestEMAAndTracedLayer:
         np.testing.assert_allclose(out.numpy(), lin(x).numpy(), rtol=1e-6)
         np.testing.assert_allclose(traced([x]).numpy(), lin(x).numpy(),
                                    rtol=1e-6)
+
+
+def test_static_nn_dynamic_rnn():
+    """Functional DynamicRNN analog (reference:
+    fluid/layers/control_flow.py DynamicRNN) — masked tail + frozen
+    states, matches nn.RNN on full-length rows."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    cell = nn.SimpleRNNCell(3, 5)
+    x = np.random.RandomState(0).randn(2, 4, 3).astype(np.float32)
+    h0 = paddle.to_tensor(np.zeros((2, 5), np.float32))
+
+    def step(x_t, h):
+        o, h2 = cell(x_t, h)
+        return o, h2
+
+    outs, last = static.nn.dynamic_rnn(
+        step, paddle.to_tensor(x), h0,
+        lengths=paddle.to_tensor(np.array([4, 2])))
+    o = outs.numpy()
+    assert np.abs(o[1, 2:]).max() == 0.0       # padded tail masked
+    ref, _ = nn.RNN(cell)(paddle.to_tensor(x))
+    np.testing.assert_allclose(o[0], ref.numpy()[0], rtol=1e-5)
+    # frozen state: last state of row 1 == its t=2 output
+    np.testing.assert_allclose(last.numpy()[1], o[1, 1], rtol=1e-5)

@@ -1,0 +1,278 @@
+"""onnx.export produces a REAL .onnx (round-5: the repo's last stub is
+gone). Validation without onnxruntime in the image: a minimal in-repo
+protobuf reader parses the file back and a numpy interpreter replays the
+graph; outputs must equal the framework's own forward."""
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+# -- minimal protobuf reader (wire format) -----------------------------------
+
+def _read_varint(b, i):
+    out = shift = 0
+    while True:
+        x = b[i]
+        i += 1
+        out |= (x & 0x7F) << shift
+        if not x & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_no, wire_type, value) over a message buffer."""
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, v
+
+
+def _parse_tensor(buf):
+    dims, dtype, name, raw = [], None, "", b""
+    for f, w, v in _fields(buf):
+        if f == 1:
+            dims.append(v)
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    np_dt = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+             11: np.float64}[dtype]
+    return name, np.frombuffer(raw, np_dt).reshape(dims)
+
+
+def _parse_node(buf):
+    ins, outs, op, attrs = [], [], "", {}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            ins.append(v.decode())
+        elif f == 2:
+            outs.append(v.decode())
+        elif f == 4:
+            op = v.decode()
+        elif f == 5:
+            nm, ints, i_val, f_val, typ = "", [], None, None, None
+            for ff, ww, vv in _fields(v):
+                if ff == 1:
+                    nm = vv.decode()
+                elif ff == 8:
+                    ints.append(vv)
+                elif ff == 3:
+                    i_val = vv
+                elif ff == 2:
+                    f_val = vv
+                elif ff == 20:
+                    typ = vv
+            attrs[nm] = (ints if typ == 7 else
+                         i_val if typ == 2 else f_val)
+    return op, ins, outs, attrs
+
+
+def load_onnx(path):
+    model = open(path, "rb").read()
+    graph = None
+    opset = None
+    for f, w, v in _fields(model):
+        if f == 7:
+            graph = v
+        elif f == 8:
+            for ff, ww, vv in _fields(v):
+                if ff == 2:
+                    opset = vv
+    assert graph is not None and opset == 13
+    nodes, inits, inputs, outputs = [], {}, [], []
+    for f, w, v in _fields(graph):
+        if f == 1:
+            nodes.append(_parse_node(v))
+        elif f == 5:
+            nm, arr = _parse_tensor(v)
+            inits[nm] = arr
+        elif f == 11:
+            for ff, _, vv in _fields(v):
+                if ff == 1:
+                    inputs.append(vv.decode())
+        elif f == 12:
+            for ff, _, vv in _fields(v):
+                if ff == 1:
+                    outputs.append(vv.decode())
+    return nodes, inits, inputs, outputs
+
+
+# -- numpy interpreter --------------------------------------------------------
+
+def run_onnx(path, feeds):
+    nodes, env, inputs, outputs = load_onnx(path)
+    env = dict(env)
+    for nm, a in zip(inputs, feeds):
+        env[nm] = np.asarray(a)
+    for op, ins, outs, at in nodes:
+        a = [env[i] for i in ins]
+        if op == "MatMul":
+            r = a[0] @ a[1]
+        elif op == "Add":
+            r = a[0] + a[1]
+        elif op == "Sub":
+            r = a[0] - a[1]
+        elif op == "Mul":
+            r = a[0] * a[1]
+        elif op == "Div":
+            r = a[0] / a[1]
+        elif op == "Max":
+            r = np.maximum(a[0], a[1])
+        elif op == "Min":
+            r = np.minimum(a[0], a[1])
+        elif op == "Pow":
+            r = a[0] ** a[1]
+        elif op == "Neg":
+            r = -a[0]
+        elif op == "Exp":
+            r = np.exp(a[0])
+        elif op == "Log":
+            r = np.log(a[0])
+        elif op == "Sqrt":
+            r = np.sqrt(a[0])
+        elif op == "Reciprocal":
+            r = 1.0 / a[0]
+        elif op == "Tanh":
+            r = np.tanh(a[0])
+        elif op == "Sigmoid":
+            r = 1 / (1 + np.exp(-a[0]))
+        elif op == "Abs":
+            r = np.abs(a[0])
+        elif op == "Identity":
+            r = a[0]
+        elif op == "Cast":
+            np_dt = {1: np.float32, 6: np.int32, 7: np.int64,
+                     9: np.bool_}[at["to"]]
+            r = a[0].astype(np_dt)
+        elif op == "Reshape":
+            r = a[0].reshape([int(d) for d in a[1]])
+        elif op == "Transpose":
+            r = np.transpose(a[0], at["perm"])
+        elif op == "Expand":
+            r = np.broadcast_to(a[0], [int(d) for d in a[1]]).copy()
+        elif op == "Concat":
+            r = np.concatenate(a, axis=at["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (a[1], a[2], a[3], a[4])
+            sl = [slice(None)] * a[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(s), int(e), int(st))
+            r = a[0][tuple(sl)]
+        elif op == "ReduceSum":
+            r = a[0].sum(axis=tuple(int(d) for d in a[1]),
+                         keepdims=bool(at.get("keepdims", 1)))
+        elif op in ("ReduceMax", "ReduceMin"):
+            fn = np.max if op == "ReduceMax" else np.min
+            r = fn(a[0], axis=tuple(at["axes"]),
+                   keepdims=bool(at.get("keepdims", 1)))
+        elif op == "Where":
+            r = np.where(a[0], a[1], a[2])
+        elif op == "Greater":
+            r = a[0] > a[1]
+        elif op == "Less":
+            r = a[0] < a[1]
+        elif op == "Conv":
+            r = _np_conv(a[0], a[1], a[2] if len(a) > 2 else None, at)
+        elif op == "MaxPool":
+            r = _np_pool(a[0], at, np.max, -np.inf)
+        elif op == "AveragePool":
+            r = _np_pool(a[0], at, np.mean, 0.0)
+        else:
+            raise NotImplementedError(f"replayer: {op}")
+        env[outs[0]] = r
+    return [env[o] for o in outputs]
+
+
+def _np_conv(x, w, b, at):
+    import torch
+    r = torch.nn.functional.conv2d(
+        torch.from_numpy(np.ascontiguousarray(x)),
+        torch.from_numpy(np.ascontiguousarray(w)),
+        torch.from_numpy(np.ascontiguousarray(b)) if b is not None
+        else None,
+        stride=tuple(at["strides"]),
+        padding=tuple(at["pads"][:2]),
+        dilation=tuple(at.get("dilations", [1, 1])),
+        groups=at.get("group", 1)).numpy()
+    return r
+
+
+def _np_pool(x, at, fn, pad_val):
+    import torch
+    t = torch.from_numpy(np.ascontiguousarray(x))
+    k, s = tuple(at["kernel_shape"]), tuple(at["strides"])
+    pads = tuple(at["pads"][:2])
+    if fn is np.max:
+        return torch.nn.functional.max_pool2d(t, k, s, pads).numpy()
+    return torch.nn.functional.avg_pool2d(t, k, s, pads).numpy()
+
+
+# -- tests --------------------------------------------------------------------
+
+def test_mlp_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                        nn.Softmax())
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    path = str(tmp_path / "mlp.onnx")
+    paddle.onnx.export(net, path, input_spec=[InputSpec([3, 8], "float32",
+                                                        "x")])
+    got = run_onnx(path, [x])[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lenet_conv_roundtrip(tmp_path):
+    paddle.seed(1)
+    from paddle_tpu.vision.models import LeNet
+    net = LeNet()
+    net.eval()
+    x = np.random.RandomState(1).rand(2, 1, 28, 28).astype(np.float32)
+    path = str(tmp_path / "lenet.onnx")
+    paddle.onnx.export(net, path,
+                       input_spec=[InputSpec([2, 1, 28, 28], "float32",
+                                             "img")])
+    got = run_onnx(path, [x])[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_unsupported_primitive_raises_clearly(tmp_path):
+    class Fancy(nn.Layer):
+        def forward(self, x):
+            from paddle_tpu import ops
+            return ops.cumsum(x, axis=0)
+    with pytest.raises(NotImplementedError, match="primitive"):
+        paddle.onnx.export(Fancy(), str(tmp_path / "f.onnx"),
+                           input_spec=[InputSpec([3, 4], "float32", "x")])
+
+
+def test_non_onnx_path_still_writes_stablehlo(tmp_path):
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(4, 2))
+    prefix = str(tmp_path / "model")
+    paddle.onnx.export(net, prefix,
+                       input_spec=[InputSpec([1, 4], "float32", "x")])
+    import os
+    assert os.path.exists(prefix + ".pdmodel")
