@@ -17,8 +17,6 @@ _warnings.filterwarnings(
 _warnings.filterwarnings(
     "ignore", message=".*donated buffers were not usable.*")
 
-__version__ = "0.1.0"
-
 import jax as _jax
 
 # Under a launcher/spawn (PADDLE_TRAINERS_NUM > 1) the distributed runtime
@@ -55,6 +53,7 @@ from .core.dtypes import bool_  # noqa: F401
 
 from .ops import *  # noqa: F401,F403
 from .ops.dispatch import in_dygraph_mode, enable_static, disable_static  # noqa: F401
+in_dynamic_mode = in_dygraph_mode  # reference: paddle/__init__.py:268 alias
 from .ops import linalg  # noqa: F401
 
 # grad function (paddle.grad)
@@ -97,6 +96,16 @@ from . import text  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+from .reader import batch  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import version  # noqa: E402,F401
+# single source of truth for __version__: the reference-parity surface
+# (version.py, v2.0-era snapshot) — pyproject's dist version is the
+# package's own release number, deliberately distinct
+__version__ = version.full_version
 from .hapi import hub  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401

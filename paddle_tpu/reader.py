@@ -1,0 +1,220 @@
+"""paddle.batch + paddle.reader decorators (reference:
+python/paddle/batch.py, python/paddle/reader/decorator.py).
+
+Pure-python generator combinators — no device interaction, so the
+reference semantics carry over unchanged. ``xmap_readers``/
+``multiprocess_reader`` are served by the DataLoader's worker pool
+(io/__init__.py) rather than re-implementing a second process fabric;
+thin thread-based equivalents are provided for API parity.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["batch", "cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    """reference: decorator.py ComposeNotAligned — compose() inputs have
+    different lengths with check_alignment=True."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: batch.py:18 — group instances into lists."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+def cache(reader):
+    """reference: decorator.py:51 — materialise once, replay from RAM.
+    A partial first pass (reader raised mid-way) is discarded, not
+    committed — a retry re-reads from scratch instead of replaying a
+    duplicated prefix."""
+    state = {}
+
+    def cached():
+        if "data" not in state:
+            state["data"] = list(reader())   # commit only on full success
+        yield from state["data"]
+    return cached
+
+
+def map_readers(func, *readers):
+    """reference: decorator.py:91 — zip readers and map func over rows."""
+
+    def reader():
+        for vals in zip(*[r() for r in readers]):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """reference: decorator.py:133 — windowed shuffle."""
+
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    """reference: decorator.py:182 — concatenate readers."""
+
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+    return chained
+
+
+def compose(*readers, **kwargs):
+    """reference: decorator.py:247 — zip readers into flat tuples."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    _SENTINEL = object()
+
+    def composed():
+        rs = [r() for r in readers]
+        if check_alignment:
+            # reference semantics: misaligned lengths RAISE, never
+            # silently truncate
+            for outputs in itertools.zip_longest(*rs,
+                                                 fillvalue=_SENTINEL):
+                if any(o is _SENTINEL for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in zip(*rs):     # truncate at the shortest
+                yield sum((make_tuple(o) for o in outputs), ())
+    return composed
+
+
+def buffered(reader, size):
+    """reference: decorator.py:307 — background-thread prefetch."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = _queue.Queue(maxsize=size)
+        err = []
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as e:   # surface, never truncate
+                err.append(e)
+            finally:
+                q.put(_End)
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                if err:
+                    raise err[0]
+                return
+            yield e
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """reference: decorator.py:366 — truncate to the first n items."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """reference: decorator.py:411 — parallel map. Thread-based here (the
+    mapper is usually numpy decode work releasing the GIL; true
+    multi-process pipelines belong to DataLoader(num_workers=...))."""
+
+    class _End:
+        pass
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        errs = []
+
+        def feed():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:
+                errs.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _End:
+                        return
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as e:
+                errs.append(e)
+            finally:
+                out_q.put(_End)          # always release the consumer
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is _End:
+                finished += 1
+                continue
+            i, d = item
+            if not order:
+                yield d
+                continue
+            pending[i] = d
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+        if errs:                         # a mapper/reader error surfaces
+            raise errs[0]
+        if order:
+            while next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+    return xreader

@@ -1,0 +1,19 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building native extensions against the
+framework). Here the native surface is csrc/ (the C inference API +
+shm ring), so the paths point there."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of paddle_tpu_capi.h for C/C++ embedders."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc")
+
+
+def get_lib():
+    """Directory where built .so artifacts land (build-on-first-use)."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc", "build")

@@ -1,0 +1,20 @@
+"""paddle.version (reference: generated python/paddle/version.py —
+full_version/major/minor/patch/rc + show()). Mirrors the reference
+snapshot's 2.0-era version surface for porters that gate on it."""
+full_version = "2.0.0"
+major = "2"
+minor = "0"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "paddle-tpu"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"rc: {rc}")
+    print(f"commit: {commit}")

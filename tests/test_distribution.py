@@ -1,0 +1,79 @@
+"""paddle.distribution parity (reference: python/paddle/distribution.py
+Uniform :168, Normal :390, Categorical :640) — densities vs scipy,
+sampling vs distribution statistics, and the reference's pinned
+Categorical quirk (softmax entropy/kl, sum-normalised probs)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Uniform, Normal, Categorical
+
+
+def test_uniform_density_entropy_sample():
+    u = Uniform([0.0], [2.0])
+    np.testing.assert_allclose(u.entropy().numpy(), [np.log(2.0)],
+                               rtol=1e-6)
+    v = paddle.to_tensor(np.array([0.8], np.float32))
+    np.testing.assert_allclose(u.log_prob(v).numpy(), [-np.log(2.0)],
+                               rtol=1e-6)
+    np.testing.assert_allclose(u.probs(v).numpy(), [0.5], rtol=1e-6)
+    out = u.probs(paddle.to_tensor(np.array([2.5], np.float32))).numpy()
+    np.testing.assert_allclose(out, [0.0])
+    paddle.seed(0)
+    s = u.sample([5000]).numpy()
+    assert s.shape == (5000, 1)
+    assert s.min() >= 0 and s.max() < 2
+    assert abs(s.mean() - 1.0) < 0.03
+    # broadcasting low/high
+    u2 = Uniform(3.0, [5.0, 6.0, 7.0])
+    assert u2.sample([4]).shape == [4, 3]
+
+
+def test_normal_matches_scipy():
+    n = Normal([0.5], [1.5])
+    v = np.array([1.2], np.float32)
+    np.testing.assert_allclose(
+        n.log_prob(paddle.to_tensor(v)).numpy(),
+        st.norm.logpdf(v, 0.5, 1.5), rtol=1e-5)
+    np.testing.assert_allclose(
+        n.probs(paddle.to_tensor(v)).numpy(),
+        st.norm.pdf(v, 0.5, 1.5), rtol=1e-5)
+    np.testing.assert_allclose(n.entropy().numpy(),
+                               st.norm.entropy(0.5, 1.5), rtol=1e-5)
+    m = Normal([1.0], [2.0])
+    # analytic KL(N0||N1)
+    mu1, s1, mu2, s2 = 0.5, 1.5, 1.0, 2.0
+    ref = (np.log(s2 / s1) + (s1 ** 2 + (mu1 - mu2) ** 2) / (2 * s2 ** 2)
+           - 0.5)
+    np.testing.assert_allclose(n.kl_divergence(m).numpy(), [ref],
+                               rtol=1e-5)
+    paddle.seed(1)
+    s = n.sample([8000]).numpy()
+    assert abs(s.mean() - 0.5) < 0.06 and abs(s.std() - 1.5) < 0.06
+
+
+def test_categorical_reference_quirk():
+    # the reference's own docstring example pins both behaviours
+    x = np.array([0.5535528, 0.20714243, 0.01162981, 0.51577556,
+                  0.36369765, 0.2609165], np.float32)
+    y = np.array([0.77663314, 0.90824795, 0.15685187, 0.04279523,
+                  0.34468332, 0.7955718], np.float32)
+    cat, cat2 = Categorical(x), Categorical(y)
+    np.testing.assert_allclose(cat.entropy().numpy(), 1.77528, rtol=1e-4)
+    np.testing.assert_allclose(cat.kl_divergence(cat2).numpy(), 0.071952,
+                               rtol=1e-3)
+    value = paddle.to_tensor(np.array([2, 1, 3], np.int64))
+    np.testing.assert_allclose(cat.probs(value).numpy(),
+                               [0.00608027, 0.108298, 0.269656],
+                               rtol=1e-4)
+    np.testing.assert_allclose(cat.log_prob(value).numpy(),
+                               [-5.10271, -2.22287, -1.31061], rtol=1e-4)
+    paddle.seed(2)
+    s = cat.sample([2, 3]).numpy()
+    assert s.shape == (2, 3) and s.min() >= 0 and s.max() <= 5
+    # empirical frequencies follow sum-normalised probs
+    paddle.seed(3)
+    big = cat.sample([20000]).numpy()
+    p_emp = np.bincount(big, minlength=6) / big.size
+    np.testing.assert_allclose(p_emp, x / x.sum(), atol=0.02)

@@ -1,0 +1,95 @@
+"""paddle.batch + paddle.reader combinators (reference: batch.py,
+reader/decorator.py — same semantics, pure python)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import reader as R
+
+
+def _r10():
+    def r():
+        yield from range(10)
+    return r
+
+
+def test_batch_semantics():
+    out = list(paddle.batch(_r10(), 3)())
+    assert out == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    out = list(paddle.batch(_r10(), 3, drop_last=True)())
+    assert out == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+
+
+def test_combinators():
+    assert list(R.firstn(_r10(), 4)()) == [0, 1, 2, 3]
+    assert list(R.chain(_r10(), _r10())()) == list(range(10)) * 2
+    assert list(R.map_readers(lambda a, b: a + b, _r10(), _r10())()) == \
+        [2 * i for i in range(10)]
+    assert sorted(R.shuffle(_r10(), 5)()) == list(range(10))
+    assert list(R.buffered(_r10(), 2)()) == list(range(10))
+    got = list(R.compose(_r10(), R.map_readers(lambda x: x * 10,
+                                               _r10()))())
+    assert got == [(i, i * 10) for i in range(10)]
+    c = R.cache(_r10())
+    assert list(c()) == list(range(10)) and list(c()) == list(range(10))
+    got = list(R.xmap_readers(lambda x: x + 1, _r10(), 3, 4, order=True)())
+    assert got == [i + 1 for i in range(10)]
+    got = sorted(R.xmap_readers(lambda x: x + 1, _r10(), 3, 4)())
+    assert got == [i + 1 for i in range(10)]
+
+
+def test_callbacks_and_sysconfig_surface():
+    import os
+    assert hasattr(paddle.callbacks, "Callback") or \
+        hasattr(paddle.callbacks, "EarlyStopping") or \
+        len(dir(paddle.callbacks)) > 3
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert os.path.exists(os.path.join(paddle.sysconfig.get_include(),
+                                       "paddle_tpu_capi.h"))
+
+
+def test_compose_alignment_raises():
+    from paddle_tpu.reader import ComposeNotAligned
+
+    def r7():
+        yield from range(7)
+    import pytest
+    with pytest.raises(ComposeNotAligned):
+        list(R.compose(_r10(), r7)())
+    # check_alignment=False truncates at the shortest, quietly
+    assert len(list(R.compose(_r10(), r7, check_alignment=False)())) == 7
+
+
+def test_reader_errors_surface_not_truncate():
+    import pytest
+
+    def bad():
+        yield 1
+        raise IOError("decode failed")
+    with pytest.raises(IOError, match="decode failed"):
+        list(R.buffered(bad, 4)())
+    with pytest.raises(IOError):
+        list(R.xmap_readers(lambda x: x, bad, 2, 4)())
+
+    def bad_map(x):
+        if x == 5:
+            raise ValueError("corrupt item")
+        return x
+    with pytest.raises(ValueError, match="corrupt"):
+        list(R.xmap_readers(bad_map, _r10(), 2, 4, order=True)())
+
+
+def test_cache_partial_pass_not_committed():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        yield 0
+        yield 1
+        if len(calls) == 1:
+            raise IOError("transient")
+        yield 2
+    c = R.cache(flaky)
+    import pytest
+    with pytest.raises(IOError):
+        list(c())
+    assert list(c()) == [0, 1, 2]      # no duplicated prefix
