@@ -55,6 +55,9 @@ from .ops import *  # noqa: F401,F403
 from .ops.dispatch import in_dygraph_mode, enable_static, disable_static  # noqa: F401
 in_dynamic_mode = in_dygraph_mode  # reference: paddle/__init__.py:268 alias
 from .ops import linalg  # noqa: F401
+from .ops.linalg import cholesky, inverse, matrix_power  # noqa: F401
+from . import tensor  # noqa: E402,F401
+from .tensor import rank  # noqa: E402,F401
 
 # grad function (paddle.grad)
 grad = _functional_grad

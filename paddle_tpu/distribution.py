@@ -140,15 +140,16 @@ class Categorical(Distribution):
     def __init__(self, logits, name=None):
         self.logits = _raw(logits)
 
-    def _sum_probs(self):
-        l = self.logits
-        return l / jnp.sum(l, axis=-1, keepdims=True)
+    @staticmethod
+    def _sum_norm(logits):
+        """The shared sum-normalisation (the pinned v2.0 quirk)."""
+        return logits / jnp.sum(logits, axis=-1, keepdims=True)
 
     def sample(self, shape=(), seed=0):
         key = self._key(seed)
 
         def impl(logits):
-            p = logits / jnp.sum(logits, axis=-1, keepdims=True)
+            p = self._sum_norm(logits)
             # default int dtype: requesting int64 under jax's default
             # x64-off config truncates with a warning on every call
             return jax.random.categorical(
@@ -170,14 +171,21 @@ class Categorical(Distribution):
             return jnp.sum(jnp.exp(la) * (la - lb), axis=-1)
         return apply("categorical_kl", impl, self.logits, other.logits)
 
+    @staticmethod
+    def _gather(p, v):
+        """1-D logits: fancy-index every value. Batched logits [B, K]:
+        per-row gather (reference index_sample semantics), value [B]."""
+        v = v.astype(jnp.int32)
+        if p.ndim == 1:
+            return p[v]
+        return jnp.take_along_axis(p, v[..., None], axis=-1)[..., 0]
+
     def probs(self, value):
         def impl(logits, v):
-            p = logits / jnp.sum(logits, axis=-1, keepdims=True)
-            return p[..., v.astype(jnp.int32)]
+            return self._gather(self._sum_norm(logits), v)
         return apply("categorical_probs", impl, self.logits, value)
 
     def log_prob(self, value):
         def impl(logits, v):
-            p = logits / jnp.sum(logits, axis=-1, keepdims=True)
-            return jnp.log(p[..., v.astype(jnp.int32)])
+            return jnp.log(self._gather(self._sum_norm(logits), v))
         return apply("categorical_log_prob", impl, self.logits, value)

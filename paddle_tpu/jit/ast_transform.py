@@ -155,6 +155,15 @@ def __pt_while__(cond_fn, body_fn, names, args):
     return tuple(state)
 
 
+def _wrap_like(raw, template):
+    """Return jnp results as Tensor when the operand side was a Tensor —
+    converted boolean expressions must keep the eager value type."""
+    from ..core.tensor import Tensor
+    if isinstance(template, Tensor):
+        return Tensor(raw, stop_gradient=True)
+    return raw
+
+
 def __pt_not__(x):
     """``not x`` that survives traced booleans (guards emitted by the
     break/continue lowering)."""
@@ -162,8 +171,56 @@ def __pt_not__(x):
         import jax.numpy as jnp
         from ..core.tensor import Tensor
         raw = x._data if isinstance(x, Tensor) else x
-        return jnp.logical_not(raw)
+        return _wrap_like(jnp.logical_not(raw), x)
     return not x
+
+
+def _as_bool_raw(x):
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    raw = x._data if isinstance(x, Tensor) else x
+    return jnp.asarray(raw).astype(jnp.bool_)
+
+
+def __pt_and__(a_thunk, b_thunk):
+    """``a and b`` (reference: logical_transformer.py convert_logical_and):
+    python value semantics (short-circuit, returns the operand) for
+    concrete values; jnp.logical_and for traced tensors — both sides
+    evaluate under tracing, mirroring the reference's converted form."""
+    a = a_thunk()
+    if _is_tensorish(a):
+        import jax.numpy as jnp
+        return _wrap_like(
+            jnp.logical_and(_as_bool_raw(a), _as_bool_raw(b_thunk())), a)
+    return a and b_thunk()
+
+
+def __pt_or__(a_thunk, b_thunk):
+    """``a or b`` (reference: logical_transformer.py convert_logical_or)."""
+    a = a_thunk()
+    if _is_tensorish(a):
+        import jax.numpy as jnp
+        return _wrap_like(
+            jnp.logical_or(_as_bool_raw(a), _as_bool_raw(b_thunk())), a)
+    return a or b_thunk()
+
+
+def __pt_assert__(cond, msg_thunk):
+    """``assert`` in converted code (reference: assert_transformer.py →
+    Assert op). Concrete condition: normal python assert. Traced: XLA has
+    no aborting side effect inside a compiled program — like the
+    reference's GPU Assert the check is skipped at trace time (the
+    static.nn.Assert facade documents the same)."""
+    if _is_tensorish(cond) and _is_traced(cond):
+        return
+    ok = cond
+    from ..core.tensor import Tensor
+    if isinstance(ok, Tensor):
+        ok = bool(np.asarray(ok._data).all())
+    if not ok:
+        # msg evaluated lazily, only on failure (python semantics)
+        msg = msg_thunk()
+        raise AssertionError(msg if msg is not None else "")
 
 
 def __pt_loop_cond__(flag, test_thunk):
@@ -374,6 +431,9 @@ _HELPERS = {
     "__pt_args__": __pt_args__,
     "__pt_call__": __pt_call__,
     "__pt_not__": __pt_not__,
+    "__pt_and__": __pt_and__,
+    "__pt_or__": __pt_or__,
+    "__pt_assert__": __pt_assert__,
     "__pt_loop_cond__": __pt_loop_cond__,
     "__pt_for_range__": __pt_for_range__,
     "__pt_for_iter__": __pt_for_iter__,
@@ -701,6 +761,52 @@ class _CtrlFlowTransformer(ast.NodeTransformer):
         return ast.Call(
             func=ast.Name(id="__pt_call__", ctx=ast.Load()),
             args=[node.func] + node.args, keywords=node.keywords)
+
+    # -- boolean operators (reference: logical_transformer.py) --------------
+    @staticmethod
+    def _thunk(expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=expr)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        # walrus bindings would be trapped in the thunk's scope; yields/
+        # awaits cannot live in a lambda — leave such BoolOps untouched
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.NamedExpr, ast.Yield, ast.YieldFrom,
+                                ast.Await)):
+                return node
+        fn = "__pt_and__" if isinstance(node.op, ast.And) else "__pt_or__"
+        expr = node.values[-1]
+        for left in reversed(node.values[:-1]):
+            expr = ast.Call(func=ast.Name(id=fn, ctx=ast.Load()),
+                            args=[self._thunk(left), self._thunk(expr)],
+                            keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Not):
+            return node
+        return ast.Call(func=ast.Name(id="__pt_not__", ctx=ast.Load()),
+                        args=[node.operand], keywords=[])
+
+    # -- assert (reference: assert_transformer.py) --------------------------
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.NamedExpr, ast.Yield, ast.YieldFrom,
+                                ast.Await)):
+                return node
+        return ast.Expr(value=ast.Call(
+            func=ast.Name(id="__pt_assert__", ctx=ast.Load()),
+            args=[node.test,
+                  self._thunk(node.msg if node.msg is not None
+                              else ast.Constant(value=None))],
+            keywords=[]))
 
     # -- if -----------------------------------------------------------------
     def visit_If(self, node):

@@ -1,0 +1,17 @@
+"""paddle.tensor namespace (reference: python/paddle/tensor/ —
+creation/linalg/logic/manipulation/math/random/search/stat modules whose
+functions are all re-exported at the paddle top level). The op surface
+here lives in paddle_tpu/ops/; this package keeps the `paddle.tensor.*`
+import path working for ported code."""
+from ..ops import *  # noqa: F401,F403
+from ..ops import linalg  # noqa: F401
+from ..ops.linalg import cholesky, inverse, matrix_power  # noqa: F401
+
+
+def rank(input):
+    """reference: fluid/layers/nn.py rank — 0-d int tensor of ndim."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    from .. import to_tensor
+    n = len(input.shape) if isinstance(input, Tensor) else np.ndim(input)
+    return to_tensor(np.asarray(n, np.int32))

@@ -1,0 +1,61 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py — yolo_loss,
+yolo_box, deform_conv2d/DeformConv2D, read_file, decode_jpeg). Facade
+over the framework's detection/conv/image implementations, keeping the
+reference's argument names."""
+from __future__ import annotations
+
+from ..ops.detection import yolo_box, yolov3_loss as _yolov3_loss
+from ..nn.functional.conv import deformable_conv as _deform
+from ..nn.layer_base import Layer
+from .image import read_file, decode_jpeg  # noqa: F401
+
+__all__ = ["yolo_loss", "yolo_box", "deform_conv2d", "DeformConv2D",
+           "read_file", "decode_jpeg"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: vision/ops.py:31 — alias of the fluid yolov3_loss op."""
+    return _yolov3_loss(x, gt_box, gt_label, anchors=anchors,
+                        anchor_mask=anchor_mask, class_num=class_num,
+                        ignore_thresh=ignore_thresh,
+                        downsample_ratio=downsample_ratio,
+                        gt_score=gt_score,
+                        use_label_smooth=use_label_smooth,
+                        scale_x_y=scale_x_y)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """reference: vision/ops.py:397 (v2 argument order; mask=None is
+    DCNv1, mask given is DCNv2)."""
+    return _deform(x, offset, weight, mask=mask, bias=bias, stride=stride,
+                   padding=padding, dilation=dilation,
+                   deformable_groups=deformable_groups, groups=groups)
+
+
+class DeformConv2D(Layer):
+    """reference: vision/ops.py:601 — layer wrapper over deform_conv2d."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size if isinstance(kernel_size, (list, tuple))
+              else (kernel_size, kernel_size))
+        self._attrs = dict(stride=stride, padding=padding,
+                           dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             mask=mask, **self._attrs)

@@ -77,3 +77,15 @@ def test_categorical_reference_quirk():
     big = cat.sample([20000]).numpy()
     p_emp = np.bincount(big, minlength=6) / big.size
     np.testing.assert_allclose(p_emp, x / x.sum(), atol=0.02)
+
+
+def test_categorical_batched_gather():
+    # batched logits [B, K] + value [B]: per-row gather, not a cross
+    # product (round-5 review finding)
+    logits = np.array([[1.0, 3.0], [2.0, 2.0]], np.float32)
+    cat = Categorical(logits)
+    v = paddle.to_tensor(np.array([1, 0], np.int64))
+    got = cat.probs(v).numpy()
+    np.testing.assert_allclose(got, [3.0 / 4.0, 2.0 / 4.0], rtol=1e-6)
+    np.testing.assert_allclose(cat.log_prob(v).numpy(),
+                               np.log([0.75, 0.5]), rtol=1e-5)

@@ -305,3 +305,101 @@ class TestErrorSourceMapping:
             ours = [fr for fr in frames if fr.filename == __file__]
             assert any("reshape([5, 5])" in (fr.line or "")
                        for fr in ours), [fr.line for fr in ours]
+
+
+class TestLogicalOperators:
+    """and/or/not on tensors under to_static (reference:
+    logical_transformer.py convert_logical_and/or/not): python value
+    semantics preserved for concrete operands, jnp logical ops for
+    traced ones."""
+
+    def test_tensor_and_or_in_if(self):
+        def f(x, y):
+            if x.sum() > 0 and y.sum() > 0:
+                out = x + y
+            elif x.sum() > 0 or y.sum() > 0:
+                out = x - y
+            else:
+                out = x * 0.0
+            return out
+        sf = jit.to_static(f)
+        for a, b in [(1.0, 1.0), (1.0, -1.0), (-1.0, 1.0), (-1.0, -1.0)]:
+            xa, yb = T(np.full(3, a)), T(np.full(3, b))
+            np.testing.assert_allclose(sf(xa, yb).numpy(),
+                                       f(xa, yb).numpy(), rtol=1e-6)
+
+    def test_not_on_tensor_condition(self):
+        def f(x):
+            if not (x.sum() > 0):
+                y = x - 1.0
+            else:
+                y = x + 1.0
+            return y
+        sf = jit.to_static(f)
+        np.testing.assert_allclose(sf(T(np.ones(2))).numpy(), 2.0)
+        np.testing.assert_allclose(sf(T(-np.ones(2))).numpy(), -2.0)
+
+    def test_python_value_semantics_preserved(self):
+        # `a or b` returns the operand, not a bool, for concrete values
+        def f(x, opt=None):
+            cfg = opt or {"scale": 2.0}
+            flag = opt is not None and len(opt) > 0
+            if flag:
+                return x * cfg["scale"] * 10.0
+            return x * cfg["scale"]
+        sf = jit.to_static(f)
+        np.testing.assert_allclose(sf(T(np.ones(2))).numpy(), 2.0)
+        np.testing.assert_allclose(
+            sf(T(np.ones(2)), {"scale": 3.0}).numpy(), 30.0)
+
+
+class TestAssertConversion:
+    """assert in converted code (reference: assert_transformer.py):
+    concrete conditions check normally (tensor conditions via .all()),
+    traced ones are skipped at trace time like the reference's Assert."""
+
+    def test_concrete_assert_fires(self):
+        def f(x):
+            # shapes are static under trace: this assert stays concrete
+            assert x.shape[0] == 2, "batch must be 2"
+            return x * 1.0
+        sf = jit.to_static(f)
+        np.testing.assert_allclose(sf(T(np.zeros((2, 3)))).numpy(), 0.0)
+        with pytest.raises(AssertionError, match="batch must be 2"):
+            sf(T(np.zeros((3, 3))))
+
+    def test_traced_assert_skipped_not_crash(self):
+        def f(x):
+            assert x.sum() > -1e9          # traced: skipped, no bool()
+            if x.mean() > 0:
+                y = x * 2.0
+            else:
+                y = x
+            return y
+        sf = jit.to_static(f)
+        np.testing.assert_allclose(sf(T(np.ones(2))).numpy(), 2.0)
+
+
+class TestLogicalAssertEdgeCases:
+    def test_boolop_result_is_tensor(self):
+        def f(x, y):
+            return (x.sum() > 0) and (y.sum() > 0)
+        got = jit.to_static(f)(T(np.ones(2)), T(np.ones(2)))
+        assert hasattr(got, "numpy"), type(got)   # Tensor, not raw array
+        assert bool(got.numpy())
+
+    def test_assert_msg_lazy(self):
+        def f(x, err=None):
+            assert err is None, f"failed: {err.code}"
+            return x
+        # passing assert: msg must never evaluate (err.code would raise)
+        out = jit.to_static(f)(T(np.ones(2)))
+        np.testing.assert_allclose(out.numpy(), 1.0)
+
+    def test_walrus_in_boolop_not_converted(self):
+        def f(x):
+            if (n := x.shape[0]) and n > 1:
+                return x * float(n)
+            return x
+        np.testing.assert_allclose(
+            jit.to_static(f)(T(np.ones(3))).numpy(), 3.0)

@@ -360,3 +360,22 @@ class TestRound3MathTail:
         out1 = ops.shard_index(paddle.to_tensor(x), index_num=20, nshards=3,
                                shard_id=1).numpy()
         np.testing.assert_array_equal(out1, [-1, -1, 5, -1])
+
+
+def test_linalg_toplevel_and_tensor_namespace():
+    """paddle.cholesky/inverse/matrix_power + paddle.rank +
+    paddle.tensor.* import path (reference: python/paddle/tensor/)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    a = np.array([[4.0, 2.0], [2.0, 3.0]], np.float32)
+    c = paddle.cholesky(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(c @ c.T, a, rtol=1e-5)
+    inv = paddle.inverse(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(inv @ a, np.eye(2), atol=1e-5)
+    mp = paddle.matrix_power(paddle.to_tensor(a), 3).numpy()
+    np.testing.assert_allclose(mp, a @ a @ a, rtol=1e-4)
+    assert int(paddle.rank(paddle.to_tensor(a)).numpy()) == 2
+    assert paddle.tensor.cholesky is paddle.cholesky
+    np.testing.assert_allclose(
+        paddle.tensor.rank(paddle.to_tensor(a)).numpy(), 2)

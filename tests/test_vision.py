@@ -251,3 +251,39 @@ class TestReviewRegressions:
         np.testing.assert_allclose(np.asarray(t._data), 1 / 255.0, rtol=1e-5)
         f = np.full((2, 2, 3), 0.5, np.float32)
         np.testing.assert_allclose(np.asarray(TF.to_tensor(f)._data), 0.5)
+
+
+def test_vision_ops_facade():
+    """paddle.vision.ops parity (reference: vision/ops.py — yolo_loss,
+    yolo_box, deform_conv2d/DeformConv2D, read_file/decode_jpeg)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.vision.ops as VO
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, 4, 6, 6).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    layer = VO.DeformConv2D(4, 8, 3, padding=1)
+    out = layer(x, off)
+    ref = paddle.nn.functional.conv2d(x, layer.weight, layer.bias,
+                                      padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+    # yolo_loss alias == ops.yolov3_loss
+    p = paddle.to_tensor(rng.randn(1, 18, 4, 4).astype(np.float32))
+    gt = np.zeros((1, 3, 4), np.float32)
+    gt[0, 0] = [0.5, 0.5, 0.3, 0.3]
+    gl = np.zeros((1, 3), np.int64)
+    a = VO.yolo_loss(p, paddle.to_tensor(gt), paddle.to_tensor(gl),
+                     anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+                     class_num=1, ignore_thresh=0.7, downsample_ratio=32,
+                     use_label_smooth=False)
+    from paddle_tpu import ops
+    b = ops.yolov3_loss(p, paddle.to_tensor(gt), paddle.to_tensor(gl),
+                        anchors=[10, 13, 16, 30, 33, 23],
+                        anchor_mask=[0, 1, 2], class_num=1,
+                        ignore_thresh=0.7, downsample_ratio=32)
+    np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-6)
+    assert hasattr(VO, "read_file") and hasattr(VO, "decode_jpeg")
