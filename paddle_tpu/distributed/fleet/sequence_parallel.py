@@ -141,7 +141,7 @@ class RingAttention:
     same shape: SURVEY §5.7 TPU build implication)."""
 
     def __init__(self, mesh=None, axis: str = "sp", causal: bool = False,
-                 batch_axes=None):
+                 batch_axes=None, use_flash: bool = False):
         if mesh is not None and mesh is not _mesh.get_mesh():
             raise ValueError(
                 "RingAttention uses the ambient mesh (set_mesh); pass "
@@ -149,11 +149,147 @@ class RingAttention:
         self._axis = axis
         self._causal = causal
         self._batch_axes = batch_axes
+        # use_flash: run the Pallas kernel per chunk (forward-only today
+        # — the lse-merge custom_vjp is future work; training paths keep
+        # the dense-chunk ring whose AD is exact)
+        self._use_flash = use_flash
 
     def __call__(self, q, k, v):
         from ...ops.dispatch import apply
         # through the op funnel: tape-recorded (backprop works), visible
         # to AMP/nan-check/profiler like every other op
+        if self._use_flash:
+            return apply("ring_flash_attention", _ring_flash_impl,
+                         q, k, v, axis=self._axis, causal=self._causal,
+                         batch_axes=self._batch_axes)
         return apply("ring_attention", _ring_impl, q, k, v,
                      axis=self._axis, causal=self._causal,
                      batch_axes=self._batch_axes)
+
+
+def _ring_flash_local(q, k, v, axis: str, causal: bool, scale,
+                      interpret: bool):
+    """Ring attention whose LOCAL chunk compute is the Pallas flash
+    kernel (ops/pallas_attention.py) instead of a dense [Tl, Tl] block
+    product — the full composition of the two long-context mechanisms:
+    flash handles within-chunk memory, the ring handles cross-chip
+    sequence scale. Per ring step the kernel emits (normalized chunk
+    output, logsumexp rows); chunks merge by the standard lse algebra
+
+        lse' = logaddexp(lse, lse_c)
+        o'   = o * exp(lse - lse') + o_c * exp(lse_c - lse')
+
+    Causality across chunks is positional: a K/V chunk strictly in the
+    future (src > rank) is masked out entirely, the diagonal chunk runs
+    the kernel's causal path, past chunks run non-causal. Runs INSIDE
+    shard_map; q/k/v are local [B, H, Tl, D] blocks with Tl a multiple
+    of 16 (the kernel's sublane tile).
+    """
+    from ...ops.pallas_attention import _fa_fwd_with_lse
+
+    S = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    B, H, Tl, D = q.shape
+    if Tl % 16:
+        raise ValueError(f"ring_flash_attention: per-shard sequence {Tl} "
+                         f"must be a multiple of 16")
+    bq = Tl if Tl <= 128 else (128 if Tl % 128 == 0 else 16)
+    bk = bq
+    BH = B * H
+    qb = q.reshape(BH, Tl, D)
+
+    def kernel(kc, vc, causal_flag):
+        return _fa_fwd_with_lse(qb, kc.reshape(BH, Tl, D),
+                                vc.reshape(BH, Tl, D), causal_flag,
+                                scale, bq, bk, interpret, Tl)
+
+    def _r3(out_lse):
+        o_c, lse_c = out_lse
+        return o_c, lse_c.reshape(BH, Tl).astype(jnp.float32)
+
+    def step(carry, s):
+        o, lse, kc, vc = carry
+        src = jnp.mod(rank - s, S)
+        if causal:
+            # 3-way switch: past chunk = full kernel, diagonal = causal
+            # kernel, future chunk = no kernel launch at all (zeros,
+            # masked lse) — skipping ~(S-1)/2S of the launches
+            idx = jnp.where(src > rank, 2,
+                            jnp.where(src == rank, 1, 0))
+            o_c, lse_c = lax.switch(
+                idx,
+                [lambda: _r3(kernel(kc, vc, False)),
+                 lambda: _r3(kernel(kc, vc, True)),
+                 lambda: (jnp.zeros((BH, Tl, D), qb.dtype),
+                          jnp.full((BH, Tl), _NEG, jnp.float32))])
+        else:
+            o_c, lse_c = kernel(kc, vc, False)
+            lse_c = lse_c.reshape(BH, Tl)
+        o_c = o_c.astype(jnp.float32)
+        lse_new = jnp.logaddexp(lse, lse_c)
+        w_old = jnp.exp(jnp.clip(lse - lse_new, _NEG, 0.0))
+        w_new = jnp.exp(jnp.clip(lse_c - lse_new, _NEG, 0.0))
+        o = o * w_old[..., None] + o_c * w_new[..., None]
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        kn = lax.ppermute(kc, axis, perm=perm)
+        vn = lax.ppermute(vc, axis, perm=perm)
+        return (o, lse_new, kn, vn), None
+
+    # plain initializers: check_vma=False on the enclosing shard_map, so
+    # no varying-axes inheritance trick is needed (unlike the dense ring)
+    o0 = jnp.zeros((BH, Tl, D), jnp.float32)
+    lse0 = jnp.full((BH, Tl), _NEG, jnp.float32)
+    (o, lse, _, _), _ = lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(S))
+    return o.reshape(B, H, Tl, D).astype(q.dtype)
+
+
+def _grad_guard(fn):
+    """Forward-only marker: differentiation raises a clear error instead
+    of the un-vjp'd pallas_call's bare AssertionError."""
+    guarded = jax.custom_vjp(fn)
+
+    def fwd(*args):
+        raise NotImplementedError(
+            "ring_flash_attention is forward-only (the lse-merge "
+            "custom_vjp is not implemented); use the dense-chunk "
+            "ring_attention / RingAttention(use_flash=False) for "
+            "training")
+
+    def bwd(res, g):   # pragma: no cover — fwd always raises first
+        raise NotImplementedError
+    guarded.defvjp(fwd, bwd)
+    return guarded
+
+
+def ring_flash_attention(q, k, v, mesh=None, axis: str = "sp",
+                         causal: bool = False, scale: Optional[float] = None,
+                         batch_axes=None, interpret: Optional[bool] = None):
+    """Sequence-parallel attention with the Pallas flash kernel as the
+    per-chunk compute (see :func:`_ring_flash_local`). Same contract as
+    :func:`ring_attention`: GLOBAL [B, H, T, D] arrays, T divisible by
+    the axis size, returns the same sharding. ``interpret`` defaults to
+    True off-TPU so CPU-mesh tests run the kernel in interpret mode."""
+    m = mesh or _mesh.ensure_mesh()
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    spec = P(batch_axes, None, axis, None)
+    # check_vma=False: pallas_call's out ShapeDtypeStructs carry no
+    # varying-mesh-axes annotation, which strict shard_map rejects; the
+    # sharding contract is fully pinned by in_specs/out_specs here
+    fn = jax.shard_map(
+        lambda qq, kk, vv: _ring_flash_local(qq, kk, vv, axis, causal,
+                                             scale, interpret),
+        mesh=m, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return _grad_guard(fn)(q, k, v)
+
+
+def _ring_flash_impl(qq, kk, vv, axis="sp", causal=False, batch_axes=None):
+    # module-level for the op cache (see _ring_impl)
+    ba = tuple(batch_axes) if isinstance(batch_axes, (list, tuple)) \
+        else batch_axes
+    return ring_flash_attention(qq, kk, vv, mesh=None, axis=axis,
+                                causal=causal, batch_axes=ba)

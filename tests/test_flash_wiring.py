@@ -152,3 +152,108 @@ class TestFlashRingComposition:
             dist.set_mesh(None)
         np.testing.assert_allclose(out_flash.numpy(), out_ring,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestRingFlashComposition:
+    """ring_flash_attention: the Pallas kernel as the per-chunk compute
+    INSIDE the sequence-parallel ring (lse-merge across chunks) — the
+    full composition, not just the equivalence pin above."""
+
+    def _qkv(self, B, H, T, D, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(seed)
+        return (jnp.asarray(rng.randn(B, H, T, D), jnp.float32) * 0.4,
+                jnp.asarray(rng.randn(B, H, T, D), jnp.float32) * 0.4,
+                jnp.asarray(rng.randn(B, H, T, D), jnp.float32))
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_ring(self, causal):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_attention, ring_flash_attention)
+        mesh = dist.build_mesh({"sp": 8})
+        dist.set_mesh(mesh)
+        try:
+            q, k, v = self._qkv(1, 2, 128, 16)
+            ref = np.asarray(ring_attention(q, k, v, mesh=mesh,
+                                            causal=causal))
+            got = np.asarray(ring_flash_attention(q, k, v, mesh=mesh,
+                                                  causal=causal))
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        finally:
+            dist.set_mesh(None)
+
+    def test_under_jit_with_dp(self):
+        import jax
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_attention, ring_flash_attention)
+        mesh = dist.build_mesh({"dp": 2, "sp": 4})
+        dist.set_mesh(mesh)
+        try:
+            q, k, v = self._qkv(2, 2, 64, 16, seed=1)
+
+            @jax.jit
+            def f(q, k, v):
+                return ring_flash_attention(q, k, v, mesh=mesh,
+                                            causal=True,
+                                            batch_axes="dp")
+            got = np.asarray(f(q, k, v))
+            ref = np.asarray(ring_attention(q, k, v, mesh=mesh,
+                                            causal=True,
+                                            batch_axes="dp"))
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        finally:
+            dist.set_mesh(None)
+
+    def test_shard_size_constraint(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.fleet.sequence_parallel import (
+            ring_flash_attention)
+        mesh = dist.build_mesh({"sp": 8})
+        dist.set_mesh(mesh)
+        try:
+            q, k, v = self._qkv(1, 1, 40, 16)   # Tl=5: not 16-multiple
+            with pytest.raises(Exception, match="multiple of 16"):
+                np.asarray(ring_flash_attention(q, k, v, mesh=mesh))
+        finally:
+            dist.set_mesh(None)
+
+
+def test_ring_attention_wrapper_use_flash():
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.sequence_parallel import RingAttention
+    mesh = dist.build_mesh({"sp": 8})
+    dist.set_mesh(mesh)
+    try:
+        rng = np.random.RandomState(4)
+        q = jnp.asarray(rng.randn(1, 2, 128, 16), jnp.float32) * 0.4
+        dense = RingAttention(causal=True)(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q))
+        flash = RingAttention(causal=True, use_flash=True)(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q))
+        np.testing.assert_allclose(flash.numpy(), dense.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        dist.set_mesh(None)
+
+
+def test_ring_flash_grad_raises_clearly():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.sequence_parallel import (
+        ring_flash_attention)
+    mesh = dist.build_mesh({"sp": 8})
+    dist.set_mesh(mesh)
+    try:
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(1, 1, 128, 16), jnp.float32)
+
+        def loss(q):
+            return jnp.sum(ring_flash_attention(q, q, q, mesh=mesh))
+        with pytest.raises(NotImplementedError, match="forward-only"):
+            jax.grad(loss)(q)
+    finally:
+        dist.set_mesh(None)
