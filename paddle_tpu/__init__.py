@@ -113,4 +113,14 @@ from .hapi import hub  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from .hapi import Model, summary  # noqa: E402,F401
+from .hapi.dynamic_flops import flops  # noqa: E402,F401
+from .compat_surface import (  # noqa: E402,F401
+    add_n, is_tensor, create_parameter, set_printoptions, scatter_,
+    tanh_, is_compiled_with_xpu, is_compiled_with_npu,
+    is_compiled_with_rocm, CUDAPinnedPlace, NPUPlace, XPUPlace,
+    get_cudnn_version, get_cuda_rng_state, set_cuda_rng_state,
+    ComplexTensor)
+from numpy import dtype  # noqa: E402,F401  (paddle.dtype parity)
+from .ops import reverse  # noqa: E402,F401  (late alias of flip)
+from .core.dtypes import bool_ as bool  # noqa: E402,F401,A001
 from .io import DataLoader  # noqa: E402,F401

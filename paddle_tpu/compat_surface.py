@@ -1,0 +1,154 @@
+"""Top-level API-surface tail (reference: python/paddle/__init__.py
+exports not covered by a dedicated module here): add_n, is_tensor,
+create_parameter, inplace-variant aliases, printoptions, and the
+other-backend probe stubs a v2.0 porter may call. Grouped in one module
+so the main __init__ stays an import manifest."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["add_n", "is_tensor", "create_parameter", "set_printoptions",
+           "scatter_", "tanh_", "is_compiled_with_xpu",
+           "is_compiled_with_npu", "is_compiled_with_rocm",
+           "CUDAPinnedPlace", "NPUPlace", "XPUPlace",
+           "get_cudnn_version", "get_cuda_rng_state",
+           "set_cuda_rng_state", "ComplexTensor"]
+
+
+def add_n(inputs, name=None):
+    """reference: sum_op.cc (paddle.add_n) — elementwise sum of a tensor
+    list (or a single tensor)."""
+    from .core.tensor import Tensor
+    from .ops.creation import clone
+    if isinstance(inputs, Tensor):
+        return clone(inputs)       # reference returns a NEW tensor
+    if not inputs:
+        raise ValueError("add_n: empty input list")
+    if len(inputs) == 1:
+        return clone(inputs[0])    # no aliasing for 1-element lists
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def is_tensor(x):
+    """reference: paddle.is_tensor."""
+    from .core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     default_initializer=None, is_bias=False):
+    """reference: fluid/layers/tensor.py create_parameter — a free
+    Parameter outside any Layer."""
+    from .core.tensor import Parameter
+    from .nn import initializer as I
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(attr, "initializer", None)
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    p = Parameter(init(tuple(shape), dtype))
+    if name:
+        p.name = name
+    return p
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: paddle.set_printoptions — tensor repr goes through
+    numpy here, so this forwards to numpy's printoptions."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    """Inplace-variant alias (reference: paddle.scatter_): same math as
+    scatter, the result written back into ``x`` under the inplace-version
+    guard."""
+    from .ops.math import scatter
+    out = scatter(x, index, updates, overwrite=overwrite)
+    # tape-recorded inplace: adopt data AND grad node; no version bump
+    # (core/tensor.py _swap_payload contract)
+    x._swap_payload(out)
+    return x
+
+
+def tanh_(x, name=None):
+    """Inplace-variant alias (reference: paddle.tanh_)."""
+    from . import tanh
+    x._swap_payload(tanh(x))
+    return x
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def _absent_place(kind):
+    class _Place:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                f"{kind} is not available in this TPU build "
+                f"(is_compiled_with_cuda()/xpu()/npu() report the "
+                f"supported backends); use CPUPlace()/TPUPlace()")
+    _Place.__name__ = kind
+    return _Place
+
+
+CUDAPinnedPlace = _absent_place("CUDAPinnedPlace")
+NPUPlace = _absent_place("NPUPlace")
+XPUPlace = _absent_place("XPUPlace")
+
+
+def get_cudnn_version():
+    """reference: paddle.get_cudnn_version — None: no cuDNN in a TPU
+    build (mirrors the reference's behaviour when not compiled with
+    CUDA)."""
+    return None
+
+
+def get_cuda_rng_state():
+    """reference: paddle.get_cuda_rng_state — empty: no CUDA generators
+    exist; the framework RNG is paddle.seed/Generator (core/generator)."""
+    return []
+
+
+def set_cuda_rng_state(state):
+    if state:
+        raise RuntimeError(
+            "set_cuda_rng_state: no CUDA generators in a TPU build; "
+            "seed the framework RNG with paddle.seed instead")
+
+
+class ComplexTensor:
+    """reference: paddle.ComplexTensor (v2.0 transitional API — removed
+    upstream shortly after). Complex data is first-class in the plain
+    Tensor here (complex64/complex128 via jnp), so this name only
+    redirects."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "ComplexTensor was a transitional v2.0 API; complex dtypes "
+            "are supported directly: paddle.to_tensor(np.array(..., "
+            "dtype=np.complex64)) — see paddle.real/paddle.imag/"
+            "paddle.conj")

@@ -248,3 +248,27 @@ class TestTrainBatchNoUpdate:
                                    rtol=1e-5)  # accumulated
         opt.step()  # the deferred update applies the summed grads
         assert not np.allclose(net.weight.numpy(), w0)
+
+
+def test_paddle_flops_matches_reference_lenet():
+    """paddle.flops via XLA cost analysis (reference:
+    hapi/dynamic_flops.py) — the reference's own docstring LeNet table
+    sums to 347,560 FLOPs (MAC convention); the compiler-measured count
+    must land within 1%."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    n = paddle.flops(LeNet(), [1, 1, 28, 28])
+    assert abs(n - 347560) / 347560 < 0.01, n
+    # custom_ops is unnecessary (compiler counts everything): warns
+    import warnings
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        paddle.flops(LeNet(), [1, 1, 28, 28], custom_ops={})
+    assert not [x for x in w if "custom_ops" in str(x.message)]
+    # empty dict is falsy -> no warning; a non-empty one warns
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        paddle.flops(LeNet(), [1, 1, 28, 28],
+                     custom_ops={"conv": lambda *a: None})
+        assert any("custom_ops" in str(x.message) for x in w)
