@@ -86,10 +86,10 @@ def scatter_(x, index, updates, overwrite=True, name=None):
 
 
 def tanh_(x, name=None):
-    """Inplace-variant alias (reference: paddle.tanh_)."""
-    from . import tanh
-    x._swap_payload(tanh(x))
-    return x
+    """Inplace-variant alias (reference: paddle.tanh_) — the single
+    implementation lives in nn.functional."""
+    from .nn.functional import tanh_ as _t
+    return _t(x)
 
 
 def is_compiled_with_xpu():

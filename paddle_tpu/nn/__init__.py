@@ -19,13 +19,18 @@ from .layers_activation import (
     ThresholdedReLU, Maxout, PReLU, RReLU, GLU,
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, MarginRankingLoss, CTCLoss, CosineEmbeddingLoss,
-    TripletMarginLoss)
+    TripletMarginLoss, HSigmoidLoss, PairwiseDistance)
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerEncoder, TransformerDecoderLayer,
                           TransformerDecoder, Transformer, CAUSAL_MASK,
                           FLASH_CROSSOVER)
 from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,
-                  SimpleRNN, LSTM, GRU)
+                  SimpleRNN, LSTM, GRU, BiRNN)
+from .beam_decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
+                   ClipGradByValue)
+from .utils_weight_norm import spectral_norm  # noqa: F401
+from . import layers_activation as loss  # noqa: F401  (paddle.nn.loss)
 from . import functional
 from . import initializer
 from .utils_weight_norm import weight_norm, remove_weight_norm, spectral_norm_fn

@@ -1,0 +1,121 @@
+"""BeamSearchDecoder + dynamic_decode (reference: nn/layer/rnn.py
+BeamSearchDecoder :1103, dynamic_decode :1565 — there a While-op loop
+over TensorArrays; here a static-unrolled loop over fixed-shape beam
+state, backtraced with the gather_tree op).
+
+Decoding state is fully fixed-shape: log-probs [B, K], finished mask
+[B, K], per-step (token, parent) records stacked to [T, B, K] and
+backtraced by ops.gather_tree at the end — no dynamic growth anywhere,
+so the whole decode jits."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Wraps a cell into a beam-search step function.
+
+    ``embedding_fn`` maps token ids [B*K] -> cell inputs; ``output_fn``
+    maps cell outputs -> vocab logits (reference argument names kept).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num=32, output_time_major=False, **kwargs):
+    """Run beam-search decoding for ``max_step_num`` steps.
+
+    ``inits``: the cell's initial states for a batch of size B (each
+    [B, ...]); they are tiled to the beam internally. Returns
+    ``(predicted_ids [B, T, K], final_scores [B, K])`` — column k of
+    predicted_ids is the k-th best full sequence (backtraced through the
+    beam parents with the gather_tree op), final_scores its accumulated
+    log-probability. Early-exits nothing: T == max_step_num always
+    (fixed shapes); finished beams keep emitting end_token with score
+    frozen, matching the reference's padding convention.
+    """
+    if kwargs:
+        raise TypeError(
+            f"dynamic_decode: unsupported keyword(s) {sorted(kwargs)}; "
+            f"supported: inits, max_step_num, output_time_major "
+            f"(impute_finished/return_length from the reference are not "
+            f"implemented — lengths are derivable from end_token "
+            f"positions in the fixed-shape output)")
+    cell = decoder.cell
+    K = decoder.beam_size
+    end = decoder.end_token
+
+    # infer B from the initial state
+    states = inits
+    leaves, td = jax.tree_util.tree_flatten(
+        states, is_leaf=lambda t: isinstance(t, Tensor))
+    if not leaves:
+        raise ValueError("dynamic_decode needs initial cell states "
+                         "(inits) to size the batch")
+    B = int(leaves[0].shape[0])
+
+    def tile(t):
+        raw = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+        return Tensor(jnp.repeat(raw, K, axis=0))     # [B*K, ...]
+    leaves = [tile(t) for t in leaves]
+    states = jax.tree_util.tree_unflatten(td, leaves)
+
+    neg = -1e9
+    log_probs = jnp.zeros((B, K), jnp.float32).at[:, 1:].set(neg)
+    finished = jnp.zeros((B, K), jnp.bool_)
+    last_ids = jnp.full((B * K,), decoder.start_token, jnp.int32)
+    step_ids, step_parents = [], []
+
+    for _ in range(int(max_step_num)):
+        inp = Tensor(last_ids)
+        if decoder.embedding_fn is not None:
+            inp = decoder.embedding_fn(inp)
+        out, new_states = cell(inp, states)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        lraw = logits._data if isinstance(logits, Tensor) else logits
+        V = lraw.shape[-1]
+        logp = jax.nn.log_softmax(lraw.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, K, V)
+        # finished beams: only end_token continues, at zero cost
+        fmask = jnp.full((V,), neg).at[end].set(0.0)
+        logp = jnp.where(finished[..., None], fmask[None, None, :], logp)
+        scores = (log_probs[..., None] + logp).reshape(B, K * V)
+        top_scores, top_idx = jax.lax.top_k(scores, K)      # [B, K]
+        parent = (top_idx // V).astype(jnp.int32)
+        token = (top_idx % V).astype(jnp.int32)
+        log_probs = top_scores
+        finished = jnp.take_along_axis(finished, parent, axis=1) | (
+            token == end)
+        # reorder every cell state by the chosen parents
+        gather = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+        new_leaves, ntd = jax.tree_util.tree_flatten(
+            new_states, is_leaf=lambda t: isinstance(t, Tensor))
+        new_leaves = [Tensor(jnp.take(
+            (t._data if isinstance(t, Tensor) else jnp.asarray(t)),
+            gather, axis=0)) for t in new_leaves]
+        states = jax.tree_util.tree_unflatten(ntd, new_leaves)
+        last_ids = token.reshape(-1)
+        step_ids.append(token)
+        step_parents.append(parent)
+
+    from ..ops.beam import gather_tree
+    ids_t = jnp.stack(step_ids)                      # [T, B, K]
+    parents_t = jnp.stack(step_parents)
+    seqs = gather_tree(Tensor(ids_t), Tensor(parents_t))
+    sraw = seqs._data if isinstance(seqs, Tensor) else jnp.asarray(seqs)
+    predicted = sraw if output_time_major else jnp.transpose(
+        sraw, (1, 0, 2))                             # [T,B,K] / [B,T,K]
+    return Tensor(predicted), Tensor(log_probs)

@@ -26,3 +26,31 @@ from .sampled import (hsigmoid_loss, hierarchical_sigmoid, nce,  # noqa: F401
                       class_center_sample, sampling_id, sample_logits)
 from ...ops.pallas_attention import flash_attention  # noqa: F401
 from ...ops.manipulation import pixel_shuffle, pixel_unshuffle  # noqa: F401
+
+
+# -- inplace-variant aliases + beam re-export (reference: functional __all__)
+from ...ops.beam import gather_tree  # noqa: F401,E402
+
+
+def _inplace(fn, x, *a, **k):
+    out = fn(x, *a, **k)
+    x._swap_payload(out)     # tape-recorded inplace (core/tensor.py)
+    return x
+
+
+def tanh_(x, name=None):
+    from ...ops.math import tanh as _t
+    return _inplace(_t, x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return _inplace(elu, x, alpha)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis)
+    if dtype is not None:
+        from ...ops.manipulation import cast
+        out = cast(out, dtype)
+    x._swap_payload(out)
+    return x

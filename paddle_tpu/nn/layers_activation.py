@@ -369,3 +369,46 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, **self._kw)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss — layer wrapper over
+    F.hsigmoid_loss holding the tree weight/bias parameters."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        C = num_classes
+        self.weight = self.create_parameter([C - 1, feature_size],
+                                            weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([C - 1, 1], bias_attr,
+                                           is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        from .functional.sampled import hsigmoid_loss
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError("is_custom=True needs path_table/path_code")
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             self.bias, path_table, path_code,
+                             self.is_sparse)
+
+
+class PairwiseDistance(Layer):
+    """reference: nn/layer/distance.py PairwiseDistance — p-norm of
+    x - y along the last dim."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ..ops import p_norm
+        return p_norm(x - y + self.epsilon, p=self.p, axis=-1,
+                      keepdim=self.keepdim)

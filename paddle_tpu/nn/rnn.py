@@ -156,11 +156,31 @@ def _scan_rnn(cell, inputs, initial_states, sequence_length, is_reverse,
     act = getattr(cell, "activation", "tanh")
     hidden = cell.hidden_size
 
-    def impl(x, wi, wh, bi, bh, *init):
+    def impl(x, wi, wh, bi, bh, *rest):
+        rest = list(rest)
+        seq_len = rest.pop(0) if sequence_length is not None else None
+        init = rest
         if not time_major:
             x = jnp.swapaxes(x, 0, 1)  # [T,B,I]
-        if is_reverse:
+        T = x.shape[0]
+        if seq_len is not None:
+            # per-row masking (reference: the LoD/padded sequence_length
+            # contract): forward reads t, reverse reads len-1-t (its own
+            # valid prefix reversed), rows past their length freeze the
+            # state and emit zeros
+            sl = seq_len.astype(jnp.int32)                    # [B]
+            t_idx = jnp.arange(T)[:, None]                    # [T,1]
+            pos = (sl[None, :] - 1 - t_idx) if is_reverse else \
+                jnp.broadcast_to(t_idx, (T, x.shape[1]))
+            pos_c = jnp.clip(pos, 0, T - 1)                   # [T,B]
+            x = jnp.take_along_axis(
+                x, pos_c[:, :, None].astype(jnp.int32), axis=0)
+            alive = (t_idx < sl[None, :])                     # [T,B]
+        elif is_reverse:
             x = jnp.flip(x, 0)
+            alive = None
+        else:
+            alive = None
         b = x.shape[1]
         if init:
             h0 = init[0]
@@ -194,9 +214,30 @@ def _scan_rnn(cell, inputs, initial_states, sequence_length, is_reverse,
             return h2, h2
 
         carry0 = (h0, c0) if kind == "lstm" else h0
-        carryT, ys = jax.lax.scan(body, carry0, x)
-        if is_reverse:
-            ys = jnp.flip(ys, 0)
+        if alive is not None:
+            def masked_body(carry, inp):
+                xt, at = inp
+                new_carry, y = body(carry, xt)
+                am = at[:, None].astype(y.dtype)
+                if kind == "lstm":
+                    (h_old, c_old), (h_new, c_new) = carry, new_carry
+                    new_carry = (h_new * am + h_old * (1 - am),
+                                 c_new * am + c_old * (1 - am))
+                else:
+                    new_carry = new_carry * am + carry * (1 - am)
+                return new_carry, y * am
+            carryT, ys = jax.lax.scan(masked_body, carry0, (x, alive))
+            # outputs are in PROCESSING order; scatter back to source
+            # positions (for reverse: position len-1-t)
+            src_idx = jnp.where(alive, pos_c, T - 1)          # [T,B]
+            out = jnp.zeros_like(ys)
+            out = out.at[src_idx, jnp.arange(ys.shape[1])[None, :]].add(
+                ys * alive[:, :, None].astype(ys.dtype))
+            ys = out
+        else:
+            carryT, ys = jax.lax.scan(body, carry0, x)
+            if is_reverse:
+                ys = jnp.flip(ys, 0)
         if not time_major:
             ys = jnp.swapaxes(ys, 0, 1)
         if kind == "lstm":
@@ -204,6 +245,8 @@ def _scan_rnn(cell, inputs, initial_states, sequence_length, is_reverse,
         return ys, carryT
 
     args = [inputs] + _cell_params(cell)
+    if sequence_length is not None:
+        args.append(sequence_length)
     if initial_states is not None:
         if kind == "lstm":
             args += [initial_states[0], initial_states[1]]
@@ -311,3 +354,30 @@ class GRU(_MultiLayerRNN):
         super().__init__(input_size, hidden_size, num_layers, direction,
                          time_major, dropout, "tanh", weight_ih_attr,
                          weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class BiRNN(Layer):
+    """reference: nn/layer/rnn.py BiRNN — forward + backward cells over
+    the same sequence, outputs concatenated on the feature dim."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, last_fw = self.fw(inputs, st_fw, sequence_length)
+        # RNN(is_reverse=True) already returns TIME-ALIGNED outputs
+        # (_scan_rnn flips back after the scan), so concat directly like
+        # the reference BiRNN
+        out_bw, last_bw = self.bw(inputs, st_bw, sequence_length)
+        from ..ops import manipulation as _m
+        out = _m.concat([out_fw, out_bw], axis=2)
+        return out, (last_fw, last_bw)

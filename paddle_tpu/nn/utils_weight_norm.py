@@ -80,3 +80,32 @@ def spectral_norm_fn(layer, name="weight", n_power_iterations=1, eps=1e-12,
 
     layer.register_forward_pre_hook(hook)
     return layer
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Tensor-functional spectral normalisation (reference:
+    fluid/layers/nn.py spectral_norm — weight / sigma_max). The
+    reference op carries PERSISTENT u/v vectors that converge across
+    calls; a pure functional has no state, so this runs a deterministic
+    power iteration from a FIXED start (PRNGKey(0)) with
+    ``max(power_iters, 20)`` steps — repeated calls are identical and
+    accurate to ~1e-3 of true sigma; for the stateful forms use
+    layers_common.SpectralNorm (layer) or spectral_norm_fn (hook)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.dispatch import apply
+
+    iters = max(int(power_iters), 20)
+
+    def impl(w):
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        h, wdim = mat.shape
+        u = jax.random.normal(jax.random.PRNGKey(0), (h,), jnp.float32)
+        for _ in range(iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ (mat @ v)
+        return w / sigma
+    return apply("spectral_norm", impl, weight)
