@@ -143,3 +143,24 @@ class PyLayer:
 
 
 PyLayerMeta = type  # API-parity alias (reference exposes a metaclass)
+
+
+# -- reference autograd/backward_mode.py ------------------------------------
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """reference: autograd/backward_mode.py backward — run backward on a
+    list of output tensors with optional cotangents."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    grad_tensors = (grad_tensors if isinstance(grad_tensors, (list, tuple))
+                    else [grad_tensors])
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("backward: tensors and grad_tensors length "
+                         "mismatch")
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(g, retain_graph=retain_graph)
+
+
+import sys as _sys
+backward_mode = _sys.modules[__name__]

@@ -41,3 +41,38 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
                   **options)
 
 from .fleet.mp_layers import split  # noqa: E402,F401
+
+# -- reference distributed/__init__.py export tail ---------------------------
+from .fleet import BoxPSDataset  # noqa: E402,F401
+
+
+class ProbabilityEntry:
+    """reference: entry_attr.py — sparse-feature admission by probability
+    (a PS accessor config string). Config-object parity only: the brpc
+    PS accessor that consumed it is ADR'd out (docs/adr/0001), so
+    nothing reads attr() here."""
+
+    def __init__(self, probability):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry:
+    """reference: entry_attr.py — sparse-feature admission by minimum
+    occurrence count."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+from . import utils  # noqa: E402,F401
+from . import cloud_utils  # noqa: E402,F401

@@ -294,3 +294,153 @@ class meta_parallel:
     LayerDesc = LayerDesc
     SharedLayerDesc = SharedLayerDesc
     get_rng_state_tracker = staticmethod(get_rng_state_tracker)
+
+
+# -- reference distributed/fleet/__init__.py export tail ---------------------
+# dataset family (defined in distributed/dataset.py; the reference
+# re-exports them under fleet)
+from ..dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: E402,F401
+
+
+class FileInstantDataset(QueueDataset):
+    """reference: dataset.py FileInstantDataset — QueueDataset variant
+    that streams each file once without the queue rotation; identical
+    here since QueueDataset already streams files in order."""
+
+
+class BoxPSDataset:
+    """reference: dataset.py BoxPSDataset — BoxPS (GPU parameter-server)
+    ingestion. The PS world is ADR'd out (docs/adr/0001); sharded
+    embeddings + InMemoryDataset cover the capability."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "BoxPSDataset belongs to the brpc/BoxPS parameter-server "
+            "stack, excluded by docs/adr/0001; use InMemoryDataset/"
+            "QueueDataset with fleet.sharded_embedding instead")
+
+
+class Role:
+    """reference: role_maker.py Role constants."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class PaddleCloudRoleMaker:
+    """reference: role_maker.py PaddleCloudRoleMaker — derives the
+    process's role from the PADDLE_* launcher env contract (the same
+    contract distributed/launch.py writes)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._eps = [e for e in eps.split(",") if e]
+        self._size = len(self._eps) or int(
+            os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._size
+
+    def _get_trainer_endpoints(self):
+        return list(self._eps)
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference: role_maker.py UserDefinedRoleMaker — explicit role
+    assignment instead of env-derived."""
+
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._rank = int(kwargs.get("current_id", self._rank))
+        self._eps = list(kwargs.get("worker_endpoints", self._eps))
+        self._size = len(self._eps) or self._size
+
+
+class UtilBase:
+    """reference: utils/fs.py + util_base — small cross-worker helpers
+    over the collective API."""
+
+    def all_reduce(self, input, mode="sum"):
+        from .. import collective as C
+        from ...core.tensor import Tensor
+        import numpy as np
+        t = input if isinstance(input, Tensor) else Tensor(
+            __import__("jax.numpy", fromlist=["asarray"]).asarray(
+                np.asarray(input)))
+        op = {"sum": C.ReduceOp.SUM, "min": C.ReduceOp.MIN,
+              "max": C.ReduceOp.MAX}[mode]
+        C.all_reduce(t, op=op)
+        return t
+
+    def barrier(self):
+        from .. import collective as C
+        C.barrier()
+
+
+class Fleet:
+    """reference: fleet/base/fleet_base.py Fleet — the class behind the
+    module-level singleton; the functional API (fleet.init/
+    distributed_model/distributed_optimizer/minimize) IS the instance
+    surface here, so this class simply binds those functions."""
+
+    def __init__(self):
+        self.util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def worker_index(self):
+        return worker_index()     # the module-level rank accessor
+
+
+class MultiSlotDataGenerator:
+    """reference: data_generator/__init__.py — PS-trainer data generator
+    emitting (slot_name, values) records; generate() adapts a sample
+    generator to the slot text protocol the datasets ingest."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line) returning a zero-arg generator "
+            "function whose iteration yields lists of (slot_name, "
+            "values) pairs — the reference data_generator contract")
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for rec in self.generate_sample(line)():
+                out.append(rec)
+        return out
+
+    def _format(self, rec):
+        parts = []
+        for name, values in rec:
+            parts.append(f"{len(values)} " + " ".join(
+                str(v) for v in values))
+        return " ".join(parts)
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant (values kept as strings)."""
+
+
+from ... import metric as metrics  # noqa: E402,F401

@@ -1,0 +1,19 @@
+"""paddle.distributed.utils (reference: distributed/utils.py — launcher
+helper functions; the real machinery lives in distributed/launch.py)."""
+
+
+def get_host_name_ip():
+    import socket
+    host = socket.gethostname()
+    try:
+        ip = socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        ip = "127.0.0.1"
+    return host, ip
+
+
+def get_logger(log_level=20, name="root"):
+    import logging
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    return logger

@@ -3,3 +3,17 @@ python/paddle/fluid/incubate/)."""
 from . import checkpoint  # noqa: F401
 from ..ops.segment import (segment_sum, segment_mean, segment_max,  # noqa: F401
                            segment_min, segment_pool)
+from . import optimizer  # noqa: F401
+
+
+class LayerHelper:
+    """reference: fluid/layer_helper.py LayerHelper — the fluid-era
+    program-building helper custom ops used to append ops/vars by hand.
+    There is no Program being appended to here; custom ops register via
+    ops.custom.register_custom_op / register_pallas_op instead."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "LayerHelper is a fluid-era program builder; define custom "
+            "computation with paddle_tpu.ops.custom.register_custom_op "
+            "(host/numpy tier) or register_pallas_op (TPU kernel tier)")

@@ -1,0 +1,141 @@
+"""paddle.incubate.optimizer (reference: incubate/optimizer/lookahead.py
+LookAhead :26, modelaverage.py ModelAverage :27).
+
+Both wrap an inner optimizer as plain python around its step() — no op
+machinery needed; the slow/averaged copies live as jnp buffers keyed by
+the parameter uid (same registry shape as optimizer state)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor, stable_uid
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """slow <- slow + alpha * (fast - slow) every k steps; fast <- slow
+    (arXiv:1907.08610; reference lookahead.py:26)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be a positive integer, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {}
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._parameter_list
+                if getattr(p, "trainable", True)]
+
+    def step(self):
+        # slow params start from the PRE-update values (reference: the
+        # slow accumulator initialises from the param at creation)
+        for p in self._params():
+            uid = stable_uid(p)
+            if uid not in self._slow:
+                # COPY: the inner optimizer's fused step donates p._data
+                # buffers — an aliased stash would be deleted under us
+                self._slow[uid] = jnp.array(p._data, copy=True)
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self._params():
+                uid = stable_uid(p)
+                slow = (self._slow[uid]
+                        + self.alpha * (p._data - self._slow[uid]))
+                self._slow[uid] = slow
+                p._data = jnp.array(slow, copy=True)   # donation-safe
+                p._inplace_version += 1
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running (windowed) average of parameters, swapped in for eval via
+    apply()/restore() (reference modelaverage.py:27 — there
+    sum_1/sum_2/sum_3 accumulator juggling over min/max_average_window;
+    here one running sum + count with the same window semantics)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.avg_window_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._params = list(parameters or [])
+        self._new = {}      # recent window: uid -> (sum, n)
+        self._old = {}      # rotated history: uid -> (sum, n)
+        self._updates = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values (call after the inner
+        optimizer's step). Window rotation per the reference
+        (modelaverage.py docstring): when num_accumulates >=
+        min_average_window AND >= min(max_average_window,
+        num_updates * rate), the recent sum rotates into the history
+        tier; history older than max_average_window is dropped."""
+        self._updates += 1
+        for p in self._params:
+            uid = stable_uid(p)
+            s, n = self._new.get(uid, (jnp.zeros_like(p._data), 0))
+            s, n = s + p._data, n + 1
+            thresh = min(self.max_window,
+                         max(1, int(self._updates * self.avg_window_rate)))
+            if n >= self.min_window and n >= thresh:
+                so, no = self._old.get(uid, (0.0, 0))
+                if no >= self.max_window:
+                    so, no = 0.0, 0            # drop stale history
+                self._old[uid] = (so + s, no + n)
+                s, n = jnp.zeros_like(p._data), 0
+            self._new[uid] = (s, n)
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged values into the parameters (context-manager
+        style use also works: ``with ma.apply(): evaluate()``)."""
+        self._backup = {stable_uid(p): jnp.array(p._data, copy=True)
+                        for p in self._params}
+        for p in self._params:
+            uid = stable_uid(p)
+            s, n = self._new.get(uid, (0.0, 0))
+            so, no = self._old.get(uid, (0.0, 0))
+            if n + no > 0:
+                p._data = (s + so) / (n + no)
+                p._inplace_version += 1
+        ma = self
+
+        class _Ctx:
+            def __enter__(self):
+                return ma
+
+            def __exit__(self, *a):
+                if need_restore:
+                    ma.restore()
+                return False
+        return _Ctx()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            uid = stable_uid(p)
+            if uid in self._backup:
+                p._data = self._backup[uid]
+                p._inplace_version += 1
+        self._backup = None

@@ -389,3 +389,49 @@ class TracedLayer:
     def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
         """Export via the same StableHLO path as jit.save."""
         save(self._layer, path)
+
+
+# -- reference jit/__init__.py export tail -----------------------------------
+
+class ProgramTranslator:
+    """reference: dygraph_to_static/program_translator.py
+    ProgramTranslator — singleton whose enable() toggles conversion;
+    here that is ast_transform.enable_ast_conversion."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        from . import ast_transform
+        ast_transform.enable_ast_conversion(bool(enable_to_static))
+
+    @property
+    def enable_to_static(self):
+        from . import ast_transform
+        return ast_transform.ast_conversion_enabled()
+
+
+_VERBOSITY = 0
+_CODE_LEVEL = -1
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: dygraph_to_static/logging_utils.py set_verbosity —
+    transformer debug logging. Level > 0 prints which functions convert."""
+    global _VERBOSITY
+    _VERBOSITY = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: set_code_level — dump transformed code. Any level > -1
+    makes convert_function print the rewritten source (ast.unparse)."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = int(level)
+
+
+from . import ast_transform as dy2static  # noqa: E402,F401  (module alias)
+print_function = dy2static  # legacy __future__ re-export slot in reference

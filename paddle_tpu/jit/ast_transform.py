@@ -1063,6 +1063,15 @@ def convert_function(fn: Callable, convert_calls: bool = True) -> Callable:
                        mode="exec")
     except SyntaxError:
         return fn
+    import sys
+    _jit = sys.modules.get("paddle_tpu.jit")
+    if _jit is not None:
+        if getattr(_jit, "_VERBOSITY", 0) > 0:
+            print(f"[to_static] converted {f.__qualname__} "
+                  f"({tr._n} control-flow sites)")
+        if getattr(_jit, "_CODE_LEVEL", -1) > -1:
+            print(f"[to_static] transformed code of {f.__qualname__}:")
+            print(ast.unparse(new_tree))
     glb = f.__globals__
     for k, v in _HELPERS.items():
         glb.setdefault(k, v)

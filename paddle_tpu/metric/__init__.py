@@ -297,3 +297,8 @@ class DetectionMAP(Metric):
 
     def name(self):
         return self._name
+
+
+# reference exports `paddle.metric.metrics` (the defining submodule)
+import sys as _sys
+metrics = _sys.modules[__name__]

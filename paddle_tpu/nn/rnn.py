@@ -170,11 +170,13 @@ def _scan_rnn(cell, inputs, initial_states, sequence_length, is_reverse,
             # state and emit zeros
             sl = seq_len.astype(jnp.int32)                    # [B]
             t_idx = jnp.arange(T)[:, None]                    # [T,1]
-            pos = (sl[None, :] - 1 - t_idx) if is_reverse else \
-                jnp.broadcast_to(t_idx, (T, x.shape[1]))
-            pos_c = jnp.clip(pos, 0, T - 1)                   # [T,B]
-            x = jnp.take_along_axis(
-                x, pos_c[:, :, None].astype(jnp.int32), axis=0)
+            if is_reverse:
+                pos = sl[None, :] - 1 - t_idx
+                pos_c = jnp.clip(pos, 0, T - 1)               # [T,B]
+                x = jnp.take_along_axis(
+                    x, pos_c[:, :, None].astype(jnp.int32), axis=0)
+            else:
+                pos_c = None        # forward order needs no shuffle
             alive = (t_idx < sl[None, :])                     # [T,B]
         elif is_reverse:
             x = jnp.flip(x, 0)
@@ -227,13 +229,16 @@ def _scan_rnn(cell, inputs, initial_states, sequence_length, is_reverse,
                     new_carry = new_carry * am + carry * (1 - am)
                 return new_carry, y * am
             carryT, ys = jax.lax.scan(masked_body, carry0, (x, alive))
-            # outputs are in PROCESSING order; scatter back to source
-            # positions (for reverse: position len-1-t)
-            src_idx = jnp.where(alive, pos_c, T - 1)          # [T,B]
-            out = jnp.zeros_like(ys)
-            out = out.at[src_idx, jnp.arange(ys.shape[1])[None, :]].add(
-                ys * alive[:, :, None].astype(ys.dtype))
-            ys = out
+            if is_reverse:
+                # outputs are in PROCESSING order; scatter back to the
+                # source positions (position len-1-t)
+                src_idx = jnp.where(alive, pos_c, T - 1)      # [T,B]
+                out = jnp.zeros_like(ys)
+                out = out.at[src_idx,
+                             jnp.arange(ys.shape[1])[None, :]].add(
+                    ys * alive[:, :, None].astype(ys.dtype))
+                ys = out
+            # forward: ys is already source-ordered and body masked it
         else:
             carryT, ys = jax.lax.scan(body, carry0, x)
             if is_reverse:

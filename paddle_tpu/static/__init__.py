@@ -272,3 +272,204 @@ class nn:
         stacked = _ops.stack(outs, axis=1)
         last = jax.tree_util.tree_unflatten(state_td, cur)
         return stacked, last
+
+
+# -- fluid-era surface tail (reference: paddle/static/__init__.py exports) ---
+
+class Scope:
+    """reference: core Scope — variable container. The executor keeps one
+    flat dict-backed scope (static/executor.py global_scope)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+import contextlib as _ctx  # noqa: E402
+
+
+@_ctx.contextmanager
+def scope_guard(scope):
+    """reference: executor.py scope_guard. Honest no-op here: the
+    jit-based executor keeps all state per-Program (each Program owns
+    its parameters), so there is no process-global variable scope to
+    swap — the context only yields the given scope object for code that
+    passes it around explicitly."""
+    yield scope
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    """reference: framework.py device_guard — per-op device placement.
+    XLA owns placement under jit; the context is accepted and ignored
+    (documented no-op, like the reference on unsupported devices)."""
+    yield
+
+
+def cpu_places(device_count=None):
+    import jax
+    n = device_count or len([d for d in jax.devices()
+                             if d.platform == "cpu"]) or 1
+    from ..core.device import CPUPlace
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []      # no CUDA devices in a TPU build (parity: empty list)
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..compat_surface import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: fluid/layers/tensor.py create_global_var."""
+    from ..ops.creation import full
+    from ..core.tensor import Tensor
+    t = full(shape, value, dtype)
+    if name:
+        t.name = name
+    return t
+
+
+class WeightNormParamAttr(object):
+    """reference: fluid/param_attr.py WeightNormParamAttr — ParamAttr
+    carrying a weight-norm dim; consumed by nn.utils.weight_norm here."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ParallelExecutor:
+    """reference: parallel_executor.py — superseded by Executor over a
+    mesh (static/executor.py shards feeds; GSPMD inserts the grad
+    allreduce). Kept as a thin alias so fluid-era scripts construct."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from .executor import Executor
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(program=self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: fluid/layers/nn.py py_func — host-python op. The
+    dispatch-level equivalent is ops.custom.register_custom_op (host
+    tier); this shim routes a one-off callable through it."""
+    from ..ops.custom import register_custom_op
+    import uuid
+    name = f"py_func_{uuid.uuid4().hex[:8]}"
+    fn = register_custom_op(name, func, backward_func)
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return fn(*xs)
+
+
+# program/persistable (de)serialization: the Program here compiles to a
+# StableHLO artifact; (de)serialize maps onto jit.save/load files
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError(
+        "serialize_program: the compiled artifact is StableHLO — use "
+        "paddle.static.save_inference_model(path, feed, fetch, exe) / "
+        "load_inference_model, or jit.save on a Layer")
+
+
+serialize_persistables = serialize_program
+deserialize_program = serialize_program
+deserialize_persistables = serialize_program
+normalize_program = serialize_program
+save_to_file = serialize_program
+load_from_file = serialize_program
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference: static/io.py save — persist a static Program's
+    parameter values (the program itself re-derives from python).
+    Parameters are the Program's touched Tensors (graph.py
+    all_parameters), keyed by name with positional fallbacks."""
+    import pickle
+    import numpy as np
+    params = list(getattr(program, "all_parameters", list)() or [])
+    state = {}
+    for i, p in enumerate(params):
+        key = getattr(p, "name", None) or f"param_{i}"
+        state[key] = np.asarray(p.numpy())
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore values saved by :func:`save` back into the Program's
+    parameters (matched by name, positional fallback)."""
+    import os
+    import pickle
+    import jax.numpy as jnp
+    path = model_path + ".pdparams"
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    if program is not None and hasattr(program, "all_parameters"):
+        for i, p in enumerate(program.all_parameters()):
+            key = getattr(p, "name", None) or f"param_{i}"
+            if key in state:
+                p._data = jnp.asarray(state[key])
+                p._inplace_version += 1
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    return load(None, model_path)
+
+
+def set_program_state(program, state_dict):
+    raise NotImplementedError(
+        "set_program_state: static Programs re-derive parameters from "
+        "python; assign through the Program's variables or use the "
+        "dygraph set_state_dict path")
+
+
+save_vars = save
+load_vars = load
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k, correct=correct, total=total)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=num_thresholds)
+    m.update(input, label)
+    return m.accumulate()
+
+
+from .. import amp  # noqa: E402,F401
+Print = nn.Print

@@ -35,3 +35,111 @@ def run_check():
     n = len(jax.devices())
     print(f"paddle_tpu is installed successfully! {n} device(s) "
           f"({jax.devices()[0].platform}) available.")
+
+
+# -- reference utils/__init__.py export tail ---------------------------------
+
+import functools as _functools
+import warnings as _warnings
+
+
+def deprecated(update_to="", since="", reason=""):
+    """reference: utils/deprecated.py — decorator emitting a
+    DeprecationWarning once per call site."""
+
+    def decorator(fn):
+        @_functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API '{getattr(fn, '__name__', fn)}' is deprecated "
+                   f"since {since or 'this release'}")
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            _warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def require_version(min_version, max_version=None):
+    """reference: utils/__init__.py require_version — version gate
+    against paddle.version."""
+    from .. import version as _v
+
+    def to_tuple(s):
+        return tuple(int(x) for x in str(s).split(".")[:3])
+    cur = to_tuple(_v.full_version)
+    if to_tuple(min_version) > cur:
+        raise Exception(
+            f"installed version {_v.full_version} < required minimum "
+            f"{min_version}")
+    if max_version is not None and to_tuple(max_version) < cur:
+        raise Exception(
+            f"installed version {_v.full_version} > required maximum "
+            f"{max_version}")
+
+
+class _UniqueName:
+    """reference: fluid/unique_name.py — name generator + guard."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _g():
+            saved = dict(self._counters)
+            if new_generator is not None:
+                self._counters.clear()
+            try:
+                yield
+            finally:
+                self._counters.clear()
+                self._counters.update(saved)
+        return _g()
+
+
+unique_name = _UniqueName()
+
+from ..profiler import Profiler  # noqa: E402,F401
+
+
+class ProfilerOptions:
+    """reference: utils/profiler.py ProfilerOptions — config holder for
+    the legacy profiler; the jax-backed Profiler takes log_dir only."""
+
+    def __init__(self, options=None):
+        self.options = options or {}
+
+
+def get_profiler(options=None):
+    return Profiler()
+
+
+class OpLastCheckpointChecker:
+    """reference: utils/op_version.py — queries op version checkpoints
+    compiled into the C++ core. No C++ op registry exists here; every op
+    is at its initial version."""
+
+    def get_op_attrs(self, op_name):
+        return []
+
+
+def cpp_extension(*a, **k):
+    raise RuntimeError(
+        "paddle.utils.cpp_extension builds pybind11 CUDA/C++ ops; this "
+        "TPU build's native extension points are "
+        "ops.custom.register_custom_op (host C/C++ via ctypes, see "
+        "csrc/) and register_pallas_op (TPU kernels); see also "
+        "paddle.sysconfig.get_include()")
+
+
+from ..vision import image as image_util  # noqa: E402,F401

@@ -406,3 +406,9 @@ class VOCDetection(Dataset):
 
     def __len__(self):
         return len(self.ids)
+
+
+# reference exposes per-dataset submodules (vision/datasets/mnist.py etc.);
+# here one module defines them all — alias the names for import parity
+import sys as _sys
+mnist = cifar = folder = voc2012 = flowers = _sys.modules[__name__]

@@ -328,3 +328,8 @@ class RandomErasing(BaseTransform):
                 arr[top:top + eh, left:left + ew] = self.value
                 return arr
         return arr
+
+
+# reference exports `paddle.vision.transforms.transforms` (submodule)
+import sys as _sys
+transforms = _sys.modules[__name__]

@@ -106,6 +106,36 @@ def test_top_level_export_parity_vs_reference():
     assert not missing, missing
 
 
+def test_namespace_export_parity_vs_reference():
+    """Same check for every public sub-namespace the reference ships."""
+    import re
+    import importlib
+    pairs = [("static", "paddle_tpu.static"), ("jit", "paddle_tpu.jit"),
+             ("utils", "paddle_tpu.utils"),
+             ("autograd", "paddle_tpu.autograd"),
+             ("distributed", "paddle_tpu.distributed"),
+             ("distributed/fleet", "paddle_tpu.distributed.fleet"),
+             ("metric", "paddle_tpu.metric"),
+             ("optimizer", "paddle_tpu.optimizer"),
+             ("io", "paddle_tpu.io"), ("text", "paddle_tpu.text"),
+             ("amp", "paddle_tpu.amp"),
+             ("vision/transforms", "paddle_tpu.vision.transforms"),
+             ("vision/datasets", "paddle_tpu.vision.datasets"),
+             ("incubate", "paddle_tpu.incubate")]
+    bad = {}
+    for ref, ourmod in pairs:
+        rsrc = open(
+            f"/root/reference/python/paddle/{ref}/__init__.py").read()
+        names = re.findall(r"from [\w.]+ import (\w+)", rsrc)
+        names += re.findall(r"^\s+'(\w+)',?\s*$", rsrc, re.M)
+        ours = importlib.import_module(ourmod)
+        missing = sorted(set(n for n in names if not n.startswith("_")
+                             and not hasattr(ours, n)))
+        if missing:
+            bad[ref] = missing
+    assert not bad, bad
+
+
 def test_inplace_aliases_keep_gradients():
     """tanh_/scatter_ must stay on the tape (round-5 review: direct
     _data assignment silently dropped the op from backward)."""
@@ -130,3 +160,35 @@ def test_add_n_never_aliases():
     np.testing.assert_allclose(x.numpy(), 0.0)
     z = paddle.add_n([x])
     assert z is not x
+
+
+def test_lookahead_and_model_average():
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    inner = optim.SGD(learning_rate=0.5, parameters=net.parameters())
+    la = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.ones((3, 4), np.float32))
+    w0 = net.weight.numpy().copy()
+    for _ in range(2):
+        net(x).sum().backward()
+        la.step()
+        la.clear_grad()
+    g = np.ones_like(w0) * 3.0
+    expect = w0 + 0.5 * ((w0 - g) - w0)   # slow <- slow+0.5(fast2-slow)
+    np.testing.assert_allclose(net.weight.numpy(), expect, rtol=1e-5)
+
+    ma = ModelAverage(0.5, parameters=net.parameters(),
+                      min_average_window=2, max_average_window=4)
+    vals = []
+    for _ in range(3):
+        net.weight._data = net.weight._data + 1.0
+        ma.step()
+        vals.append(net.weight.numpy().copy())
+    cur = net.weight.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(net.weight.numpy(),
+                                   np.mean(vals, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(net.weight.numpy(), cur)
