@@ -9,14 +9,16 @@ The model's pure forward is traced to a jaxpr (same functionalization as
 maps to an ONNX node, and unsupported primitives raise listing the op —
 partial coverage is explicit, never silently-wrong output.
 
-Supported primitive subset (covers MLP/conv/softmax-style inference
-graphs: LeNet, MLP heads, ResNet-style conv+BN folded at eval): dot
-products, elementwise arithmetic/min/max/pow, neg/exp/log/sqrt/rsqrt/
-abs/tanh/logistic/erf/sign/floor, comparisons + select_n, reductions
+Supported primitive subset (covers MLP/conv/softmax nets AND
+transformers — LeNet, ResNet-18, and GPT round-trip within 1e-4 in
+the tests): general dot_general (canonicalising transposes + flattened
+batched MatMul), embedding-style gather -> Gather, elementwise
+arithmetic/min/max/pow/square, neg/exp/log/sqrt/rsqrt/abs/tanh/
+logistic/erf/erfc/sign/floor, comparisons + select_n, reductions
 (sum/max/min/mean via sum+div), reshape/transpose/broadcast/concat/
 slice/squeeze/pad, convert_element_type, conv_general_dilated (NCHW),
-reduce_window max (MaxPool) and add (AveragePool), iota (materialised),
-stop_gradient / copy (Identity).
+reduce_window max (MaxPool) and add (AveragePool, count_include_pad),
+iota (materialised), stop_gradient / copy (Identity).
 
 ``tests/test_onnx_export.py`` replays the serialized file with an
 in-repo numpy interpreter (its own minimal protobuf reader) and checks
@@ -188,6 +190,13 @@ def _convert_eqn(g: _Graph, eqn):
         # lax.rem is truncated (dividend-sign) remainder = ONNX fmod=1;
         # fmod=0 would flip signs and is spec-invalid for floats
         return out(g.emit("Mod", ins, attrs=_attr_int("fmod", 1)))
+    if prim == "erfc":
+        e = g.emit("Erf", ins)
+        one = g.add_const(np.asarray(1.0, np.dtype(avals_in[0].dtype)))
+        return out(g.emit("Sub", [one, e]))
+    if prim == "square":
+        two = g.add_const(np.asarray(2.0, np.dtype(avals_in[0].dtype)))
+        return out(g.emit("Pow", [ins[0], two]))
     if prim == "rsqrt":
         s = g.emit("Sqrt", ins)
         return out(g.emit("Reciprocal", [s]))
@@ -273,23 +282,79 @@ def _convert_eqn(g: _Graph, eqn):
             arr.reshape([-1 if i == dim else 1
                          for i in range(len(shape))]), shape).copy()
         return out(g.add_const(arr, "iota"))
+    if prim == "gather":
+        dn = p["dimension_numbers"]
+        op_aval, idx_aval = avals_in
+        ss = tuple(p["slice_sizes"])
+        # embedding-style take along axis 0: whole rows selected by a
+        # trailing size-1 index vector -> ONNX Gather(axis=0)
+        ok = (tuple(dn.collapsed_slice_dims) == (0,)
+              and tuple(dn.start_index_map) == (0,)
+              and not dn.operand_batching_dims
+              and not dn.start_indices_batching_dims
+              and ss == (1,) + tuple(op_aval.shape[1:])
+              and tuple(dn.offset_dims) == tuple(
+                  range(idx_aval.ndim - 1,
+                        idx_aval.ndim - 1 + op_aval.ndim - 1)))
+        if not ok:
+            raise NotImplementedError(
+                f"onnx export: general gather {dn} (only axis-0 row "
+                f"take / embedding lookup maps to ONNX Gather)")
+        idx_shape = list(idx_aval.shape[:-1])
+        rs = g.add_const(np.asarray(idx_shape, np.int64), "shape")
+        idx = g.emit("Reshape", [ins[1], rs])   # drop index-vector dim
+        # jax out-of-bounds semantics: ONNX Gather is undefined there,
+        # so every non-PROMISE mode gets an explicit Clip on the indices.
+        # For mode=clip that is exact; for jnp.take's default
+        # FILL_OR_DROP an out-of-range id clamps to the edge row instead
+        # of producing the fill value — a documented divergence confined
+        # to inputs that were already out of the table's range.
+        mode_name = getattr(p.get("mode"), "name", str(p.get("mode")))
+        if "PROMISE" not in mode_name.upper():
+            lo = g.add_const(np.asarray(0, np.int64))
+            hi = g.add_const(np.asarray(op_aval.shape[0] - 1, np.int64))
+            idx = g.emit("Clip", [idx, lo, hi])
+        return out(g.emit("Gather", [ins[0], idx],
+                          attrs=_attr_int("axis", 0)))
     if prim == "dot_general":
         ((lc, rc), (lb, rb)) = p["dimension_numbers"]
         la, ra = avals_in
-        # numpy-style batched matmul: batch dims leading on both sides,
-        # contract lhs last with rhs first-after-batch
-        ok = (tuple(lb) == tuple(range(len(lb)))
-              and tuple(rb) == tuple(range(len(rb)))
-              and list(lc) == [la.ndim - 1]
-              and list(rc) == [len(rb)]
-              # exactly one free dim each side: more would make ONNX
-              # MatMul read the extra dims as batch dims and misalign
-              and la.ndim == len(lb) + 2
-              and ra.ndim == len(rb) + 2)
-        if not ok:
+        if len(lc) != 1 or len(rc) != 1 or len(lb) != len(rb):
             raise NotImplementedError(
                 f"onnx export: dot_general dims {p['dimension_numbers']}")
-        return out(g.emit("MatMul", ins))
+        # canonicalise to batched MatMul: transpose both sides to
+        # (batch..., free..., K) x (batch..., K, free...), flattening
+        # multiple free dims through Reshape; dot_general's output order
+        # (batch, lhs-free, rhs-free) matches MatMul's directly
+        lfree = [d for d in range(la.ndim) if d not in lb and d != lc[0]]
+        rfree = [d for d in range(ra.ndim) if d not in rb and d != rc[0]]
+        lperm = list(lb) + lfree + [lc[0]]
+        rperm = list(rb) + [rc[0]] + rfree
+        lhs = ins[0]
+        if lperm != list(range(la.ndim)):
+            lhs = g.emit("Transpose", [lhs], attrs=_attr_ints("perm", lperm))
+        rhs = ins[1]
+        if rperm != list(range(ra.ndim)):
+            rhs = g.emit("Transpose", [rhs], attrs=_attr_ints("perm", rperm))
+        bshape = [la.shape[d] for d in lb]
+        m = int(np.prod([la.shape[d] for d in lfree])) if lfree else 1
+        n = int(np.prod([ra.shape[d] for d in rfree])) if rfree else 1
+        k = la.shape[lc[0]]
+        need_l_rs = len(lfree) != 1
+        need_r_rs = len(rfree) != 1
+        if need_l_rs:
+            rs = g.add_const(np.asarray(bshape + [m, k], np.int64), "shape")
+            lhs = g.emit("Reshape", [lhs, rs])
+        if need_r_rs:
+            rs = g.add_const(np.asarray(bshape + [k, n], np.int64), "shape")
+            rhs = g.emit("Reshape", [rhs, rs])
+        mm = g.emit("MatMul", [lhs, rhs])
+        out_shape = (bshape + [la.shape[d] for d in lfree]
+                     + [ra.shape[d] for d in rfree])
+        if need_l_rs or need_r_rs:
+            rs = g.add_const(np.asarray(out_shape, np.int64), "shape")
+            mm = g.emit("Reshape", [mm, rs])
+        return out(mm)
     if prim == "conv_general_dilated":
         dn = p["dimension_numbers"]
         if (dn.lhs_spec[0] != 0 or dn.lhs_spec[1] != 1

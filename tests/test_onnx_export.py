@@ -169,6 +169,10 @@ def run_onnx(path, feeds):
             r = a[0].reshape([int(d) for d in a[1]])
         elif op == "Transpose":
             r = np.transpose(a[0], at["perm"])
+        elif op == "Gather":
+            r = np.take(a[0], a[1].astype(np.int64), axis=at.get("axis", 0))
+        elif op == "Clip":
+            r = np.clip(a[0], a[1], a[2])
         elif op == "Expand":
             r = np.broadcast_to(a[0], [int(d) for d in a[1]]).copy()
         elif op == "Concat":
@@ -192,6 +196,17 @@ def run_onnx(path, feeds):
             r = a[0] > a[1]
         elif op == "Less":
             r = a[0] < a[1]
+        elif op == "GreaterOrEqual":
+            r = a[0] >= a[1]
+        elif op == "LessOrEqual":
+            r = a[0] <= a[1]
+        elif op == "Equal":
+            r = a[0] == a[1]
+        elif op == "Not":
+            r = ~a[0]
+        elif op == "Erf":
+            import math
+            r = np.vectorize(math.erf)(a[0]).astype(np.float32)
         elif op == "Conv":
             r = _np_conv(a[0], a[1], a[2] if len(a) > 2 else None, at)
         elif op == "MaxPool":
@@ -276,3 +291,39 @@ def test_non_onnx_path_still_writes_stablehlo(tmp_path):
                        input_spec=[InputSpec([1, 4], "float32", "x")])
     import os
     assert os.path.exists(prefix + ".pdmodel")
+
+
+@pytest.mark.slow
+def test_resnet18_roundtrip(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    net = resnet18(num_classes=10)
+    net.eval()
+    x = np.random.RandomState(0).rand(1, 3, 32, 32).astype(np.float32)
+    path = str(tmp_path / "r18.onnx")
+    paddle.onnx.export(net, path,
+                       input_spec=[InputSpec([1, 3, 32, 32], "float32",
+                                             "x")])
+    got = run_onnx(path, [x])[0]
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gpt_transformer_roundtrip(tmp_path):
+    """Transformers export too: embedding gather, batched attention
+    matmuls (general dot_general), gelu's erfc, causal-mask select."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=4, max_position_embeddings=16,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0,
+                    attn_impl="dense")
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    path = str(tmp_path / "gpt.onnx")
+    paddle.onnx.export(net, path,
+                       input_spec=[InputSpec([1, 8], "int32", "ids")])
+    ids = np.random.RandomState(0).randint(0, 64, (1, 8)).astype(np.int32)
+    got = run_onnx(path, [ids])[0]
+    ref = net(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
