@@ -234,3 +234,53 @@ def gpt_pipeline_fns(model: "GPTForCausalLM", num_stages: int):
         },
         "stages": S, "layers_per_stage": k,
     }
+
+
+def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
+                  top_k=1, temperature=1.0, eos_token_id=None):
+    """Autoregressive decoding for GPTForCausalLM (reference capability:
+    PaddleNLP GenerationMixin.generate — greedy / top-k sampling; the
+    beam form lives in nn.BeamSearchDecoder/dynamic_decode).
+
+    Recomputes the full prefix each step (no KV cache): correct and
+    simple; the fixed-shape KV-cache fast path is the documented next
+    step. Returns ids [B, input_len + max_length]."""
+    import numpy as np
+    from ..core import generator as _gen
+    from ..core.tensor import Tensor
+    import jax
+    import jax.numpy as jnp
+
+    if decode_strategy not in ("greedy", "sampling"):
+        raise ValueError(
+            f"decode_strategy {decode_strategy!r} not in "
+            f"('greedy', 'sampling'); beam search = "
+            f"nn.BeamSearchDecoder + dynamic_decode")
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(np.asarray(input_ids), jnp.int32)
+    finished = jnp.zeros((ids.shape[0],), jnp.bool_)
+    for _ in range(int(max_length)):
+        logits = model(Tensor(ids))
+        lraw = logits._data[:, -1, :].astype(jnp.float32)
+        if decode_strategy == "greedy" or top_k == 1:
+            nxt = jnp.argmax(lraw, axis=-1).astype(jnp.int32)
+        else:   # sampling
+            lraw = lraw / max(float(temperature), 1e-6)
+            if top_k and top_k > 0:
+                kth = jax.lax.top_k(lraw, int(top_k))[0][:, -1:]
+                lraw = jnp.where(lraw < kth, -1e9, lraw)
+            nxt = jax.random.categorical(_gen.next_key(), lraw,
+                                         axis=-1).astype(jnp.int32)
+        if eos_token_id is not None:
+            # rows that already emitted eos are frozen to eos (reference
+            # GenerationMixin per-row finished semantics)
+            nxt = jnp.where(finished, jnp.asarray(eos_token_id,
+                                                  nxt.dtype), nxt)
+            finished = finished | (nxt == eos_token_id)
+        ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        if eos_token_id is not None and bool(jnp.all(finished)):
+            break
+    return Tensor(ids)
+
+
+GPTForCausalLM.generate = _gpt_generate

@@ -311,8 +311,9 @@ def _convert_eqn(g: _Graph, eqn):
         # to inputs that were already out of the table's range.
         mode_name = getattr(p.get("mode"), "name", str(p.get("mode")))
         if "PROMISE" not in mode_name.upper():
-            lo = g.add_const(np.asarray(0, np.int64))
-            hi = g.add_const(np.asarray(op_aval.shape[0] - 1, np.int64))
+            idt = np.dtype(idx_aval.dtype)   # Clip inputs must share T
+            lo = g.add_const(np.asarray(0, idt))
+            hi = g.add_const(np.asarray(op_aval.shape[0] - 1, idt))
             idx = g.emit("Clip", [idx, lo, hi])
         return out(g.emit("Gather", [ins[0], idx],
                           attrs=_attr_int("axis", 0)))

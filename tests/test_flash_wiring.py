@@ -257,3 +257,58 @@ def test_ring_flash_grad_raises_clearly():
             jax.grad(loss)(q)
     finally:
         dist.set_mesh(None)
+
+
+def test_gpt_generate_greedy_and_sampling():
+    """GPTForCausalLM.generate (PaddleNLP GenerationMixin capability):
+    greedy is deterministic and equals stepwise argmax; sampling with
+    top_k stays in the top-k support."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    import jax.numpy as jnp
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+    out = net.generate(ids, max_length=4)
+    assert tuple(out.shape) == (1, 7)
+    # greedy equals manual stepwise argmax
+    cur = ids.numpy()
+    for _ in range(4):
+        logits = net(paddle.to_tensor(cur.astype(np.int32))).numpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out.numpy(), cur)
+    paddle.seed(3)
+    s = net.generate(ids, max_length=4, decode_strategy="sampling",
+                     top_k=5)
+    assert tuple(s.shape) == (1, 7)
+    # every sampled token lies in the stepwise top-5 of the true logits
+    sn = s.numpy()
+    for t in range(3, 7):
+        logits = net(paddle.to_tensor(sn[:, :t].astype(np.int32))).numpy()
+        top5 = np.argsort(-logits[0, -1])[:5]
+        assert sn[0, t] in top5, (t, sn[0, t], top5)
+    with pytest.raises(ValueError, match="decode_strategy"):
+        net.generate(ids, max_length=2, decode_strategy="beam")
+
+
+def test_gpt_generate_per_row_eos_freeze():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(1)
+    cfg = GPTConfig(vocab_size=16, hidden_size=16, num_layers=1,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int32))
+    # find a token some row emits greedily, then use it as eos
+    first = net.generate(ids, max_length=1).numpy()[:, -1]
+    eos = int(first[0])
+    out = net.generate(ids, max_length=6, eos_token_id=eos).numpy()
+    # row 0 hit eos at step 1: every later token must stay eos
+    row0 = out[0, 2:]
+    hit = np.where(row0 == eos)[0]
+    assert hit.size and (row0[hit[0]:] == eos).all()
