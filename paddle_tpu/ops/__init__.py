@@ -112,9 +112,43 @@ def _attach_methods():
         # creation-ish
         "fill_": creation.fill_, "zero_": creation.zero_,
         "uniform_": creation.uniform_, "normal_": creation.normal_,
+        # round-5 tail (reference tensor/__init__.py method list)
+        "acos": m.acos, "asin": m.asin, "atan": m.atan,
+        "cosh": m.cosh, "sinh": m.sinh, "conj": m.conj,
+        "real": m.real, "imag": m.imag, "stanh": m.stanh,
+        "addmm": m.addmm, "tril": creation.tril, "triu": creation.triu,
+        "multinomial": creation.multinomial, "mul": m.multiply,
+        "floor_mod": m.mod, "reverse": mp.flip,
     }
     for name, fn in methods.items():
         setattr(Tensor, name, fn)
+
+    # shared implementations, not hand-rolled closures: the method and
+    # the free function must take the same dispatch path
+    Tensor.t = mp.t
+    Tensor.numel = creation.numel
+    Tensor.is_empty = m.is_empty
+
+    def _rank_m(self):
+        import numpy as _np
+        return creation.to_tensor(_np.asarray(self.ndim, _np.int32))
+    Tensor.rank = _rank_m
+
+    # inplace variants: functional result adopted onto the tape via
+    # _swap_payload (core/tensor.py contract — grads stay correct)
+    def _inplace_of(fn):
+        def method(self, *a, **k):
+            self._swap_payload(fn(self, *a, **k))
+            return self
+        return method
+    for iname, ifn in {
+            "add_": m.add, "subtract_": m.subtract, "scale_": m.scale,
+            "clip_": m.clip, "exp_": m.exp, "sqrt_": m.sqrt,
+            "rsqrt_": m.rsqrt, "reciprocal_": m.reciprocal,
+            "floor_": m.floor, "ceil_": m.ceil, "round_": m.round,
+            "tanh_": m.tanh, "flatten_": mp.flatten,
+            "scatter_": m.scatter}.items():
+        setattr(Tensor, iname, _inplace_of(ifn))
 
     # operator dunders
     def _rsub(x, y):

@@ -46,6 +46,13 @@ def transpose(x, perm, name=None):
 
 
 def t(x, name=None):
+    """reference: Tensor.t contract — 0/1-D pass through, 2-D transpose,
+    higher ranks raise (use transpose)."""
+    if len(x.shape) > 2:
+        raise ValueError(
+            f"t() expects a tensor with <= 2 dims, got {len(x.shape)} "
+            f"(reference Tensor.t contract); use transpose")
+
     def impl(a):
         if a.ndim < 2:
             return a

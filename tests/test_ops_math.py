@@ -379,3 +379,57 @@ def test_linalg_toplevel_and_tensor_namespace():
     assert paddle.tensor.cholesky is paddle.cholesky
     np.testing.assert_allclose(
         paddle.tensor.rank(paddle.to_tensor(a)).numpy(), 2)
+
+
+def test_tensor_method_parity_vs_reference():
+    """Every method-shaped name in the reference's tensor/__init__.py
+    resolves on Tensor (free creation functions excluded — they live at
+    the paddle top level and are covered by the top-level parity test)."""
+    import re
+    import numpy as np
+    import paddle_tpu as paddle
+
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    names = re.findall(r"from \.\w+ import (\w+)", src)
+    names += re.findall(r"^\s+'(\w+)',?\s*$", src, re.M)
+    free = {"arange", "array_length", "array_read", "array_write",
+            "create_array", "empty", "empty_like", "eye", "full",
+            "full_like", "linspace", "meshgrid", "ones", "ones_like",
+            "rand", "randint", "randn", "randperm", "set_printoptions",
+            "to_tensor", "zeros", "zeros_like", "normal", "uniform",
+            "standard_normal", "add_n", "diag", "is_tensor", "multiplex",
+            "concat", "stack", "broadcast_shape", "shard_index",
+            "scatter_nd", "increment", "is_empty"}
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    missing = sorted(set(n for n in names if not n.startswith("_")
+                         and n not in free and not hasattr(t, n)))
+    assert not missing, missing
+
+
+def test_tensor_method_tail_semantics():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(x.t().numpy(), x.numpy().T)
+    assert int(x.numel().numpy()) == 4
+    assert int(x.rank().numpy()) == 2
+    np.testing.assert_allclose(x.tril().numpy(), np.tril(x.numpy()))
+    np.testing.assert_allclose(
+        x.mul(paddle.to_tensor(np.float32(2.0))).numpy(), x.numpy() * 2)
+    np.testing.assert_allclose(x.reverse(axis=[0]).numpy(),
+                               x.numpy()[::-1])
+    import pytest
+    with pytest.raises(ValueError, match="t\\(\\) expects"):
+        paddle.to_tensor(np.ones((2, 2, 2), np.float32)).t()
+    # inplace variants stay on the tape
+    y = paddle.to_tensor(np.array([0.5, 1.5], np.float32),
+                         stop_gradient=False)
+    z = y * 2.0
+    z.sqrt_()
+    z.sum().backward()
+    ref = 2.0 * 0.5 / np.sqrt(np.array([1.0, 3.0]))
+    np.testing.assert_allclose(y.grad.numpy(), ref, rtol=1e-5)
+    w = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+    w.clip_(min=0.0)
+    np.testing.assert_allclose(w.numpy(), [1.0, 0.0])
