@@ -78,10 +78,21 @@ class GPTModel(Layer):
         self.decoder = TransformerEncoder(layer, c.num_layers,
                                           norm=LayerNorm(c.hidden_size))
 
-    def forward(self, input_ids, position_ids=None):
+    def gen_cache(self, input_ids):
+        """Per-layer incremental KV caches for autoregressive decoding
+        (reference: TransformerEncoder.gen_cache). The layer gen_cache
+        reads only batch size and dtype, so seed it from a single-token
+        embedding slice instead of embedding the whole prompt."""
+        h0 = self.word_embeddings(input_ids[:, :1])
+        return self.decoder.gen_cache(h0)
+
+    def forward(self, input_ids, position_ids=None, cache=None):
         seq_len = input_ids.shape[1]
         if position_ids is None:
-            position_ids = ops.arange(0, seq_len, dtype="int32")
+            # with a KV cache the new tokens sit AFTER the cached prefix
+            offset = int(cache[0].k.shape[2]) if cache is not None else 0
+            position_ids = ops.arange(offset, offset + seq_len,
+                                      dtype="int32")
             position_ids = ops.expand(ops.unsqueeze(position_ids, 0),
                                       [input_ids.shape[0], seq_len])
         h = (self.word_embeddings(input_ids)
@@ -89,9 +100,12 @@ class GPTModel(Layer):
         h = self.embedding_dropout(h)
         # causal mask as the CAUSAL_MASK sentinel: the flash path applies
         # causality inside the kernel, the dense path materialises the
-        # additive triu lazily (nn/transformer.py MultiHeadAttention)
+        # additive triu lazily with the cached-prefix offset
+        # (nn/transformer.py MultiHeadAttention)
         from ..nn.transformer import CAUSAL_MASK
-        return self.decoder(h, src_mask=CAUSAL_MASK)
+        if cache is None:
+            return self.decoder(h, src_mask=CAUSAL_MASK)
+        return self.decoder(h, src_mask=CAUSAL_MASK, cache=cache)
 
 
 class GPTForCausalLM(Layer):
@@ -101,10 +115,13 @@ class GPTForCausalLM(Layer):
         super().__init__()
         self.gpt = GPTModel(config)
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, cache=None):
+        out = self.gpt(input_ids, position_ids, cache=cache)
+        h, new_cache = out if cache is not None else (out, None)
         # logits = h @ E^T with the tied embedding matrix
-        return ops.matmul(h, self.gpt.word_embeddings.weight, transpose_y=True)
+        logits = ops.matmul(h, self.gpt.word_embeddings.weight,
+                            transpose_y=True)
+        return logits if cache is None else (logits, new_cache)
 
 
 class GPTPretrainingCriterion(Layer):
@@ -237,14 +254,17 @@ def gpt_pipeline_fns(model: "GPTForCausalLM", num_stages: int):
 
 
 def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
-                  top_k=1, temperature=1.0, eos_token_id=None):
+                  top_k=1, temperature=1.0, eos_token_id=None,
+                  use_cache=True):
     """Autoregressive decoding for GPTForCausalLM (reference capability:
     PaddleNLP GenerationMixin.generate — greedy / top-k sampling; the
     beam form lives in nn.BeamSearchDecoder/dynamic_decode).
 
-    Recomputes the full prefix each step (no KV cache): correct and
-    simple; the fixed-shape KV-cache fast path is the documented next
-    step. Returns ids [B, input_len + max_length]."""
+    ``use_cache=True`` (default) runs incremental decoding over the
+    per-layer KV caches (each step attends new token vs cached prefix —
+    O(T) work per token); ``use_cache=False`` recomputes the full prefix
+    each step (O(T^2), kept as the reference for testing). Returns ids
+    [B, input_len + max_length]."""
     import numpy as np
     from ..core import generator as _gen
     from ..core.tensor import Tensor
@@ -259,8 +279,15 @@ def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids), jnp.int32)
     finished = jnp.zeros((ids.shape[0],), jnp.bool_)
+    cache = None
+    if use_cache:
+        cache = model.gpt.gen_cache(Tensor(ids))
+    step_input = ids
     for _ in range(int(max_length)):
-        logits = model(Tensor(ids))
+        if use_cache:
+            logits, cache = model(Tensor(step_input), cache=cache)
+        else:
+            logits = model(Tensor(ids))
         lraw = logits._data[:, -1, :].astype(jnp.float32)
         if decode_strategy == "greedy" or top_k == 1:
             nxt = jnp.argmax(lraw, axis=-1).astype(jnp.int32)
@@ -278,6 +305,7 @@ def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
                                                   nxt.dtype), nxt)
             finished = finished | (nxt == eos_token_id)
         ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        step_input = nxt[:, None]          # cache path: one new token
         if eos_token_id is not None and bool(jnp.all(finished)):
             break
     return Tensor(ids)

@@ -130,8 +130,8 @@ def _attach_methods():
     Tensor.is_empty = m.is_empty
 
     def _rank_m(self):
-        import numpy as _np
-        return creation.to_tensor(_np.asarray(self.ndim, _np.int32))
+        from ..tensor import rank as _rank   # deferred: circular import
+        return _rank(self)
     Tensor.rank = _rank_m
 
     # inplace variants: functional result adopted onto the tape via

@@ -312,3 +312,28 @@ def test_gpt_generate_per_row_eos_freeze():
     row0 = out[0, 2:]
     hit = np.where(row0 == eos)[0]
     assert hit.size and (row0[hit[0]:] == eos).all()
+
+
+def test_gpt_generate_kv_cache_equals_recompute():
+    """use_cache=True (incremental KV decoding through the MHA cache +
+    position offsets) must be token-identical to full-prefix recompute."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    paddle.seed(2)
+    cfg = GPTConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    ids = paddle.to_tensor(np.array([[1, 2, 3], [4, 5, 6]], np.int32))
+    slow = net.generate(ids, max_length=6, use_cache=False).numpy()
+    fast = net.generate(ids, max_length=6, use_cache=True).numpy()
+    np.testing.assert_array_equal(slow, fast)
+    # cached forward returns (logits, new_cache) and grows the cache
+    cache = net.gpt.gen_cache(ids)
+    logits, cache = net(ids, cache=cache)
+    assert tuple(logits.shape) == (2, 3, 32)
+    assert int(cache[0].k.shape[2]) == 3
+    logits2, cache = net(paddle.to_tensor(
+        np.array([[7], [8]], np.int32)), cache=cache)
+    assert tuple(logits2.shape) == (2, 1, 32)
+    assert int(cache[0].k.shape[2]) == 4

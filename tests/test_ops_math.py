@@ -390,7 +390,14 @@ def test_tensor_method_parity_vs_reference():
     import paddle_tpu as paddle
 
     src = open("/root/reference/python/paddle/tensor/__init__.py").read()
-    names = re.findall(r"from \.\w+ import (\w+)", src)
+    names = []
+    for m in re.finditer(r"from \.\w+ import ([\w,\s]+)", src):
+        for n in m.group(1).split(","):
+            n = n.strip()
+            if " as " in n:          # `import flip as reverse`
+                n = n.split(" as ")[-1].strip()
+            if n:
+                names.append(n)
     names += re.findall(r"^\s+'(\w+)',?\s*$", src, re.M)
     free = {"arange", "array_length", "array_read", "array_write",
             "create_array", "empty", "empty_like", "eye", "full",
