@@ -26,11 +26,30 @@ import jax as _jax
 import os as _os
 if (int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
         and not _os.environ.get("_PADDLE_TPU_DIST_INITIALIZED")):
+    import time as _time
     _eps = _os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
-    _jax.distributed.initialize(
-        coordinator_address=(_eps[0] or None) if _eps else None,
-        num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
-        process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
+    # retry with backoff: workers race the coordinator at job start and must
+    # wait for it rather than fail fast. Inline (not utils.resilience): no
+    # paddle_tpu subpackage may load before this pre-backend bootstrap.
+    _deadline = _time.monotonic() + float(
+        _os.environ.get("PADDLE_TPU_INIT_TIMEOUT", "300"))
+    _delay = 1.0
+    while True:
+        try:
+            _jax.distributed.initialize(
+                coordinator_address=(_eps[0] or None) if _eps else None,
+                num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+                process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
+            break
+        except Exception as _e:
+            if _time.monotonic() >= _deadline:
+                raise RuntimeError(
+                    "jax.distributed.initialize did not come up within "
+                    "PADDLE_TPU_INIT_TIMEOUT="
+                    f"{_os.environ.get('PADDLE_TPU_INIT_TIMEOUT', '300')}s"
+                ) from _e
+            _time.sleep(min(_delay, max(0.0, _deadline - _time.monotonic())))
+            _delay = min(_delay * 2.0, 15.0)
     _os.environ["_PADDLE_TPU_DIST_INITIALIZED"] = "1"
 
 # float32 ops must be float32-accurate (the reference computes true fp32 unless

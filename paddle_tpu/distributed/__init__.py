@@ -22,6 +22,9 @@ from .collective import (  # noqa: F401
 from .parallel import (  # noqa: F401
     DataParallel, sync_params_buffers, shard_batch, build_global_batch,
 )
+from .elastic import (  # noqa: F401
+    PreemptionGuard, PREEMPTION_EXIT_CODE, under_elastic_supervisor,
+)
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,
     set_hybrid_communicate_group, get_hybrid_communicate_group,

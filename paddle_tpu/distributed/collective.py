@@ -130,21 +130,23 @@ def destroy_process_group(group=None):
 
 # -- mapped-context detection -------------------------------------------------
 
+def _axis_bound(name: str) -> bool:
+    """Is the mesh axis bound in the current (shard_map) trace?"""
+    try:
+        lax.axis_index(name)
+        return True
+    except NameError:
+        return False  # jax's signal for an unbound axis name
+    except Exception:
+        return False  # anything else equally means "not usable here"
+
+
 def _axes_in_scope() -> Tuple[str, ...]:
     """Mesh axes bound in the current (shard_map) trace."""
     m = _mesh.get_mesh()
     if m is None:
         return ()
-    found = []
-    for name in m.axis_names:
-        try:
-            lax.axis_index(name)
-            found.append(name)
-        except (NameError, Exception):
-            # jax raises NameError for unbound axis names; anything else
-            # equally means "not usable here"
-            pass
-    return tuple(found)
+    return tuple(name for name in m.axis_names if _axis_bound(name))
 
 
 def _resolve_axes(group: Group) -> Tuple[str, ...]:

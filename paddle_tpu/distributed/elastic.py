@@ -1,0 +1,126 @@
+"""Preemption-aware elastic training support.
+
+The trainer half of the elastic contract (supervisor half:
+``paddle_tpu.distributed.launch --elastic``; full contract:
+docs/fault_tolerance.md). A :class:`PreemptionGuard` arms SIGTERM/SIGINT so
+the training loop can observe "the platform wants this process gone", commit
+a final checkpoint, and exit with :data:`PREEMPTION_EXIT_CODE` — which the
+supervisor treats as "restart for free, don't burn the restart budget"
+(reference analog: EDL's auto-checkpoint + launch_utils watch loop, which
+only ever tears the whole job down; here preemption becomes a resumable
+event instead).
+
+Import-light on purpose: the guard must be usable before any backend touch.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Callable, Optional, Sequence
+
+#: Reserved exit code for "drained after preemption; resume me". Chosen
+#: outside the shell (126-128) and signal (128+N) ranges and unlikely to
+#: collide with user scripts. The elastic supervisor restarts this rank
+#: without counting it against --max_restarts.
+PREEMPTION_EXIT_CODE = 117
+
+#: Env var the elastic supervisor sets in every child so training loops can
+#: auto-arm a PreemptionGuard without code changes.
+ELASTIC_ENV_VAR = "PADDLE_TPU_ELASTIC"
+
+_DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+def under_elastic_supervisor() -> bool:
+    return bool(os.environ.get(ELASTIC_ENV_VAR))
+
+
+class PreemptionGuard:
+    """Signal-armed preemption flag for training loops.
+
+    ::
+
+        guard = PreemptionGuard()          # arms SIGTERM/SIGINT
+        for epoch in epochs:
+            train_one_epoch(...)
+            guard.exit_if_preempted(save_fn=lambda: ckpt.save(epoch))
+
+    The handler only sets a flag (async-signal-safe); all real work — the
+    final checkpoint, the exit — happens at the next poll point in the
+    training loop, so a preemption can never tear a half-written shard.
+    Previous handlers are chained, and :meth:`uninstall` restores them.
+    """
+
+    def __init__(self, signals: Sequence[int] = _DEFAULT_SIGNALS,
+                 install: bool = True):
+        self._signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self._installed = False
+        if install:
+            self.install()
+
+    # -- signal plumbing ----------------------------------------------------
+    def install(self):
+        if self._installed or threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._handler)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        self._event.set()
+        prev = self._prev.get(signum)
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(signum, frame)
+
+    # -- polling API --------------------------------------------------------
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+    def should_stop(self) -> bool:
+        return self.preempted
+
+    def preempt(self):
+        """Mark preemption programmatically (tests, cloud-notice pollers)."""
+        self._event.set()
+
+    def exit_if_preempted(self, save_fn: Optional[Callable[[], None]] = None,
+                          code: int = PREEMPTION_EXIT_CODE):
+        """At a safe point: if preempted, run ``save_fn`` (the final
+        checkpoint commit) and exit with the reserved resume code."""
+        if not self.preempted:
+            return
+        if save_fn is not None:
+            save_fn()
+        self.uninstall()
+        sys.exit(code)
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+def maybe_auto_guard(guard: Optional[PreemptionGuard]) -> Optional[PreemptionGuard]:
+    """Return ``guard``, or a fresh one when running under the elastic
+    supervisor (which sets :data:`ELASTIC_ENV_VAR` in every child)."""
+    if guard is not None:
+        return guard
+    if under_elastic_supervisor():
+        return PreemptionGuard()
+    return None

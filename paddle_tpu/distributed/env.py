@@ -69,6 +69,32 @@ class ParallelEnv:
         return self._endpoints
 
 
+def _initialize_distributed_with_retry(coordinator, num_processes,
+                                       process_id):
+    """``jax.distributed.initialize`` with backoff — workers racing the
+    coordinator at job start must wait for it, not fail fast. Total budget
+    from PADDLE_TPU_INIT_TIMEOUT (seconds, default 300)."""
+    from ..utils.resilience import Deadline, RetryError, retry_call
+
+    deadline = Deadline.from_env("PADDLE_TPU_INIT_TIMEOUT", 300.0)
+
+    def _attempt():
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+
+    try:
+        retry_call(_attempt, max_attempts=1000, backoff=1.0, max_backoff=15.0,
+                   deadline=deadline)
+    except RetryError as e:
+        raise RuntimeError(
+            f"jax.distributed.initialize(coordinator={coordinator}, "
+            f"num_processes={num_processes}, process_id={process_id}) did "
+            f"not come up within PADDLE_TPU_INIT_TIMEOUT="
+            f"{deadline.seconds}s") from (e.__cause__ or e)
+
+
 def init_parallel_env():
     """reference: distributed/parallel.py:60. Multi-host: initialize the JAX
     distributed runtime from the PADDLE_* env contract (normally already
@@ -81,10 +107,8 @@ def init_parallel_env():
         return env
     if env._world_size > 1:
         coordinator = env._endpoints[0] if env._endpoints[0] else None
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=env._world_size,
-            process_id=env._rank)
+        _initialize_distributed_with_retry(
+            coordinator, env._world_size, env._rank)
     _INITIALIZED[0] = True
     return env
 

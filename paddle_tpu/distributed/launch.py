@@ -13,6 +13,13 @@ reference launch scripts port unchanged; ``init_parallel_env`` turns it into
 standard layout is ONE process per host (XLA owns all local chips), so
 ``--nproc_per_node`` defaults to 1; multi-chip-per-process parallelism is
 mesh sharding, not process fan-out.
+
+``--elastic`` switches the watch loop from "any nonzero exit tears the job
+down" to a supervisor that restarts failed ranks with exponential backoff +
+jitter under a ``--max_restarts`` budget, treats
+:data:`~paddle_tpu.distributed.elastic.PREEMPTION_EXIT_CODE` as a free
+resume, tails the dead rank's workerlog for diagnosis, and drains children
+gracefully on SIGTERM/SIGINT (full contract: docs/fault_tolerance.md).
 """
 from __future__ import annotations
 
@@ -23,6 +30,8 @@ import subprocess
 import sys
 import time
 from typing import List, Optional
+
+from .elastic import PREEMPTION_EXIT_CODE, ELASTIC_ENV_VAR
 
 
 def _parse_args(argv=None):
@@ -42,6 +51,19 @@ def _parse_args(argv=None):
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("--devices", "--gpus", "--selected_devices", type=str,
                    default=None, dest="devices")
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise ranks: restart failures instead of "
+                        "tearing the job down (docs/fault_tolerance.md)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="crash-restart budget per job (preemption exits "
+                        "are free and do not consume it)")
+    p.add_argument("--grace_period", type=float, default=10.0,
+                   help="seconds between graceful-drain SIGTERM and SIGKILL")
+    p.add_argument("--restart_backoff", type=float,
+                   default=float(os.environ.get(
+                       "PADDLE_TPU_RESTART_BACKOFF", "1.0")),
+                   help="initial restart backoff in seconds (doubles per "
+                        "crash, +/-20%% jitter, capped at 30s)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -56,6 +78,45 @@ def get_cluster(ips: List[str], nproc_per_node: int, start_port: int):
     return endpoints
 
 
+def _spawn_rank(rank: int, local_rank: int, endpoints: List[str],
+                script: str, script_args: List[str],
+                log_dir: Optional[str] = None,
+                extra_env: Optional[dict] = None,
+                restart_num: int = 0):
+    """Spawn one trainer with the PADDLE_* env contract. Restarts append to
+    the same workerlog with a separator so the full history stays in one
+    file."""
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "FLAGS_selected_devices": str(local_rank),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_TPU_RESTART_NUM": str(restart_num),
+    })
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, "-u", script] + list(script_args)
+    log_path = None
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"workerlog.{rank}")
+        log_f = open(log_path, "a" if restart_num else "w")
+        if restart_num:
+            log_f.write(f"\n----- restart {restart_num} -----\n")
+            log_f.flush()
+        proc = subprocess.Popen(cmd, env=env, stdout=log_f, stderr=log_f)
+        proc._log_file = log_f
+    else:
+        proc = subprocess.Popen(cmd, env=env)
+    proc._rank = rank
+    proc._local_rank = local_rank
+    proc._log_path = log_path
+    return proc
+
+
 def start_local_trainers(endpoints: List[str], node_ips: List[str],
                          node_rank: int, nproc_per_node: int,
                          script: str, script_args: List[str],
@@ -63,45 +124,25 @@ def start_local_trainers(endpoints: List[str], node_ips: List[str],
                          extra_env: Optional[dict] = None):
     """Spawn this node's trainer processes with the PADDLE_* contract
     (reference: launch_utils.py:452)."""
-    procs = []
     base_rank = node_rank * nproc_per_node
-    for local_rank in range(nproc_per_node):
-        rank = base_rank + local_rank
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
-            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "FLAGS_selected_devices": str(local_rank),
-            "PADDLE_LOCAL_RANK": str(local_rank),
-        })
-        if extra_env:
-            env.update(extra_env)
-        cmd = [sys.executable, "-u", script] + list(script_args)
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            log_f = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
-            proc = subprocess.Popen(cmd, env=env, stdout=log_f, stderr=log_f)
-            proc._log_file = log_f
-        else:
-            proc = subprocess.Popen(cmd, env=env)
-        proc._rank = rank
-        procs.append(proc)
-    return procs
+    return [_spawn_rank(base_rank + lr, lr, endpoints, script, script_args,
+                        log_dir, extra_env)
+            for lr in range(nproc_per_node)]
 
 
-def terminate_local_procs(procs):
-    """SIGTERM then SIGKILL (reference: launch_utils.py:308)."""
+def terminate_local_procs(procs, grace_period: float = 5.0):
+    """SIGTERM, wait up to ``grace_period``, then SIGKILL
+    (reference: launch_utils.py:308)."""
     for p in procs:
         if p.poll() is None:
             p.terminate()
-    deadline = time.time() + 5
+    deadline = time.time() + grace_period
     for p in procs:
         try:
             p.wait(timeout=max(0.1, deadline - time.time()))
         except subprocess.TimeoutExpired:
             p.kill()
+            p.wait()
     for p in procs:
         f = getattr(p, "_log_file", None)
         if f:
@@ -128,16 +169,162 @@ def watch_local_trainers(procs) -> int:
     return 0
 
 
+def _tail_log(path: Optional[str], lines: int = 40) -> str:
+    if not path or not os.path.exists(path):
+        return ""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 64 * 1024))
+            data = f.read().decode("utf-8", errors="replace")
+        return "\n".join(data.splitlines()[-lines:])
+    except OSError as e:
+        return f"<could not read {path}: {e}>"
+
+
+class ElasticSupervisor:
+    """Restart failed ranks instead of tearing the job down.
+
+    Semantics (docs/fault_tolerance.md):
+
+    - exit 0            → rank done, not restarted
+    - PREEMPTION_EXIT_CODE → graceful drain; restart for free
+    - other nonzero     → crash; restart with exponential backoff + jitter
+      while the shared ``max_restarts`` budget lasts, else tear down and
+      propagate that exit code
+    - SIGTERM/SIGINT on the supervisor → forward SIGTERM to children
+      (their PreemptionGuard commits a final checkpoint), wait
+      ``grace_period``, SIGKILL stragglers
+    """
+
+    def __init__(self, endpoints, script, script_args, log_dir=None,
+                 max_restarts=3, grace_period=10.0, restart_backoff=1.0,
+                 extra_env=None, poll_interval=0.2, sleep=time.sleep,
+                 node_rank=0, nproc_per_node=None):
+        self.endpoints = endpoints
+        self.node_rank = int(node_rank)
+        self.nproc_per_node = (len(endpoints) if nproc_per_node is None
+                               else int(nproc_per_node))
+        self.script = script
+        self.script_args = script_args
+        self.log_dir = log_dir
+        self.max_restarts = int(max_restarts)
+        self.grace_period = float(grace_period)
+        self.backoff0 = float(restart_backoff)
+        self.poll_interval = poll_interval
+        self._sleep = sleep
+        self.extra_env = dict(extra_env or {})
+        self.extra_env.setdefault(ELASTIC_ENV_VAR, "1")
+        self.restarts_used = 0
+        self._drain = False
+        self._restart_counts = {}   # rank -> total respawns (incl. free)
+
+    def request_drain(self, signum=None, frame=None):
+        self._drain = True
+
+    def _respawn(self, dead):
+        rank = dead._rank
+        f = getattr(dead, "_log_file", None)
+        if f:
+            f.close()
+        n = self._restart_counts.get(rank, 0) + 1
+        self._restart_counts[rank] = n
+        return _spawn_rank(rank, dead._local_rank, self.endpoints,
+                           self.script, self.script_args, self.log_dir,
+                           self.extra_env, restart_num=n)
+
+    def _backoff_pause(self):
+        import random
+        delay = min(self.backoff0 * (2 ** max(0, self.restarts_used - 1)),
+                    30.0)
+        return delay * (1.0 + 0.2 * (2.0 * random.random() - 1.0))
+
+    def run(self) -> int:
+        alive = start_local_trainers(
+            self.endpoints, None, self.node_rank, self.nproc_per_node,
+            self.script, self.script_args, self.log_dir, self.extra_env)
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, self.request_drain)
+        try:
+            while alive:
+                if self._drain:
+                    sys.stderr.write(
+                        "elastic supervisor: draining "
+                        f"{len(alive)} rank(s) (grace "
+                        f"{self.grace_period}s)\n")
+                    terminate_local_procs(alive, self.grace_period)
+                    return 1
+                self._sleep(self.poll_interval)
+                for p in list(alive):
+                    ret = p.poll()
+                    if ret is None:
+                        continue
+                    alive.remove(p)
+                    f = getattr(p, "_log_file", None)
+                    if f:
+                        f.close()
+                    if ret == 0:
+                        continue
+                    tail = _tail_log(p._log_path)
+                    if tail:
+                        sys.stderr.write(
+                            f"----- workerlog.{p._rank} (tail) -----\n"
+                            f"{tail}\n----- end workerlog.{p._rank} -----\n")
+                    if ret == PREEMPTION_EXIT_CODE:
+                        sys.stderr.write(
+                            f"rank {p._rank} drained after preemption "
+                            f"(exit {ret}); restarting (free — budget "
+                            f"{self.max_restarts - self.restarts_used} "
+                            f"left)\n")
+                        alive.append(self._respawn(p))
+                        continue
+                    if self.restarts_used >= self.max_restarts:
+                        sys.stderr.write(
+                            f"rank {p._rank} exited with code {ret}; "
+                            f"restart budget ({self.max_restarts}) "
+                            f"exhausted — terminating the job\n")
+                        terminate_local_procs(alive, self.grace_period)
+                        return ret
+                    self.restarts_used += 1
+                    pause = self._backoff_pause()
+                    sys.stderr.write(
+                        f"rank {p._rank} exited with code {ret}; "
+                        f"restarting in {pause:.2f}s "
+                        f"({self.restarts_used}/{self.max_restarts} "
+                        f"restarts used)\n")
+                    self._sleep(pause)
+                    if self._drain:
+                        break
+                    alive.append(self._respawn(p))
+            return 0
+        finally:
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+            terminate_local_procs(alive, self.grace_period)
+
+
 def launch(argv=None) -> int:
     args = _parse_args(argv)
     ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
     endpoints = get_cluster(ips, args.nproc_per_node, args.start_port)
+
+    if args.elastic:
+        sup = ElasticSupervisor(
+            endpoints, args.training_script, args.training_script_args,
+            log_dir=args.log_dir, max_restarts=args.max_restarts,
+            grace_period=args.grace_period,
+            restart_backoff=args.restart_backoff,
+            node_rank=args.node_rank, nproc_per_node=args.nproc_per_node)
+        return sup.run()
+
     procs = start_local_trainers(
         endpoints, ips, args.node_rank, args.nproc_per_node,
         args.training_script, args.training_script_args, args.log_dir)
 
     def _sig(_signum, _frame):
-        terminate_local_procs(procs)
+        terminate_local_procs(procs, args.grace_period)
         sys.exit(1)
 
     signal.signal(signal.SIGTERM, _sig)

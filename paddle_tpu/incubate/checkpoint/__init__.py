@@ -15,5 +15,6 @@ re-shards them onto the *current* mesh (which may have a different
 topology — resharding on restore). Async mode moves the device→host fetch
 and file write off the training thread (the orbax-style pattern).
 """
-from .sharded import (save_sharded, load_sharded, AsyncSaver)  # noqa: F401
+from .sharded import (save_sharded, load_sharded, AsyncSaver,  # noqa: F401
+                      CheckpointIntegrityError, verify_checkpoint)
 from .auto_checkpoint import TrainEpochRange, train_epoch_range  # noqa: F401

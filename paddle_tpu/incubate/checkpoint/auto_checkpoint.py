@@ -11,15 +11,26 @@ registered model/optimizer state plus the epoch counter; storage goes
 through the FS facade so a LocalFS path and an HDFS-shaped path behave the
 same. A killed job rebuilt with the same name resumes at the next
 unfinished epoch with identical state.
+
+Fault-tolerance contract (docs/fault_tolerance.md): restore verifies shard
+checksums and falls back to the newest *intact* committed epoch when the
+referenced one is corrupt or half-deleted; orphaned partial epoch dirs
+(from a crash mid-save) are garbage-collected at startup; under the elastic
+launcher the epoch loop polls a PreemptionGuard and, on preemption, commits
+a final checkpoint and exits with the reserved resume code.
 """
 from __future__ import annotations
 
 import json
 import os
 import shutil
+import sys
+import warnings
 from typing import Optional
 
-from .sharded import save_sharded, load_sharded, AsyncSaver
+from .sharded import (save_sharded, load_sharded, AsyncSaver,
+                      CheckpointIntegrityError)
+from ...utils.resilience import fault_injector
 
 
 def _default_root():
@@ -32,6 +43,13 @@ def _job_id():
     return os.environ.get("PADDLE_JOB_ID", "default_job")
 
 
+def _epoch_no(name: str) -> Optional[int]:
+    """epoch_<N> -> N, or None for malformed names (stray tmp/partial dirs
+    left by a crash must never abort the commit/GC path)."""
+    suffix = name.split("_", 1)[1] if name.startswith("epoch_") else ""
+    return int(suffix) if suffix.isdigit() else None
+
+
 class TrainEpochRange:
     """Iterate epochs with automatic save/restore.
 
@@ -42,12 +60,18 @@ class TrainEpochRange:
             train_one_epoch(...)
         # kill + rerun: the loop resumes at the first unfinished epoch
         # with model/optimizer state restored.
+
+    Under ``launch --elastic`` (PADDLE_TPU_ELASTIC set) a PreemptionGuard is
+    armed automatically: SIGTERM makes the loop commit a final checkpoint at
+    the next epoch boundary and exit with PREEMPTION_EXIT_CODE, which the
+    supervisor restarts without burning the restart budget. Pass
+    ``preemption_guard=`` to share an explicitly-armed guard.
     """
 
     def __init__(self, max_epoch_num: int, name: Optional[str] = None,
                  model=None, optimizer=None, checkpoint_path: Optional[str] = None,
                  save_checkpoint_inter: int = 1, async_save: bool = False,
-                 keep_last: int = 2):
+                 keep_last: int = 2, preemption_guard=None):
         self.max_epoch_num = int(max_epoch_num)
         self.name = name or _job_id()
         self._model = model
@@ -57,7 +81,10 @@ class TrainEpochRange:
         self._inter = max(1, int(save_checkpoint_inter))
         self._keep_last = keep_last
         self._saver = AsyncSaver() if async_save else None
+        from ...distributed.elastic import maybe_auto_guard
+        self._guard = maybe_auto_guard(preemption_guard)
         self.restored_epoch = -1
+        self._last_saved = -1
         self._restore()
 
     # -- persistence --------------------------------------------------------
@@ -75,24 +102,61 @@ class TrainEpochRange:
             state["optimizer"] = dict(self._optimizer.state_dict())
         return state
 
-    def _restore(self):
+    def _committed_epoch(self) -> int:
         sp = self._status_path()
         if not os.path.exists(sp):
+            return -1
+        try:
+            with open(sp) as f:
+                status = json.load(f)
+            return int(status.get("epoch_no", -1))
+        except (ValueError, OSError):
+            # torn status.json (should not happen: tmp+replace) — treat as
+            # no commit rather than killing the restart
+            return -1
+
+    def _gc_orphans(self, committed: int):
+        """Remove partial epoch dirs newer than the committed epoch — debris
+        from a save that died before its commit (startup only, so this can
+        never race an in-flight async save)."""
+        if not os.path.isdir(self._dir):
             return
-        with open(sp) as f:
-            status = json.load(f)
-        epoch = int(status.get("epoch_no", -1))
-        if epoch < 0:
+        for name in os.listdir(self._dir):
+            e = _epoch_no(name)
+            if e is not None and e > committed:
+                shutil.rmtree(os.path.join(self._dir, name),
+                              ignore_errors=True)
+
+    def _restore(self):
+        committed = self._committed_epoch()
+        self._gc_orphans(committed)
+        if committed < 0:
             return
-        ckpt = self._epoch_dir(epoch)
-        if not os.path.isdir(ckpt):
+        # newest intact committed epoch: the referenced one first, then any
+        # older surviving epoch dirs (corruption/half-deletion fallback)
+        candidates = sorted(
+            {e for e in (_epoch_no(n) for n in os.listdir(self._dir))
+             if e is not None and e <= committed},
+            reverse=True)
+        for epoch in candidates:
+            ckpt = self._epoch_dir(epoch)
+            if not os.path.isdir(ckpt):
+                continue
+            try:
+                state = load_sharded(ckpt)
+            except (CheckpointIntegrityError, OSError, ValueError,
+                    KeyError) as e:
+                warnings.warn(
+                    f"auto_checkpoint: epoch {epoch} checkpoint at {ckpt} "
+                    f"is not intact ({e}); falling back to an older epoch")
+                continue
+            if self._model is not None and "model" in state:
+                self._model.set_state_dict(state["model"])
+            if self._optimizer is not None and "optimizer" in state:
+                self._optimizer.set_state_dict(state["optimizer"])
+            self.restored_epoch = epoch
+            self._last_saved = epoch
             return
-        state = load_sharded(ckpt)
-        if self._model is not None and "model" in state:
-            self._model.set_state_dict(state["model"])
-        if self._optimizer is not None and "optimizer" in state:
-            self._optimizer.set_state_dict(state["optimizer"])
-        self.restored_epoch = epoch
 
     def _commit(self, epoch: int):
         # status.json is written only after the shard files exist, so a
@@ -109,6 +173,7 @@ class TrainEpochRange:
 
     def save(self, epoch: int):
         ckpt = self._epoch_dir(epoch)
+        self._last_saved = epoch
         if self._saver is not None:
             # async: the fetch+write AND the status commit happen on the
             # background thread — training overlaps the whole save, and
@@ -123,9 +188,9 @@ class TrainEpochRange:
         if self._keep_last is None:
             return
         for name in os.listdir(self._dir):
-            if not name.startswith("epoch_"):
+            e = _epoch_no(name)
+            if e is None:
                 continue
-            e = int(name.split("_", 1)[1])
             if e <= current - self._keep_last * self._inter:
                 shutil.rmtree(os.path.join(self._dir, name),
                               ignore_errors=True)
@@ -138,13 +203,26 @@ class TrainEpochRange:
         if self._saver is not None:
             self._saver.wait()
 
+    def _poll_preemption(self, epoch: int):
+        if self._guard is None or not self._guard.preempted:
+            return
+        if self._last_saved < epoch:
+            self.save(epoch)
+        self.wait()  # the final checkpoint must be committed before exit
+        self._guard.exit_if_preempted()
+
     def __iter__(self):
         try:
             for epoch in range(self.restored_epoch + 1, self.max_epoch_num):
+                # fault site "epoch": PADDLE_TPU_FAULT_SPEC="epoch:N:crash"
+                # hard-kills the Nth iteration of this process, mid-epoch
+                # from the checkpoint's point of view
+                fault_injector().fire("epoch")
                 yield epoch
                 if ((epoch + 1) % self._inter == 0
                         or epoch == self.max_epoch_num - 1):
                     self.save(epoch)
+                self._poll_preemption(epoch)
         finally:
             self.wait()  # don't exit with an uncommitted in-flight save
 

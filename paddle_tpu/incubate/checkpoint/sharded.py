@@ -3,9 +3,18 @@
 Layout of a checkpoint directory::
 
     ckpt/
-      metadata_<proc>.json   # per-host: key -> {shape, dtype, spec, shards}
+      metadata_<proc>.json   # per-host: format 2 doc (see below)
       shards_<proc>.npz      # per-host: "<key>|<i>" -> shard ndarray
       scalars.json           # non-array leaves (ints, floats, strings)
+
+``metadata_<proc>.json`` (format 2) is
+``{"format": 2, "checksums": {"shards_<proc>.npz": "<sha256>"},
+"entries": {key: {shape, dtype, spec, shards}}}``; format-1 checkpoints
+(a bare ``{key: entry}`` map, no checksums) still load. The checksum is
+verified on load — a flipped bit or truncated shard archive raises
+:class:`CheckpointIntegrityError` instead of silently restoring garbage,
+and ``TrainEpochRange._restore`` uses that signal to fall back to the
+newest intact committed epoch (docs/fault_tolerance.md).
 
 Multi-host jobs write only addressable shards (parallel, no cross-host
 traffic); load expects all hosts' files on a shared filesystem (the
@@ -14,6 +23,7 @@ fleet/utils/fs.py).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -24,6 +34,20 @@ import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from ...utils.resilience import fault_injector
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint directory is torn: checksum mismatch or a shard archive
+    referenced by metadata is missing."""
+
+
+def _sha256_of(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 def _flatten(tree, prefix=""):
@@ -100,20 +124,95 @@ def save_sharded(state, path: str, overwrite: bool = True):
         meta[key] = entry
     tmp = os.path.join(path, f".tmp_shards_{proc}.npz")
     np.savez(tmp, **shard_blobs)
-    os.replace(tmp, os.path.join(path, f"shards_{proc}.npz"))
-    with open(os.path.join(path, f"metadata_{proc}.json"), "w") as f:
-        json.dump(meta, f)
+    shards_name = f"shards_{proc}.npz"
+    os.replace(tmp, os.path.join(path, shards_name))
+    # fault site "save": a crash here leaves shard archives without
+    # metadata — exactly the torn-checkpoint shape _restore must survive
+    fault_injector().fire("save")
+    doc = {"format": 2,
+           "checksums": {shards_name: _sha256_of(
+               os.path.join(path, shards_name))},
+           "entries": meta}
+    mtmp = os.path.join(path, f".tmp_metadata_{proc}.json")
+    with open(mtmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(mtmp, os.path.join(path, f"metadata_{proc}.json"))
     if proc == 0:
         with open(os.path.join(path, "scalars.json"), "w") as f:
             json.dump(scalars, f)
 
 
-def load_sharded(path: str, mesh=None, return_tensor: bool = True):
+def _corrupt_first_shard_file(path: str):
+    """FaultInjector ``load:N:corrupt`` action: flip one byte near the end
+    of the first shard archive (deterministic torn-checkpoint simulation)."""
+    for fn in sorted(os.listdir(path)):
+        if fn.startswith("shards_") and fn.endswith(".npz"):
+            full = os.path.join(path, fn)
+            with open(full, "r+b") as f:
+                f.seek(-1, os.SEEK_END)
+                byte = f.read(1)
+                f.seek(-1, os.SEEK_END)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            return
+
+
+def verify_checkpoint(path: str):
+    """Checksum-verify a checkpoint directory without loading it.
+
+    Raises :class:`CheckpointIntegrityError` when a shard archive referenced
+    by a format-2 metadata file is missing or fails its sha256; format-1
+    metadata (no checksums) only gets the existence check. Also fails when
+    the directory has shard archives but no metadata at all (a save that
+    died between the two writes)."""
+    if not os.path.isdir(path):
+        raise CheckpointIntegrityError(f"{path} is not a directory")
+    names = os.listdir(path)
+    meta_files = [n for n in names if n.startswith("metadata_")]
+    shard_files = [n for n in names if n.startswith("shards_")
+                   and n.endswith(".npz")]
+    if not meta_files:
+        raise CheckpointIntegrityError(
+            f"{path}: no metadata_*.json "
+            f"({'shards present — torn save' if shard_files else 'empty'})")
+    for fn in sorted(meta_files):
+        with open(os.path.join(path, fn)) as f:
+            m = json.load(f)
+        proc = fn[len("metadata_"):-len(".json")]
+        expect = (m.get("checksums", {}) if m.get("format") == 2
+                  else {f"shards_{proc}.npz": None})
+        for shards_name, digest in expect.items():
+            full = os.path.join(path, shards_name)
+            if not os.path.exists(full):
+                raise CheckpointIntegrityError(
+                    f"{path}: {fn} references missing {shards_name}")
+            if digest is not None and _sha256_of(full) != digest:
+                raise CheckpointIntegrityError(
+                    f"{path}: checksum mismatch for {shards_name} "
+                    f"(expected {digest[:12]}…)")
+
+
+def _meta_entries(m):
+    """Entries map from a format-2 doc or a legacy format-1 bare map."""
+    if isinstance(m, dict) and m.get("format") == 2:
+        return m["entries"]
+    return m
+
+
+def load_sharded(path: str, mesh=None, return_tensor: bool = True,
+                 verify: bool = True):
     """Load a sharded checkpoint, reassembling global arrays from every
     host's shard files and (when ``mesh`` is given) re-sharding each array
     onto the current mesh using its recorded PartitionSpec — axes missing
-    from the new mesh degrade to replication (resharding on restore)."""
+    from the new mesh degrade to replication (resharding on restore).
+
+    ``verify=True`` (default) checksum-verifies every shard archive first
+    and raises :class:`CheckpointIntegrityError` on a torn checkpoint."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if fault_injector().fire("load") == "corrupt":
+        _corrupt_first_shard_file(path)
+    if verify:
+        verify_checkpoint(path)
 
     metas = {}
     for fn in sorted(os.listdir(path)):
@@ -121,7 +220,7 @@ def load_sharded(path: str, mesh=None, return_tensor: bool = True):
             with open(os.path.join(path, fn)) as f:
                 m = json.load(f)
             proc = fn[len("metadata_"):-len(".json")]
-            for k, v in m.items():
+            for k, v in _meta_entries(m).items():
                 metas.setdefault(k, []).append((proc, v))
     blobs = {}
     for fn in sorted(os.listdir(path)):

@@ -1,6 +1,8 @@
 """paddle.utils (reference: python/paddle/utils/)."""
 from . import download  # noqa: F401
+from . import resilience  # noqa: F401
 from .download import get_weights_path_from_url  # noqa: F401
+from .resilience import retry, retry_call, Deadline, FaultInjector  # noqa: F401
 
 
 def try_import(module_name, err_msg=None):

@@ -14,8 +14,12 @@ import shutil
 import tarfile
 import zipfile
 
+from .resilience import RetryError, retry_call
+
 WEIGHTS_HOME = os.path.expanduser(
     os.environ.get("PADDLE_TPU_HOME", "~/.cache/paddle_tpu"))
+
+DOWNLOAD_RETRIES = int(os.environ.get("PADDLE_TPU_DOWNLOAD_RETRIES", "3"))
 
 
 class DownloadError(RuntimeError):
@@ -39,15 +43,23 @@ def _download(url, path, md5sum=None):
     if os.path.exists(fullname) and _md5check(fullname, md5sum):
         return fullname
     import urllib.request
-    try:
+
+    def _fetch():
         tmp = fullname + ".tmp"
         urllib.request.urlretrieve(url, tmp)
         os.replace(tmp, fullname)
-    except Exception as e:
+
+    try:
+        # transient network errors get 3 attempts with exponential backoff
+        # (resilience.retry_call) before becoming a permanent DownloadError
+        retry_call(_fetch, max_attempts=DOWNLOAD_RETRIES, backoff=0.5)
+    except RetryError as e:
+        cause = e.__cause__ or e
         raise DownloadError(
-            f"failed to download {url}: {e!r}. This environment may have "
-            f"no network egress — fetch the file manually and pass its "
-            f"path (data_file=/path) or place it at {fullname}") from e
+            f"failed to download {url} after {DOWNLOAD_RETRIES} attempts: "
+            f"{cause!r}. This environment may have no network egress — "
+            f"fetch the file manually and pass its path (data_file=/path) "
+            f"or place it at {fullname}") from cause
     if not _md5check(fullname, md5sum):
         raise DownloadError(f"md5 mismatch for {fullname}")
     return fullname

@@ -1,0 +1,214 @@
+"""Retry/backoff, deadlines, and deterministic fault injection.
+
+The substrate for the elastic fault-tolerance runtime (see
+docs/fault_tolerance.md): transient-error retry with exponential backoff +
+jitter, wall-clock deadlines, and an env-driven ``FaultInjector`` that lets
+tests kill trainers and corrupt checkpoints at exact, reproducible points.
+
+Pure stdlib on purpose — this module is imported from the pre-backend
+bootstrap path and from the launcher supervisor, neither of which may touch
+JAX.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import random
+import sys
+import time
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted; ``__cause__`` is the last exception."""
+
+
+class DeadlineExceeded(TimeoutError):
+    pass
+
+
+class FaultInjected(RuntimeError):
+    """Raised by FaultInjector for the ``raise`` action."""
+
+
+#: exit code of a FaultInjector ``crash`` action — a simulated hard crash;
+#: the elastic supervisor counts it against the restart budget.
+FAULT_CRASH_EXIT_CODE = 43
+
+
+class Deadline:
+    """A wall-clock budget. ``clock`` is injectable so tests never sleep."""
+
+    def __init__(self, seconds: Optional[float], clock=time.monotonic):
+        self._clock = clock
+        self.seconds = None if seconds is None else float(seconds)
+        self._t0 = clock()
+
+    @classmethod
+    def from_env(cls, var: str, default: Optional[float] = None, **kw):
+        raw = os.environ.get(var)
+        return cls(float(raw) if raw not in (None, "") else default, **kw)
+
+    def remaining(self) -> float:
+        if self.seconds is None:
+            return float("inf")
+        return self.seconds - (self._clock() - self._t0)
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def check(self, what: str = "operation"):
+        if self.expired():
+            raise DeadlineExceeded(
+                f"{what} exceeded its {self.seconds}s deadline")
+
+
+def retry_call(fn: Callable, args=(), kwargs=None, *,
+               max_attempts: int = 3,
+               backoff: float = 0.5,
+               multiplier: float = 2.0,
+               max_backoff: float = 30.0,
+               jitter: float = 0.1,
+               retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+               deadline: Optional[Deadline] = None,
+               sleep=time.sleep,
+               rng=random.random,
+               on_retry: Optional[Callable] = None):
+    """Call ``fn`` with exponential backoff + jitter between failures.
+
+    Attempts stop at ``max_attempts`` (or when ``deadline`` expires, if one
+    is given) and the last exception is re-raised wrapped in
+    :class:`RetryError`. ``sleep``/``rng`` are injectable so unit tests run
+    with a fake clock and deterministic jitter.
+    """
+    kwargs = kwargs or {}
+    delay = backoff
+    last = None
+    for attempt in range(1, max(1, int(max_attempts)) + 1):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:  # noqa: PERF203 — the whole point
+            last = e
+            out_of_time = deadline is not None and deadline.expired()
+            if attempt >= max_attempts or out_of_time:
+                break
+            pause = delay * (1.0 + jitter * (2.0 * rng() - 1.0))
+            if deadline is not None:
+                pause = min(pause, max(0.0, deadline.remaining()))
+            if on_retry is not None:
+                on_retry(attempt, e, pause)
+            sleep(pause)
+            delay = min(delay * multiplier, max_backoff)
+    raise RetryError(
+        f"{getattr(fn, '__name__', fn)} failed after {attempt} "
+        f"attempt(s): {last!r}") from last
+
+
+def retry(max_attempts: int = 3, backoff: float = 0.5, multiplier: float = 2.0,
+          max_backoff: float = 30.0, jitter: float = 0.1,
+          retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+          sleep=time.sleep, rng=random.random,
+          on_retry: Optional[Callable] = None):
+    """Decorator form of :func:`retry_call`::
+
+        @retry(max_attempts=3, backoff=0.5)
+        def fetch(): ...
+    """
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(
+                fn, args, kwargs, max_attempts=max_attempts, backoff=backoff,
+                multiplier=multiplier, max_backoff=max_backoff, jitter=jitter,
+                retry_on=retry_on, sleep=sleep, rng=rng, on_retry=on_retry)
+        return wrapper
+    return decorator
+
+
+# -- fault injection ----------------------------------------------------------
+
+class FaultInjector:
+    """Deterministic, env-driven fault injection for resilience tests.
+
+    Spec grammar (``PADDLE_TPU_FAULT_SPEC``)::
+
+        spec     := rule ("," rule)*
+        rule     := site ":" occurrence ":" action
+        site     := identifier           # e.g. epoch, step, save, load
+        occurrence := positive integer   # 1-based count of fire(site) calls
+        action   := "crash" | "raise" | anything  # others returned verbatim
+
+    Example: ``epoch:3:crash,load:1:corrupt`` — hard-exit the process (code
+    :data:`FAULT_CRASH_EXIT_CODE`) on the third ``fire("epoch")`` of this
+    process, and hand the string ``"corrupt"`` back to the first
+    ``fire("load")`` caller (the checkpoint loader corrupts a shard file and
+    then proceeds, so checksum verification can be exercised end to end).
+
+    Counters are per-process: a restarted trainer starts counting from zero
+    again, which is exactly what makes "crash once, then succeed" scenarios
+    expressible with a single rule.
+    """
+
+    def __init__(self, spec: Optional[str] = None):
+        if spec is None:
+            spec = os.environ.get("PADDLE_TPU_FAULT_SPEC", "")
+        self._rules = {}   # site -> list of (occurrence, action)
+        self._counts = {}  # site -> fires so far
+        for rule in spec.split(","):
+            rule = rule.strip()
+            if not rule:
+                continue
+            parts = rule.split(":")
+            if len(parts) != 3 or not parts[1].isdigit():
+                raise ValueError(
+                    f"bad PADDLE_TPU_FAULT_SPEC rule {rule!r}; expected "
+                    f"site:occurrence:action (e.g. epoch:2:crash)")
+            site, occ, action = parts[0], int(parts[1]), parts[2]
+            self._rules.setdefault(site, []).append((occ, action))
+
+    def armed(self, site: Optional[str] = None) -> bool:
+        if site is None:
+            return bool(self._rules)
+        return site in self._rules
+
+    def fire(self, site: str) -> Optional[str]:
+        """Count one occurrence of ``site``; execute/return a matching rule.
+
+        ``crash`` → ``os._exit(FAULT_CRASH_EXIT_CODE)`` (no cleanup, like a
+        real kill). ``raise`` → raises :class:`FaultInjected`. Any other
+        action string is returned for the call site to interpret
+        (e.g. ``corrupt``). Returns None when no rule matches.
+        """
+        if site not in self._rules:
+            return None
+        self._counts[site] = self._counts.get(site, 0) + 1
+        n = self._counts[site]
+        for occ, action in self._rules[site]:
+            if occ != n:
+                continue
+            if action == "crash":
+                sys.stderr.write(
+                    f"[FaultInjector] crash at {site}:{n}\n")
+                sys.stderr.flush()
+                os._exit(FAULT_CRASH_EXIT_CODE)
+            if action == "raise":
+                raise FaultInjected(f"injected fault at {site}:{n}")
+            return action
+        return None
+
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def fault_injector() -> FaultInjector:
+    """The process-wide injector, parsed once from the environment."""
+    global _INJECTOR
+    if _INJECTOR is None:
+        _INJECTOR = FaultInjector()
+    return _INJECTOR
+
+
+def _reset_fault_injector_for_tests():
+    global _INJECTOR
+    _INJECTOR = None

@@ -1,0 +1,383 @@
+"""Fault-tolerance runtime unit tests (docs/fault_tolerance.md):
+retry/backoff with an injectable clock, deadlines, deterministic fault
+injection, checksum-verified checkpoint load, corrupt-shard fallback in
+TrainEpochRange, and graceful-drain exit codes. The end-to-end elastic
+launcher proof lives in test_elastic_launch.py."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as optim
+from paddle_tpu.incubate.checkpoint import (
+    save_sharded, load_sharded, TrainEpochRange,
+    CheckpointIntegrityError, verify_checkpoint)
+from paddle_tpu.utils.resilience import (
+    retry, retry_call, RetryError, Deadline, DeadlineExceeded,
+    FaultInjector, FaultInjected, FAULT_CRASH_EXIT_CODE)
+from paddle_tpu.distributed.elastic import (
+    PreemptionGuard, PREEMPTION_EXIT_CODE)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failures_no_real_sleep(self):
+        clock = FakeClock()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry_call(flaky, max_attempts=5, backoff=0.5, jitter=0.0,
+                         sleep=clock.sleep)
+        assert out == "ok" and len(calls) == 3
+        assert clock.sleeps == [0.5, 1.0]  # exponential, no jitter
+
+    def test_exhaustion_raises_retry_error_with_cause(self):
+        clock = FakeClock()
+
+        def always():
+            raise ValueError("nope")
+
+        with pytest.raises(RetryError) as ei:
+            retry_call(always, max_attempts=3, backoff=0.1, jitter=0.0,
+                       sleep=clock.sleep)
+        assert isinstance(ei.value.__cause__, ValueError)
+        assert len(clock.sleeps) == 2  # no sleep after the final attempt
+
+    def test_jitter_bounds(self):
+        clock = FakeClock()
+
+        def always():
+            raise OSError("x")
+
+        with pytest.raises(RetryError):
+            retry_call(always, max_attempts=4, backoff=1.0, multiplier=1.0,
+                       jitter=0.1, sleep=clock.sleep, rng=lambda: 1.0)
+        assert all(abs(s - 1.1) < 1e-9 for s in clock.sleeps)
+
+    def test_retry_on_filters_exception_types(self):
+        def typeerr():
+            raise TypeError("not retryable")
+
+        with pytest.raises(TypeError):
+            retry_call(typeerr, max_attempts=3, retry_on=(OSError,),
+                       sleep=lambda s: None)
+
+    def test_decorator_form(self):
+        clock = FakeClock()
+        state = {"n": 0}
+
+        @retry(max_attempts=3, backoff=0.2, jitter=0.0, sleep=clock.sleep)
+        def fn(x):
+            state["n"] += 1
+            if state["n"] < 2:
+                raise OSError("once")
+            return x * 2
+
+        assert fn(21) == 42
+        assert clock.sleeps == [0.2]
+
+    def test_deadline_stops_retrying_early(self):
+        clock = FakeClock()
+        dl = Deadline(1.0, clock=clock)
+
+        def always():
+            raise OSError("x")
+
+        with pytest.raises(RetryError):
+            retry_call(always, max_attempts=100, backoff=0.6, jitter=0.0,
+                       deadline=dl, sleep=clock.sleep)
+        # 0.6 + 0.4 (clamped to remaining) then expired → 2 sleeps max
+        assert len(clock.sleeps) <= 2
+
+
+class TestDeadline:
+    def test_remaining_and_expired(self):
+        clock = FakeClock()
+        dl = Deadline(2.0, clock=clock)
+        assert dl.remaining() == 2.0 and not dl.expired()
+        clock.t = 2.5
+        assert dl.expired()
+        with pytest.raises(DeadlineExceeded):
+            dl.check("init")
+
+    def test_none_means_unbounded(self):
+        dl = Deadline(None)
+        assert dl.remaining() == float("inf") and not dl.expired()
+        dl.check()
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("X_TIMEOUT", "7.5")
+        assert Deadline.from_env("X_TIMEOUT").seconds == 7.5
+        monkeypatch.delenv("X_TIMEOUT")
+        assert Deadline.from_env("X_TIMEOUT", 3.0).seconds == 3.0
+
+
+class TestFaultInjector:
+    def test_spec_parsing_and_occurrence_counting(self):
+        fi = FaultInjector("load:2:corrupt,step:1:slow")
+        assert fi.armed("load") and fi.armed("step") and not fi.armed("save")
+        assert fi.fire("step") == "slow"
+        assert fi.fire("step") is None      # occurrence 2: no rule
+        assert fi.fire("load") is None      # occurrence 1
+        assert fi.fire("load") == "corrupt"  # occurrence 2
+        assert fi.fire("load") is None
+        assert fi.fire("unknown_site") is None
+
+    def test_empty_spec_is_inert(self):
+        fi = FaultInjector("")
+        assert not fi.armed()
+        assert fi.fire("epoch") is None
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError, match="epoch:2:crash"):
+            FaultInjector("epoch-2-crash")
+
+    def test_raise_action(self):
+        fi = FaultInjector("op:1:raise")
+        with pytest.raises(FaultInjected, match="op:1"):
+            fi.fire("op")
+
+    def test_crash_action_hard_exits_with_reserved_code(self, tmp_path):
+        # crash = os._exit(FAULT_CRASH_EXIT_CODE); prove it in a throwaway
+        # interpreter (stdlib only — fast)
+        code = (
+            "import importlib.util\n"
+            "spec = importlib.util.spec_from_file_location('resilience',\n"
+            "    '/root/repo/paddle_tpu/utils/resilience.py')\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "m.FaultInjector('boom:1:crash').fire('boom')\n"
+            "print('UNREACHABLE')\n")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == FAULT_CRASH_EXIT_CODE
+        assert "UNREACHABLE" not in proc.stdout
+
+
+def _flip_last_byte(ckpt_dir):
+    fn = sorted(f for f in os.listdir(ckpt_dir) if f.startswith("shards_"))[0]
+    full = os.path.join(ckpt_dir, fn)
+    with open(full, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return full
+
+
+class TestCheckpointIntegrity:
+    def test_checksums_written_into_metadata(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        save_sharded({"a": jnp.arange(6.0)}, ck)
+        with open(os.path.join(ck, "metadata_0.json")) as f:
+            doc = json.load(f)
+        assert doc["format"] == 2
+        assert "shards_0.npz" in doc["checksums"]
+        assert len(doc["checksums"]["shards_0.npz"]) == 64  # sha256 hex
+
+    def test_corrupt_shard_raises_checksum_error(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        save_sharded({"a": jnp.arange(6.0)}, ck)
+        _flip_last_byte(ck)
+        with pytest.raises(CheckpointIntegrityError, match="checksum"):
+            load_sharded(ck)
+        # verify=False is the escape hatch for forensics
+        out = load_sharded(ck, verify=False)
+        assert "a" in out
+
+    def test_missing_shard_file_raises(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        save_sharded({"a": jnp.arange(6.0)}, ck)
+        os.remove(os.path.join(ck, "shards_0.npz"))
+        with pytest.raises(CheckpointIntegrityError, match="missing"):
+            load_sharded(ck)
+
+    def test_torn_save_without_metadata_raises(self, tmp_path):
+        ck = tmp_path / "ck"
+        ck.mkdir()
+        (ck / "shards_0.npz").write_bytes(b"partial garbage")
+        with pytest.raises(CheckpointIntegrityError, match="torn"):
+            verify_checkpoint(str(ck))
+
+    def test_legacy_format1_checkpoint_still_loads(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        save_sharded({"a": jnp.arange(4.0), "s": 5}, ck)
+        mp = os.path.join(ck, "metadata_0.json")
+        with open(mp) as f:
+            doc = json.load(f)
+        with open(mp, "w") as f:
+            json.dump(doc["entries"], f)  # strip the format-2 envelope
+        out = load_sharded(ck)
+        np.testing.assert_allclose(out["a"].numpy(), np.arange(4.0))
+        assert out["s"] == 5
+
+    def test_fault_injected_corruption_on_load(self, tmp_path, monkeypatch):
+        from paddle_tpu.utils import resilience
+        ck = str(tmp_path / "ck")
+        save_sharded({"a": jnp.arange(4.0)}, ck)
+        monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", "load:1:corrupt")
+        resilience._reset_fault_injector_for_tests()
+        try:
+            with pytest.raises(CheckpointIntegrityError):
+                load_sharded(ck)
+        finally:
+            monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC")
+            resilience._reset_fault_injector_for_tests()
+
+
+def _tiny_job(tmp_path, name="jobA", epochs=3, guard=None, keep_last=10):
+    paddle.seed(11)
+    net = nn.Linear(4, 2)
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    r = TrainEpochRange(epochs, name, model=net, optimizer=opt,
+                        checkpoint_path=str(tmp_path / "auto"),
+                        keep_last=keep_last, preemption_guard=guard)
+    return net, opt, r
+
+
+def _step(net, opt):
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+class TestAutoCheckpointResilience:
+    def test_corrupt_newest_epoch_falls_back_to_previous(self, tmp_path):
+        net, opt, r = _tiny_job(tmp_path)
+        for _ in r:
+            _step(net, opt)
+        job = tmp_path / "auto" / "jobA"
+        _flip_last_byte(str(job / "epoch_2"))
+        with pytest.warns(UserWarning, match="not intact"):
+            _, _, r2 = _tiny_job(tmp_path)
+        assert r2.restored_epoch == 1
+
+    def test_half_deleted_epoch_falls_back(self, tmp_path):
+        net, opt, r = _tiny_job(tmp_path)
+        for _ in r:
+            _step(net, opt)
+        job = tmp_path / "auto" / "jobA"
+        os.remove(str(job / "epoch_2" / "shards_0.npz"))
+        with pytest.warns(UserWarning, match="not intact"):
+            _, _, r2 = _tiny_job(tmp_path)
+        assert r2.restored_epoch == 1
+
+    def test_malformed_epoch_dir_does_not_abort_gc_or_restore(self, tmp_path):
+        net, opt, r = _tiny_job(tmp_path, keep_last=1)
+        job = tmp_path / "auto" / "jobA"
+        job.mkdir(parents=True, exist_ok=True)
+        (job / "epoch_2.tmp_partial").mkdir()  # crash debris, non-numeric
+        for _ in r:  # commit path runs _gc over the stray entry
+            _step(net, opt)
+        assert (job / "epoch_2.tmp_partial").exists()  # skipped, not fatal
+        _, _, r2 = _tiny_job(tmp_path, keep_last=1)
+        assert r2.restored_epoch == 2
+
+    def test_orphaned_partial_epochs_gced_on_restore(self, tmp_path):
+        net, opt, r = _tiny_job(tmp_path)
+        for _ in r:
+            _step(net, opt)
+        job = tmp_path / "auto" / "jobA"
+        (job / "epoch_7").mkdir()  # newer than committed epoch 2 → orphan
+        _, _, r2 = _tiny_job(tmp_path)
+        assert r2.restored_epoch == 2
+        assert not (job / "epoch_7").exists()
+
+    def test_preempted_range_commits_and_exits_with_resume_code(
+            self, tmp_path):
+        guard = PreemptionGuard(install=False)
+        net, opt, r = _tiny_job(tmp_path, name="jobP", epochs=5, guard=guard)
+        done = []
+        with pytest.raises(SystemExit) as ei:
+            for epoch in r:
+                _step(net, opt)
+                done.append(epoch)
+                if epoch == 1:
+                    guard.preempt()  # platform preemption notice
+        assert ei.value.code == PREEMPTION_EXIT_CODE
+        assert done == [0, 1]
+        # the final checkpoint was committed before exit → resume at 2
+        _, _, r2 = _tiny_job(tmp_path, name="jobP", epochs=5)
+        assert r2.restored_epoch == 1
+
+
+class TestPreemptionGuard:
+    def test_sigterm_sets_flag_and_exit_code(self):
+        with PreemptionGuard() as g:
+            assert not g.preempted
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert g.preempted
+            saved = []
+            with pytest.raises(SystemExit) as ei:
+                g.exit_if_preempted(save_fn=lambda: saved.append(1))
+            assert ei.value.code == PREEMPTION_EXIT_CODE
+            assert saved == [1]
+
+    def test_noop_when_not_preempted(self):
+        g = PreemptionGuard(install=False)
+        g.exit_if_preempted(save_fn=lambda: pytest.fail("must not save"))
+
+    def test_uninstall_restores_previous_handler(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        g = PreemptionGuard()
+        assert signal.getsignal(signal.SIGTERM) != prev
+        g.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+
+class TestFaultToleranceCallback:
+    class _ModelStub:
+        def __init__(self):
+            self.saved = []
+
+        def save(self, path):
+            self.saved.append(path)
+
+    def test_preemption_saves_then_exits(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import FaultToleranceCallback
+        guard = PreemptionGuard(install=False)
+        cb = FaultToleranceCallback(str(tmp_path / "ft"), guard=guard)
+        m = self._ModelStub()
+        cb.set_model(m)
+        cb.on_train_begin()
+        cb.on_train_batch_end(0)       # not preempted: no exit
+        guard.preempt()
+        with pytest.raises(SystemExit) as ei:
+            cb.on_train_batch_end(1)
+        assert ei.value.code == PREEMPTION_EXIT_CODE
+        assert m.saved and m.saved[0].endswith("preempted")
+
+    def test_epoch_end_saves_latest(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import FaultToleranceCallback
+        guard = PreemptionGuard(install=False)
+        cb = FaultToleranceCallback(str(tmp_path / "ft"), guard=guard)
+        m = self._ModelStub()
+        cb.set_model(m)
+        cb.on_epoch_end(0)
+        assert m.saved == [os.path.join(str(tmp_path / "ft"), "latest")]

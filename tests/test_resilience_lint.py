@@ -1,0 +1,74 @@
+"""The silent-except lint (tools/lint_silent_except.py) runs as part of
+tier-1: failures in the resilience paths (launcher, elastic supervisor,
+checkpoint layer, retry substrate) must never be silently swallowed."""
+import importlib.util
+import os
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "lint_silent_except", os.path.join(REPO, "tools", "lint_silent_except.py"))
+lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lint)
+
+
+class TestDetector:
+    def _check(self, tmp_path, src):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(src))
+        return lint.check_file(str(p))
+
+    def test_flags_bare_except(self, tmp_path):
+        offs = self._check(tmp_path, """
+            try:
+                work()
+            except:
+                pass
+        """)
+        assert len(offs) == 1 and "bare" in offs[0][2]
+
+    def test_flags_swallowed_exception(self, tmp_path):
+        offs = self._check(tmp_path, """
+            try:
+                work()
+            except (ValueError, Exception):
+                pass
+        """)
+        assert len(offs) == 1 and "swallows" in offs[0][2]
+
+    def test_flags_ellipsis_body(self, tmp_path):
+        offs = self._check(tmp_path, """
+            try:
+                work()
+            except Exception:
+                ...
+        """)
+        assert len(offs) == 1
+
+    def test_allows_handled_broad_except(self, tmp_path):
+        offs = self._check(tmp_path, """
+            try:
+                work()
+            except Exception as e:
+                log(e)
+                raise
+        """)
+        assert offs == []
+
+    def test_allows_narrow_except_pass(self, tmp_path):
+        # narrow swallows (e.g. FileNotFoundError on cleanup) are fine
+        offs = self._check(tmp_path, """
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+        """)
+        assert offs == []
+
+
+class TestRepoIsClean:
+    def test_no_silent_excepts_in_resilience_paths(self):
+        offenders = lint.find_offenders()
+        assert offenders == [], "\n".join(
+            f"{p}:{ln}: {msg}" for p, ln, msg in offenders)

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Forbid silently-swallowed failures in the resilience-critical paths.
+
+The elastic fault-tolerance runtime (docs/fault_tolerance.md) depends on
+failures *propagating*: a swallowed exception in the launcher, the elastic
+supervisor, or the checkpoint layer turns a recoverable crash into silent
+state corruption. This lint rejects, inside the directories below:
+
+- bare ``except:`` handlers
+- ``except Exception:`` / ``except BaseException:`` (alone or in a tuple)
+  whose body does nothing (only ``pass`` / ``...``)
+
+Catching Exception and then *acting* (logging, re-raising, returning an
+explicit sentinel) is fine — the rule targets the do-nothing swallow.
+
+Run directly (``python tools/lint_silent_except.py``; exit 1 on offenders)
+or via the test suite (tests/test_resilience_lint.py, tier-1).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: directories where a silent swallow is a correctness bug, not a style nit
+CHECKED_DIRS = (
+    os.path.join("paddle_tpu", "distributed"),
+    os.path.join("paddle_tpu", "incubate", "checkpoint"),
+    os.path.join("paddle_tpu", "utils"),
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _names_in(expr):
+    """Exception-class names referenced by an except clause's type expr."""
+    if expr is None:
+        return set()
+    if isinstance(expr, ast.Name):
+        return {expr.id}
+    if isinstance(expr, ast.Attribute):
+        return {expr.attr}
+    if isinstance(expr, ast.Tuple):
+        out = set()
+        for elt in expr.elts:
+            out |= _names_in(elt)
+        return out
+    return set()
+
+
+def _body_is_noop(body):
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+def check_file(path):
+    with open(path, "rb") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
+    offenders = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            offenders.append(
+                (path, node.lineno,
+                 "bare 'except:' swallows everything incl. SystemExit"))
+        elif _names_in(node.type) & _BROAD and _body_is_noop(node.body):
+            offenders.append(
+                (path, node.lineno,
+                 "'except Exception: pass' silently swallows failures"))
+    return offenders
+
+
+def find_offenders(root=REPO_ROOT, dirs=CHECKED_DIRS):
+    offenders = []
+    for rel in dirs:
+        base = os.path.join(root, rel)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    offenders.extend(check_file(os.path.join(dirpath, fn)))
+    return offenders
+
+
+def main():
+    offenders = find_offenders()
+    for path, lineno, msg in offenders:
+        print(f"{os.path.relpath(path, REPO_ROOT)}:{lineno}: {msg}")
+    if offenders:
+        print(f"{len(offenders)} silent-except offender(s); failures in "
+              f"resilience paths must propagate or be handled explicitly "
+              f"(docs/fault_tolerance.md)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
