@@ -139,7 +139,7 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
-        return np.asarray(self._data)
+        return np.asarray(self._data)  # noqa: PTA001 -- numpy() IS the eager materialization API; never called under trace
 
     def item(self, *args):
         if args:
@@ -147,7 +147,7 @@ class Tensor:
         return self.numpy().item()
 
     def tolist(self):
-        return self.numpy().tolist()
+        return self.numpy().tolist()  # noqa: PTA001 -- eager materialization API by contract
 
     def astype(self, dtype) -> "Tensor":
         from ..ops.dispatch import apply

@@ -22,7 +22,7 @@ from .dispatch import apply, apply_raw
 
 def _shape_list(shape):
     if isinstance(shape, Tensor):
-        shape = shape.numpy().tolist()
+        shape = shape.numpy().tolist()  # noqa: PTA002 -- shapes must be concrete host values
     if isinstance(shape, (int, np.integer)):
         return [int(shape)]
     return [int(s._data if isinstance(s, Tensor) else s) for s in shape]
@@ -54,7 +54,12 @@ def ones(shape, dtype=None, name=None):
 def full(shape, fill_value, dtype=None, name=None):
     d = _dt.convert_dtype(dtype)
     if isinstance(fill_value, Tensor):
-        fill_value = fill_value.item()
+        if d is None:
+            fd = fill_value._data.dtype
+            d = (np.dtype("bool") if fd == np.bool_
+                 else np.dtype("int64") if jnp.issubdtype(fd, jnp.integer)
+                 else _dt.get_default_dtype())
+        fill_value = fill_value._data  # stays on device; jnp.full broadcasts
     if d is None:
         d = (np.dtype("bool") if isinstance(fill_value, bool)
              else np.dtype("int64") if isinstance(fill_value, int)
@@ -86,9 +91,9 @@ def empty_like(x, dtype=None, name=None):
 def arange(start=0, end=None, step=1, dtype=None, name=None):
     if end is None:
         start, end = 0, start
-    start = start.item() if isinstance(start, Tensor) else start
-    end = end.item() if isinstance(end, Tensor) else end
-    step = step.item() if isinstance(step, Tensor) else step
+    start = start.item() if isinstance(start, Tensor) else start  # noqa: PTA002 -- arange output shape depends on the values
+    end = end.item() if isinstance(end, Tensor) else end  # noqa: PTA002 -- arange output shape depends on the values
+    step = step.item() if isinstance(step, Tensor) else step  # noqa: PTA002 -- arange output shape depends on the values
     d = _dt.convert_dtype(dtype)
     if d is None:
         d = (np.dtype("int64") if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
@@ -97,9 +102,9 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
 
 
 def linspace(start, stop, num, dtype=None, name=None):
-    start = start.item() if isinstance(start, Tensor) else start
-    stop = stop.item() if isinstance(stop, Tensor) else stop
-    num = int(num.item() if isinstance(num, Tensor) else num)
+    start = start._data if isinstance(start, Tensor) else start
+    stop = stop._data if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)  # noqa: PTA002 -- num is the output length (a shape) and must be concrete
     d = _dtype_or_default(dtype)
     return apply("linspace", lambda: jnp.linspace(start, stop, num, dtype=d))
 

@@ -363,7 +363,7 @@ def filter_by_instag(ins, ins_tag, filter_tag, is_lod=False,
     fixed-shape convention here: kept rows stay, dropped rows are
     ``out_val_if_empty``, plus (mask, loss_weight) outputs. Callers that
     need compaction do it host-side."""
-    ft = np.asarray(_raw(filter_tag)).reshape(-1)
+    ft = np.asarray(_raw(filter_tag)).reshape(-1)  # noqa: PTA002 -- filter set is a small static list unrolled into the graph
 
     def impl(x, tags):
         hit = jnp.zeros((tags.shape[0],), jnp.bool_)

@@ -21,12 +21,12 @@ slice_builtin = builtins.slice
 
 
 def _int(v):
-    return int(v.item() if isinstance(v, Tensor) else v)
+    return int(v.item() if isinstance(v, Tensor) else v)  # noqa: PTA002 -- shape/axis arguments must be concrete host values
 
 
 def _shape_list(shape):
     if isinstance(shape, Tensor):
-        return [int(s) for s in shape.numpy().tolist()]
+        return [int(s) for s in shape.numpy().tolist()]  # noqa: PTA001,PTA002 -- shapes must be concrete host values
     return [_int(s) for s in shape]
 
 
@@ -91,7 +91,7 @@ def split(x, num_or_sections, axis=0, name=None):
     def impl(a):
         if isinstance(num_or_sections, int):
             return list(jnp.split(a, num_or_sections, axis=ax))
-        secs = [_int(s) if not isinstance(s, Tensor) else int(s.item())
+        secs = [_int(s) if not isinstance(s, Tensor) else int(s.item())  # noqa: PTA002 -- split points are shapes; must be concrete
                 for s in num_or_sections]
         total = a.shape[ax]
         if -1 in secs:
@@ -249,7 +249,7 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     `pad` is [left, right, top, bottom, ...] over trailing spatial dims when
     len(pad) < 2*ndim, else per-dim pairs."""
     if isinstance(pad, Tensor):
-        pad = pad.numpy().tolist()
+        pad = pad.numpy().tolist()  # noqa: PTA002 -- pad widths are static shape arguments in XLA
     pad = [_int(p) for p in pad]
     jmode = {"constant": "constant", "reflect": "reflect",
              "replicate": "edge", "circular": "wrap"}[mode]
@@ -383,4 +383,4 @@ def einsum(equation, *operands):
 
 
 def tolist(x):
-    return x.numpy().tolist()
+    return x.numpy().tolist()  # noqa: PTA002 -- tolist() IS the materialization API; host transfer is the contract

@@ -23,7 +23,7 @@ _this = sys.modules[__name__]
 
 def _axis_arg(axis):
     if isinstance(axis, Tensor):
-        a = axis.numpy().tolist()
+        a = axis.numpy().tolist()  # noqa: PTA001,PTA002 -- reduction axes are static arguments in XLA; a Tensor axis must be concretized
         return tuple(a) if isinstance(a, list) else int(a)
     if isinstance(axis, (list, tuple)):
         return tuple(int(x) for x in axis)
@@ -350,7 +350,7 @@ def sort(x, axis=-1, descending=False, name=None):
 
 
 def topk(x, k, axis=None, largest=True, sorted=True, name=None):
-    kk = int(k.item() if isinstance(k, Tensor) else k)
+    kk = int(k.item() if isinstance(k, Tensor) else k)  # noqa: PTA002 -- k fixes the output shape and must be concrete
 
     def impl(a):
         ax = axis if axis is not None else a.ndim - 1
@@ -462,7 +462,7 @@ def _along_axis_index(a, i, axis):
 
 
 def gather(x, index, axis=0, name=None):
-    ax = int(axis.item() if isinstance(axis, Tensor) else axis)
+    ax = int(axis.item() if isinstance(axis, Tensor) else axis)  # noqa: PTA002 -- gather axis is a static argument in XLA
     return apply("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i,
                                                  axis=ax), x, index)
 

@@ -256,7 +256,7 @@ class ReduceOnPlateau(LRScheduler):
     def step(self, metrics=None, epoch=None):
         if metrics is None:
             return
-        v = float(metrics.numpy()) if hasattr(metrics, "numpy") else float(metrics)
+        v = float(metrics.numpy()) if hasattr(metrics, "numpy") else float(metrics)  # noqa: PTA002 -- ReduceOnPlateau branches on the metric value; per-epoch, not per-step
         better = (self.best is None
                   or (self.mode == "min" and v < self.best - self.threshold)
                   or (self.mode == "max" and v > self.best + self.threshold))

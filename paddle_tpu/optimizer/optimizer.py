@@ -303,7 +303,7 @@ class AdamW(Adam):
         if isinstance(weight_decay, (int, float)):
             self._coeff = float(weight_decay)
         elif isinstance(weight_decay, Tensor):
-            self._coeff = float(weight_decay.numpy())
+            self._coeff = float(weight_decay.numpy())  # noqa: PTA002 -- constructor-time, not in the step path
         else:
             raise TypeError(
                 f"AdamW weight_decay must be a float or Tensor, got "

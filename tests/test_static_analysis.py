@@ -1,0 +1,398 @@
+"""Tier-1 tests for the static-analysis framework (tools/analyze).
+
+Covers the analyzer core (suppression parsing, baseline add/expire
+semantics, JSON schema), one positive + one negative fixture per rule,
+and the two acceptance gates from the issue:
+
+- the repo-wide run exits 0 against the checked-in baseline;
+- seeding ``if x.item():`` into a jit-reachable function in a scratch
+  copy of the tree exits 1 with PTA001 at the right file:line.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analyze.core import (Finding, Project, filter_noqa,  # noqa: E402
+                                load_baseline, run_rules, split_findings,
+                                write_baseline)
+from tools.analyze.rules import ALL_RULES, rules_by_code  # noqa: E402
+
+RULES = rules_by_code()
+
+
+def _mini(tmp_path, files):
+    """Materialize {relpath: source} under tmp_path, return a Project."""
+    roots = set()
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        roots.add(rel.split("/")[0])
+    py_roots = sorted(r for r in roots if r != "tools")
+    return Project(str(tmp_path), py_roots)
+
+
+def _run(tmp_path, files, codes):
+    project = _mini(tmp_path, files)
+    findings = run_rules(project, [RULES[c] for c in codes])
+    return project, findings
+
+
+def _driver(args, cwd=REPO):
+    proc = subprocess.run([sys.executable, "-m", "tools.analyze"] + args,
+                          cwd=cwd, capture_output=True, text=True)
+    return proc
+
+
+# -- PTA001 tracer safety -----------------------------------------------------
+
+JIT_POS = """\
+    import jax
+
+    @jax.jit
+    def entry(x):
+        return helper(x)
+
+    def helper(x):
+        if x.item():
+            return x
+        return x
+"""
+
+
+def test_pta001_flags_host_call_reachable_from_jit(tmp_path):
+    _, findings = _run(tmp_path, {"paddle_tpu/a.py": JIT_POS}, ["PTA001"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "PTA001" and f.path == "paddle_tpu/a.py"
+    assert f.line == 8  # the `if x.item():` line
+    assert "branches on a host-forced" in f.message
+    assert "jit-reachable" in f.message
+
+
+def test_pta001_ignores_same_code_without_jit_root(tmp_path):
+    src = JIT_POS.replace("    @jax.jit\n", "")
+    _, findings = _run(tmp_path, {"paddle_tpu/a.py": src}, ["PTA001"])
+    assert findings == []
+
+
+def test_pta001_function_passed_to_trace_wrapper_is_a_root(tmp_path):
+    src = """\
+        import jax
+
+        def step(x):
+            return float(x)
+
+        compiled = jax.jit(step)
+    """
+    _, findings = _run(tmp_path, {"paddle_tpu/a.py": src}, ["PTA001"])
+    assert len(findings) == 1
+    assert "float() on parameter-derived value" in findings[0].message
+
+
+# -- PTA002 host sync in hot paths --------------------------------------------
+
+SYNC_SRC = """\
+    import numpy as np
+
+    def op(x):
+        return np.asarray(x)
+
+    def op2(x):
+        return x.numpy()
+"""
+
+
+def test_pta002_flags_syncs_in_ops_dir(tmp_path):
+    _, findings = _run(tmp_path, {"paddle_tpu/ops/m.py": SYNC_SRC},
+                       ["PTA002"])
+    assert {f.line for f in findings} == {4, 7}
+    assert all(f.rule == "PTA002" for f in findings)
+
+
+def test_pta002_ignores_cold_paths_and_literals(tmp_path):
+    _, cold = _run(tmp_path, {"paddle_tpu/vision/m.py": SYNC_SRC},
+                   ["PTA002"])
+    assert cold == []
+    lit = """\
+        import numpy as np
+
+        def op():
+            return np.asarray([1, 2, 3])
+    """
+    _, findings = _run(tmp_path, {"paddle_tpu/ops/m.py": lit}, ["PTA002"])
+    assert findings == []
+
+
+# -- PTA003 silent except -----------------------------------------------------
+
+SWALLOW = """\
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+"""
+
+
+def test_pta003_flags_swallow_in_checked_dirs(tmp_path):
+    _, findings = _run(tmp_path, {"paddle_tpu/utils/x.py": SWALLOW},
+                       ["PTA003"])
+    assert len(findings) == 1 and "swallows" in findings[0].message
+
+
+def test_pta003_ignores_handled_and_unchecked(tmp_path):
+    handled = SWALLOW.replace("        pass\n", "        raise\n")
+    _, findings = _run(tmp_path, {"paddle_tpu/utils/x.py": handled},
+                       ["PTA003"])
+    assert findings == []
+    _, findings = _run(tmp_path, {"paddle_tpu/ops/x.py": SWALLOW},
+                       ["PTA003"])
+    assert findings == []
+
+
+# -- PTA004 op registry <-> catalog -------------------------------------------
+
+OPS_MOD = '''\
+    """Ops. reference: operators/foo_op.cc"""
+    from .dispatch import apply
+
+    def foo(x):
+        return apply("foo", lambda a: a, x)
+
+    def bar(x):
+        return apply("bar", lambda a: a, x)
+'''
+
+
+def test_pta004_unlisted_and_stale(tmp_path):
+    files = {
+        "paddle_tpu/ops/m.py": OPS_MOD,
+        "tools/op_catalog.txt": "bar\nghost\n",
+    }
+    _, findings = _run(tmp_path, files, ["PTA004"])
+    anchors = {f.anchor for f in findings}
+    assert "unlisted:foo" in anchors       # registered, not in catalog
+    assert "stale:ghost" in anchors        # cataloged, claimed by nothing
+    assert not any(a.startswith(("unlisted:bar", "stale:bar"))
+                   for a in anchors)
+
+
+def test_pta004_native_claims(tmp_path):
+    files = {
+        "paddle_tpu/ops/m.py": OPS_MOD,
+        "tools/op_catalog.txt": "bar\n# native: foo\n# native: gone\n",
+    }
+    _, findings = _run(tmp_path, files, ["PTA004"])
+    anchors = {f.anchor for f in findings}
+    assert "unlisted:foo" not in anchors   # claimed by the native line
+    assert "stale-native:gone" in anchors  # claim with no op behind it
+
+
+def test_pta004_catalog_hygiene(tmp_path):
+    files = {
+        "paddle_tpu/ops/m.py": OPS_MOD,
+        "tools/op_catalog.txt": "foo\nbar\nbar\n",  # unsorted + duplicate
+    }
+    _, findings = _run(tmp_path, files, ["PTA004"])
+    anchors = {f.anchor for f in findings}
+    assert "sort:bar" in anchors and "dup:bar" in anchors
+
+
+def test_pta004_missing_reference_docstring(tmp_path):
+    files = {
+        "paddle_tpu/ops/m.py": 'def foo(x):\n    return x\n',
+        "tools/op_catalog.txt": "foo\n",
+    }
+    _, findings = _run(tmp_path, files, ["PTA004"])
+    assert any(f.anchor == "no-reference-line" for f in findings)
+
+
+# -- PTA005 api hygiene -------------------------------------------------------
+
+def test_pta005_mutable_default(tmp_path):
+    src = """\
+        from __future__ import annotations
+
+        def f(x, acc=[]):
+            return acc
+    """
+    _, findings = _run(tmp_path, {"paddle_tpu/api.py": src}, ["PTA005"])
+    assert len(findings) == 1 and "mutable default" in findings[0].message
+
+
+def test_pta005_future_annotations_and_clean(tmp_path):
+    src = """\
+        def f(x: int) -> int:
+            return x
+    """
+    _, findings = _run(tmp_path, {"paddle_tpu/api.py": src}, ["PTA005"])
+    assert len(findings) == 1
+    assert "__future__" in findings[0].message
+    clean = "from __future__ import annotations\n\n\ndef f(x: int) -> int:\n    return x\n"
+    _, findings = _run(tmp_path, {"paddle_tpu/api.py": clean}, ["PTA005"])
+    assert findings == []
+
+
+# -- suppression (noqa) -------------------------------------------------------
+
+def test_noqa_parsing_and_filtering(tmp_path):
+    src = """\
+        import numpy as np
+
+        def op(x):
+            a = np.asarray(x)  # noqa: PTA002 -- semantically required
+            b = np.asarray(x)  # noqa
+            c = np.asarray(x)  # noqa: PTA001
+            return a, b, c
+    """
+    project, findings = _run(tmp_path, {"paddle_tpu/ops/m.py": src},
+                             ["PTA002"])
+    kept, suppressed = filter_noqa(project, findings)
+    assert len(suppressed) == 2      # targeted code + bare noqa
+    assert len(kept) == 1            # wrong-code noqa does not suppress
+    assert kept[0].line == 6
+
+
+# -- PTA000 syntax errors -----------------------------------------------------
+
+def test_syntax_error_reported_as_pta000(tmp_path):
+    _, findings = _run(tmp_path, {"paddle_tpu/broken.py": "def f(:\n"},
+                       ["PTA003"])
+    assert len(findings) == 1 and findings[0].rule == "PTA000"
+
+
+# -- baseline semantics -------------------------------------------------------
+
+def test_baseline_add_expire_and_count_semantics(tmp_path):
+    f1 = Finding("PTA002", "a.py", 3, 0, "m", anchor="x.numpy()")
+    f2 = Finding("PTA002", "a.py", 9, 0, "m", anchor="x.numpy()")  # same fp
+    f3 = Finding("PTA001", "b.py", 1, 0, "m", anchor="bool(x)")
+    assert f1.fingerprint == f2.fingerprint != f3.fingerprint
+
+    bl_path = str(tmp_path / "bl.json")
+    write_baseline(bl_path, [f1, f3])
+    baseline = load_baseline(bl_path)
+
+    # same findings -> all baselined, nothing new or expired
+    new, baselined, expired = split_findings([f1, f3], baseline)
+    assert new == [] and len(baselined) == 2 and expired == []
+
+    # a second occurrence of the same fingerprint is NEW (count=1 recorded)
+    new, baselined, expired = split_findings([f1, f2, f3], baseline)
+    assert new == [f2] and expired == []
+
+    # a fixed finding expires its baseline entry
+    new, baselined, expired = split_findings([f1], baseline)
+    assert new == [] and expired == [f3.fingerprint]
+
+
+def test_baseline_is_line_number_independent(tmp_path):
+    a = Finding("PTA002", "a.py", 3, 0, "m", anchor="x.numpy()")
+    moved = Finding("PTA002", "a.py", 30, 4, "m", anchor="x.numpy()")
+    assert a.fingerprint == moved.fingerprint
+
+
+# -- driver: exit codes, JSON schema, rule selection --------------------------
+
+def test_driver_json_schema_and_exit_codes(tmp_path):
+    (tmp_path / "paddle_tpu" / "ops").mkdir(parents=True)
+    (tmp_path / "paddle_tpu" / "ops" / "m.py").write_text(
+        "import numpy as np\n\n\ndef op(x):\n    return np.asarray(x)\n")
+
+    proc = _driver(["--root", str(tmp_path), "--baseline", "none",
+                    "--json", "paddle_tpu"])
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == 1
+    assert set(payload["counts"]) == {"total", "new", "baselined",
+                                      "suppressed",
+                                      "expired_baseline_entries"}
+    assert payload["counts"]["new"] >= 1
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "path", "line", "col", "message",
+                          "fingerprint", "status"}
+
+    # write a baseline, then the same tree is clean (exit 0)
+    proc = _driver(["--root", str(tmp_path), "--baseline", "bl.json",
+                    "--write-baseline", "paddle_tpu"])
+    assert proc.returncode == 0, proc.stderr
+    proc = _driver(["--root", str(tmp_path), "--baseline", "bl.json",
+                    "paddle_tpu"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_driver_rule_selection(tmp_path):
+    (tmp_path / "paddle_tpu" / "ops").mkdir(parents=True)
+    (tmp_path / "paddle_tpu" / "ops" / "m.py").write_text(
+        "import numpy as np\n\n\ndef op(x):\n    return np.asarray(x)\n")
+    proc = _driver(["--root", str(tmp_path), "--baseline", "none",
+                    "--rule", "PTA003", "--json", "paddle_tpu"])
+    assert proc.returncode == 0  # PTA002 finding filtered out
+    assert json.loads(proc.stdout)["rules"] == ["PTA003"]
+
+    proc = _driver(["--root", str(tmp_path), "--baseline", "none",
+                    "--rule", "PTA999", "paddle_tpu"])
+    assert proc.returncode != 0 and "unknown rule" in proc.stderr
+
+
+def test_all_rules_have_distinct_codes():
+    codes = [r.code for r in ALL_RULES]
+    assert len(codes) == len(set(codes)) == 5
+    assert codes == sorted(codes)
+
+
+# -- acceptance gates ---------------------------------------------------------
+
+def test_repo_wide_run_is_clean_against_checked_in_baseline():
+    proc = _driver(["paddle_tpu"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+SEEDED = """\
+import jax
+
+
+@jax.jit
+def _seeded_entry(x):
+    return _seeded_helper(x)
+
+
+def _seeded_helper(x):
+    if x.item():
+        return x
+    return x
+"""
+
+
+def test_seeded_tracer_leak_in_scratch_copy_fails_the_gate(tmp_path):
+    """Copy the tree, seed `if x.item():` into a jit-reachable function,
+    and check the gate fails with PTA001 at exactly that file:line."""
+    scratch = tmp_path / "scratch"
+    shutil.copytree(os.path.join(REPO, "paddle_tpu"),
+                    str(scratch / "paddle_tpu"),
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    (scratch / "tools" / "analyze").mkdir(parents=True)
+    for rel in ("tools/op_catalog.txt", "tools/op_coverage.py",
+                "tools/analyze/baseline.json"):
+        shutil.copy(os.path.join(REPO, rel), str(scratch / rel))
+    (scratch / "paddle_tpu" / "_seeded_check.py").write_text(SEEDED)
+
+    proc = _driver(["--root", str(scratch), "--json", "paddle_tpu"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    new = [f for f in payload["findings"] if f["status"] == "new"]
+    seeded = [f for f in new if f["path"] == "paddle_tpu/_seeded_check.py"]
+    assert len(seeded) == 1, new
+    assert seeded[0]["rule"] == "PTA001"
+    assert seeded[0]["line"] == 10  # the `if x.item():` line
+    # the seed can also pull existing methods named `item` into the
+    # reachable set (name-based over-approximation); nothing else may leak
+    assert all(f["rule"] == "PTA001" for f in new), new

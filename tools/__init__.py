@@ -1,0 +1,2 @@
+# tools/ is importable so `python -m tools.analyze` works; the scripts in
+# this directory remain directly runnable (`python tools/<script>.py`).

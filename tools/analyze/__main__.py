@@ -1,0 +1,153 @@
+"""Driver: ``python -m tools.analyze [options] [paths...]``.
+
+Exit codes (check_bench_regression-style):
+    0   clean — no findings beyond the baseline
+    1   new findings (or --write-baseline wrote nothing because of an error)
+    2   internal error in the analyzer itself
+
+The default baseline is tools/analyze/baseline.json; pass ``--baseline
+none`` to compare against nothing (every finding is then "new").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+from .core import (Project, filter_noqa, load_baseline, run_rules,
+                   split_findings, write_baseline)
+from .rules import ALL_RULES, rules_by_code
+
+DEFAULT_BASELINE = os.path.join("tools", "analyze", "baseline.json")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="paddle-tpu-analyze: AST-based tracer-safety, "
+                    "host-sync and API-surface analyzer")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to analyze (default: paddle_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths and the baseline "
+                         "(default: autodetected from this file)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="PTA###", help="run only these rules "
+                    "(repeatable)")
+    ap.add_argument("--skip", action="append", default=[],
+                    metavar="PTA###", help="disable these rules "
+                    "(repeatable)")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help=f"baseline file relative to root (default: "
+                         f"{DEFAULT_BASELINE}; 'none' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record all current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    return ap
+
+
+def select_rules(args) -> list:
+    by_code = rules_by_code()
+    if args.rule:
+        unknown = [c for c in args.rule if c.upper() not in by_code]
+        if unknown:
+            raise SystemExit(f"unknown rule(s): {', '.join(unknown)} "
+                             f"(known: {', '.join(sorted(by_code))})")
+        rules = [by_code[c.upper()] for c in args.rule]
+    else:
+        rules = list(ALL_RULES)
+    skip = {c.upper() for c in args.skip}
+    return [r for r in rules if r.code not in skip]
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.code}  {r.name}: {r.description}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else _repo_root()
+    paths = args.paths or ["paddle_tpu"]
+    rules = select_rules(args)
+
+    baseline_arg = args.baseline or DEFAULT_BASELINE
+    baseline_path = (None if baseline_arg.lower() == "none"
+                     else os.path.join(root, baseline_arg)
+                     if not os.path.isabs(baseline_arg) else baseline_arg)
+
+    project = Project(root, paths)
+    findings = run_rules(project, rules)
+    findings, suppressed = filter_noqa(project, findings)
+
+    if args.write_baseline:
+        if baseline_path is None:
+            print("--write-baseline requires a baseline file", file=sys.stderr)
+            return 1
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) "
+              f"({len({f.fingerprint for f in findings})} fingerprints) "
+              f"to {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    new, baselined, expired = split_findings(findings, baseline)
+
+    if args.as_json:
+        new_ids = {id(x) for x in new}
+        payload = {
+            "version": 1,
+            "root": root,
+            "rules": [r.code for r in rules],
+            "counts": {"total": len(findings), "new": len(new),
+                       "baselined": len(baselined),
+                       "suppressed": len(suppressed),
+                       "expired_baseline_entries": len(expired)},
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "col": f.col, "message": f.message,
+                 "fingerprint": f.fingerprint,
+                 "status": "new" if id(f) in new_ids else "baselined"}
+                for f in findings],
+        }
+        print(json.dumps(payload, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if baselined:
+            print(f"[{len(baselined)} pre-existing finding(s) suppressed "
+                  f"by baseline]")
+        if suppressed:
+            print(f"[{len(suppressed)} finding(s) suppressed by inline "
+                  f"noqa]")
+        if expired:
+            print(f"[{len(expired)} baseline entr(ies) no longer match — "
+                  f"run --write-baseline to prune]")
+        if new:
+            print(f"{len(new)} new finding(s); fix them, add "
+                  f"`# noqa: PTA### -- reason`, or regenerate the "
+                  f"baseline (docs/static_analysis.md)")
+        else:
+            print(f"clean: 0 new findings "
+                  f"({len(baselined)} baselined, {len(suppressed)} noqa)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception:
+        traceback.print_exc()
+        sys.exit(2)

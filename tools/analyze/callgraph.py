@@ -1,0 +1,182 @@
+"""Conservative call-graph over the analyzed files for jit-reachability.
+
+PTA001 needs to know which functions can execute *under a JAX trace*: a
+host sync that is perfectly fine in eager code is a tracer leak inside
+``jax.jit`` / ``pjit`` / ``to_static``. Full python call resolution is
+undecidable, so this walks a name-based over-approximation:
+
+roots
+    - defs decorated with jit / pjit / to_static (bare, dotted or called:
+      ``@jax.jit``, ``@to_static(input_spec=...)``, ``@functools.partial(
+      jax.jit, static_argnums=...)``),
+    - named functions passed as arguments to trace-entering wrappers
+      (``jax.jit(f)``, ``jax.lax.scan(f, ...)``, ``jax.vjp``, ``pmap``,
+      ``shard_map``, ``checkpoint`` ...).
+
+edges
+    - ``f()`` links to every def named ``f`` (same file preferred),
+    - ``obj.m()`` / ``self.m()`` links to every *method* named ``m``.
+
+Calls through variables, dicts or ``fn(*args)`` parameters are invisible;
+in exchange the reachable set is small and high-precision (the dispatch
+funnel internals, optimizer ``_update`` rules, scan/cond branch bodies),
+which keeps PTA001 findings actionable rather than noisy.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import Project, SourceFile, dotted_name
+
+#: decorator names (last dotted component) that enter a trace
+JIT_DECORATORS = {"jit", "pjit", "to_static"}
+
+#: callables whose function-valued arguments are traced
+TRACE_WRAPPERS = {
+    "jit", "pjit", "vjp", "jvp", "grad", "value_and_grad", "pmap",
+    "checkpoint", "remat", "scan", "while_loop", "fori_loop", "cond",
+    "switch", "custom_vjp", "custom_jvp", "eval_shape", "make_jaxpr",
+    "shard_map", "xmap", "pallas_call", "associated_scan", "vmap",
+}
+
+
+class FuncInfo:
+    __slots__ = ("file", "node", "name", "qualname", "is_method",
+                 "root_via", "reachable_from")
+
+    def __init__(self, file: SourceFile, node, qualname: str,
+                 is_method: bool):
+        self.file = file
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.is_method = is_method
+        self.root_via: Optional[str] = None       # why it is a root
+        self.reachable_from: Optional[str] = None  # provenance root qualname
+
+
+class CallGraph:
+    def __init__(self):
+        self.functions: List[FuncInfo] = []
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.methods_by_name: Dict[str, List[FuncInfo]] = {}
+        self.per_file_by_name: Dict[str, Dict[str, List[FuncInfo]]] = {}
+        self.roots: List[FuncInfo] = []
+
+    def reachable(self) -> List[FuncInfo]:
+        return [f for f in self.functions if f.reachable_from is not None]
+
+
+def _collect_defs(graph: CallGraph, sf: SourceFile):
+    file_map: Dict[str, List[FuncInfo]] = {}
+    graph.per_file_by_name[sf.relpath] = file_map
+
+    def visit(node, qual: str, in_class: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+                fi = FuncInfo(sf, child, q, in_class)
+                graph.functions.append(fi)
+                graph.by_name.setdefault(child.name, []).append(fi)
+                file_map.setdefault(child.name, []).append(fi)
+                if in_class:
+                    graph.methods_by_name.setdefault(child.name,
+                                                     []).append(fi)
+                visit(child, q, False)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{qual}.{child.name}" if qual else child.name
+                visit(child, q, True)
+            else:
+                visit(child, qual, in_class)
+
+    visit(sf.tree, "", False)
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    base = dec.func if isinstance(dec, ast.Call) else dec
+    if dotted_name(base).rpartition(".")[2] in JIT_DECORATORS:
+        return True
+    # functools.partial(jax.jit, ...) and friends: look one level into args
+    if isinstance(dec, ast.Call):
+        for a in dec.args:
+            if dotted_name(a).rpartition(".")[2] in JIT_DECORATORS:
+                return True
+    return False
+
+
+def _mark_roots(graph: CallGraph, sf: SourceFile):
+    file_map = graph.per_file_by_name[sf.relpath]
+    for fi in graph.functions:
+        if fi.file is not sf:
+            continue
+        for dec in fi.node.decorator_list:
+            if _decorator_is_jit(dec):
+                fi.root_via = f"decorator @{dotted_name(dec if not isinstance(dec, ast.Call) else dec.func) or 'jit'}"
+                graph.roots.append(fi)
+                break
+    # named functions handed to trace-entering wrappers
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        wrapper = dotted_name(node.func).rpartition(".")[2]
+        if wrapper not in TRACE_WRAPPERS:
+            continue
+        cand = list(node.args) + [kw.value for kw in node.keywords]
+        for a in cand:
+            if isinstance(a, ast.Name) and a.id in file_map:
+                for fi in file_map[a.id]:
+                    if fi.root_via is None:
+                        fi.root_via = f"passed to {dotted_name(node.func)}()"
+                        graph.roots.append(fi)
+
+
+def _own_body_calls(func_node):
+    """Call nodes in a function body, including nested defs' bodies only via
+    their own FuncInfo (we stop at nested defs here) but including lambdas."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _edges(graph: CallGraph, fi: FuncInfo) -> List[FuncInfo]:
+    out: List[FuncInfo] = []
+    file_map = graph.per_file_by_name[fi.file.relpath]
+    for call in _own_body_calls(fi.node):
+        f = call.func
+        if isinstance(f, ast.Name):
+            targets = file_map.get(f.id) or graph.by_name.get(f.id) or []
+            out.extend(targets)
+        elif isinstance(f, ast.Attribute):
+            out.extend(graph.methods_by_name.get(f.attr, []))
+    return out
+
+
+def build(project: Project) -> CallGraph:
+    graph = CallGraph()
+    for sf in project.files:
+        if sf.tree is not None:
+            _collect_defs(graph, sf)
+    for sf in project.files:
+        if sf.tree is not None:
+            _mark_roots(graph, sf)
+
+    # BFS with provenance
+    queue = []
+    for r in graph.roots:
+        if r.reachable_from is None:
+            r.reachable_from = r.qualname
+            queue.append(r)
+    while queue:
+        fi = queue.pop(0)
+        for callee in _edges(graph, fi):
+            if callee.reachable_from is None:
+                callee.reachable_from = fi.reachable_from
+                queue.append(callee)
+    return graph

@@ -1,0 +1,277 @@
+"""Analyzer core: source model, findings, noqa suppression, baseline.
+
+Design notes
+------------
+Fingerprints are line-number independent: sha1(rule | relpath | stripped
+source-line text). Unrelated edits that shift line numbers therefore do not
+invalidate the baseline; duplicate identical lines in one file share a
+fingerprint, so the baseline stores an occurrence *count* per fingerprint
+and only occurrences beyond that count register as new (the same scheme
+ruff/pylint baselines use).
+
+Everything here is stdlib-only so the gate can run before pytest without
+importing jax or paddle_tpu.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: matches an inline suppression: `# noqa` (all rules) or `# noqa: PTA001`
+#: or `# noqa: PTA001,PTA004 -- justification text`
+_NOQA_RE = re.compile(
+    r"#\s*noqa\b(?::\s*(?P<codes>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?",
+    re.IGNORECASE)
+
+_ALL_CODES = "__all__"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # "PTA001"
+    path: str          # repo-root-relative posix path
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    anchor: str = ""   # text the fingerprint hashes (defaults to source line)
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1()
+        h.update(f"{self.rule}|{self.path}|{self.anchor}".encode())
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: " \
+               f"{self.rule} {self.message}"
+
+
+class SourceFile:
+    """One parsed python (or text) file plus its suppression map."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.relpath = relpath
+        with open(abspath, "rb") as f:
+            raw = f.read()
+        self.text = raw.decode("utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[Tuple[int, str]] = None
+        if relpath.endswith(".py"):
+            try:
+                self.tree = ast.parse(self.text, filename=abspath)
+            except SyntaxError as e:
+                self.parse_error = (e.lineno or 0, e.msg or "syntax error")
+        self.noqa: Dict[int, set] = self._parse_noqa()
+
+    def _parse_noqa(self) -> Dict[int, set]:
+        out: Dict[int, set] = {}
+        for i, ln in enumerate(self.lines, 1):
+            if "noqa" not in ln:
+                continue
+            m = _NOQA_RE.search(ln)
+            if not m:
+                continue
+            codes = m.group("codes")
+            if codes:
+                out[i] = {c.strip().upper() for c in codes.split(",")}
+            else:
+                out[i] = {_ALL_CODES}
+        return out
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node_or_line, message: str,
+                col: Optional[int] = None, anchor: str = "") -> Finding:
+        if isinstance(node_or_line, int):
+            line, c = node_or_line, (col or 0)
+        else:
+            line = getattr(node_or_line, "lineno", 0)
+            c = getattr(node_or_line, "col_offset", 0) if col is None else col
+        return Finding(rule=rule, path=self.relpath, line=line, col=c,
+                       message=message,
+                       anchor=anchor or self.line_text(line))
+
+    def is_suppressed(self, f: Finding) -> bool:
+        codes = self.noqa.get(f.line)
+        return bool(codes) and (_ALL_CODES in codes or f.rule in codes)
+
+
+class Project:
+    """All files under the analyzed paths, plus a lazily built call graph."""
+
+    def __init__(self, root: str, paths: List[str]):
+        self.root = os.path.abspath(root)
+        self.files: List[SourceFile] = []
+        self.by_relpath: Dict[str, SourceFile] = {}
+        self._callgraph = None
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if os.path.isfile(ap):
+                self._add(ap)
+            else:
+                for dirpath, dirnames, filenames in os.walk(ap):
+                    dirnames[:] = sorted(
+                        d for d in dirnames
+                        if d not in ("__pycache__", ".git"))
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            self._add(os.path.join(dirpath, fn))
+
+    def _add(self, abspath: str):
+        rel = os.path.relpath(abspath, self.root).replace(os.sep, "/")
+        if rel in self.by_relpath:
+            return
+        sf = SourceFile(abspath, rel)
+        self.files.append(sf)
+        self.by_relpath[rel] = sf
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from . import callgraph
+            self._callgraph = callgraph.build(self)
+        return self._callgraph
+
+    def read_rootfile(self, relpath: str) -> Optional[SourceFile]:
+        """A file addressed from the repo root (e.g. tools/op_catalog.txt)
+        whether or not it was in the analyzed paths."""
+        sf = self.by_relpath.get(relpath)
+        if sf is not None:
+            return sf
+        ap = os.path.join(self.root, relpath)
+        if not os.path.isfile(ap):
+            return None
+        return SourceFile(ap, relpath)
+
+
+# -- rule running -------------------------------------------------------------
+
+def run_rules(project: Project, rules) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files:
+        if sf.parse_error is not None:
+            line, msg = sf.parse_error
+            findings.append(Finding("PTA000", sf.relpath, line, 0,
+                                    f"syntax error: {msg}", anchor=msg))
+            continue
+        for rule in rules:
+            findings.extend(rule.visit_file(sf, project))
+    for rule in rules:
+        findings.extend(rule.finalize(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def filter_noqa(project: Project,
+                findings: List[Finding]) -> Tuple[List[Finding],
+                                                  List[Finding]]:
+    """Split into (kept, suppressed) using each file's inline noqa map."""
+    kept, suppressed = [], []
+    for f in findings:
+        sf = project.by_relpath.get(f.path)
+        if sf is not None and sf.is_suppressed(f):
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+# -- baseline -----------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> {"rule", "path", "message", "count"}; {} if absent."""
+    if not path or not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return data.get("findings", {})
+
+
+def split_findings(findings: List[Finding], baseline: Dict[str, dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Return (new, baselined, expired_fingerprints).
+
+    For each fingerprint the first `count` occurrences (in line order —
+    run_rules sorts) are baselined; any beyond that are new. Baseline
+    entries whose fingerprint occurs fewer times than recorded are
+    (partially) expired — reported so `--write-baseline` can prune them.
+    """
+    seen: Dict[str, int] = {}
+    new, baselined = [], []
+    for f in findings:
+        fp = f.fingerprint
+        allowed = baseline.get(fp, {}).get("count", 0)
+        seen[fp] = seen.get(fp, 0) + 1
+        if seen[fp] <= allowed:
+            baselined.append(f)
+        else:
+            new.append(f)
+    expired = [fp for fp, entry in baseline.items()
+               if seen.get(fp, 0) < entry.get("count", 0)]
+    return new, baselined, expired
+
+
+def baseline_payload(findings: List[Finding]) -> dict:
+    entries: Dict[str, dict] = {}
+    for f in findings:
+        e = entries.get(f.fingerprint)
+        if e is None:
+            entries[f.fingerprint] = {"rule": f.rule, "path": f.path,
+                                      "message": f.message, "count": 1}
+        else:
+            e["count"] += 1
+    return {"version": BASELINE_VERSION, "findings": entries}
+
+
+def write_baseline(path: str, findings: List[Finding]):
+    payload = baseline_payload(findings)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+# -- shared AST helpers (used by several rules) -------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """Flatten Name/Attribute chains: jax.lax.scan -> "jax.lax.scan"."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def walk_own_body(func_node: ast.AST):
+    """Yield nodes of a function's body without descending into nested
+    function/class definitions (those are analyzed as their own units)."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

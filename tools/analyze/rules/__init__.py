@@ -1,0 +1,14 @@
+"""Rule registry. Each rule module exposes a single ``RULE`` instance."""
+from __future__ import annotations
+
+from .pta001_tracer_safety import RULE as PTA001  # noqa: F401
+from .pta002_host_sync import RULE as PTA002      # noqa: F401
+from .pta003_silent_except import RULE as PTA003  # noqa: F401
+from .pta004_op_registry import RULE as PTA004    # noqa: F401
+from .pta005_api_hygiene import RULE as PTA005    # noqa: F401
+
+ALL_RULES = [PTA001, PTA002, PTA003, PTA004, PTA005]
+
+
+def rules_by_code():
+    return {r.code: r for r in ALL_RULES}

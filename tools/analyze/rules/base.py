@@ -1,0 +1,18 @@
+"""Minimal rule interface: per-file visit plus a project-wide finalize."""
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Finding, Project, SourceFile
+
+
+class Rule:
+    code: str = "PTA000"
+    name: str = "base"
+    description: str = ""
+
+    def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []

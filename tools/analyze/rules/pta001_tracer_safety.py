@@ -1,0 +1,131 @@
+"""PTA001: host-forcing operations inside jit-reachable functions.
+
+Inside a traced region (anything reachable from ``jax.jit`` / ``pjit`` /
+``to_static`` — see callgraph.py) a value is a Tracer, and forcing it to a
+concrete host value either raises ``TracerError`` at runtime or, worse,
+silently inserts a device->host round-trip that splits the XLA program
+(cf. the LazyTensor eager/compiled boundary analysis, arxiv 2102.13267).
+
+Flagged inside jit-reachable functions:
+
+- ``x.item()`` / ``x.numpy()`` / ``x.tolist()`` / ``x.block_until_ready()``
+- ``np.*(x)`` — numpy materializes its arguments (allowlist for the
+  handful of np attributes that are type-level, not value-level)
+- ``bool(x)`` / ``float(x)`` / ``int(x)`` where ``x`` derives from a
+  function parameter (parameters are the traced values in a jitted fn)
+- ``if`` / ``while`` whose test contains any of the above (branching on a
+  traced value — the classic tracer leak)
+
+Suppress intentional cases with ``# noqa: PTA001 -- <why this value is
+static at trace time>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .base import Rule
+from ..core import Finding, Project, SourceFile, dotted_name
+
+HOST_METHODS = {"item", "numpy", "tolist", "block_until_ready"}
+
+#: np.<attr> that never materialize array values
+NP_SAFE_ATTRS = {
+    "dtype", "issubdtype", "result_type", "promote_types", "can_cast",
+    "finfo", "iinfo", "errstate", "ndim", "newaxis", "pi", "e", "inf",
+    "nan", "float16", "float32", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "bool_", "generic", "ndarray", "integer",
+    "floating", "complexfloating", "inexact", "number",
+}
+
+CASTS = {"bool", "float", "int"}
+
+
+def _param_names(func_node) -> Set[str]:
+    a = func_node.args
+    names = {x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _host_forcing(node: ast.AST, params: Set[str]) -> str:
+    """Return a description if ``node`` is a host-forcing call, else ''."""
+    if not isinstance(node, ast.Call):
+        return ""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in HOST_METHODS and not node.args:
+            return f".{f.attr}() host-materializes a traced value"
+        base = dotted_name(f.value)
+        if base in ("np", "numpy") and f.attr not in NP_SAFE_ATTRS:
+            return (f"np.{f.attr}() materializes its arguments on host "
+                    f"(use jnp inside traced code)")
+    elif isinstance(f, ast.Name) and f.id in CASTS and len(node.args) == 1:
+        arg = node.args[0]
+        if not isinstance(arg, ast.Constant):
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and sub.id in params:
+                    return (f"{f.id}() on parameter-derived value forces a "
+                            f"concrete host value under trace")
+    return ""
+
+
+class TracerSafetyRule(Rule):
+    code = "PTA001"
+    name = "tracer-safety"
+    description = ("host-forcing calls / branches inside functions "
+                   "reachable from jit, pjit or to_static")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        graph = project.callgraph
+        for fi in graph.reachable():
+            sf = fi.file
+            params = _param_names(fi.node)
+            via = (f" [jit-reachable via {fi.reachable_from}]"
+                   if fi.reachable_from != fi.qualname
+                   else " [jit entry point]")
+            flagged_calls = set()
+
+            # branch tests first: more specific message, dedup the call
+            for node in self._own_body(fi.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    for sub in ast.walk(node.test):
+                        why = _host_forcing(sub, params)
+                        if why:
+                            flagged_calls.add(id(sub))
+                            kind = ("while" if isinstance(node, ast.While)
+                                    else "if")
+                            findings.append(sf.finding(
+                                self.code, node,
+                                f"`{kind}` branches on a host-forced "
+                                f"value in `{fi.qualname}`: {why}{via}"))
+                            break
+            for node in self._own_body(fi.node):
+                if id(node) in flagged_calls:
+                    continue
+                why = _host_forcing(node, params)
+                if why:
+                    findings.append(sf.finding(
+                        self.code, node,
+                        f"{why} in jit-reachable `{fi.qualname}`{via}"))
+        return findings
+
+    @staticmethod
+    def _own_body(func_node):
+        stack = list(ast.iter_child_nodes(func_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+RULE = TracerSafetyRule()

@@ -1,0 +1,86 @@
+"""PTA002: host synchronization inside hot-path directories.
+
+XLA only fuses what it can see in one program; a device->host round-trip
+(``.numpy()``, ``.item()``, ``np.asarray`` on device values,
+``block_until_ready``) in per-op or per-step code serializes the pipeline
+and breaks fusion across the sync point (cf. arxiv 2301.13062 on
+fusion-breaking host round-trips). ROADMAP's "as fast as the hardware
+allows" means the op library, the optimizers and the training loop must
+stay sync-free except where semantics *require* a concrete value (shape
+arguments, dygraph control flow, end-of-step metric reporting).
+
+Scope: ``paddle_tpu/ops/``, ``paddle_tpu/optimizer/``, ``paddle_tpu/amp/``
+and the hapi training loop. Intentional syncs carry
+``# noqa: PTA002 -- <why a concrete value is semantically required>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import Rule
+from ..core import Finding, Project, SourceFile, dotted_name
+
+HOT_PREFIXES = (
+    "paddle_tpu/ops/",
+    "paddle_tpu/optimizer/",
+    "paddle_tpu/amp/",
+    "paddle_tpu/hapi/model.py",
+)
+
+SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}
+NP_MATERIALIZERS = {"asarray", "array", "ascontiguousarray", "copy"}
+
+
+def _is_static_literal(node: ast.AST) -> bool:
+    """Literals / containers of literals can't be device values."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(_is_static_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_literal(node.operand)
+    return False
+
+
+class HostSyncRule(Rule):
+    code = "PTA002"
+    name = "host-sync-in-hot-path"
+    description = ("device->host syncs (.numpy()/.item()/np.asarray/"
+                   "block_until_ready) in ops/, optimizer/, amp/ and the "
+                   "training loop")
+
+    def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
+        if not sf.relpath.startswith(HOT_PREFIXES):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "block_until_ready":
+                    findings.append(sf.finding(
+                        self.code, node,
+                        "block_until_ready() stalls the dispatch pipeline"))
+                elif f.attr in SYNC_METHODS and not node.args:
+                    findings.append(sf.finding(
+                        self.code, node,
+                        f".{f.attr}() is a device->host sync in a hot path "
+                        f"— hoist it out of the per-step path or justify "
+                        f"with `# noqa: PTA002 -- reason`"))
+                else:
+                    base = dotted_name(f.value)
+                    if (base in ("np", "numpy")
+                            and f.attr in NP_MATERIALIZERS
+                            and node.args
+                            and not _is_static_literal(node.args[0])):
+                        findings.append(sf.finding(
+                            self.code, node,
+                            f"np.{f.attr}() on a possibly-device value "
+                            f"forces a host transfer (use jnp.{f.attr} to "
+                            f"stay on device)"))
+        return findings
+
+
+RULE = HostSyncRule()

@@ -1,0 +1,98 @@
+"""PTA003: silently-swallowed failures in resilience-critical paths.
+
+Absorbed from ``tools/lint_silent_except.py`` (which remains as a thin
+shim): the elastic fault-tolerance runtime (docs/fault_tolerance.md)
+depends on failures *propagating* — a swallowed exception in the launcher,
+the elastic supervisor or the checkpoint layer turns a recoverable crash
+into silent state corruption. Rejected, inside CHECKED_DIRS:
+
+- bare ``except:`` handlers
+- ``except Exception:`` / ``except BaseException:`` (alone or in a tuple)
+  whose body does nothing (only ``pass`` / ``...``)
+
+Catching Exception and then *acting* (logging, re-raising, returning an
+explicit sentinel) is fine — the rule targets the do-nothing swallow.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from .base import Rule
+from ..core import Finding, Project, SourceFile
+
+#: directories where a silent swallow is a correctness bug, not a style nit
+CHECKED_DIRS = (
+    "paddle_tpu/distributed",
+    "paddle_tpu/incubate/checkpoint",
+    "paddle_tpu/utils",
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _names_in(expr):
+    """Exception-class names referenced by an except clause's type expr."""
+    if expr is None:
+        return set()
+    if isinstance(expr, ast.Name):
+        return {expr.id}
+    if isinstance(expr, ast.Attribute):
+        return {expr.attr}
+    if isinstance(expr, ast.Tuple):
+        out = set()
+        for elt in expr.elts:
+            out |= _names_in(elt)
+        return out
+    return set()
+
+
+def _body_is_noop(body):
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+def iter_offenders(tree: ast.AST) -> List[Tuple[int, str]]:
+    """(lineno, message) pairs for every silent-except in ``tree``."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            out.append((node.lineno,
+                        "bare 'except:' swallows everything incl. "
+                        "SystemExit"))
+        elif _names_in(node.type) & _BROAD and _body_is_noop(node.body):
+            out.append((node.lineno,
+                        "'except Exception: pass' silently swallows "
+                        "failures"))
+    return out
+
+
+class SilentExceptRule(Rule):
+    code = "PTA003"
+    name = "silent-except"
+    description = ("bare/broad do-nothing except handlers in "
+                   "resilience-critical paths (launcher, elastic "
+                   "supervisor, checkpoint layer)")
+
+    def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
+        if not any(sf.relpath.startswith(d + "/") or sf.relpath == d
+                   for d in CHECKED_DIRS):
+            return []
+        return [
+            sf.finding(self.code, lineno,
+                       msg + " (failures in resilience paths must "
+                             "propagate; docs/fault_tolerance.md)")
+            for lineno, msg in iter_offenders(sf.tree)
+        ]
+
+
+RULE = SilentExceptRule()

@@ -1,0 +1,326 @@
+"""PTA004: op-registry <-> catalog consistency.
+
+The dispatch funnel (``paddle_tpu/ops/dispatch.py``) is the single place
+every framework op goes through, and ``tools/op_catalog.txt`` is the
+audited list of reference forward ops (``tools/op_coverage.py`` maps each
+entry to an implementation / absorption / ADR). Those two surfaces drift
+silently: an op registered under a name the catalog never heard of is
+invisible to the coverage audit, and a catalog entry nothing claims is a
+parity hole that looks "done".
+
+Static cross-check, both directions:
+
+- **registration side**: every string-literal op name passed to
+  ``apply(...)`` / ``apply_raw(...)`` / ``defop(...)`` /
+  ``@register_op(...)`` (plus the keys of table-driven op dicts like
+  ``_UNARY`` in ops modules) must be claimed by the catalog — directly,
+  through an ``ALIASES`` / ``MANUAL_IMPL`` mapping in op_coverage.py, or
+  via the catalog's ``_v2``/trailing-``2`` variants.
+- **catalog side**: every catalog entry must be claimed by a registered
+  op name, a def/class of that name somewhere in the analyzed tree, or an
+  op_coverage.py status table (MANUAL_IMPL / ABSORBED / ADR / NA).
+- catalog hygiene: entries sorted, unique, non-empty (``#`` comments ok).
+- ``# native: <name>`` comment lines claim tpu-native / internal ops that
+  have no reference catalog entry; a native claim whose op no longer
+  exists is flagged as stale.
+- every ops module documents its parity target with a ``reference:`` line
+  in the module docstring.
+- ops registered inside module-private helpers that nothing calls or
+  re-exports are flagged: the public surface can't reach them, so they
+  are dead registrations.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .base import Rule
+from ..core import Finding, Project, SourceFile
+
+CATALOG_RELPATH = "tools/op_catalog.txt"
+COVERAGE_RELPATH = "tools/op_coverage.py"
+OPS_DIR = "paddle_tpu/ops/"
+
+REGISTER_FUNCS = {"apply", "apply_raw", "_apply", "defop", "register_op"}
+COVERAGE_TABLES = {"ALIASES", "MANUAL_IMPL", "ABSORBED", "ADR", "NA"}
+
+_OP_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_TABLE_NAME_RE = re.compile(r"^_[A-Z][A-Z_]*$")
+
+
+def _literal_str_keys(d: ast.Dict) -> List[Tuple[str, int]]:
+    out = []
+    for k, v in zip(d.keys, d.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            out.append((k.value, k.lineno))
+        elif k is None and isinstance(v, ast.DictComp):
+            # `{**{k: v for k in [...]}, ...}` — the ADR table pattern
+            it = v.generators[0].iter
+            if isinstance(it, (ast.List, ast.Tuple)):
+                for e in it.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                  str):
+                        out.append((e.value, e.lineno))
+    return out
+
+
+def _collect_registered(project: Project) -> Dict[str, List[Tuple[SourceFile,
+                                                                  int, str]]]:
+    """op name -> [(file, line, enclosing_toplevel_def)] for every static
+    registration site in the analyzed files."""
+    reg: Dict[str, List[Tuple[SourceFile, int, str]]] = {}
+
+    def add(name, sf, lineno, encl):
+        reg.setdefault(name, []).append((sf, lineno, encl))
+
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        # registration calls, with enclosing top-level def tracked
+        def walk(node, encl: str):
+            for child in ast.iter_child_nodes(node):
+                child_encl = encl
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_encl = encl or child.name
+                if isinstance(child, ast.Call):
+                    f = child.func
+                    fname = f.attr if isinstance(f, ast.Attribute) else (
+                        f.id if isinstance(f, ast.Name) else "")
+                    if (fname in REGISTER_FUNCS and child.args
+                            and isinstance(child.args[0], ast.Constant)
+                            and isinstance(child.args[0].value, str)):
+                        add(child.args[0].value, sf, child.lineno,
+                            child_encl)
+                walk(child, child_encl)
+        walk(sf.tree, "")
+
+        # table-driven op dicts (ops modules only): _UNARY = {"abs": ...}
+        if OPS_DIR in sf.relpath:
+            for node in sf.tree.body:
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and _TABLE_NAME_RE.match(node.targets[0].id)
+                        and isinstance(node.value, ast.Dict)):
+                    for name, lineno in _literal_str_keys(node.value):
+                        if _OP_NAME_RE.match(name):
+                            add(name, sf, lineno, "")
+    return reg
+
+
+def _collect_coverage_claims(project: Project) -> Tuple[Set[str], Set[str]]:
+    """(catalog-side claim keys, our-side claimed names) from the status
+    tables in tools/op_coverage.py. Missing file -> empty sets."""
+    sf = project.read_rootfile(COVERAGE_RELPATH)
+    keys: Set[str] = set()
+    ours: Set[str] = set()
+    if sf is None or sf.tree is None:
+        return keys, ours
+    for node in sf.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in COVERAGE_TABLES
+                and isinstance(node.value, ast.Dict)):
+            continue
+        tbl = node.targets[0].id
+        for k, _ in _literal_str_keys(node.value):
+            keys.add(k)
+        if tbl == "ALIASES":
+            for v in node.value.values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    ours.add(v.value)
+        elif tbl == "MANUAL_IMPL":
+            for v in node.value.values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    # "module:attr[.attr]" — the attr is our-side name
+                    attr = v.value.partition(":")[2]
+                    if attr:
+                        ours.add(attr.split(".")[-1])
+    return keys, ours
+
+
+def _collect_used_names(project: Project) -> Set[str]:
+    """Names that are called or re-exported somewhere in the analyzed
+    tree — a registration inside a private helper is only *dead* when
+    nothing uses the helper."""
+    used: Set[str] = set()
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                    and node.id.startswith("_")):
+                used.add(node.id)  # called, aliased, or put in a table
+            elif isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+                used.add(node.attr)
+    return used
+
+
+def _collect_defnames(project: Project) -> Set[str]:
+    names: Set[str] = set()
+    for sf in project.files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                names.add(node.name)
+    return names
+
+
+def _catalog_candidates(name: str, aliases: Dict[str, str]) -> List[str]:
+    """Mirror op_coverage.resolve()'s candidate generation, statically."""
+    cands = [name]
+    if name in aliases:
+        cands.append(aliases[name])
+    if name.endswith("_v2"):
+        cands.append(name[:-3])
+        if name[:-3] in aliases:
+            cands.append(aliases[name[:-3]])
+    elif name.endswith("2") and not name.endswith("v2"):
+        cands.append(name[:-1])
+    return cands
+
+
+def _collect_aliases(project: Project) -> Dict[str, str]:
+    sf = project.read_rootfile(COVERAGE_RELPATH)
+    out: Dict[str, str] = {}
+    if sf is None or sf.tree is None:
+        return out
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "ALIASES"
+                and isinstance(node.value, ast.Dict)):
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    out[k.value] = v.value
+    return out
+
+
+class OpRegistryRule(Rule):
+    code = "PTA004"
+    name = "op-registry-consistency"
+    description = ("dispatch registrations, tools/op_catalog.txt and "
+                   "tools/op_coverage.py status tables must agree")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        if not any(OPS_DIR in sf.relpath for sf in project.files):
+            return []  # nothing op-shaped in the analyzed paths
+        findings: List[Finding] = []
+
+        catalog_sf = project.read_rootfile(CATALOG_RELPATH)
+        if catalog_sf is None:
+            return []  # mini-repos without a catalog: nothing to check
+        entries: List[Tuple[str, int]] = []
+        native: Dict[str, int] = {}  # `# native: name` claims
+        for i, ln in enumerate(catalog_sf.lines, 1):
+            s = ln.strip()
+            if s.startswith("#"):
+                m = re.match(r"#\s*native:\s*([a-z][a-z0-9_]*)\s*$", s)
+                if m:
+                    native.setdefault(m.group(1), i)
+            elif s:
+                entries.append((s, i))
+
+        # hygiene: sorted + unique
+        seen: Dict[str, int] = {}
+        prev = ""
+        for name, lineno in entries:
+            if name in seen:
+                findings.append(catalog_sf.finding(
+                    self.code, lineno,
+                    f"duplicate catalog entry '{name}' "
+                    f"(first at line {seen[name]})", anchor=f"dup:{name}"))
+            else:
+                seen[name] = lineno
+            if name < prev:
+                findings.append(catalog_sf.finding(
+                    self.code, lineno,
+                    f"catalog entry '{name}' breaks sort order "
+                    f"(after '{prev}')", anchor=f"sort:{name}"))
+            prev = name
+
+        catalog = set(seen)
+        registered = _collect_registered(project)
+        coverage_keys, coverage_ours = _collect_coverage_claims(project)
+        aliases = _collect_aliases(project)
+        alias_rev: Dict[str, List[str]] = {}
+        for k, v in aliases.items():
+            alias_rev.setdefault(v, []).append(k)
+        defnames = _collect_defnames(project)
+        used_names = _collect_used_names(project)
+
+        # registration side: every registered name must be claimed
+        catalog_variants = set(catalog)
+        for c in catalog:
+            if c.endswith("_v2"):
+                catalog_variants.add(c[:-3])
+            elif c.endswith("2") and not c.endswith("v2"):
+                catalog_variants.add(c[:-1])
+        for name, sites in sorted(registered.items()):
+            claimed = (name in catalog_variants
+                       or name in native
+                       or name in coverage_ours
+                       or any(a in catalog for a in alias_rev.get(name, ())))
+            if not claimed:
+                sf, lineno, _encl = sites[0]
+                findings.append(sf.finding(
+                    self.code, lineno,
+                    f"op '{name}' is registered through dispatch but has "
+                    f"no entry in {CATALOG_RELPATH} and no ALIASES/"
+                    f"MANUAL_IMPL mapping in {COVERAGE_RELPATH}",
+                    anchor=f"unlisted:{name}"))
+            # dead registration inside a private helper nothing uses
+            for sf, lineno, encl in sites:
+                if (OPS_DIR in sf.relpath and encl.startswith("_")
+                        and not encl.startswith("__")
+                        and encl not in used_names):
+                    findings.append(sf.finding(
+                        self.code, lineno,
+                        f"op '{name}' is registered inside module-private "
+                        f"helper `{encl}` — unreachable from the public "
+                        f"API surface", anchor=f"private:{name}:{encl}"))
+
+        # catalog side: every entry must be claimed by something real
+        for name, lineno in entries:
+            if name in coverage_keys:
+                continue
+            cands = _catalog_candidates(name, aliases)
+            if any(c in registered or c in defnames for c in cands):
+                continue
+            findings.append(catalog_sf.finding(
+                self.code, lineno,
+                f"catalog entry '{name}' is claimed by nothing: no "
+                f"registered op, no def/class of that name, no status "
+                f"table in {COVERAGE_RELPATH} — implement it or record "
+                f"an ADR/absorbed/na status", anchor=f"stale:{name}"))
+
+        # native claims must still exist on our side
+        for name, lineno in sorted(native.items()):
+            if name not in registered and name not in defnames:
+                findings.append(catalog_sf.finding(
+                    self.code, lineno,
+                    f"`# native: {name}` claims an op that is no longer "
+                    f"registered anywhere — delete the claim or restore "
+                    f"the op", anchor=f"stale-native:{name}"))
+
+        # ops modules must state their parity target
+        for sf in project.files:
+            if (OPS_DIR in sf.relpath and sf.tree is not None
+                    and not sf.relpath.endswith("__init__.py")):
+                doc = ast.get_docstring(sf.tree) or ""
+                if "reference" not in doc.lower():
+                    findings.append(sf.finding(
+                        self.code, 1,
+                        "ops module docstring lacks a `reference:` line "
+                        "naming its parity target in the reference "
+                        "codebase", anchor="no-reference-line"))
+        return findings
+
+
+RULE = OpRegistryRule()

@@ -1,0 +1,89 @@
+"""PTA005: public-API hygiene — mutable default args, missing
+``from __future__ import annotations``.
+
+Mutable defaults (``def f(x=[])``) are shared across calls; in an op
+library they alias state between unrelated user calls — the reference
+bans them outright in its python lint. And modules that use type
+annotations without the ``__future__`` import evaluate them eagerly at
+import time, which both slows cold import (ROADMAP: serving path) and
+breaks under deferred / optional imports (e.g. annotations naming types
+from gated optional deps).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import Rule
+from ..core import Finding, Project, SourceFile
+
+API_PREFIX = "paddle_tpu/"
+
+_MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict"}
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        return name in _MUTABLE_CALLS and not node.args and not node.keywords
+    return False
+
+
+def _has_annotations(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AnnAssign):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.returns is not None:
+                return True
+            a = node.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                if arg.annotation is not None:
+                    return True
+    return False
+
+
+def _has_future_annotations(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            if any(alias.name == "annotations" for alias in node.names):
+                return True
+    return False
+
+
+class ApiHygieneRule(Rule):
+    code = "PTA005"
+    name = "api-hygiene"
+    description = ("mutable default arguments and missing `from __future__ "
+                   "import annotations` in public API modules")
+
+    def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
+        if API_PREFIX not in sf.relpath:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            a = node.args
+            for default in list(a.defaults) + [d for d in a.kw_defaults
+                                               if d is not None]:
+                if _is_mutable_default(default):
+                    findings.append(sf.finding(
+                        self.code, default,
+                        f"mutable default argument in `{node.name}` is "
+                        f"shared across calls — use None and initialize "
+                        f"inside the body"))
+        if _has_annotations(sf.tree) and not _has_future_annotations(sf.tree):
+            findings.append(sf.finding(
+                self.code, 1,
+                "module uses type annotations without `from __future__ "
+                "import annotations` (eager evaluation at import time)",
+                anchor="no-future-annotations"))
+        return findings
+
+
+RULE = ApiHygieneRule()

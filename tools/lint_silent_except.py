@@ -1,20 +1,12 @@
 #!/usr/bin/env python
 """Forbid silently-swallowed failures in the resilience-critical paths.
 
-The elastic fault-tolerance runtime (docs/fault_tolerance.md) depends on
-failures *propagating*: a swallowed exception in the launcher, the elastic
-supervisor, or the checkpoint layer turns a recoverable crash into silent
-state corruption. This lint rejects, inside the directories below:
-
-- bare ``except:`` handlers
-- ``except Exception:`` / ``except BaseException:`` (alone or in a tuple)
-  whose body does nothing (only ``pass`` / ``...``)
-
-Catching Exception and then *acting* (logging, re-raising, returning an
-explicit sentinel) is fine — the rule targets the do-nothing swallow.
-
-Run directly (``python tools/lint_silent_except.py``; exit 1 on offenders)
-or via the test suite (tests/test_resilience_lint.py, tier-1).
+Shim: the actual rule now lives in the static-analysis framework as
+PTA003 (tools/analyze/rules/pta003_silent_except.py) and runs with the
+rest of the analyzer (``python -m tools.analyze``). This file keeps the
+original standalone interface — ``check_file`` / ``find_offenders`` /
+``main`` / ``CHECKED_DIRS`` — for tests/test_resilience_lint.py and for
+anyone running ``python tools/lint_silent_except.py`` directly.
 """
 from __future__ import annotations
 
@@ -24,42 +16,16 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: directories where a silent swallow is a correctness bug, not a style nit
-CHECKED_DIRS = (
-    os.path.join("paddle_tpu", "distributed"),
-    os.path.join("paddle_tpu", "incubate", "checkpoint"),
-    os.path.join("paddle_tpu", "utils"),
+if REPO_ROOT not in sys.path:  # the test loads this file by path
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.analyze.rules.pta003_silent_except import (  # noqa: E402
+    iter_offenders,
 )
+from tools.analyze.rules import pta003_silent_except as _rule  # noqa: E402
 
-_BROAD = {"Exception", "BaseException"}
-
-
-def _names_in(expr):
-    """Exception-class names referenced by an except clause's type expr."""
-    if expr is None:
-        return set()
-    if isinstance(expr, ast.Name):
-        return {expr.id}
-    if isinstance(expr, ast.Attribute):
-        return {expr.attr}
-    if isinstance(expr, ast.Tuple):
-        out = set()
-        for elt in expr.elts:
-            out |= _names_in(elt)
-        return out
-    return set()
-
-
-def _body_is_noop(body):
-    for stmt in body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if (isinstance(stmt, ast.Expr)
-                and isinstance(stmt.value, ast.Constant)
-                and stmt.value.value is Ellipsis):
-            continue
-        return False
-    return True
+#: directories where a silent swallow is a correctness bug, not a style nit
+CHECKED_DIRS = tuple(os.path.join(*d.split("/")) for d in _rule.CHECKED_DIRS)
 
 
 def check_file(path):
@@ -69,19 +35,7 @@ def check_file(path):
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
         return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
-    offenders = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if node.type is None:
-            offenders.append(
-                (path, node.lineno,
-                 "bare 'except:' swallows everything incl. SystemExit"))
-        elif _names_in(node.type) & _BROAD and _body_is_noop(node.body):
-            offenders.append(
-                (path, node.lineno,
-                 "'except Exception: pass' silently swallows failures"))
-    return offenders
+    return [(path, lineno, msg) for lineno, msg in iter_offenders(tree)]
 
 
 def find_offenders(root=REPO_ROOT, dirs=CHECKED_DIRS):

@@ -77,6 +77,7 @@ ALIASES = {
     "depthwise_conv2d_transpose": "conv2d_transpose",
     "sigmoid_cross_entropy_with_logits": "binary_cross_entropy_with_logits",
     "range": "arange", "isfinite_op": "isfinite",
+    "reverse": "flip",
     "brelu": "hardtanh", "softshrink": "softshrink",
     "bilinear_tensor_product": "bilinear",
     "margin_rank_loss": "margin_rank_loss",
@@ -474,7 +475,7 @@ def main():
 
     names = [l.strip() for l in
              open(os.path.join(REPO, "tools", "op_catalog.txt"))
-             if l.strip()]
+             if l.strip() and not l.lstrip().startswith("#")]
     corpus = _tests_corpus()
     rows, blanks, bad, untested = [], [], [], []
     counts = {"impl": 0, "absorbed": 0, "adr": 0, "na": 0}

@@ -38,7 +38,19 @@ def main():
                     help="run only the slow lane")
     ap.add_argument("--all", action="store_true", help="run both lanes")
     ap.add_argument("--files", nargs="*", help="restrict to these files")
+    ap.add_argument("--no-analyze", action="store_true",
+                    help="skip the static-analysis gate")
     args = ap.parse_args()
+
+    if not args.no_analyze:
+        # Static analysis gates the suite: 0 clean, 1 new findings,
+        # 2 analyzer internal error (python -m tools.analyze semantics).
+        t0 = time.time()
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.analyze", "paddle_tpu"], cwd=REPO)
+        print(f"static analysis: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
 
     files = args.files or sorted(
         glob.glob(os.path.join(REPO, "tests", "test_*.py")))
