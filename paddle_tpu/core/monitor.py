@@ -8,9 +8,70 @@ to any subsystem (io workers, checkpointing, launcher) for counters.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Dict, Union
 
 Number = Union[int, float]
+
+#: default reservoir bound per histogram — old samples roll off so quantiles
+#: track recent behaviour (a sliding window, not all-time).
+DEFAULT_HIST_SAMPLES = 2048
+
+
+class _Histogram:
+    """Bounded-reservoir value distribution (count/total are all-time;
+    quantiles come from the newest ``maxlen`` samples)."""
+
+    __slots__ = ("count", "total", "vmin", "vmax", "samples")
+
+    def __init__(self, max_samples: int = DEFAULT_HIST_SAMPLES):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.samples = deque(maxlen=max_samples)
+
+    def observe(self, value: Number):
+        v = float(value)  # noqa: PTA001 -- monitor samples are host-side scalars by contract (never called under trace; the name-collision is with an unrelated `.observe`)
+        self.count += 1
+        self.total += v
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        self.samples.append(v)
+
+    def quantile(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        xs = sorted(self.samples)
+        if len(xs) == 1:
+            return xs[0]
+        # linear interpolation between closest ranks
+        pos = min(max(q, 0.0), 1.0) * (len(xs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+    def summary(self) -> Dict[str, float]:
+        xs = sorted(self.samples)
+
+        def _q(q):
+            if not xs:
+                return 0.0
+            pos = q * (len(xs) - 1)
+            lo = int(pos)
+            hi = min(lo + 1, len(xs) - 1)
+            return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+        return {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin if self.count else 0.0,
+            "max": self.vmax if self.count else 0.0,
+            "mean": (self.total / self.count) if self.count else 0.0,
+            "p50": _q(0.50),
+            "p95": _q(0.95),
+            "p99": _q(0.99),
+        }
 
 
 class StatRegistry:
@@ -19,6 +80,7 @@ class StatRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._stats: Dict[str, Number] = {}
+        self._hists: Dict[str, _Histogram] = {}
 
     def add(self, name: str, value: Number) -> Number:
         with self._lock:
@@ -37,16 +99,48 @@ class StatRegistry:
         with self._lock:
             if name is None:
                 self._stats.clear()
+                self._hists.clear()
             else:
                 self._stats.pop(name, None)
+                self._hists.pop(name, None)
 
     def stats(self) -> Dict[str, Number]:
         with self._lock:
             return dict(self._stats)
 
+    # -- histograms ---------------------------------------------------------
+    def observe(self, name: str, value: Number,
+                max_samples: int = DEFAULT_HIST_SAMPLES):
+        """Record one sample of a value distribution (latency, fill ratio).
+        Bounded memory: quantiles reflect the newest ``max_samples``."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _Histogram(max_samples)
+            h.observe(value)
+
+    def quantile(self, name: str, q: float, default: float = 0.0) -> float:
+        with self._lock:
+            h = self._hists.get(name)
+            return h.quantile(q) if h is not None else default
+
+    def histogram(self, name: str) -> Dict[str, float]:
+        """Summary dict (count/sum/min/max/mean/p50/p95/p99); zeros if the
+        histogram has never been observed."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.summary() if h is not None else _Histogram(1).summary()
+
+    def histograms(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: h.summary() for k, h in self._hists.items()}
+
     def print_stats(self):
         for k, v in sorted(self.stats().items()):
             print(f"STAT {k} = {v}")
+        for k, s in sorted(self.histograms().items()):
+            print(f"HIST {k} = count={s['count']} p50={s['p50']:.6g} "
+                  f"p95={s['p95']:.6g} p99={s['p99']:.6g}")
 
 
 _REGISTRY = StatRegistry()
@@ -67,6 +161,17 @@ def stat_set(name: str, value: Number):
 
 def stat_get(name: str, default: Number = 0) -> Number:
     return _REGISTRY.get(name, default)
+
+
+def stat_observe(name: str, value: Number,
+                 max_samples: int = DEFAULT_HIST_SAMPLES):
+    """Record a histogram sample on the default registry (bounded memory)."""
+    _REGISTRY.observe(name, value, max_samples)
+
+
+def stat_quantile(name: str, q: float, default: float = 0.0) -> float:
+    """q-quantile (0..1) of a histogram's recent samples, or ``default``."""
+    return _REGISTRY.quantile(name, q, default)
 
 
 def device_memory_stats(device=None) -> Dict[str, Number]:

@@ -37,6 +37,56 @@ def under_elastic_supervisor() -> bool:
     return bool(os.environ.get(ELASTIC_ENV_VAR))
 
 
+class ChainedSignalHandler:
+    """Install a callback on signals WITHOUT clobbering what was there.
+
+    Several subsystems legitimately want the same signals — the elastic
+    :class:`PreemptionGuard` arms SIGTERM for checkpoint-then-exit, and the
+    serving ``Engine`` arms SIGTERM for graceful drain. A plain
+    ``signal.signal`` call from the second one silently disables the first.
+    This helper saves the previous handler at install time and invokes it
+    *after* the callback, so every interested party observes the signal;
+    :meth:`uninstall` restores the saved handlers.
+
+    Installation is a no-op off the main thread (CPython only delivers
+    signals to the main thread, and ``signal.signal`` raises elsewhere).
+    """
+
+    def __init__(self, callback: Callable[[int, object], None],
+                 signals: Sequence[int] = _DEFAULT_SIGNALS):
+        self._callback = callback
+        self._signals = tuple(signals)
+        self._prev = {}
+        self._installed = False
+
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
+    def install(self):
+        if (self._installed
+                or threading.current_thread() is not threading.main_thread()):
+            return self
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame):
+        self._callback(signum, frame)
+        prev = self._prev.get(signum)
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(signum, frame)
+
+
 class PreemptionGuard:
     """Signal-armed preemption flag for training loops.
 
@@ -55,35 +105,25 @@ class PreemptionGuard:
 
     def __init__(self, signals: Sequence[int] = _DEFAULT_SIGNALS,
                  install: bool = True):
-        self._signals = tuple(signals)
         self._event = threading.Event()
-        self._prev = {}
-        self._installed = False
+        self._chain = ChainedSignalHandler(self._handler, signals)
         if install:
             self.install()
 
     # -- signal plumbing ----------------------------------------------------
     def install(self):
-        if self._installed or threading.current_thread() is not threading.main_thread():
-            return self
-        for sig in self._signals:
-            self._prev[sig] = signal.signal(sig, self._handler)
-        self._installed = True
+        self._chain.install()
         return self
 
     def uninstall(self):
-        if not self._installed:
-            return
-        for sig, prev in self._prev.items():
-            signal.signal(sig, prev)
-        self._prev.clear()
-        self._installed = False
+        self._chain.uninstall()
+
+    @property
+    def _installed(self) -> bool:  # kept for older callers/tests
+        return self._chain.installed
 
     def _handler(self, signum, frame):
         self._event.set()
-        prev = self._prev.get(signum)
-        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
-            prev(signum, frame)
 
     # -- polling API --------------------------------------------------------
     @property

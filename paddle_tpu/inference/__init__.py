@@ -13,6 +13,7 @@ design: XLA compiles the whole program at load.
 """
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Dict, List, Optional
 
@@ -85,8 +86,14 @@ class Predictor:
     def __init__(self, config: Config):
         prefix = config._prefix
         from jax import export as jax_export
+        from ..serving.cache import default_cache
         with open(prefix + ".pdmodel", "rb") as f:
             self._exported = jax_export.deserialize(f.read())
+        # compiled-callable cache keyed on (artifact, input shapes/dtypes):
+        # batch-size churn stops recompiling — each signature costs one XLA
+        # compile, shared across Predictors over the same artifact
+        self._model_key = os.path.abspath(prefix)
+        self._exec_cache = default_cache()
         with open(config._params_file or prefix + ".pdiparams", "rb") as f:
             blob = pickle.load(f)
         self._params = [jnp.asarray(p) for p in blob["params"]]
@@ -119,8 +126,7 @@ class Predictor:
             xs = [jnp.asarray(a) for a in inputs]
         else:
             xs = [self._inputs[n]._value for n in self._input_names]
-        outs = self._exported.call(self._params, *xs)
-        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        outs = self._call_cached(xs)
         if self._n_out is not None:
             outs = outs[:self._n_out]
         self._outputs = []
@@ -129,6 +135,23 @@ class Predictor:
             h._value = o
             self._outputs.append(h)
         return [np.asarray(o) for o in outs]
+
+    def _call_cached(self, xs):
+        """Execute through the shape-keyed ExecutableCache: a jax.jit
+        wrapper per input signature means one XLA compile per signature
+        (shape-polymorphic artifacts re-lower per shape otherwise)."""
+        from ..serving.cache import signature_of
+        sig = signature_of(xs)
+        exported = self._exported
+
+        def _compile():
+            return jax.jit(lambda params, *xargs: exported.call(
+                params, *xargs))
+
+        fn = self._exec_cache.get_or_compile((self._model_key, sig),
+                                             _compile)
+        outs = fn(self._params, *xs)
+        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
 
 
 def create_predictor(config: Config) -> Predictor:

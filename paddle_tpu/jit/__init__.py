@@ -290,11 +290,48 @@ def save(layer, path, input_spec=None, **config):
     def infer_fn(param_raws, *input_raws):
         return pure(list(param_raws), list(input_raws), key, None)
 
-    avals = [jax.ShapeDtypeStruct(
-        tuple(d if d is not None else 1 for d in s.shape), s.dtype)
-        for s in specs]
     param_avals = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype) for p in state]
-    exported = jax_export.export(jax.jit(infer_fn))(param_avals, *avals)
+
+    def _concrete_avals():
+        return [jax.ShapeDtypeStruct(
+            tuple(d if d is not None else 1 for d in s.shape), s.dtype)
+            for s in specs]
+
+    def _symbolic_avals():
+        # None dims export as shape-polymorphic symbols so ONE artifact
+        # serves every batch size (the serving engine's bucket set); a None
+        # at axis 0 is the batch dim and shares one symbol across inputs.
+        scope = jax_export.SymbolicScope()
+        avals = []
+        for i, s in enumerate(specs):
+            if s.shape is None or all(d is not None for d in s.shape):
+                avals.append(jax.ShapeDtypeStruct(
+                    tuple(s.shape or ()), s.dtype))
+                continue
+            dims = ",".join(
+                ("batch" if j == 0 else f"dyn_{i}_{j}") if d is None
+                else str(d) for j, d in enumerate(s.shape))
+            sym = jax_export.symbolic_shape(dims, scope=scope)
+            avals.append(jax.ShapeDtypeStruct(tuple(sym), s.dtype))
+        return avals
+
+    dynamic = any(d is None for s in specs for d in (s.shape or []))
+    if dynamic:
+        import warnings
+        try:
+            exported = jax_export.export(jax.jit(infer_fn))(
+                param_avals, *_symbolic_avals())
+        except Exception as e:
+            # models with shape-dependent Python control flow can't be
+            # polymorphic; keep the historical fixed-shape (None -> 1) export
+            warnings.warn(
+                f"jit.save: shape-polymorphic export failed ({e!r}); "
+                f"falling back to concrete shapes with None -> 1")
+            exported = jax_export.export(jax.jit(infer_fn))(
+                param_avals, *_concrete_avals())
+    else:
+        exported = jax_export.export(jax.jit(infer_fn))(
+            param_avals, *_concrete_avals())
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:

@@ -1,0 +1,37 @@
+"""paddle_tpu.serving: async dynamic-batching inference engine.
+
+The traffic-facing layer over :mod:`paddle_tpu.inference`: concurrent
+requests are admitted into a bounded :class:`BatchQueue`, coalesced by a
+:class:`DynamicBatcher` into batches padded to a small closed set of shape
+buckets, and executed through a shape-keyed :class:`ExecutableCache` so
+after warmup no request ever waits on an XLA recompile. See
+docs/serving.md for architecture and tuning.
+
+Quick start::
+
+    from paddle_tpu import serving
+    engine = serving.Engine("/path/to/model")      # jit.save prefix
+    fut = engine.submit([x])                        # -> Future
+    y, = fut.result()
+    engine.drain()                                  # graceful shutdown
+
+Or over HTTP: ``python -m paddle_tpu.serving serve --model /path/to/model``.
+"""
+from __future__ import annotations
+
+from .buckets import BucketSpec, pow2_buckets  # noqa: F401
+from .cache import ExecutableCache, default_cache, signature_of  # noqa: F401
+from .queue import BatchQueue  # noqa: F401
+from .batcher import Batch, DynamicBatcher  # noqa: F401
+from .engine import Engine, EngineConfig  # noqa: F401
+from .request import (  # noqa: F401
+    Deadline, DeadlineExceeded, EngineDraining, InferenceRequest,
+    QueueFull, RequestTooLarge, ServingError)
+
+__all__ = [
+    "Engine", "EngineConfig", "BucketSpec", "pow2_buckets",
+    "ExecutableCache", "default_cache", "signature_of", "BatchQueue",
+    "DynamicBatcher", "Batch", "InferenceRequest", "Deadline",
+    "DeadlineExceeded", "EngineDraining", "QueueFull", "RequestTooLarge",
+    "ServingError",
+]

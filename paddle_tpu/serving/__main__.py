@@ -1,0 +1,70 @@
+"""``python -m paddle_tpu.serving serve --model /path/prefix`` — stand up
+the dynamic-batching HTTP inference server over a jit.save artifact.
+
+SIGTERM/SIGINT begin a graceful drain (chained with any PreemptionGuard):
+admission stops, queued requests finish, /healthz flips to 503, process
+exits cleanly.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _parse_int_list(raw: str):
+    return [int(x) for x in raw.split(",") if x.strip()]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.serving")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sv = sub.add_parser("serve", help="serve a jit.save artifact over HTTP")
+    sv.add_argument("--model", required=True,
+                    help="artifact path prefix (the X of X.pdmodel)")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8500)
+    sv.add_argument("--buckets", default="",
+                    help="comma-separated batch buckets (default: powers "
+                         "of two up to --max-batch)")
+    sv.add_argument("--seq-buckets", default="",
+                    help="optional comma-separated sequence buckets "
+                         "(requires a padding-masked model)")
+    sv.add_argument("--max-batch", type=int, default=64)
+    sv.add_argument("--max-queue", type=int, default=256)
+    sv.add_argument("--max-delay-ms", type=float, default=5.0,
+                    help="batcher coalescing window")
+    sv.add_argument("--deadline-s", type=float, default=None,
+                    help="default per-request deadline")
+    sv.add_argument("--oversize", choices=("split", "reject"),
+                    default="split")
+    args = ap.parse_args(argv)
+
+    from . import Engine, EngineConfig
+    from .http import serve_forever
+
+    cfg = EngineConfig(
+        batch_buckets=_parse_int_list(args.buckets),
+        seq_buckets=_parse_int_list(args.seq_buckets) or None,
+        max_batch=args.max_batch,
+        max_queue=args.max_queue,
+        max_batch_delay=args.max_delay_ms / 1000.0,
+        default_deadline=args.deadline_s,
+        oversize_policy=args.oversize,
+    )
+    engine = Engine(args.model, cfg)
+    engine.install_drain_signal_handler()
+
+    def _ready(httpd):
+        host, port = httpd.server_address[:2]
+        print(f"paddle_tpu.serving: listening on http://{host}:{port} "
+              f"(buckets={list(cfg.buckets.batch_buckets)}, "
+              f"delay={cfg.max_batch_delay * 1000:.1f}ms)", flush=True)
+
+    serve_forever(engine, args.host, args.port, quiet=False, ready_cb=_ready)
+    engine.drain()
+    print("paddle_tpu.serving: drained, bye", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,112 @@
+"""Shape buckets: the small closed set of padded shapes the engine runs.
+
+XLA specializes an executable per concrete input shape, so an open-ended
+request mix (batch 3, then 5, then 7, ...) means unbounded recompilation —
+the shape-churn cost LazyTensor (arxiv 2102.13267) identifies. Bucketing
+rounds every batch up to the next member of a fixed set (powers of two by
+default, the same trick TVM-style compile-once stacks use), so after one
+warmup pass every request hits a cached executable.
+
+Padding rows are zeros and are sliced off before results are scattered
+back; row-parallel models (anything per-example) produce bitwise-identical
+rows whether or not padding rows ride along.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def pow2_buckets(max_value: int, start: int = 1) -> Tuple[int, ...]:
+    """(start, 2*start, ... , max_value) — max_value is always included."""
+    out = []
+    b = start
+    while b < max_value:
+        out.append(b)
+        b *= 2
+    out.append(max_value)
+    return tuple(out)
+
+
+class BucketSpec:
+    """The batch (and optionally sequence) buckets the engine may run.
+
+    ``batch_buckets`` bounds rows per dispatched batch; ``seq_buckets``
+    (optional) pads axis 1 of rank>=2 inputs up to a bucket so variable
+    sequence lengths also reuse executables. Sequence padding changes
+    padded-token values (zeros), so it is only valid for models that mask
+    padding — it is opt-in, unlike batch bucketing.
+    """
+
+    def __init__(self, batch_buckets: Sequence[int] = (),
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 max_batch: int = 64):
+        bb = tuple(sorted(set(int(b) for b in batch_buckets))) \
+            or pow2_buckets(int(max_batch))
+        if bb[0] < 1:
+            raise ValueError(f"batch buckets must be >= 1, got {bb}")
+        self.batch_buckets = bb
+        self.seq_buckets = tuple(sorted(set(int(s) for s in seq_buckets))) \
+            if seq_buckets else None
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    def batch_bucket_for(self, rows: int) -> Optional[int]:
+        """Smallest bucket >= rows, or None when rows exceed every bucket."""
+        for b in self.batch_buckets:
+            if rows <= b:
+                return b
+        return None
+
+    def seq_bucket_for(self, seq: Optional[int]) -> Optional[int]:
+        """Smallest sequence bucket >= seq; unbucketed lengths (or no
+        sequence bucketing configured) pass through unchanged."""
+        if seq is None or self.seq_buckets is None:
+            return seq
+        for s in self.seq_buckets:
+            if seq <= s:
+                return s
+        return seq
+
+    def __repr__(self):
+        return (f"BucketSpec(batch={list(self.batch_buckets)}, "
+                f"seq={list(self.seq_buckets) if self.seq_buckets else None})")
+
+
+def pad_rows(arrays: Sequence[np.ndarray], bucket_rows: int) -> List[np.ndarray]:
+    """Zero-pad the leading axis of every array up to ``bucket_rows``."""
+    out = []
+    for a in arrays:
+        rows = a.shape[0]
+        if rows == bucket_rows:
+            out.append(a)
+            continue
+        if rows > bucket_rows:
+            raise ValueError(f"{rows} rows do not fit bucket {bucket_rows}")
+        pad = np.zeros((bucket_rows - rows,) + a.shape[1:], dtype=a.dtype)
+        out.append(np.concatenate([a, pad], axis=0))
+    return out
+
+
+def pad_seq(arrays: Sequence[np.ndarray], seq_bucket: Optional[int]) -> List[np.ndarray]:
+    """Zero-pad axis 1 of rank>=2 arrays up to ``seq_bucket`` (no-op when
+    seq bucketing is off or the array is already that long)."""
+    if seq_bucket is None:
+        return list(arrays)
+    out = []
+    for a in arrays:
+        if a.ndim < 2 or a.shape[1] >= seq_bucket:
+            out.append(a)
+            continue
+        width = [(0, 0)] * a.ndim
+        width[1] = (0, seq_bucket - a.shape[1])
+        out.append(np.pad(a, width))
+    return out
+
+
+def unpad_rows(arrays: Sequence[np.ndarray], rows: int) -> List[np.ndarray]:
+    """Slice each output back to the real row count."""
+    return [a[:rows] if getattr(a, "ndim", 0) > 0 else a for a in arrays]

@@ -1,0 +1,104 @@
+"""ExecutableCache: compiled-callable cache keyed on (model, shapes, dtype).
+
+The compile-once-reuse layer under both the serving engine and the
+standalone :class:`~paddle_tpu.inference.Predictor`. An entry is whatever
+``compile_fn`` returns — in practice a ``jax.jit``-wrapped call of the
+deserialized StableHLO program, so each distinct input signature costs
+exactly one XLA compile and every later hit is a cheap executable launch.
+LRU-bounded with hit/miss/evict counters so recompile pressure is visible
+(``/statsz`` surfaces them; zero misses after warmup is the steady state).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+#: signature element: ((dim, ...), dtype-string) per input array
+SigT = Tuple[Tuple[Tuple[int, ...], str], ...]
+
+_DEFAULT_CAPACITY_ENV = "PADDLE_TPU_EXEC_CACHE_SIZE"
+
+
+def signature_of(arrays: Sequence[Any]) -> SigT:
+    """Shape/dtype signature of a list of arrays (numpy or jax)."""
+    return tuple((tuple(int(d) for d in a.shape), str(a.dtype))
+                 for a in arrays)
+
+
+class ExecutableCache:
+    """LRU cache of compiled executables with observable counters."""
+
+    def __init__(self, capacity: int = 128):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Any, Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get_or_compile(self, key: Any, compile_fn: Callable[[], Any]) -> Any:
+        """Return the cached executable for ``key``, compiling on miss.
+
+        ``compile_fn`` runs outside the lock (XLA compiles can take
+        seconds); concurrent misses on the same key race benignly — the
+        first finisher's entry wins and the duplicate is dropped.
+        """
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return entry
+            self.misses += 1
+        compiled = compile_fn()
+        with self._lock:
+            winner = self._entries.setdefault(key, compiled)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+        return winner
+
+    def contains(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"size": len(self._entries), "capacity": self._capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+_DEFAULT: Optional[ExecutableCache] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_cache() -> ExecutableCache:
+    """Process-wide cache (Predictors share it so two predictors over the
+    same artifact reuse each other's executables). Capacity comes from
+    ``PADDLE_TPU_EXEC_CACHE_SIZE`` (default 128)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            cap = int(os.environ.get(_DEFAULT_CAPACITY_ENV, "128") or "128")
+            _DEFAULT = ExecutableCache(capacity=cap)
+        return _DEFAULT
+
+
+def _reset_default_cache_for_tests():
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = None

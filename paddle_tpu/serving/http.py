@@ -1,0 +1,120 @@
+"""Stdlib-only HTTP front-end over an :class:`Engine`.
+
+Endpoints:
+  * ``POST /predict`` — body ``{"inputs": [nested-list, ...],
+    "dtypes": ["float32", ...] (optional), "deadline_s": float (optional)}``;
+    responds ``{"outputs": [...], "shapes": [...], "req_ms": float}``.
+  * ``GET /healthz`` — ``{"status": "ok"|"draining"}`` (503 while
+    draining, so load balancers stop routing here during preemption).
+  * ``GET /statsz`` — the engine's full stats payload: scalar counters,
+    latency/fill histograms (p50/p95/p99), executable-cache hit/miss/evict.
+
+Threading model: ``ThreadingHTTPServer`` handles each connection on its
+own thread; handlers block on the request future, while the engine's
+single worker thread does the batching — concurrent POSTs are exactly what
+gives the batcher something to coalesce.
+"""
+from __future__ import annotations
+
+import json
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .request import DeadlineExceeded, ServingError
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, engine, quiet: bool = True):
+        self.engine = engine
+        self.quiet = quiet
+        super().__init__(addr, _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # one engine per server process; found via self.server
+
+    def log_message(self, fmt, *args):
+        if not self.server.quiet:
+            BaseHTTPRequestHandler.log_message(self, fmt, *args)
+
+    def _send_json(self, code: int, payload: dict):
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        engine = self.server.engine
+        if self.path == "/healthz":
+            if engine.draining:
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(200, {"status": "ok"})
+        elif self.path == "/statsz":
+            self._send_json(200, engine.stats())
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        engine = self.server.engine
+        t0 = time.monotonic()
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+            raw_inputs = payload["inputs"]
+            dtypes = payload.get("dtypes") or ["float32"] * len(raw_inputs)
+            arrays = [np.asarray(a, dtype=np.dtype(d))
+                      for a, d in zip(raw_inputs, dtypes)]
+            fut = engine.submit(arrays, deadline=payload.get("deadline_s"))
+            outs = fut.result(timeout=payload.get("timeout_s", 60.0))
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        except DeadlineExceeded as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except ServingError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        self._send_json(200, {
+            "outputs": [o.tolist() for o in outs],
+            "shapes": [list(o.shape) for o in outs],
+            "req_ms": (time.monotonic() - t0) * 1000.0,
+        })
+
+
+def make_server(engine, host: str = "127.0.0.1", port: int = 8500,
+                quiet: bool = True) -> ServingHTTPServer:
+    """Bind (port 0 picks a free one; see ``server.server_address``)."""
+    return ServingHTTPServer((host, port), engine, quiet=quiet)
+
+
+def serve_forever(engine, host: str = "127.0.0.1", port: int = 8500,
+                  quiet: bool = False,
+                  ready_cb: Optional[callable] = None):
+    """Blocking serve loop; shuts the listener down once a drain begins and
+    the queue has flushed."""
+    httpd = make_server(engine, host, port, quiet=quiet)
+    if ready_cb is not None:
+        ready_cb(httpd)
+    import threading
+
+    def _watch_drain():
+        engine._stopped.wait()
+        httpd.shutdown()
+
+    threading.Thread(target=_watch_drain, daemon=True).start()
+    try:
+        httpd.serve_forever(poll_interval=0.2)
+    finally:
+        httpd.server_close()

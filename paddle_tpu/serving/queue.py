@@ -1,0 +1,110 @@
+"""BatchQueue: bounded FIFO admission queue with deadline eviction.
+
+Admission control is the backpressure point: ``put`` blocks up to the
+caller's patience when the queue is full (or rejects immediately in
+``block=False`` mode) and raises :class:`QueueFull` — callers see load
+shedding as an explicit error instead of unbounded memory growth.
+Deadline-expired requests are evicted at the head (FIFO order means the
+head is the oldest, so expiry is observed in arrival order) and their
+futures fail with ``DeadlineExceeded`` before any device work is wasted.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from .request import EngineDraining, InferenceRequest, QueueFull
+
+
+class BatchQueue:
+    """Bounded FIFO of :class:`InferenceRequest` with condition-variable
+    hand-off between submitters and the batcher worker."""
+
+    def __init__(self, max_size: int = 256, clock=time.monotonic):
+        if max_size < 1:
+            raise ValueError("max_size must be >= 1")
+        self._max = max_size
+        self._clock = clock
+        self._dq: "deque[InferenceRequest]" = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self.evicted_expired = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def close(self):
+        """Stop admission (drain). Waiting putters fail with
+        EngineDraining; takers drain the remaining items then see None."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+
+    # -- producer side ------------------------------------------------------
+    def put(self, req: InferenceRequest, block: bool = True,
+            timeout: Optional[float] = None):
+        with self._not_full:
+            if self._closed:
+                raise EngineDraining("engine is draining; request rejected")
+            if len(self._dq) >= self._max:
+                if not block:
+                    raise QueueFull(
+                        f"queue at capacity ({self._max}); request rejected")
+                end = None if timeout is None else self._clock() + timeout
+                while len(self._dq) >= self._max and not self._closed:
+                    remaining = None if end is None else end - self._clock()
+                    if remaining is not None and remaining <= 0:
+                        raise QueueFull(
+                            f"queue stayed at capacity ({self._max}) for "
+                            f"{timeout}s; request rejected")
+                    self._not_full.wait(remaining)
+                if self._closed:
+                    raise EngineDraining(
+                        "engine began draining while request waited for "
+                        "queue space")
+            self._dq.append(req)
+            self._not_empty.notify()
+
+    # -- consumer side ------------------------------------------------------
+    def take(self, timeout: Optional[float] = None,
+             fits: Optional[Callable[[InferenceRequest], bool]] = None
+             ) -> Optional[InferenceRequest]:
+        """Pop the head request, or None.
+
+        None means: timed out empty, closed-and-empty, or the head exists
+        but ``fits(head)`` is False (the caller's batch is full / shape-
+        incompatible; the head stays queued for the next batch). Expired
+        heads are evicted (future fails) and skipped.
+        """
+        end = None if timeout is None else self._clock() + timeout
+        with self._not_empty:
+            while True:
+                while self._dq and self._dq[0].expired:
+                    victim = self._dq.popleft()
+                    victim.fail_expired()
+                    self.evicted_expired += 1
+                    self._not_full.notify()
+                if self._dq:
+                    head = self._dq[0]
+                    if fits is not None and not fits(head):
+                        return None
+                    self._dq.popleft()
+                    self._not_full.notify()
+                    return head
+                if self._closed:
+                    return None
+                remaining = None if end is None else end - self._clock()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._not_empty.wait(remaining)

@@ -1,0 +1,86 @@
+"""Request objects and error taxonomy for the serving engine.
+
+A request is a list of numpy input arrays whose leading axis is the row
+(batch) dimension; the engine owns a ``concurrent.futures.Future`` per
+request and resolves it with the list of output arrays (or an exception).
+Deadlines reuse the :class:`~paddle_tpu.utils.resilience.Deadline`
+substrate so the whole stack shares one wall-clock-budget idiom.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.resilience import Deadline, DeadlineExceeded  # noqa: F401
+
+_REQ_IDS = itertools.count(1)
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-side rejections."""
+
+
+class QueueFull(ServingError):
+    """Admission control rejected the request: the queue is at capacity and
+    the configured backpressure wait elapsed."""
+
+
+class EngineDraining(ServingError):
+    """The engine is draining (preemption or explicit drain); no new
+    requests are admitted."""
+
+
+class RequestTooLarge(ServingError):
+    """Request rows exceed the largest batch bucket and the engine is
+    configured to reject (rather than split) oversized requests."""
+
+
+class InferenceRequest:
+    """One queued inference call: inputs + deadline + result future."""
+
+    __slots__ = ("req_id", "inputs", "nrows", "deadline", "future",
+                 "t_enqueue")
+
+    def __init__(self, inputs: Sequence[np.ndarray],
+                 deadline: Optional[Deadline] = None,
+                 clock=time.monotonic):
+        if not inputs:
+            raise ValueError("request needs at least one input array")
+        arrays = [np.asarray(a) for a in inputs]
+        rows = {a.shape[0] for a in arrays if a.ndim > 0}
+        if len(rows) != 1:
+            raise ValueError(
+                f"all inputs must share the leading (row) dimension; "
+                f"got shapes {[a.shape for a in arrays]}")
+        self.req_id = next(_REQ_IDS)
+        self.inputs: List[np.ndarray] = arrays
+        self.nrows = arrays[0].shape[0]
+        self.deadline = deadline
+        self.future: Future = Future()
+        self.t_enqueue = clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and self.deadline.expired()
+
+    def seq_len(self) -> Optional[int]:
+        """Length of axis 1 of the first input, when it has one (the
+        sequence dimension for token models)."""
+        a = self.inputs[0]
+        return int(a.shape[1]) if a.ndim >= 2 else None
+
+    def fail(self, exc: BaseException) -> bool:
+        """Resolve the future with ``exc`` (idempotent)."""
+        if self.future.done():
+            return False
+        self.future.set_exception(exc)
+        return True
+
+    def fail_expired(self) -> bool:
+        return self.fail(DeadlineExceeded(
+            f"request {self.req_id} ({self.nrows} rows) exceeded its "
+            f"{self.deadline.seconds}s deadline before dispatch"))

@@ -1,0 +1,139 @@
+"""serving.http front-end: /predict, /healthz, /statsz, error mapping,
+and the `python -m paddle_tpu.serving` CLI argument plumbing."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.serving import Engine, EngineConfig
+from paddle_tpu.serving.http import make_server
+
+
+def _double(*arrays):
+    return [np.asarray(a) * 2.0 for a in arrays]
+
+
+@pytest.fixture()
+def served():
+    eng = Engine(_double, EngineConfig(max_batch=8, max_batch_delay=0.005),
+                 registry=StatRegistry())
+    srv = make_server(eng, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield eng, srv.server_address[1]
+    srv.shutdown()
+    srv.server_close()
+    eng.drain()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(port, path, payload):
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHTTP:
+    def test_healthz_ok_then_draining(self, served):
+        eng, port = served
+        assert _get(port, "/healthz") == (200, {"status": "ok"})
+        eng.begin_drain()
+        code, body = _get(port, "/healthz")
+        assert code == 503 and body["status"] == "draining"
+
+    def test_predict_roundtrip(self, served):
+        _, port = served
+        x = [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]
+        code, body = _post(port, "/predict", {"inputs": [x]})
+        assert code == 200
+        assert body["shapes"] == [[3, 2]]
+        assert np.allclose(body["outputs"][0], np.asarray(x) * 2.0)
+        assert body["req_ms"] > 0
+
+    def test_predict_int_dtype(self, served):
+        _, port = served
+        code, body = _post(port, "/predict", {
+            "inputs": [[[1, 2], [3, 4]]], "dtypes": ["int32"]})
+        assert code == 200
+        assert body["outputs"][0] == [[2, 4], [6, 8]]
+
+    def test_bad_request_400(self, served):
+        _, port = served
+        code, body = _post(port, "/predict", {"wrong_key": []})
+        assert code == 400 and "bad request" in body["error"]
+
+    def test_unknown_route_404(self, served):
+        _, port = served
+        assert _get(port, "/nope")[0] == 404
+        assert _post(port, "/nope", {})[0] == 404
+
+    def test_statsz_counts_requests(self, served):
+        _, port = served
+        for _ in range(3):
+            _post(port, "/predict", {"inputs": [[[1.0, 1.0]]]})
+        code, stats = _get(port, "/statsz")
+        assert code == 200
+        assert stats["stats"]["serving.completed"] == 3
+        assert stats["histograms"]["serving.latency_ms"]["count"] == 3
+        assert stats["executable_cache"]["misses"] >= 1
+        assert stats["draining"] is False
+
+    def test_draining_predict_503(self, served):
+        eng, port = served
+        eng.begin_drain()
+        eng._stopped.wait(10)
+        code, body = _post(port, "/predict", {"inputs": [[[1.0, 1.0]]]})
+        assert code == 503 and "drain" in body["error"]
+
+
+class TestCLI:
+    def test_parse_and_serve_smoke(self, tmp_path):
+        """Drive main() with a real artifact on an ephemeral port, hit
+        /healthz, then SIGTERM-equivalent drain via begin_drain."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        prefix = str(tmp_path / "cli_model")
+        paddle.jit.save(Net(), prefix,
+                        input_spec=[InputSpec([None, 3], "float32", "x")])
+
+        from paddle_tpu.serving import Engine, EngineConfig
+        from paddle_tpu.serving.__main__ import _parse_int_list
+
+        assert _parse_int_list("1,2,8") == [1, 2, 8]
+        assert _parse_int_list("") == []
+
+        # engine-from-path-prefix (what the CLI constructs)
+        eng = Engine(prefix, EngineConfig(max_batch=4),
+                     registry=StatRegistry())
+        out, = eng.submit([np.ones((2, 3), np.float32)]).result(60)
+        assert out.shape == (2, 2)
+        eng.drain()

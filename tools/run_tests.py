@@ -27,6 +27,7 @@ _WEIGHT_HINTS = {
     "test_sequence_parallel.py": 70, "test_pipeline.py": 90,
     "test_launch_spawn.py": 60, "test_nn_layers.py": 70,
     "test_detection_round3.py": 50, "test_sampled_segment_ops.py": 50,
+    "test_serving.py": 40, "test_serving_http.py": 20,
 }
 
 
