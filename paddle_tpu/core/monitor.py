@@ -20,7 +20,14 @@ DEFAULT_HIST_SAMPLES = 2048
 
 class _Histogram:
     """Bounded-reservoir value distribution (count/total are all-time;
-    quantiles come from the newest ``maxlen`` samples)."""
+    quantiles come from the newest ``maxlen`` samples).
+
+    Thread-safety contract: _Histogram has no lock of its own. Every
+    instance is owned by exactly one StatRegistry, which creates it and
+    calls ``observe``/``summary``/``quantile`` strictly inside
+    ``self._lock`` — the count/total/vmin/vmax updates in ``observe`` are
+    not atomic individually, but the registry lock makes the whole method
+    a critical section. Do not hand instances out past the registry."""
 
     __slots__ = ("count", "total", "vmin", "vmax", "samples")
 

@@ -73,10 +73,18 @@ class ChainedSignalHandler:
         return self
 
     def uninstall(self):
+        """Restore the handlers saved at install time — but only where we
+        are still the current handler. If a third party re-registered a
+        signal after our install, blindly restoring would silently disable
+        *them* (the exact clobbering this class exists to prevent), so
+        their handler is left in place."""
         if not self._installed:
             return
         for sig, prev in self._prev.items():
-            signal.signal(sig, prev)
+            # == not `is`: each `self._on_signal` access builds a fresh
+            # bound method; equality compares __self__ and __func__
+            if signal.getsignal(sig) == self._on_signal:
+                signal.signal(sig, prev)
         self._prev.clear()
         self._installed = False
 

@@ -139,10 +139,12 @@ def terminate_local_procs(procs, grace_period: float = 5.0):
     deadline = time.time() + grace_period
     for p in procs:
         try:
-            p.wait(timeout=max(0.1, deadline - time.time()))
+            # Reaping children here is the handler's intended last act
+            # before exit; nothing else can run in this process anyway.
+            p.wait(timeout=max(0.1, deadline - time.time()))  # noqa: PTA007 -- bounded teardown wait; the supervisor exits right after
         except subprocess.TimeoutExpired:
             p.kill()
-            p.wait()
+            p.wait()  # noqa: PTA007 -- SIGKILL already sent; wait only reaps the zombie
     for p in procs:
         f = getattr(p, "_log_file", None)
         if f:

@@ -194,13 +194,21 @@ class Engine:
             return self._signal_chain
         kwargs = {} if signals is None else {"signals": tuple(signals)}
         self._signal_chain = ChainedSignalHandler(
-            lambda signum, frame: self.begin_drain(), **kwargs)
+            self._on_drain_signal, **kwargs)
         self._signal_chain.install()
         return self._signal_chain
 
+    def _on_drain_signal(self, signum, frame):
+        """Async-signal-safe drain trigger: only sets the flag. Closing the
+        queue takes its lock — if the signal lands while the interrupted
+        thread holds that lock, a close() here would self-deadlock — so the
+        worker loop performs the close at its next poll."""
+        self._draining.set()
+
     def begin_drain(self):
-        """Stop admission and let the worker flush the queue (non-blocking;
-        signal-handler safe — only sets flags)."""
+        """Stop admission and let the worker flush the queue (non-blocking).
+        Thread-safe, but NOT for signal context: closing the queue acquires
+        its lock — signal handlers must go through ``_on_drain_signal``."""
         self._draining.set()
         self._queue.close()
 
@@ -261,6 +269,10 @@ class Engine:
                         and not self._draining.is_set():
                     self._stat_add("preemption_drains", 1)
                     self.begin_drain()
+                elif self._draining.is_set() and not self._queue.closed:
+                    # the flag came from _on_drain_signal (which cannot
+                    # touch the queue lock); finish the drain here
+                    self._queue.close()
                 batch = self._batcher.next_batch(timeout=poll)
                 self._stat_set("queue_depth", len(self._queue))
                 self._stat_set("deadline_evicted",

@@ -32,7 +32,7 @@ class BatchQueue:
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._closed = False
-        self.evicted_expired = 0
+        self._evicted_expired = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -42,6 +42,13 @@ class BatchQueue:
     def closed(self) -> bool:
         with self._lock:
             return self._closed
+
+    @property
+    def evicted_expired(self) -> int:
+        """Deadline-evicted request count; read under the queue lock (the
+        counter is updated inside ``take``'s critical section)."""
+        with self._lock:
+            return self._evicted_expired
 
     def close(self):
         """Stop admission (drain). Waiting putters fail with
@@ -93,7 +100,7 @@ class BatchQueue:
                 while self._dq and self._dq[0].expired:
                     victim = self._dq.popleft()
                     victim.fail_expired()
-                    self.evicted_expired += 1
+                    self._evicted_expired += 1
                     self._not_full.notify()
                 if self._dq:
                     head = self._dq[0]

@@ -1,0 +1,42 @@
+"""Seeded lock-discipline bugs — PTA006 acceptance fixture.
+
+Never imported by the package; tests/test_concurrency_lint.py runs the
+analyzer on this file and asserts both PTA006 finding classes fire:
+
+- ``bump_unguarded``: a counter the class guards with ``self._lock``
+  (see ``incr``) written with no lock held (unguarded-access);
+- ``pop_check_then_act``: the emptiness test and the ``pop`` each hold
+  the lock, but separately — another thread can drain the list between
+  them (check-then-act).
+"""
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+
+    def incr(self):
+        with self._lock:
+            self.count += 1
+            self.items.append(self.count)
+
+    def bump_unguarded(self):
+        self.count += 1  # seeded: guarded attr, no lock
+
+    def pop_check_then_act(self):
+        if self.items:  # seeded: test outside the lock the pop takes
+            with self._lock:
+                return self.items.pop()
+        return None
+
+
+def start():
+    c = SharedCounter()
+    writer = threading.Thread(target=c.bump_unguarded)
+    popper = threading.Thread(target=c.pop_check_then_act)
+    writer.start()
+    popper.start()
+    return c

@@ -1,0 +1,35 @@
+"""Seeded signal-handler hazards — PTA007 acceptance fixture.
+
+Never imported by the package; tests/test_concurrency_lint.py runs the
+analyzer on this file and asserts every PTA007 finding class fires:
+
+- logging inside a handler (error: the logging module's internal locks
+  deadlock if the signal lands mid-log);
+- lock acquisition inside a handler (error: self-deadlock against the
+  interrupted thread);
+- a blocking call inside a handler (warning);
+- a ``raise`` escaping the handler (warning).
+"""
+import logging
+import signal
+import threading
+import time
+
+log = logging.getLogger(__name__)
+_STATE_LOCK = threading.Lock()
+
+
+def _on_term(signum, frame):
+    log.warning("terminating on signal %s", signum)  # seeded: logging
+    with _STATE_LOCK:  # seeded: lock acquisition
+        pass
+    time.sleep(0.1)  # seeded: blocking call
+
+
+def _on_int(signum, frame):
+    raise KeyboardInterrupt  # seeded: raise escaping the handler
+
+
+def install():
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_int)

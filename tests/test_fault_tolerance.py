@@ -350,6 +350,23 @@ class TestPreemptionGuard:
         g.uninstall()
         assert signal.getsignal(signal.SIGTERM) == prev
 
+    def test_uninstall_leaves_third_party_reregistration_alone(self):
+        # Regression: if someone re-registers the signal after our
+        # install, uninstall must NOT clobber them with our saved
+        # handler — that is the exact bug the chain exists to prevent.
+        original = signal.getsignal(signal.SIGUSR1)
+
+        def third_party(signum, frame):
+            pass
+
+        g = PreemptionGuard(signals=(signal.SIGUSR1,))
+        try:
+            signal.signal(signal.SIGUSR1, third_party)
+            g.uninstall()
+            assert signal.getsignal(signal.SIGUSR1) is third_party
+        finally:
+            signal.signal(signal.SIGUSR1, original)
+
 
 class TestFaultToleranceCallback:
     class _ModelStub:

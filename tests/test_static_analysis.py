@@ -312,13 +312,14 @@ def test_driver_json_schema_and_exit_codes(tmp_path):
     assert proc.returncode == 1, proc.stderr
     payload = json.loads(proc.stdout)
     assert payload["version"] == 1
-    assert set(payload["counts"]) == {"total", "new", "baselined",
+    assert set(payload["counts"]) == {"total", "new", "gating", "baselined",
                                       "suppressed",
                                       "expired_baseline_entries"}
     assert payload["counts"]["new"] >= 1
     for f in payload["findings"]:
         assert set(f) == {"rule", "path", "line", "col", "message",
-                          "fingerprint", "status"}
+                          "severity", "fingerprint", "status"}
+        assert f["severity"] in ("error", "warning")
 
     # write a baseline, then the same tree is clean (exit 0)
     proc = _driver(["--root", str(tmp_path), "--baseline", "bl.json",
@@ -345,7 +346,7 @@ def test_driver_rule_selection(tmp_path):
 
 def test_all_rules_have_distinct_codes():
     codes = [r.code for r in ALL_RULES]
-    assert len(codes) == len(set(codes)) == 5
+    assert len(codes) == len(set(codes)) == 7
     assert codes == sorted(codes)
 
 

@@ -47,11 +47,21 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help=f"baseline file relative to root (default: "
                          f"{DEFAULT_BASELINE}; 'none' disables)")
-    ap.add_argument("--write-baseline", action="store_true",
+    ap.add_argument("--write-baseline", "--regen-baseline",
+                    action="store_true", dest="write_baseline",
                     help="record all current findings as the new baseline "
                          "and exit 0")
-    ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output")
+    ap.add_argument("--json", action="store_const", const="json",
+                    dest="format", help="shorthand for --format json")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text", dest="format",
+                    help="output format (default: text)")
+    ap.add_argument("--output", "-o", default=None, metavar="FILE",
+                    help="write the json/sarif payload to FILE (a text "
+                         "summary still goes to stdout)")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings gate the exit code too (default: only "
+                         "error-severity findings do)")
     ap.add_argument("--list-rules", action="store_true")
     return ap
 
@@ -102,28 +112,48 @@ def main(argv=None) -> int:
 
     baseline = load_baseline(baseline_path) if baseline_path else {}
     new, baselined, expired = split_findings(findings, baseline)
+    new_ids = {id(x) for x in new}
+    # warnings only gate under --strict; errors always do
+    gating = [f for f in new if args.strict or f.severity == "error"]
 
-    if args.as_json:
-        new_ids = {id(x) for x in new}
+    payload = None
+    if args.format == "json":
         payload = {
             "version": 1,
             "root": root,
             "rules": [r.code for r in rules],
             "counts": {"total": len(findings), "new": len(new),
+                       "gating": len(gating),
                        "baselined": len(baselined),
                        "suppressed": len(suppressed),
                        "expired_baseline_entries": len(expired)},
             "findings": [
                 {"rule": f.rule, "path": f.path, "line": f.line,
                  "col": f.col, "message": f.message,
+                 "severity": f.severity,
                  "fingerprint": f.fingerprint,
                  "status": "new" if id(f) in new_ids else "baselined"}
                 for f in findings],
         }
+    elif args.format == "sarif":
+        from .sarif import to_sarif
+        payload = to_sarif(findings, rules, new_ids)
+
+    if payload is not None and args.output:
+        out_path = (args.output if os.path.isabs(args.output)
+                    else os.path.join(root, args.output))
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {args.format} ({len(findings)} finding(s), "
+              f"{len(new)} new) to {os.path.relpath(out_path, root)}")
+    elif payload is not None:
         print(json.dumps(payload, indent=1))
-    else:
+
+    if payload is None or args.output:
         for f in new:
-            print(f.render())
+            sev = "" if f.severity == "error" else f" ({f.severity})"
+            print(f.render() + sev)
         if baselined:
             print(f"[{len(baselined)} pre-existing finding(s) suppressed "
                   f"by baseline]")
@@ -132,15 +162,18 @@ def main(argv=None) -> int:
                   f"noqa]")
         if expired:
             print(f"[{len(expired)} baseline entr(ies) no longer match — "
-                  f"run --write-baseline to prune]")
+                  f"run --regen-baseline to prune]")
         if new:
-            print(f"{len(new)} new finding(s); fix them, add "
-                  f"`# noqa: PTA### -- reason`, or regenerate the "
-                  f"baseline (docs/static_analysis.md)")
+            gate_note = ("" if len(gating) == len(new) else
+                         f" ({len(new) - len(gating)} warning(s) not "
+                         f"gating; use --strict)")
+            print(f"{len(new)} new finding(s){gate_note}; fix them, add "
+                  f"`# noqa: PTA### -- reason`, or run --regen-baseline "
+                  f"(docs/static_analysis.md)")
         else:
             print(f"clean: 0 new findings "
                   f"({len(baselined)} baselined, {len(suppressed)} noqa)")
-    return 1 if new else 0
+    return 1 if gating else 0
 
 
 if __name__ == "__main__":

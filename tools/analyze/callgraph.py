@@ -1,31 +1,44 @@
-"""Conservative call-graph over the analyzed files for jit-reachability.
+"""Attribute-aware call graph over the analyzed files.
 
-PTA001 needs to know which functions can execute *under a JAX trace*: a
-host sync that is perfectly fine in eager code is a tracer leak inside
-``jax.jit`` / ``pjit`` / ``to_static``. Full python call resolution is
-undecidable, so this walks a name-based over-approximation:
+Three rule families need to know "what can call what":
 
-roots
-    - defs decorated with jit / pjit / to_static (bare, dotted or called:
-      ``@jax.jit``, ``@to_static(input_spec=...)``, ``@functools.partial(
-      jax.jit, static_argnums=...)``),
-    - named functions passed as arguments to trace-entering wrappers
-      (``jax.jit(f)``, ``jax.lax.scan(f, ...)``, ``jax.vjp``, ``pmap``,
-      ``shard_map``, ``checkpoint`` ...).
+- PTA001 needs the functions that can execute *under a JAX trace*
+  (anything reachable from ``jax.jit`` / ``pjit`` / ``to_static``);
+- PTA006 needs the methods that can execute *on a non-main thread*
+  (``threading.Thread(target=...)``, ``Thread``/``Process`` subclasses'
+  ``run``, ``executor.submit(fn)``, and signal callbacks);
+- PTA007 needs the functions that can execute *in signal-handler
+  context* (installed via ``signal.signal`` or ``ChainedSignalHandler``).
 
-edges
-    - ``f()`` links to every def named ``f`` (same file preferred),
-    - ``obj.m()`` / ``self.m()`` links to every *method* named ``m``.
+Full python call resolution is undecidable; this graph resolves what is
+statically evident and degrades deliberately for the rest:
 
-Calls through variables, dicts or ``fn(*args)`` parameters are invisible;
-in exchange the reachable set is small and high-precision (the dispatch
-funnel internals, optimizer ``_update`` rules, scan/cond branch bodies),
-which keeps PTA001 findings actionable rather than noisy.
+edges (attribute-aware)
+    - ``f()`` → the local/nested def, else the imported symbol (aliased
+      imports and relative ``from ..pkg import mod`` are followed through
+      the project's module map), else every def named ``f``;
+    - ``self.m()`` / ``cls.m()`` → the method in the enclosing class (MRO
+      walked through project-local bases), falling back to every method
+      named ``m`` only when the class doesn't define it;
+    - ``mod.f()`` → the def in the resolved module file; calls into
+      *external* modules (``np.concatenate``) produce no edge;
+    - ``obj.m()`` → methods of ``obj``'s inferred class(es). Types come
+      from local assignments (``x = Class()``), parameter/variable
+      annotations (``Optional``/``Union`` unwrapped), return annotations
+      of resolved callees, and per-class ``self.attr`` assignment scans;
+    - ``Class().m()`` → ``Class.m``; bare ``Class()`` → ``Class.__init__``.
+
+Unresolvable dynamic dispatch stays *conservative* in two different
+directions, matching each client's failure cost: the jit walk
+(``reachable_from``) falls back to every same-named method so a tracer
+leak is never missed, while the thread/signal walks
+(``thread_reachable_from`` / ``signal_reachable_from``) drop the edge so
+a concurrency finding is never hallucinated through a name collision.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .core import Project, SourceFile, dotted_name
 
@@ -40,57 +53,538 @@ TRACE_WRAPPERS = {
     "shard_map", "xmap", "pallas_call", "associated_scan", "vmap",
 }
 
+#: constructors whose ``target=`` argument runs on its own thread/process
+THREAD_CTORS = {"Thread", "Process"}
+
+#: Optional/Union wrappers unwrapped during annotation inference
+_UNION_WRAPPERS = {"Optional", "Union"}
+
 
 class FuncInfo:
-    __slots__ = ("file", "node", "name", "qualname", "is_method",
-                 "root_via", "reachable_from")
+    __slots__ = ("file", "node", "name", "qualname", "is_method", "cls",
+                 "root_via", "reachable_from",
+                 "thread_root_via", "thread_reachable_from",
+                 "signal_root_via", "signal_reachable_from")
 
     def __init__(self, file: SourceFile, node, qualname: str,
-                 is_method: bool):
+                 is_method: bool, cls: Optional["ClassInfo"] = None):
+        self.file = file
+        self.node = node
+        self.name = getattr(node, "name", "<lambda>")
+        self.qualname = qualname
+        self.is_method = is_method
+        self.cls = cls
+        self.root_via: Optional[str] = None        # why it is a jit root
+        self.reachable_from: Optional[str] = None  # jit provenance
+        self.thread_root_via: Optional[str] = None
+        self.thread_reachable_from: Optional[str] = None
+        self.signal_root_via: Optional[str] = None
+        self.signal_reachable_from: Optional[str] = None
+
+
+class ClassInfo:
+    __slots__ = ("file", "node", "name", "qualname", "bases", "methods",
+                 "_attr_types")
+
+    def __init__(self, file: SourceFile, node: ast.ClassDef, qualname: str):
         self.file = file
         self.node = node
         self.name = node.name
         self.qualname = qualname
-        self.is_method = is_method
-        self.root_via: Optional[str] = None       # why it is a root
-        self.reachable_from: Optional[str] = None  # provenance root qualname
+        self.bases = [dotted_name(b) for b in node.bases]
+        self.methods: Dict[str, FuncInfo] = {}
+        self._attr_types: Optional[Dict[str, List["ClassInfo"]]] = None
+
+
+def _module_name(relpath: str) -> Optional[str]:
+    if not relpath.endswith(".py"):
+        return None
+    p = relpath[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def _package_of(relpath: str) -> str:
+    mod = _module_name(relpath) or ""
+    if relpath.endswith("__init__.py"):
+        return mod
+    return mod.rpartition(".")[0]
 
 
 class CallGraph:
-    def __init__(self):
+    def __init__(self, project: Project):
+        self.project = project
         self.functions: List[FuncInfo] = []
         self.by_name: Dict[str, List[FuncInfo]] = {}
         self.methods_by_name: Dict[str, List[FuncInfo]] = {}
         self.per_file_by_name: Dict[str, Dict[str, List[FuncInfo]]] = {}
-        self.roots: List[FuncInfo] = []
+        self.classes: List[ClassInfo] = []
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.modules: Dict[str, str] = {}        # module name -> relpath
+        self.file_imports: Dict[str, Dict[str, tuple]] = {}
+        self.roots: List[FuncInfo] = []          # jit roots
+        self.thread_roots: List[FuncInfo] = []
+        self.signal_roots: List[FuncInfo] = []
+        self._env_cache: Dict[int, Dict[str, List[ClassInfo]]] = {}
+        self._edge_cache: Dict[Tuple[int, bool], List[FuncInfo]] = {}
 
+    # -- reachability views ---------------------------------------------------
     def reachable(self) -> List[FuncInfo]:
+        """jit-reachable (PTA001)."""
         return [f for f in self.functions if f.reachable_from is not None]
 
+    def thread_reachable(self) -> List[FuncInfo]:
+        return [f for f in self.functions
+                if f.thread_reachable_from is not None]
+
+    def signal_reachable(self) -> List[FuncInfo]:
+        return [f for f in self.functions
+                if f.signal_reachable_from is not None]
+
+    # -- symbol resolution ----------------------------------------------------
+    def _toplevel_symbol(self, relpath: str, name: str):
+        for fi in self.per_file_by_name.get(relpath, {}).get(name, []):
+            if fi.qualname == name:
+                return fi
+        for ci in self.classes_by_name.get(name, []):
+            if ci.file.relpath == relpath and ci.qualname == name:
+                return ci
+        return None
+
+    def resolve_symbol(self, sf: SourceFile, name: str, _depth: int = 0):
+        """``name`` in ``sf``'s namespace → FuncInfo | ClassInfo |
+        ("module", relpath) | ("extmodule", dotted) | None."""
+        if _depth > 4:
+            return None
+        sym = self._toplevel_symbol(sf.relpath, name)
+        if sym is not None:
+            return sym
+        ent = self.file_imports.get(sf.relpath, {}).get(name)
+        if ent is None:
+            return None
+        if ent[0] == "module":
+            rel = self.modules.get(ent[1])
+            return ("module", rel) if rel else ("extmodule", ent[1])
+        _, base, orig = ent
+        rel = self.modules.get(f"{base}.{orig}" if base else orig)
+        if rel is not None:
+            return ("module", rel)
+        rel = self.modules.get(base)
+        if rel is not None:
+            target = self.project.by_relpath.get(rel)
+            if target is not None:
+                return self.resolve_symbol(target, orig, _depth + 1)
+            return None
+        return ("extmodule", f"{base}.{orig}" if base else orig)
+
+    def resolve_dotted(self, sf: SourceFile, dotted: str):
+        """Resolve ``a.b.c`` starting from ``sf``'s namespace."""
+        parts = dotted.split(".")
+        cur = self.resolve_symbol(sf, parts[0])
+        for p in parts[1:]:
+            if isinstance(cur, tuple) and cur[0] == "module":
+                target = self.project.by_relpath.get(cur[1])
+                if target is None:
+                    return None
+                sub = self.modules.get((_module_name(cur[1]) or "") + "." + p)
+                nxt = self.resolve_symbol(target, p)
+                cur = nxt if nxt is not None else (
+                    ("module", sub) if sub else None)
+            elif isinstance(cur, tuple) and cur[0] == "extmodule":
+                cur = ("extmodule", cur[1] + "." + p)
+            else:
+                return None
+        return cur
+
+    # -- type inference -------------------------------------------------------
+    def annotation_classes(self, sf: SourceFile, ann,
+                           _depth: int = 0) -> List[ClassInfo]:
+        if ann is None or _depth > 3:
+            return []
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return []
+        if isinstance(ann, ast.Subscript):
+            if dotted_name(ann.value).rpartition(".")[2] in _UNION_WRAPPERS:
+                sl = ann.slice
+                elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+                out: List[ClassInfo] = []
+                for e in elts:
+                    out.extend(self.annotation_classes(sf, e, _depth + 1))
+                return out
+            return []
+        if isinstance(ann, (ast.Name, ast.Attribute)):
+            d = dotted_name(ann)
+            sym = (self.resolve_dotted(sf, d) if "." in d
+                   else self.resolve_symbol(sf, d))
+            if isinstance(sym, ClassInfo):
+                return [sym]
+            if sym is None:
+                # unique-name fallback: one project class with this name
+                cands = self.classes_by_name.get(d.rpartition(".")[2], [])
+                if len(cands) == 1:
+                    return list(cands)
+        return []
+
+    def expr_classes(self, sf: SourceFile, expr,
+                     fi: Optional[FuncInfo] = None,
+                     _depth: int = 0) -> List[ClassInfo]:
+        """Classes an expression's *value* may be an instance of."""
+        if _depth > 3:
+            return []
+        if isinstance(expr, ast.BoolOp):
+            out: List[ClassInfo] = []
+            for v in expr.values:
+                out.extend(self.expr_classes(sf, v, fi, _depth + 1))
+            return out
+        if isinstance(expr, ast.IfExp):
+            return (self.expr_classes(sf, expr.body, fi, _depth + 1)
+                    + self.expr_classes(sf, expr.orelse, fi, _depth + 1))
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            d = dotted_name(f)
+            sym = None
+            if isinstance(f, ast.Name):
+                sym = self.resolve_symbol(sf, f.id)
+            elif isinstance(f, ast.Attribute) and d:
+                sym = self.resolve_dotted(sf, d)
+            if isinstance(sym, ClassInfo):
+                return [sym]
+            if isinstance(sym, FuncInfo):
+                ret = getattr(sym.node, "returns", None)
+                return self.annotation_classes(sym.file, ret, _depth + 1)
+            return []
+        if isinstance(expr, ast.Name) and fi is not None:
+            return self.local_env(fi).get(expr.id, [])
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and fi is not None and fi.cls is not None):
+            return self.class_attr_types(fi.cls).get(expr.attr, [])
+        return []
+
+    def class_attr_types(self, ci: ClassInfo) -> Dict[str, List[ClassInfo]]:
+        """``self.attr`` → inferred classes, scanned over all methods."""
+        if ci._attr_types is not None:
+            return ci._attr_types
+        ci._attr_types = {}  # set first: cycles terminate
+        out = ci._attr_types
+        for m in ci.methods.values():
+            if isinstance(m.node, ast.Lambda):
+                continue
+            ann_params = {}
+            a = m.node.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                if arg.annotation is not None:
+                    ann_params[arg.arg] = arg.annotation
+            for node in _walk_own(m.node):
+                tgt, val, ann = None, None, None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    tgt, val, ann = node.target, node.value, node.annotation
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                classes: List[ClassInfo] = []
+                if ann is not None:
+                    classes = self.annotation_classes(ci.file, ann)
+                if not classes and isinstance(val, ast.Name) \
+                        and val.id in ann_params:
+                    classes = self.annotation_classes(
+                        ci.file, ann_params[val.id])
+                if not classes and val is not None:
+                    classes = self.expr_classes(ci.file, val, m)
+                if classes:
+                    cur = out.setdefault(tgt.attr, [])
+                    for c in classes:
+                        if c not in cur:
+                            cur.append(c)
+        return out
+
+    def local_env(self, fi: FuncInfo) -> Dict[str, List[ClassInfo]]:
+        """Parameter/assignment name → inferred classes, flow-insensitive."""
+        env = self._env_cache.get(id(fi))
+        if env is not None:
+            return env
+        env = self._env_cache[id(fi)] = {}
+        node = fi.node
+        if not isinstance(node, ast.Lambda):
+            a = node.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                if arg.annotation is not None:
+                    cs = self.annotation_classes(fi.file, arg.annotation)
+                    if cs:
+                        env[arg.arg] = cs
+            for sub in _walk_own(node):
+                tgt, val, ann = None, None, None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    tgt, val = sub.targets[0], sub.value
+                elif isinstance(sub, ast.AnnAssign):
+                    tgt, val, ann = sub.target, sub.value, sub.annotation
+                if not isinstance(tgt, ast.Name):
+                    continue
+                cs = self.annotation_classes(fi.file, ann) if ann else []
+                if not cs and val is not None:
+                    cs = self.expr_classes(fi.file, val, fi)
+                if cs:
+                    env.setdefault(tgt.id, [])
+                    for c in cs:
+                        if c not in env[tgt.id]:
+                            env[tgt.id].append(c)
+        return env
+
+    # -- method lookup with project-local MRO ---------------------------------
+    def lookup_method(self, ci: ClassInfo, name: str,
+                      _seen=None) -> Optional[FuncInfo]:
+        if _seen is None:
+            _seen = set()
+        if id(ci) in _seen:
+            return None
+        _seen.add(id(ci))
+        m = ci.methods.get(name)
+        if m is not None:
+            return m
+        for base in ci.bases:
+            sym = (self.resolve_dotted(ci.file, base) if "." in base
+                   else self.resolve_symbol(ci.file, base))
+            if isinstance(sym, ClassInfo):
+                m = self.lookup_method(sym, name, _seen)
+                if m is not None:
+                    return m
+        return None
+
+    def base_classes_of(self, fi: FuncInfo, base_expr) -> List[ClassInfo]:
+        """Inferred classes of a call receiver expression."""
+        if isinstance(base_expr, ast.Name):
+            return self.local_env(fi).get(base_expr.id, [])
+        if isinstance(base_expr, ast.Call):
+            return self.expr_classes(fi.file, base_expr, fi)
+        if (isinstance(base_expr, ast.Attribute)
+                and isinstance(base_expr.value, ast.Name)
+                and base_expr.value.id == "self" and fi.cls is not None):
+            return self.class_attr_types(fi.cls).get(base_expr.attr, [])
+        return []
+
+    def _ctor(self, ci: ClassInfo) -> List[FuncInfo]:
+        init = self.lookup_method(ci, "__init__")
+        return [init] if init is not None else []
+
+    # -- edges ----------------------------------------------------------------
+    def callee_targets(self, fi: FuncInfo, call: ast.Call,
+                       precise_only: bool) -> List[FuncInfo]:
+        """Resolve one call site. ``precise_only=True`` (thread/signal
+        walks) drops unresolvable calls; ``False`` (jit walk) falls back
+        to the name-based over-approximation."""
+        f = call.func
+        sf = fi.file
+        file_map = self.per_file_by_name.get(sf.relpath, {})
+        if isinstance(f, ast.Name):
+            if f.id in file_map:
+                return list(file_map[f.id])
+            sym = self.resolve_symbol(sf, f.id)
+            if isinstance(sym, FuncInfo):
+                return [sym]
+            if isinstance(sym, ClassInfo):
+                # constructor edges only on the precise walks: the jit
+                # walk keeps its legacy name-based reach — a Layer()
+                # built inside a reachable helper is setup-time, and
+                # flagging every __init__ would bury the real leaks
+                return self._ctor(sym) if precise_only else []
+            if sym is not None or precise_only:
+                return []
+            return list(self.by_name.get(f.id, []))
+        if not isinstance(f, ast.Attribute):
+            return []
+        m = f.attr
+        base = f.value
+        if (isinstance(base, ast.Name) and base.id in ("self", "cls")
+                and fi.cls is not None):
+            tgt = self.lookup_method(fi.cls, m)
+            if tgt is not None:
+                return [tgt]
+            return [] if precise_only else list(
+                self.methods_by_name.get(m, []))
+        d = dotted_name(base)
+        if d and "?" not in d:
+            sym = self.resolve_dotted(sf, d)
+            if isinstance(sym, tuple) and sym[0] == "module":
+                target = self.project.by_relpath.get(sym[1])
+                s2 = self.resolve_symbol(target, m) if target else None
+                if isinstance(s2, FuncInfo):
+                    return [s2]
+                if isinstance(s2, ClassInfo):
+                    return self._ctor(s2) if precise_only else []
+                return []
+            if isinstance(sym, tuple) and sym[0] == "extmodule":
+                return []          # np.concatenate(...) etc: external
+            if isinstance(sym, ClassInfo):
+                tgt = self.lookup_method(sym, m)   # Class.method(obj, ...)
+                return [tgt] if tgt else []
+        owners = self.base_classes_of(fi, base)
+        if owners:
+            out = []
+            for c in owners:
+                tgt = self.lookup_method(c, m)
+                if tgt is not None and tgt not in out:
+                    out.append(tgt)
+            if out:
+                return out
+            return [] if precise_only else list(
+                self.methods_by_name.get(m, []))
+        return [] if precise_only else list(self.methods_by_name.get(m, []))
+
+    def edges(self, fi: FuncInfo, precise_only: bool) -> List[FuncInfo]:
+        key = (id(fi), precise_only)
+        cached = self._edge_cache.get(key)
+        if cached is not None:
+            return cached
+        out: List[FuncInfo] = []
+        for call in _own_body_calls(fi.node):
+            for tgt in self.callee_targets(fi, call, precise_only):
+                if tgt not in out:
+                    out.append(tgt)
+        self._edge_cache[key] = out
+        return out
+
+    def resolve_func_ref(self, sf: SourceFile, expr,
+                         ctx: Optional[FuncInfo]) -> List[FuncInfo]:
+        """Resolve a function *reference* (``target=X``, handler args).
+        Lambdas become synthetic FuncInfos so walks can enter them."""
+        if isinstance(expr, ast.Lambda):
+            owner = ctx.qualname if ctx is not None else "<module>"
+            fi = FuncInfo(sf, expr, f"{owner}.<lambda>:{expr.lineno}",
+                          False, ctx.cls if ctx is not None else None)
+            self.functions.append(fi)
+            return [fi]
+        if isinstance(expr, ast.Name):
+            fis = self.per_file_by_name.get(sf.relpath, {}).get(expr.id)
+            if fis:
+                return list(fis)
+            sym = self.resolve_symbol(sf, expr.id)
+            if isinstance(sym, FuncInfo):
+                return [sym]
+            return []
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if (isinstance(base, ast.Name) and base.id in ("self", "cls")
+                    and ctx is not None and ctx.cls is not None):
+                tgt = self.lookup_method(ctx.cls, expr.attr)
+                return [tgt] if tgt else []
+            owners = (self.base_classes_of(ctx, base)
+                      if ctx is not None else [])
+            out = []
+            for c in owners:
+                tgt = self.lookup_method(c, expr.attr)
+                if tgt is not None:
+                    out.append(tgt)
+            if out:
+                return out
+            # unique-name fallback: a single project def with this name
+            cands = self.by_name.get(expr.attr, [])
+            if len(cands) == 1:
+                return list(cands)
+        return []
+
+
+# -- AST walking helpers ------------------------------------------------------
+
+def _walk_own(func_node):
+    """Nodes of a function's own body, stopping at nested defs/classes."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _own_body_calls(func_node):
+    for node in _walk_own(func_node):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _iter_calls_with_context(graph: CallGraph, sf: SourceFile):
+    """Yield (call, enclosing FuncInfo or None) for every call in a file."""
+    fis = [fi for fi in graph.functions
+           if fi.file is sf and not isinstance(fi.node, ast.Lambda)]
+    for fi in fis:
+        for call in _own_body_calls(fi.node):
+            yield call, fi
+    # module/class level: everything not inside a def
+    stack = [(sf.tree, None)]
+    while stack:
+        node, _ = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Call):
+                yield child, None
+            stack.append((child, None))
+
+
+# -- graph construction -------------------------------------------------------
 
 def _collect_defs(graph: CallGraph, sf: SourceFile):
     file_map: Dict[str, List[FuncInfo]] = {}
     graph.per_file_by_name[sf.relpath] = file_map
 
-    def visit(node, qual: str, in_class: bool):
+    def visit(node, qual: str, cls: Optional[ClassInfo]):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 q = f"{qual}.{child.name}" if qual else child.name
-                fi = FuncInfo(sf, child, q, in_class)
+                fi = FuncInfo(sf, child, q, cls is not None, cls)
                 graph.functions.append(fi)
                 graph.by_name.setdefault(child.name, []).append(fi)
                 file_map.setdefault(child.name, []).append(fi)
-                if in_class:
+                if cls is not None:
                     graph.methods_by_name.setdefault(child.name,
                                                      []).append(fi)
-                visit(child, q, False)
+                    cls.methods.setdefault(child.name, fi)
+                visit(child, q, None)
             elif isinstance(child, ast.ClassDef):
                 q = f"{qual}.{child.name}" if qual else child.name
-                visit(child, q, True)
+                ci = ClassInfo(sf, child, q)
+                graph.classes.append(ci)
+                graph.classes_by_name.setdefault(child.name, []).append(ci)
+                visit(child, q, ci)
             else:
-                visit(child, qual, in_class)
+                visit(child, qual, cls)
 
-    visit(sf.tree, "", False)
+    visit(sf.tree, "", None)
+
+
+def _collect_imports(graph: CallGraph, sf: SourceFile):
+    imp: Dict[str, tuple] = {}
+    pkg = _package_of(sf.relpath)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    imp[a.asname] = ("module", a.name)
+                else:
+                    top = a.name.split(".")[0]
+                    imp.setdefault(top, ("module", top))
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = pkg.split(".") if pkg else []
+                up = node.level - 1
+                parts = parts[: len(parts) - up] if up <= len(parts) else []
+                base = ".".join(parts + ([node.module] if node.module
+                                         else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imp[a.asname or a.name] = ("from", base, a.name)
+    graph.file_imports[sf.relpath] = imp
 
 
 def _decorator_is_jit(dec: ast.AST) -> bool:
@@ -105,10 +599,10 @@ def _decorator_is_jit(dec: ast.AST) -> bool:
     return False
 
 
-def _mark_roots(graph: CallGraph, sf: SourceFile):
+def _mark_jit_roots(graph: CallGraph, sf: SourceFile):
     file_map = graph.per_file_by_name[sf.relpath]
     for fi in graph.functions:
-        if fi.file is not sf:
+        if fi.file is not sf or isinstance(fi.node, ast.Lambda):
             continue
         for dec in fi.node.decorator_list:
             if _decorator_is_jit(dec):
@@ -131,52 +625,102 @@ def _mark_roots(graph: CallGraph, sf: SourceFile):
                         graph.roots.append(fi)
 
 
-def _own_body_calls(func_node):
-    """Call nodes in a function body, including nested defs' bodies only via
-    their own FuncInfo (we stop at nested defs here) but including lambdas."""
-    stack = list(ast.iter_child_nodes(func_node))
-    while stack:
-        node = stack.pop()
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            continue
-        if isinstance(node, ast.Call):
-            yield node
-        stack.extend(ast.iter_child_nodes(node))
+def _thread_target_arg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    # threading.Thread(group, target, ...): target is the 2nd positional
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
 
 
-def _edges(graph: CallGraph, fi: FuncInfo) -> List[FuncInfo]:
-    out: List[FuncInfo] = []
-    file_map = graph.per_file_by_name[fi.file.relpath]
-    for call in _own_body_calls(fi.node):
+def _mark_concurrency_roots(graph: CallGraph, sf: SourceFile):
+    def add(kind: str, fis: List[FuncInfo], via: str):
+        roots = graph.thread_roots if kind == "thread" else graph.signal_roots
+        attr = kind + "_root_via"
+        for fi in fis:
+            if getattr(fi, attr) is None:
+                setattr(fi, attr, via)
+                roots.append(fi)
+
+    for call, ctx in _iter_calls_with_context(graph, sf):
         f = call.func
-        if isinstance(f, ast.Name):
-            targets = file_map.get(f.id) or graph.by_name.get(f.id) or []
-            out.extend(targets)
-        elif isinstance(f, ast.Attribute):
-            out.extend(graph.methods_by_name.get(f.attr, []))
-    return out
+        callee = dotted_name(f)
+        last = callee.rpartition(".")[2]
+        if last in THREAD_CTORS:
+            tgt = _thread_target_arg(call)
+            if tgt is not None:
+                add("thread", graph.resolve_func_ref(sf, tgt, ctx),
+                    f"{callee}(target=...) at {sf.relpath}:{call.lineno}")
+        elif isinstance(f, ast.Attribute) and f.attr in ("submit", "map") \
+                and call.args:
+            # executor.submit(fn, ...): only a *resolved function* first
+            # arg makes a root (engine.submit(arrays) resolves to nothing)
+            fis = graph.resolve_func_ref(sf, call.args[0], ctx)
+            if fis:
+                add("thread", fis,
+                    f"submitted to executor at {sf.relpath}:{call.lineno}")
+        elif callee == "signal.signal" or callee.endswith(".signal.signal"):
+            if len(call.args) >= 2:
+                add("signal", graph.resolve_func_ref(sf, call.args[1], ctx),
+                    f"signal.signal() at {sf.relpath}:{call.lineno}")
+        elif last == "ChainedSignalHandler":
+            handler = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "callback":
+                    handler = kw.value
+            if handler is not None:
+                add("signal", graph.resolve_func_ref(sf, handler, ctx),
+                    f"ChainedSignalHandler at {sf.relpath}:{call.lineno}")
+
+    # Thread/Process subclasses: run() is the entry point
+    for ci in graph.classes:
+        if ci.file is not sf:
+            continue
+        if any(b.rpartition(".")[2] in THREAD_CTORS for b in ci.bases):
+            run = ci.methods.get("run")
+            if run is not None:
+                add("thread", [run],
+                    f"{ci.qualname}.run (Thread subclass)")
 
 
-def build(project: Project) -> CallGraph:
-    graph = CallGraph()
-    for sf in project.files:
-        if sf.tree is not None:
-            _collect_defs(graph, sf)
-    for sf in project.files:
-        if sf.tree is not None:
-            _mark_roots(graph, sf)
-
-    # BFS with provenance
+def _bfs(graph: CallGraph, roots: List[FuncInfo], mark_attr: str,
+         precise_only: bool):
     queue = []
-    for r in graph.roots:
-        if r.reachable_from is None:
-            r.reachable_from = r.qualname
+    for r in roots:
+        if getattr(r, mark_attr) is None:
+            setattr(r, mark_attr, r.qualname)
             queue.append(r)
     while queue:
         fi = queue.pop(0)
-        for callee in _edges(graph, fi):
-            if callee.reachable_from is None:
-                callee.reachable_from = fi.reachable_from
+        for callee in graph.edges(fi, precise_only):
+            if getattr(callee, mark_attr) is None:
+                setattr(callee, mark_attr, getattr(fi, mark_attr))
                 queue.append(callee)
+
+
+def build(project: Project) -> CallGraph:
+    graph = CallGraph(project)
+    for sf in project.files:
+        if sf.tree is not None:
+            mod = _module_name(sf.relpath)
+            if mod:
+                graph.modules[mod] = sf.relpath
+            _collect_defs(graph, sf)
+    for sf in project.files:
+        if sf.tree is not None:
+            _collect_imports(graph, sf)
+    for sf in project.files:
+        if sf.tree is not None:
+            _mark_jit_roots(graph, sf)
+            _mark_concurrency_roots(graph, sf)
+
+    # jit walk keeps the name-based over-approximation (never miss a
+    # tracer leak); thread/signal walks are precise (never invent a race)
+    _bfs(graph, graph.roots, "reachable_from", precise_only=False)
+    _bfs(graph, graph.thread_roots + graph.signal_roots,
+         "thread_reachable_from", precise_only=True)
+    _bfs(graph, graph.signal_roots, "signal_reachable_from",
+         precise_only=True)
     return graph

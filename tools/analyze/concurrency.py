@@ -1,0 +1,306 @@
+"""Shared lock/sync model for the concurrency rules (PTA006, PTA007).
+
+Per-class inference, no annotations required:
+
+lock groups
+    ``self._lock = threading.Lock()`` declares a lock attribute.
+    ``self._not_empty = threading.Condition(self._lock)`` *aliases* into
+    ``_lock``'s group — ``with self._not_empty:`` holds the same
+    underlying mutex (this is exactly BatchQueue's layout; without the
+    aliasing every condition-guarded access would be a false positive).
+    ``RLock`` is tracked with its kind so PTA007 can downgrade reentrant
+    acquisition to a warning. ``Event``/``Barrier``/``Queue`` are sync
+    primitives (never "guarded data") but not locks.
+
+guarded attributes
+    Any ``self.<attr>`` *written* at least once while a lock of the class
+    is held is classified as guarded by that lock's group. Writes are
+    assignments, augmented assignments, subscript stores/deletes, and
+    mutating method calls (``.append``/``.pop``/``.update``/...).
+
+held-lock annotation
+    ``held_map`` maps every node of a function body to the frozenset of
+    lock tokens held there (``"self.<group>"`` for instance locks,
+    bare names for module-level locks, and the raw dotted receiver for
+    cross-object locks like ``self._queue._lock``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, ClassInfo, FuncInfo, _walk_own
+from .core import SourceFile, dotted_name
+
+#: constructor (last dotted component) -> lock kind
+LOCK_CTORS = {"Lock": "lock", "RLock": "rlock",
+              "Semaphore": "lock", "BoundedSemaphore": "lock"}
+
+#: sync primitives excluded from "guarded data" classification
+OTHER_SYNC_CTORS = {"Condition", "Event", "Barrier", "Queue", "SimpleQueue",
+                    "LifoQueue", "PriorityQueue", "JoinableQueue"}
+
+#: method names that mutate their receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "update", "add",
+    "setdefault", "sort", "reverse", "rotate",
+}
+
+
+class ClassLocks:
+    """Lock layout + guarded-attribute map of one class."""
+
+    __slots__ = ("cls", "groups", "kinds", "sync_attrs", "guarded")
+
+    def __init__(self, cls: ClassInfo):
+        self.cls = cls
+        self.groups: Dict[str, str] = {}     # lock attr -> canonical group
+        self.kinds: Dict[str, str] = {}      # group -> "lock" | "rlock"
+        self.sync_attrs: Set[str] = set()    # every sync-primitive attr
+        self.guarded: Dict[str, Set[str]] = {}  # data attr -> groups
+
+
+class Access:
+    __slots__ = ("node", "base", "attr", "is_write")
+
+    def __init__(self, node: ast.AST, base: ast.AST, attr: str,
+                 is_write: bool):
+        self.node = node      # the node the finding anchors to
+        self.base = base      # receiver expression (Name 'self', ...)
+        self.attr = attr
+        self.is_write = is_write
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """'lock' | 'rlock' | 'condition' | 'sync' for a ctor call, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    last = dotted_name(value.func).rpartition(".")[2]
+    if last in LOCK_CTORS:
+        return LOCK_CTORS[last]
+    if last == "Condition":
+        return "condition"
+    if last in OTHER_SYNC_CTORS:
+        return "sync"
+    return None
+
+
+def _self_attr_targets(stmt: ast.stmt) -> Iterator[Tuple[str, ast.AST]]:
+    """(attr, value) pairs for ``self.X = <value>`` in one statement."""
+    if isinstance(stmt, ast.Assign):
+        pairs = [(t, stmt.value) for t in stmt.targets]
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        pairs = [(stmt.target, stmt.value)]
+    else:
+        return
+    for tgt, val in pairs:
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            yield tgt.attr, val
+
+
+def module_locks(sf: SourceFile) -> Dict[str, str]:
+    """Top-level ``NAME = threading.Lock()`` assignments: name -> kind."""
+    out: Dict[str, str] = {}
+    if sf.tree is None:
+        return out
+    for stmt in sf.tree.body:
+        if isinstance(stmt, ast.Assign):
+            kind = _ctor_kind(stmt.value)
+            if kind in ("lock", "rlock"):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = kind
+    return out
+
+
+class ConcurrencyModel:
+    """Caches per-class lock layouts and per-function held-lock maps."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self._class_locks: Dict[int, ClassLocks] = {}
+        self._module_locks: Dict[str, Dict[str, str]] = {}
+        self._held: Dict[int, Dict[int, FrozenSet[str]]] = {}
+
+    # -- lock layout ----------------------------------------------------------
+    def locks_for(self, ci: Optional[ClassInfo]) -> Optional[ClassLocks]:
+        if ci is None:
+            return None
+        cl = self._class_locks.get(id(ci))
+        if cl is None:
+            cl = self._class_locks[id(ci)] = self._build_class_locks(ci)
+        return cl
+
+    def _build_class_locks(self, ci: ClassInfo) -> ClassLocks:
+        cl = ClassLocks(ci)
+        methods = list(dict.fromkeys(ci.methods.values()))
+        # pass 1: direct lock/sync ctors
+        for m in methods:
+            for stmt in _walk_own(m.node):
+                for attr, val in _self_attr_targets(stmt):
+                    kind = _ctor_kind(val)
+                    if kind in ("lock", "rlock"):
+                        cl.groups[attr] = attr
+                        cl.kinds[attr] = kind
+                        cl.sync_attrs.add(attr)
+                    elif kind is not None:
+                        cl.sync_attrs.add(attr)
+        # pass 2: Condition(self._lock) aliases into the lock's group;
+        # a bare Condition() owns its mutex and forms its own group
+        for m in methods:
+            for stmt in _walk_own(m.node):
+                for attr, val in _self_attr_targets(stmt):
+                    if _ctor_kind(val) != "condition":
+                        continue
+                    underlying = None
+                    if isinstance(val, ast.Call) and val.args:
+                        a0 = val.args[0]
+                        if (isinstance(a0, ast.Attribute)
+                                and isinstance(a0.value, ast.Name)
+                                and a0.value.id == "self"):
+                            underlying = a0.attr
+                    if underlying is not None:
+                        cl.groups[attr] = cl.groups.get(underlying,
+                                                        underlying)
+                    else:
+                        cl.groups[attr] = attr
+                        cl.kinds.setdefault(attr, "lock")
+        # pass 3: guarded-attribute inference from locked writes
+        for m in methods:
+            hm = self.held_map_with(m, cl)
+            for acc in attr_accesses(m):
+                if not acc.is_write:
+                    continue
+                if not (isinstance(acc.base, ast.Name)
+                        and acc.base.id == "self"):
+                    continue
+                if acc.attr in cl.sync_attrs:
+                    continue
+                held = hm.get(id(acc.node), frozenset())
+                for tok in held:
+                    if tok.startswith("self."):
+                        cl.guarded.setdefault(acc.attr,
+                                              set()).add(tok[len("self."):])
+        return cl
+
+    def module_locks_of(self, sf: SourceFile) -> Dict[str, str]:
+        ml = self._module_locks.get(sf.relpath)
+        if ml is None:
+            ml = self._module_locks[sf.relpath] = module_locks(sf)
+        return ml
+
+    # -- held-lock annotation -------------------------------------------------
+    def lock_tokens(self, expr: ast.AST, cl: Optional[ClassLocks],
+                    mlocks: Dict[str, str]) -> List[str]:
+        """Tokens a ``with <expr>:`` acquires; [] if not a known lock."""
+        d = dotted_name(expr)
+        if not d or "?" in d:
+            return []
+        if isinstance(expr, ast.Name):
+            return [d] if d in mlocks else []
+        if d.startswith("self.") and d.count(".") == 1 and cl is not None:
+            attr = d[len("self."):]
+            if attr in cl.groups:
+                return [f"self.{cl.groups[attr]}"]
+            return []
+        # cross-object lock (e.g. `with self._queue._lock:`): keep the raw
+        # dotted receiver form so cross-class access checks can match it
+        if "." in d:
+            return [d]
+        return []
+
+    def held_map(self, fi: FuncInfo) -> Dict[int, FrozenSet[str]]:
+        hm = self._held.get(id(fi))
+        if hm is None:
+            hm = self._held[id(fi)] = self.held_map_with(
+                fi, self.locks_for(fi.cls))
+        return hm
+
+    def held_map_with(self, fi: FuncInfo,
+                      cl: Optional[ClassLocks]) -> Dict[int, FrozenSet[str]]:
+        mlocks = self.module_locks_of(fi.file)
+        out: Dict[int, FrozenSet[str]] = {}
+
+        def annot(node, held: FrozenSet[str]):
+            out[id(node)] = held
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = set(held)
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        out[id(sub)] = held
+                    inner.update(self.lock_tokens(item.context_expr, cl,
+                                                  mlocks))
+                inner_f = frozenset(inner)
+                for stmt in node.body:
+                    annot(stmt, inner_f)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            for child in ast.iter_child_nodes(node):
+                annot(child, held)
+
+        empty = frozenset()
+        for child in ast.iter_child_nodes(fi.node):
+            annot(child, empty)
+        return out
+
+
+def attr_accesses(fi: FuncInfo) -> List[Access]:
+    """Attribute reads/writes in a function's own body.
+
+    A receiver claimed by a write form (assignment target, augmented
+    assignment, subscript store, mutating method call) is not double-
+    reported as a read.
+    """
+    writes: List[Access] = []
+    claimed: Set[int] = set()
+
+    def claim_write(attr_node: ast.Attribute, anchor: ast.AST):
+        writes.append(Access(anchor, attr_node.value, attr_node.attr, True))
+        claimed.add(id(attr_node))
+
+    def claim_target(tgt: ast.AST, anchor: ast.AST):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                claim_target(e, anchor)
+        elif isinstance(tgt, ast.Attribute):
+            claim_write(tgt, anchor)
+        elif isinstance(tgt, ast.Subscript) \
+                and isinstance(tgt.value, ast.Attribute):
+            claim_write(tgt.value, anchor)
+
+    nodes = list(_walk_own(fi.node))
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                claim_target(t, node)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            claim_target(node.target, node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                claim_target(t, node)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in MUTATORS \
+                    and isinstance(f.value, ast.Attribute):
+                claim_write(f.value, node)
+
+    reads = [Access(n, n.value, n.attr, False)
+             for n in nodes
+             if isinstance(n, ast.Attribute)
+             and isinstance(n.ctx, ast.Load)
+             and id(n) not in claimed]
+    return writes + reads
+
+
+def nodes_under(*roots: ast.AST) -> Set[int]:
+    """ids of every node in the given subtrees (for region membership)."""
+    out: Set[int] = set()
+    for r in roots:
+        for n in ast.walk(r):
+            out.add(id(n))
+    return out

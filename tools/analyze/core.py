@@ -39,6 +39,7 @@ class Finding:
     col: int           # 0-based
     message: str
     anchor: str = ""   # text the fingerprint hashes (defaults to source line)
+    severity: str = "error"  # "error" gates; "warning" gates under --strict
 
     @property
     def fingerprint(self) -> str:
@@ -91,7 +92,8 @@ class SourceFile:
         return ""
 
     def finding(self, rule: str, node_or_line, message: str,
-                col: Optional[int] = None, anchor: str = "") -> Finding:
+                col: Optional[int] = None, anchor: str = "",
+                severity: str = "error") -> Finding:
         if isinstance(node_or_line, int):
             line, c = node_or_line, (col or 0)
         else:
@@ -99,7 +101,8 @@ class SourceFile:
             c = getattr(node_or_line, "col_offset", 0) if col is None else col
         return Finding(rule=rule, path=self.relpath, line=line, col=c,
                        message=message,
-                       anchor=anchor or self.line_text(line))
+                       anchor=anchor or self.line_text(line),
+                       severity=severity)
 
     def is_suppressed(self, f: Finding) -> bool:
         codes = self.noqa.get(f.line)

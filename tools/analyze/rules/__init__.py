@@ -1,13 +1,15 @@
 """Rule registry. Each rule module exposes a single ``RULE`` instance."""
 from __future__ import annotations
 
-from .pta001_tracer_safety import RULE as PTA001  # noqa: F401
-from .pta002_host_sync import RULE as PTA002      # noqa: F401
-from .pta003_silent_except import RULE as PTA003  # noqa: F401
-from .pta004_op_registry import RULE as PTA004    # noqa: F401
-from .pta005_api_hygiene import RULE as PTA005    # noqa: F401
+from .pta001_tracer_safety import RULE as PTA001    # noqa: F401
+from .pta002_host_sync import RULE as PTA002        # noqa: F401
+from .pta003_silent_except import RULE as PTA003    # noqa: F401
+from .pta004_op_registry import RULE as PTA004      # noqa: F401
+from .pta005_api_hygiene import RULE as PTA005      # noqa: F401
+from .pta006_lock_discipline import RULE as PTA006  # noqa: F401
+from .pta007_signal_safety import RULE as PTA007    # noqa: F401
 
-ALL_RULES = [PTA001, PTA002, PTA003, PTA004, PTA005]
+ALL_RULES = [PTA001, PTA002, PTA003, PTA004, PTA005, PTA006, PTA007]
 
 
 def rules_by_code():

@@ -10,6 +10,9 @@ class Rule:
     code: str = "PTA000"
     name: str = "base"
     description: str = ""
+    #: default severity for this rule's findings ("error" | "warning");
+    #: individual findings may override via SourceFile.finding(severity=...)
+    severity: str = "error"
 
     def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
         return []

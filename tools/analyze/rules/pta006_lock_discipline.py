@@ -1,0 +1,174 @@
+"""PTA006: lock-discipline — racy access to lock-guarded attributes.
+
+An attribute the class itself protects (written at least once under
+``with self._lock:`` — Condition variables alias into their underlying
+lock, see tools/analyze/concurrency.py) must be protected *everywhere it
+can race*. Flagged, in functions reachable from a thread entry point
+(``threading.Thread(target=...)``, ``Thread``/``Process`` subclasses'
+``run``, ``executor.submit``, signal callbacks — signal handlers
+interleave with the interrupted code exactly like a thread):
+
+- reads or writes of a guarded ``self.<attr>`` without the guarding lock
+  held (``unguarded-access``);
+- compound check-then-act: an ``if``/``while`` tests a guarded attribute
+  and its body mutates it, with the lock held separately on each side —
+  each access is individually locked but the compound is not atomic
+  (``check-then-act``);
+- cross-object access to another class's guarded attribute
+  (``engine._queue.some_counter`` when ``some_counter`` is guarded
+  inside ``BatchQueue``) without that object's lock.
+
+Suppress provably single-threaded cases with ``# noqa: PTA006 -- <why
+no second thread can observe this attribute>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import Rule
+from ..concurrency import ConcurrencyModel, attr_accesses, nodes_under
+from ..core import Finding, Project, dotted_name
+
+_SKIP_METHODS = {"__init__", "__new__", "__del__"}
+
+
+def _via(fi) -> str:
+    if fi.thread_root_via is not None:
+        return f"[thread entry: {fi.thread_root_via}]"
+    return f"[thread-reachable via {fi.thread_reachable_from}]"
+
+
+class LockDisciplineRule(Rule):
+    code = "PTA006"
+    name = "lock-discipline"
+    description = ("reads/writes of lock-guarded attributes without the "
+                   "lock held, in thread-reachable code")
+    severity = "error"
+
+    def finalize(self, project: Project) -> List[Finding]:
+        graph = project.callgraph
+        model = ConcurrencyModel(graph)
+        findings: List[Finding] = []
+        for fi in graph.thread_reachable():
+            if fi.name in _SKIP_METHODS:
+                continue
+            findings.extend(self._check_function(graph, model, fi))
+        return findings
+
+    def _check_function(self, graph, model, fi) -> List[Finding]:
+        sf = fi.file
+        cl = model.locks_for(fi.cls)
+        hm = model.held_map(fi)
+        via = _via(fi)
+        findings: List[Finding] = []
+        accesses = attr_accesses(fi)
+
+        # -- check-then-act: test and mutation locked separately ------------
+        subsumed = set()   # access nodes explained by a check-then-act
+        if cl is not None:
+            for stmt in self._own_stmts(fi.node):
+                if not isinstance(stmt, (ast.If, ast.While)):
+                    continue
+                held_at = hm.get(id(stmt), frozenset())
+                test_ids = nodes_under(stmt.test)
+                body_ids = nodes_under(*(stmt.body + stmt.orelse))
+                for attr, groups in cl.guarded.items():
+                    if any(f"self.{g}" in held_at for g in groups):
+                        continue   # whole statement inside the lock: atomic
+                    t_reads = [a for a in accesses
+                               if a.attr == attr and id(a.node) in test_ids
+                               and self._is_self(a)]
+                    b_writes = [a for a in accesses
+                                if a.attr == attr and a.is_write
+                                and id(a.node) in body_ids
+                                and self._is_self(a)]
+                    if not t_reads or not b_writes:
+                        continue
+                    relocked = [a for a in b_writes
+                                if any(f"self.{g}" in
+                                       hm.get(id(a.node), frozenset())
+                                       for g in groups)]
+                    if not relocked:
+                        continue   # both sides unguarded: plain findings
+                    lock = sorted(groups)[0]
+                    kind = ("while" if isinstance(stmt, ast.While)
+                            else "if")
+                    findings.append(sf.finding(
+                        self.code, stmt,
+                        f"check-then-act on `self.{attr}` (guarded by "
+                        f"`self.{lock}` in `{fi.cls.name}`): the `{kind}` "
+                        f"test and the mutation hold the lock separately, "
+                        f"so the attribute can change between them — hoist "
+                        f"the test inside the locked block {via}",
+                        severity=self.severity))
+                    for a in t_reads:
+                        subsumed.add(id(a.node))
+
+        # -- plain unguarded access ------------------------------------------
+        for acc in accesses:
+            if id(acc.node) in subsumed:
+                continue
+            held = hm.get(id(acc.node), frozenset())
+            if self._is_self(acc):
+                if cl is None or acc.attr not in cl.guarded:
+                    continue
+                groups = cl.guarded[acc.attr]
+                if any(f"self.{g}" in held for g in groups):
+                    continue
+                lock = sorted(groups)[0]
+                action = "written" if acc.is_write else "read"
+                findings.append(sf.finding(
+                    self.code, acc.node,
+                    f"`self.{acc.attr}` is guarded by `self.{lock}` "
+                    f"elsewhere in `{fi.cls.name}` but {action} here "
+                    f"without it {via}",
+                    severity=self.severity))
+            else:
+                findings.extend(self._cross_class(graph, model, fi, acc,
+                                                  held, via))
+        return findings
+
+    def _cross_class(self, graph, model, fi, acc, held, via) -> List[Finding]:
+        recv = dotted_name(acc.base)
+        if not recv or "?" in recv or recv in ("cls",):
+            return []
+        owners = graph.base_classes_of(fi, acc.base)
+        out: List[Finding] = []
+        for ci in owners:
+            if acc.attr in ci.methods:       # property/method, not data
+                continue
+            ocl = model.locks_for(ci)
+            if ocl is None or acc.attr not in ocl.guarded:
+                continue
+            groups = ocl.guarded[acc.attr]
+            if any(f"{recv}.{g}" in held for g in groups):
+                continue
+            lock = sorted(groups)[0]
+            action = "written" if acc.is_write else "read"
+            out.append(fi.file.finding(
+                self.code, acc.node,
+                f"`{recv}.{acc.attr}` is lock-guarded inside "
+                f"`{ci.name}` (by `{lock}`) but {action} here without "
+                f"holding it — expose it through a locked property "
+                f"instead {via}",
+                severity=self.severity))
+        return out
+
+    @staticmethod
+    def _is_self(acc) -> bool:
+        return isinstance(acc.base, ast.Name) and acc.base.id == "self"
+
+    @staticmethod
+    def _own_stmts(func_node):
+        stack = list(ast.iter_child_nodes(func_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+RULE = LockDisciplineRule()

@@ -1,0 +1,203 @@
+"""PTA007: async-signal-safety of code reachable from signal handlers.
+
+CPython delivers signals on the main thread, *between bytecodes of
+whatever that thread was doing*. Anything a handler (or code it calls —
+the walk starts from every function installed via ``signal.signal`` or
+``ChainedSignalHandler``) does that needs cooperation from the
+interrupted frame can therefore deadlock or corrupt state:
+
+- acquiring a non-reentrant lock the interrupted thread may already hold
+  is a self-deadlock (error); an ``RLock`` only deadlocks cross-thread,
+  so reentrant acquisition is a warning;
+- ``logging`` takes module-level and handler locks internally — the
+  classic "SIGTERM during a log call" hang (error);
+- blocking calls (``time.sleep``, ``subprocess`` waits, ``.wait()`` /
+  ``.communicate()`` / argument-less ``.join()``) stall the main thread
+  inside the handler (warning);
+- a ``raise`` escaping the handler unwinds whatever frame happened to be
+  executing (warning; flagged in the installed handler itself).
+
+The safe handler shape is flag-only: set an ``Event``, let the program's
+normal control flow observe it (see PreemptionGuard). Suppress deliberate
+exceptions (e.g. teardown-then-``sys.exit``) with ``# noqa: PTA007 --
+<why blocking/raising here is the intended last act>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import Rule
+from ..concurrency import ConcurrencyModel
+from ..core import Finding, Project, dotted_name
+
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log"}
+
+_LOG_RECEIVER_HINTS = ("log", "logger")
+
+BLOCKING_DOTTED = {"time.sleep", "select.select", "os.waitpid",
+                   "subprocess.run", "subprocess.call",
+                   "subprocess.check_call", "subprocess.check_output"}
+
+
+def _via(fi) -> str:
+    if fi.signal_root_via is not None:
+        return f"[installed: {fi.signal_root_via}]"
+    return f"[signal-reachable via {fi.signal_reachable_from}]"
+
+
+def _is_logging_call(call: ast.Call) -> bool:
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in LOG_METHODS:
+        return False
+    base = f.value
+    if isinstance(base, ast.Call):  # logging.getLogger(...).info(...)
+        return dotted_name(base.func).startswith("logging")
+    d = dotted_name(base)
+    if d == "logging" or d.startswith("logging."):
+        return True
+    last = d.rpartition(".")[2].lower()
+    return any(h in last for h in _LOG_RECEIVER_HINTS)
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    d = dotted_name(call.func)
+    if d in BLOCKING_DOTTED:
+        return f"`{d}()` blocks the main thread inside the handler"
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in ("wait", "communicate"):
+            return (f"`.{f.attr}()` blocks inside the handler (the "
+                    f"condition it waits on may need the interrupted "
+                    f"frame to make progress)")
+        if f.attr == "join" and not call.args:
+            # str.join always has a positional argument; thread/process
+            # joins are argument-less or timeout-kwarg only
+            return "`.join()` blocks inside the handler"
+    return ""
+
+
+class SignalSafetyRule(Rule):
+    code = "PTA007"
+    name = "signal-safety"
+    description = ("lock acquisition, logging, blocking calls and escaping "
+                   "raises in signal-handler-reachable code")
+    severity = "error"
+
+    def finalize(self, project: Project) -> List[Finding]:
+        graph = project.callgraph
+        model = ConcurrencyModel(graph)
+        findings: List[Finding] = []
+        for fi in graph.signal_reachable():
+            findings.extend(self._check_function(model, fi))
+        return findings
+
+    def _check_function(self, model, fi) -> List[Finding]:
+        sf = fi.file
+        cl = model.locks_for(fi.cls)
+        mlocks = model.module_locks_of(sf)
+        via = _via(fi)
+        findings: List[Finding] = []
+
+        for node in self._own_body(fi.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    findings.extend(self._lock_acquisition(
+                        sf, cl, mlocks, item.context_expr, node, via))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    findings.extend(self._lock_acquisition(
+                        sf, cl, mlocks, f.value, node, via))
+                elif _is_logging_call(node):
+                    findings.append(sf.finding(
+                        self.code, node,
+                        f"logging call in signal context — the logging "
+                        f"module takes internal locks; a signal landing "
+                        f"mid-log deadlocks {via}",
+                        severity="error"))
+                else:
+                    why = _blocking_reason(node)
+                    if why:
+                        findings.append(sf.finding(
+                            self.code, node,
+                            f"{why}; handlers should only set flags {via}",
+                            severity="warning"))
+
+        if fi.signal_root_via is not None:
+            findings.extend(self._escaping_raises(sf, fi, via))
+        return findings
+
+    def _lock_acquisition(self, sf, cl, mlocks, lock_expr, anchor,
+                          via) -> List[Finding]:
+        d = dotted_name(lock_expr)
+        kind = None
+        if isinstance(lock_expr, ast.Name):
+            kind = mlocks.get(d)
+        elif d.startswith("self.") and d.count(".") == 1 and cl is not None:
+            attr = d[len("self."):]
+            group = cl.groups.get(attr)
+            if group is not None:
+                kind = cl.kinds.get(group, "lock")
+        if kind is None:
+            return []
+        if kind == "rlock":
+            return [sf.finding(
+                self.code, anchor,
+                f"acquires reentrant `{d}` in signal context — safe only "
+                f"if every other owner is the main thread {via}",
+                severity="warning")]
+        return [sf.finding(
+            self.code, anchor,
+            f"acquires `{d}` in signal context — if the interrupted "
+            f"thread holds it the handler never returns (self-deadlock); "
+            f"set a flag and do the locked work at a poll point {via}",
+            severity="error")]
+
+    def _escaping_raises(self, sf, fi, via) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node, in_try: bool):
+            if isinstance(node, ast.Raise):
+                if not in_try:
+                    findings.append(sf.finding(
+                        self.code, node,
+                        f"`raise` escaping a signal handler unwinds "
+                        f"whatever frame the signal interrupted {via}",
+                        severity="warning"))
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.Try):
+                covered = in_try or bool(node.handlers)
+                for stmt in node.body + node.orelse:
+                    visit(stmt, covered)
+                # finally blocks and except bodies re-raise outward
+                for stmt in node.finalbody:
+                    visit(stmt, in_try)
+                for h in node.handlers:
+                    for stmt in h.body:
+                        visit(stmt, in_try)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_try)
+
+        for child in ast.iter_child_nodes(fi.node):
+            visit(child, False)
+        return findings
+
+    @staticmethod
+    def _own_body(func_node):
+        stack = list(ast.iter_child_nodes(func_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+RULE = SignalSafetyRule()

@@ -1,0 +1,62 @@
+"""SARIF 2.1.0 serialization of analyzer findings.
+
+One run, one driver (``paddle-tpu-analyze``); every selected rule is
+listed in ``tool.driver.rules`` (so viewers can render rule metadata even
+for rules with zero results) and each result carries ``ruleIndex`` into
+that list, a ``level`` mapped from the finding severity, and
+``baselineState`` ("new" vs "unchanged") so CI annotators can highlight
+only the findings the current change introduced.
+"""
+from __future__ import annotations
+
+from typing import List, Set
+
+from .core import Finding
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+SARIF_VERSION = "2.1.0"
+
+_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def to_sarif(findings: List[Finding], rules, new_ids: Set[int]) -> dict:
+    rule_index = {r.code: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": _LEVELS.get(f.severity, "error"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+            }],
+            "partialFingerprints": {"pta/v1": f.fingerprint},
+            "baselineState": "new" if id(f) in new_ids else "unchanged",
+        }
+        if f.rule in rule_index:
+            res["ruleIndex"] = rule_index[f.rule]
+        results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "paddle-tpu-analyze",
+                "rules": [{
+                    "id": r.code,
+                    "name": r.name,
+                    "shortDescription": {"text": r.description},
+                    "defaultConfiguration": {
+                        "level": _LEVELS.get(r.severity, "error")},
+                } for r in rules],
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }

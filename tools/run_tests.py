@@ -46,9 +46,13 @@ def main():
     if not args.no_analyze:
         # Static analysis gates the suite: 0 clean, 1 new findings,
         # 2 analyzer internal error (python -m tools.analyze semantics).
+        # --strict gates on warnings too; the SARIF sidecar feeds code
+        # scanning UIs without a second analyzer run.
         t0 = time.time()
         code = subprocess.call(
-            [sys.executable, "-m", "tools.analyze", "paddle_tpu"], cwd=REPO)
+            [sys.executable, "-m", "tools.analyze", "--strict",
+             "--format", "sarif", "--output", "analysis.sarif",
+             "paddle_tpu"], cwd=REPO)
         print(f"static analysis: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
