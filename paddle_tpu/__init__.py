@@ -118,6 +118,7 @@ from . import text  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
 from . import serving  # noqa: E402,F401
+from . import sentinel  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import reader  # noqa: E402,F401

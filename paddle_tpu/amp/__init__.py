@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core import dtypes as _dt
+from ..core import monitor as _monitor
 from ..ops.dispatch import register_amp_handler, apply_raw
 
 # reference: imperative/amp_auto_cast.cc default lists
@@ -217,7 +218,9 @@ class GradScaler:
             for p in optimizer._parameter_list:
                 if p._grad is not None:
                     p._grad = next(it)
-            self._found_inf = self._found_inf or bool(found)
+            if bool(found):
+                self._found_inf = True
+                _monitor.stat_add("amp.found_inf_steps", 1)
         self._unscaled_ids.add(id(optimizer))
 
     def step(self, optimizer):
@@ -247,6 +250,7 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        _monitor.stat_set("amp.loss_scale", self._scale)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
@@ -266,16 +270,34 @@ class GradScaler:
         self._scale = float(v)
 
     def state_dict(self):
+        # emits both this repo's historical keys (good_steps/bad_steps) and
+        # the reference AmpScaler's (incr_count/decr_count, grad_scaler.py),
+        # so checkpoints round-trip with ported scripts in either direction
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every_n,
                 "decr_every_n_nan_or_inf": self._decr_every_n,
-                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+                "incr_count": self._good_steps,
+                "decr_count": self._bad_steps,
+                "use_dynamic_loss_scaling": self._dynamic,
+                "found_inf": self._found_inf}
 
     def load_state_dict(self, state):
         self._scale = state.get("scale", self._scale)
-        self._good_steps = state.get("good_steps", 0)
-        self._bad_steps = state.get("bad_steps", 0)
+        self._incr_ratio = state.get("incr_ratio", self._incr_ratio)
+        self._decr_ratio = state.get("decr_ratio", self._decr_ratio)
+        self._incr_every_n = state.get("incr_every_n_steps",
+                                       self._incr_every_n)
+        self._decr_every_n = state.get("decr_every_n_nan_or_inf",
+                                       self._decr_every_n)
+        self._good_steps = int(state.get(
+            "good_steps", state.get("incr_count", self._good_steps)))
+        self._bad_steps = int(state.get(
+            "bad_steps", state.get("decr_count", self._bad_steps)))
+        self._dynamic = bool(state.get("use_dynamic_loss_scaling",
+                                       self._dynamic))
+        self._found_inf = bool(state.get("found_inf", False))
 
 
 AmpScaler = GradScaler

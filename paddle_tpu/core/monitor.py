@@ -115,6 +115,13 @@ class StatRegistry:
         with self._lock:
             return dict(self._stats)
 
+    def stats_with_prefix(self, prefix: str) -> Dict[str, Number]:
+        """All counters/gauges under a dotted namespace (e.g. ``sentinel.``,
+        ``amp.``) — the dashboard-scrape shape for one subsystem."""
+        with self._lock:
+            return {k: v for k, v in self._stats.items()
+                    if k.startswith(prefix)}
+
     # -- histograms ---------------------------------------------------------
     def observe(self, name: str, value: Number,
                 max_samples: int = DEFAULT_HIST_SAMPLES):
@@ -179,6 +186,11 @@ def stat_observe(name: str, value: Number,
 def stat_quantile(name: str, q: float, default: float = 0.0) -> float:
     """q-quantile (0..1) of a histogram's recent samples, or ``default``."""
     return _REGISTRY.quantile(name, q, default)
+
+
+def stats_with_prefix(prefix: str) -> Dict[str, Number]:
+    """Default-registry view of one subsystem's counters (``sentinel.``…)."""
+    return _REGISTRY.stats_with_prefix(prefix)
 
 
 def device_memory_stats(device=None) -> Dict[str, Number]:

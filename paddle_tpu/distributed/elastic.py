@@ -26,6 +26,12 @@ from typing import Callable, Optional, Sequence
 #: without counting it against --max_restarts.
 PREEMPTION_EXIT_CODE = 117
 
+#: Exit code the numerical-anomaly sentinel uses for a *deterministic*
+#: divergence (``halt`` rung). Unlike a preemption (free restart) or a crash
+#: (budgeted restart), a diverged run would diverge again from the same
+#: state, so the supervisor tears the job down instead of respawning.
+DIVERGENCE_EXIT_CODE = 119
+
 #: Env var the elastic supervisor sets in every child so training loops can
 #: auto-arm a PreemptionGuard without code changes.
 ELASTIC_ENV_VAR = "PADDLE_TPU_ELASTIC"

@@ -31,7 +31,8 @@ import sys
 import time
 from typing import List, Optional
 
-from .elastic import PREEMPTION_EXIT_CODE, ELASTIC_ENV_VAR
+from .elastic import (PREEMPTION_EXIT_CODE, DIVERGENCE_EXIT_CODE,
+                      ELASTIC_ENV_VAR)
 
 
 def _parse_args(argv=None):
@@ -192,6 +193,8 @@ class ElasticSupervisor:
 
     - exit 0            → rank done, not restarted
     - PREEMPTION_EXIT_CODE → graceful drain; restart for free
+    - DIVERGENCE_EXIT_CODE → sentinel halted a deterministic numerical
+      divergence; never restarted (same state → same NaNs), job torn down
     - other nonzero     → crash; restart with exponential backoff + jitter
       while the shared ``max_restarts`` budget lasts, else tear down and
       propagate that exit code
@@ -282,6 +285,16 @@ class ElasticSupervisor:
                             f"left)\n")
                         alive.append(self._respawn(p))
                         continue
+                    if ret == DIVERGENCE_EXIT_CODE:
+                        # the sentinel halted a deterministic divergence:
+                        # the same state replays the same NaNs, so a
+                        # restart only burns budget — tear down instead
+                        sys.stderr.write(
+                            f"rank {p._rank} halted on numerical "
+                            f"divergence (exit {ret}); not restarting — "
+                            f"terminating the job\n")
+                        terminate_local_procs(alive, self.grace_period)
+                        return ret
                     if self.restarts_used >= self.max_restarts:
                         sys.stderr.write(
                             f"rank {p._rank} exited with code {ret}; "

@@ -297,6 +297,81 @@ class FaultToleranceCallback(Callback):
         self._poll()
 
 
+class AnomalyGuardCallback(Callback):
+    """Numerical-anomaly guarding for ``Model.fit``
+    (docs/fault_tolerance.md, "Numerical faults").
+
+    Wires the :mod:`paddle_tpu.sentinel` stack into the fit loop:
+
+    - attaches a :class:`~paddle_tpu.sentinel.Sentinel` to the model's
+      optimizer, so NaN/Inf gradients are caught *before* the update by
+      the fused on-device probe (one scalar fetch per guarded step) and
+      the poisoned update is skipped;
+    - feeds each batch's logged loss into the EWMA z-score spike detector
+      (``Sentinel.feed_loss`` — no extra host syncs, the fit loop fetched
+      that float anyway);
+    - keeps health-stamped rollback snapshots under
+      ``save_dir/snapshots`` every ``snapshot_freq`` epochs (an epoch that
+      saw anomalies is stamped unhealthy, so the ``rollback`` rung never
+      restores into the divergence it is escaping);
+    - on escalation: quarantines the offending batch under
+      ``save_dir/quarantine``, rolls back, or halts with
+      ``DIVERGENCE_EXIT_CODE`` per the configured ladder.
+    """
+
+    def __init__(self, save_dir=None, config=None, snapshot_freq=1,
+                 keep_last=2, attach_optimizer=True):
+        super().__init__()
+        self.save_dir = save_dir
+        self.snapshot_freq = max(1, int(snapshot_freq))
+        self.keep_last = keep_last
+        self.attach_optimizer = attach_optimizer
+        self._config = config
+        self.sentinel = None
+        self.rollback = None
+        self._epoch_anomalies = 0
+
+    def on_train_begin(self, logs=None):
+        from ..sentinel import (Sentinel, SentinelConfig, CheckpointRollback)
+        if self.sentinel is None:
+            cfg = self._config
+            if cfg is None:
+                qdir = (os.path.join(self.save_dir, "quarantine")
+                        if self.save_dir else None)
+                cfg = SentinelConfig(quarantine_dir=qdir)
+            if self.save_dir:
+                self.rollback = CheckpointRollback(
+                    os.path.join(self.save_dir, "snapshots"),
+                    model=self.model.network,
+                    optimizer=self.model._optimizer,
+                    keep_last=self.keep_last)
+            self.sentinel = Sentinel(cfg, rollback=self.rollback)
+            self.sentinel.batch_getter = \
+                lambda: getattr(self.model, "_last_batch", None)
+        if self.attach_optimizer and self.model._optimizer is not None:
+            self.sentinel.attach(self.model._optimizer)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self.sentinel is not None:
+            self._epoch_anomalies = self.sentinel.anomalies
+
+    def on_train_batch_end(self, step, logs=None):
+        loss = (logs or {}).get("loss")
+        if self.sentinel is not None and loss is not None:
+            loss = loss[0] if isinstance(loss, (list, tuple)) else loss
+            self.sentinel.feed_loss(np.asarray(loss).reshape(-1)[0])
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.rollback is None or epoch % self.snapshot_freq != 0:
+            return
+        healthy = (self.sentinel.anomalies == self._epoch_anomalies)
+        self.rollback.snapshot(
+            self.sentinel._step, healthy=healthy,
+            reason=None if healthy else
+            f"epoch {epoch} saw "
+            f"{self.sentinel.anomalies - self._epoch_anomalies} anomalies")
+
+
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
                      log_freq=2, verbose=2, save_freq=1, save_dir=None,
                      metrics=None, mode="train"):

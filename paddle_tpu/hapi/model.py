@@ -556,15 +556,22 @@ class Model:
                 train_raws, fixed_raws, x_raws, y_raws, key)
             for p, g in zip(ts["trainable"], grads):
                 p._grad = g if p._grad is None else p._grad + g
-        elif any(p._grad is not None for p in ts["trainable"]):
+        elif (any(p._grad is not None for p in ts["trainable"])
+                or opt._sentinel is not None):
             # finishing an accumulation window: add this batch's grads to
             # the carried sum and let the eager optimizer (clip/regularize
             # inside step()) apply the combined update — reference
-            # semantics for train_batch after update=False calls
+            # semantics for train_batch after update=False calls.
+            # A sentinel-guarded optimizer takes this route too: its health
+            # probe needs the grads materialized and its skip/rollback
+            # decision happens in the Optimizer.step hook, neither of which
+            # exists inside the fully-fused update program
             loss, preds, grads, effects = ts["grads_fn"](
                 train_raws, fixed_raws, x_raws, y_raws, key)
             for p, g in zip(ts["trainable"], grads):
                 p._grad = g if p._grad is None else p._grad + g
+            if opt._sentinel is not None:
+                opt._sentinel.observe(loss=loss)
             opt.step()
             opt.clear_grad()
         else:
@@ -718,6 +725,7 @@ class Model:
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 xs, ys = self._split_batch(batch)
+                self._last_batch = (xs, ys)  # for sentinel quarantine dumps
                 loss, metrics = self.train_batch(xs, ys)
                 logs = {"loss": loss}
                 for m, r in zip(self._metrics, metrics):

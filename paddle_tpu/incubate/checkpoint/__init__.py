@@ -16,5 +16,7 @@ topology — resharding on restore). Async mode moves the device→host fetch
 and file write off the training thread (the orbax-style pattern).
 """
 from .sharded import (save_sharded, load_sharded, AsyncSaver,  # noqa: F401
-                      CheckpointIntegrityError, verify_checkpoint)
+                      CheckpointIntegrityError, verify_checkpoint,
+                      HEALTH_STAMP_FILE, write_health_stamp,
+                      read_health_stamp)
 from .auto_checkpoint import TrainEpochRange, train_epoch_range  # noqa: F401

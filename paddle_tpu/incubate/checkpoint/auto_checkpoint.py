@@ -29,7 +29,8 @@ import warnings
 from typing import Optional
 
 from .sharded import (save_sharded, load_sharded, AsyncSaver,
-                      CheckpointIntegrityError)
+                      CheckpointIntegrityError, read_health_stamp,
+                      write_health_stamp)
 from ...utils.resilience import fault_injector
 
 
@@ -142,6 +143,16 @@ class TrainEpochRange:
             ckpt = self._epoch_dir(epoch)
             if not os.path.isdir(ckpt):
                 continue
+            stamp = read_health_stamp(ckpt)
+            if not stamp.get("healthy", True):
+                # sentinel stamped this state numerically bad after it was
+                # saved — integrity-intact but not worth resuming into
+                warnings.warn(
+                    f"auto_checkpoint: epoch {epoch} checkpoint at {ckpt} "
+                    f"is stamped unhealthy "
+                    f"({stamp.get('reason', 'no reason recorded')}); "
+                    f"falling back to an older epoch")
+                continue
             try:
                 state = load_sharded(ckpt)
             except (CheckpointIntegrityError, OSError, ValueError,
@@ -157,6 +168,14 @@ class TrainEpochRange:
             self.restored_epoch = epoch
             self._last_saved = epoch
             return
+
+    def mark_unhealthy(self, epoch: int, reason: Optional[str] = None):
+        """Health-stamp an already-saved epoch as numerically bad (the
+        sentinel detected the divergence only after the save); a restore
+        will then skip it even though its checksums are intact."""
+        ckpt = self._epoch_dir(epoch)
+        if os.path.isdir(ckpt):
+            write_health_stamp(ckpt, False, step=epoch, reason=reason)
 
     def _commit(self, epoch: int):
         # status.json is written only after the shard files exist, so a

@@ -27,6 +27,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -189,6 +190,47 @@ def verify_checkpoint(path: str):
                 raise CheckpointIntegrityError(
                     f"{path}: checksum mismatch for {shards_name} "
                     f"(expected {digest[:12]}…)")
+
+
+#: Health-stamp sidecar the numerical-anomaly sentinel writes next to the
+#: shard/metadata files. Integrity (checksums) says the bytes are intact;
+#: the stamp says the *state* was numerically sane when saved. A checkpoint
+#: without a stamp is assumed healthy — every pre-sentinel checkpoint stays
+#: restorable.
+HEALTH_STAMP_FILE = "health.json"
+
+
+def write_health_stamp(path: str, healthy: bool, step: Optional[int] = None,
+                       reason: Optional[str] = None):
+    """Write (or overwrite) the health-stamp sidecar on checkpoint dir
+    ``path``. tmp+replace so a crash mid-write leaves the previous stamp,
+    never a torn one."""
+    stamp = {"healthy": bool(healthy), "time": time.time()}
+    if step is not None:
+        stamp["step"] = int(step)
+    if reason is not None:
+        stamp["reason"] = str(reason)
+    final = os.path.join(path, HEALTH_STAMP_FILE)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(stamp, f)
+    os.replace(tmp, final)
+
+
+def read_health_stamp(path: str) -> Dict[str, Any]:
+    """Read the health stamp of checkpoint dir ``path``. Missing or
+    unparsable stamps read as ``{"healthy": True}`` — absence of evidence
+    of sickness is health (backward compat with stamp-less checkpoints)."""
+    full = os.path.join(path, HEALTH_STAMP_FILE)
+    try:
+        with open(full) as f:
+            stamp = json.load(f)
+    except (OSError, ValueError):
+        return {"healthy": True}
+    if not isinstance(stamp, dict):
+        return {"healthy": True}
+    stamp.setdefault("healthy", True)
+    return stamp
 
 
 def _meta_entries(m):

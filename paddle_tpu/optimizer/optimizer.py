@@ -41,6 +41,7 @@ class Optimizer:
         self._jit_update = None
         self._jit_key = None
         self._accumulators_built = False
+        self._sentinel = None  # set by paddle_tpu.sentinel.Sentinel.attach
         self.helper = None
 
     # -- lr -----------------------------------------------------------------
@@ -128,6 +129,9 @@ class Optimizer:
         return [None] * len(params)
 
     def step(self):
+        if self._sentinel is not None and \
+                not self._sentinel.approve_step(self):
+            return  # anomaly: the update is skipped, grads never applied
         self._ensure_state()
         params = [p for p in self._parameter_list if p._grad is not None
                   and p.trainable]

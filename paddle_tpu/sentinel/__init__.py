@@ -1,0 +1,65 @@
+"""Numerical-anomaly sentinel: NaN/Inf guard, divergence detection, and
+auto-rollback to last-known-good checkpoints.
+
+The sentinel closes the robustness gap the elastic runtime leaves open:
+that layer restarts *crashed* processes, but a numerically diverged run
+does not crash — it keeps burning accelerator hours writing NaN into every
+weight. Three layers, each usable alone:
+
+- :class:`StepGuard` / :class:`LossSpikeDetector` — detection. One fused
+  on-device finiteness reduction over loss + all grads with a single
+  scalar fetch per guarded step, plus a host-side EWMA z-score spike
+  detector over the loss the trainer already fetches.
+- :class:`PolicyEngine` / :class:`Sentinel` — response. A configurable
+  escalation ladder (``skip_step`` → ``quarantine_batch`` → ``rollback``
+  → ``halt``) driven by consecutive anomaly counts, hooked into
+  ``Optimizer.step`` so poisoned updates never reach the parameters.
+- :class:`CheckpointRollback` — recovery. Health-stamped sharded
+  snapshots with a newest-healthy restore walk.
+
+Quickstart::
+
+    import paddle_tpu as paddle
+    from paddle_tpu import sentinel
+
+    rb = sentinel.CheckpointRollback("ckpts", model=net, optimizer=opt)
+    guard = sentinel.Sentinel(
+        sentinel.SentinelConfig(quarantine_dir="quarantine"),
+        optimizer=opt, rollback=rb)
+    for step, (x, y) in enumerate(loader):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        guard.observe(loss=loss, batch=([x], [y]))
+        opt.step()               # guarded
+        opt.clear_grad()
+        if step % 50 == 0:
+            rb.snapshot(step)
+
+For ``hapi.Model`` users, ``hapi.callbacks.AnomalyGuardCallback`` wires
+all of this up from the fit loop.
+"""
+from ..distributed.elastic import DIVERGENCE_EXIT_CODE  # noqa: F401
+from .detector import LossSpikeDetector  # noqa: F401
+from .guard import StepGuard, poison_grads, poison_loss  # noqa: F401
+from .policy import (  # noqa: F401
+    ACTIONS, DEFAULT_LADDER, AnomalyReport, PolicyEngine, Sentinel,
+    SentinelConfig)
+from .quarantine import quarantine_batch, read_quarantine  # noqa: F401
+from .rollback import CheckpointRollback  # noqa: F401
+
+__all__ = [
+    "ACTIONS",
+    "DEFAULT_LADDER",
+    "DIVERGENCE_EXIT_CODE",
+    "AnomalyReport",
+    "CheckpointRollback",
+    "LossSpikeDetector",
+    "PolicyEngine",
+    "Sentinel",
+    "SentinelConfig",
+    "StepGuard",
+    "poison_grads",
+    "poison_loss",
+    "quarantine_batch",
+    "read_quarantine",
+]

@@ -1,0 +1,79 @@
+"""On-device step health probe: one fused reduction, one scalar fetch.
+
+Reference: the framework layer's ``FLAGS_check_nan_inf`` / ``nan_inf_utils``
+checks every output tensor from the host — O(n_tensors) device round-trips
+per step. On TPU that serializes the async dispatch pipeline (the LazyTensor
+argument, arxiv 2102.13267; enforced locally by the PTA002 lint), so the
+probe here mirrors ``GradScaler._fused_unscale`` instead: reduce the loss
+and *all* gradients to a single finiteness flag inside one XLA program and
+fetch exactly one tiny array per guarded step. The fetch is the sentinel's
+single sanctioned host sync, amortizable further with ``check_every > 1``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import monitor as _monitor
+
+
+@jax.jit
+def _fused_health(grads, loss):
+    """All-finite flag over ``loss`` + every grad, packed with the loss
+    value into one length-2 f32 vector so the host pays a single fetch for
+    both the health bit and the detector's loss sample."""
+    checks = [jnp.all(jnp.isfinite(g)) for g in grads]
+    checks.append(jnp.isfinite(loss))
+    finite = jnp.all(jnp.stack(checks))
+    return jnp.stack([finite.astype(jnp.float32),
+                      loss.astype(jnp.float32)])
+
+
+def poison_grads(optimizer):
+    """Overwrite every present gradient with NaN (the FaultInjector ``nan``
+    action at the ``grads`` site — deterministic divergence for tests)."""
+    for p in optimizer._parameter_list:
+        if p._grad is not None:
+            p._grad = jnp.full_like(p._grad, jnp.nan)
+
+
+def poison_loss(loss):
+    """NaN of the same scalar shape/dtype as ``loss`` (``nan`` action at
+    the ``loss`` site)."""
+    if loss is None:
+        return jnp.float32(jnp.nan)
+    return jnp.full_like(jnp.asarray(loss), jnp.nan)
+
+
+class StepGuard:
+    """Amortized on-device health probe over (loss, grads).
+
+    ``probe`` runs the fused reduction and fetches its 2-float result —
+    ONE host sync, counted in ``sentinel.host_syncs`` so tests can assert
+    the sync budget. ``should_check`` implements every-N-steps
+    amortization: unchecked steps cost nothing at all.
+    """
+
+    def __init__(self, check_every: int = 1):
+        if int(check_every) < 1:
+            raise ValueError(f"check_every must be >= 1, got {check_every}")
+        self.check_every = int(check_every)
+
+    def should_check(self, step: int) -> bool:
+        return step % self.check_every == 0
+
+    def probe(self, grads: Sequence, loss=None) -> Tuple[bool, Optional[float]]:
+        """Returns ``(finite, loss_value)``; ``loss_value`` is None when no
+        loss was supplied (the probe then covers gradients only)."""
+        have_loss = loss is not None
+        loss_raw = jnp.asarray(loss, jnp.float32) if have_loss \
+            else jnp.float32(0.0)
+        out = _fused_health(tuple(grads), loss_raw)
+        _monitor.stat_add("sentinel.checks", 1)
+        _monitor.stat_add("sentinel.host_syncs", 1)
+        vals = np.asarray(out)  # noqa: PTA002 -- the sentinel's ONE sanctioned fetch: a 2-float flag the policy engine must branch on; everything upstream stayed fused on device
+        finite = bool(vals[0])
+        return finite, float(vals[1]) if have_loss else None

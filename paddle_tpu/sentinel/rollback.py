@@ -1,0 +1,111 @@
+"""Last-known-good snapshots for the sentinel's ``rollback`` rung.
+
+A thin adapter over the sharded checkpoint format
+(``incubate/checkpoint/sharded.py``): periodic snapshots of model +
+optimizer state, each carrying the health-stamp sidecar the sentinel
+writes, and a restore that walks snapshots newest-first skipping anything
+stamped unhealthy or failing its shard checksums. A missing stamp means
+healthy (pre-sentinel checkpoints stay restorable — backward compat).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import warnings
+from typing import List, Optional
+
+from ..core import monitor as _monitor
+from ..incubate.checkpoint.sharded import (
+    save_sharded, load_sharded, CheckpointIntegrityError,
+    write_health_stamp, read_health_stamp)
+
+
+def _snap_no(name: str) -> Optional[int]:
+    suffix = name.split("_", 1)[1] if name.startswith("snap_") else ""
+    return int(suffix) if suffix.isdigit() else None
+
+
+class CheckpointRollback:
+    """Snapshot/restore pair used by :class:`~paddle_tpu.sentinel.Sentinel`.
+
+    ``model`` and ``optimizer`` are anything with ``state_dict`` /
+    ``set_state_dict`` (an ``nn.Layer``, an ``Optimizer``); either may be
+    None. ``keep_last`` bounds disk use — but unhealthy-stamped snapshots
+    never count against it, so a divergence cannot GC away the last good
+    state it will need.
+    """
+
+    def __init__(self, path: str, model=None, optimizer=None,
+                 keep_last: int = 2):
+        self.path = str(path)
+        self._model = model
+        self._optimizer = optimizer
+        self.keep_last = max(1, int(keep_last))
+
+    # -- save side -----------------------------------------------------------
+    def _snap_dir(self, step: int) -> str:
+        return os.path.join(self.path, f"snap_{step}")
+
+    def _state(self) -> dict:
+        state = {}
+        if self._model is not None:
+            state["model"] = dict(self._model.state_dict())
+        if self._optimizer is not None:
+            state["optimizer"] = dict(self._optimizer.state_dict())
+        return state
+
+    def snapshot(self, step: int, healthy: bool = True,
+                 reason: Optional[str] = None) -> str:
+        """Write one snapshot + its health stamp; GC old *healthy* ones."""
+        d = self._snap_dir(step)
+        save_sharded(self._state(), d)
+        write_health_stamp(d, healthy, step=step, reason=reason)
+        self._gc()
+        return d
+
+    def steps(self) -> List[int]:
+        if not os.path.isdir(self.path):
+            return []
+        return sorted(s for s in (_snap_no(n) for n in os.listdir(self.path))
+                      if s is not None)
+
+    def mark_unhealthy(self, step: int, reason: Optional[str] = None):
+        """Retroactively stamp a snapshot bad (the sentinel discovered the
+        divergence only after this state was already saved)."""
+        d = self._snap_dir(step)
+        if os.path.isdir(d):
+            write_health_stamp(d, False, step=step, reason=reason)
+
+    def _gc(self):
+        healthy = [s for s in self.steps()
+                   if read_health_stamp(self._snap_dir(s)).get("healthy",
+                                                               True)]
+        for s in healthy[:-self.keep_last]:
+            shutil.rmtree(self._snap_dir(s), ignore_errors=True)
+
+    # -- restore side --------------------------------------------------------
+    def restore_newest_healthy(self) -> Optional[int]:
+        """Walk snapshots newest-first; restore the first one that is both
+        health-stamped healthy (missing stamp = healthy) and integrity-
+        intact. Returns the restored step, or None when nothing usable is
+        left."""
+        for step in reversed(self.steps()):
+            d = self._snap_dir(step)
+            stamp = read_health_stamp(d)
+            if not stamp.get("healthy", True):
+                continue
+            try:
+                state = load_sharded(d)
+            except (CheckpointIntegrityError, OSError, ValueError,
+                    KeyError) as e:
+                warnings.warn(
+                    f"sentinel rollback: snapshot {d} is not intact ({e}); "
+                    f"trying an older one")
+                continue
+            if self._model is not None and "model" in state:
+                self._model.set_state_dict(state["model"])
+            if self._optimizer is not None and "optimizer" in state:
+                self._optimizer.set_state_dict(state["optimizer"])
+            _monitor.stat_add("sentinel.rollbacks", 1)
+            return step
+        return None

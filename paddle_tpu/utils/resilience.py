@@ -145,9 +145,17 @@ class FaultInjector:
     ``fire("load")`` caller (the checkpoint loader corrupts a shard file and
     then proceeds, so checksum verification can be exercised end to end).
 
+    Verbatim actions interpreted by current call sites: ``corrupt`` at
+    ``save``/``load`` (checkpoint shard corruption, sharded.py) and ``nan``
+    at ``grads``/``loss`` (the numerical-anomaly sentinel poisons the
+    corresponding values with NaN right before its health probe —
+    ``grads:5:nan`` makes step 5 diverge deterministically).
+
     Counters are per-process: a restarted trainer starts counting from zero
     again, which is exactly what makes "crash once, then succeed" scenarios
-    expressible with a single rule.
+    expressible with a single rule. Duplicate ``site:occurrence`` pairs are
+    rejected — only one action can win a given firing, and silently keeping
+    the first (or last) makes the loser impossible to debug.
     """
 
     def __init__(self, spec: Optional[str] = None):
@@ -159,12 +167,21 @@ class FaultInjector:
             rule = rule.strip()
             if not rule:
                 continue
-            parts = rule.split(":")
-            if len(parts) != 3 or not parts[1].isdigit():
+            parts = [p.strip() for p in rule.split(":")]
+            if len(parts) != 3 or not all(parts) or not parts[1].isdigit():
                 raise ValueError(
                     f"bad PADDLE_TPU_FAULT_SPEC rule {rule!r}; expected "
                     f"site:occurrence:action (e.g. epoch:2:crash)")
             site, occ, action = parts[0], int(parts[1]), parts[2]
+            if occ < 1:
+                raise ValueError(
+                    f"bad PADDLE_TPU_FAULT_SPEC rule {rule!r}: occurrence "
+                    f"is 1-based (the first fire is 1); 0 would never fire")
+            if any(o == occ for o, _ in self._rules.get(site, ())):
+                raise ValueError(
+                    f"duplicate PADDLE_TPU_FAULT_SPEC rule for "
+                    f"{site}:{occ}: each site:occurrence pair may appear "
+                    f"only once")
             self._rules.setdefault(site, []).append((occ, action))
 
     def armed(self, site: Optional[str] = None) -> bool:

@@ -1,0 +1,637 @@
+"""Unit tests for the numerical-anomaly sentinel (paddle_tpu/sentinel/):
+detector statistics, policy ladder, fused step guard, quarantine dumps,
+health-stamped rollback, TrainEpochRange health awareness, the hardened
+FaultInjector spec parser, and GradScaler telemetry/state round-trip."""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, sentinel
+from paddle_tpu import optimizer as optim
+from paddle_tpu.core import monitor
+from paddle_tpu.incubate.checkpoint import (
+    TrainEpochRange, save_sharded, write_health_stamp, read_health_stamp,
+    HEALTH_STAMP_FILE)
+from paddle_tpu.sentinel import (
+    AnomalyReport, CheckpointRollback, LossSpikeDetector, PolicyEngine,
+    Sentinel, SentinelConfig, StepGuard, quarantine_batch, read_quarantine)
+from paddle_tpu.utils.resilience import FaultInjector
+
+
+@pytest.fixture(autouse=True)
+def _clean_sentinel_stats():
+    for prefix in ("sentinel.", "amp."):
+        for k in list(monitor.stats_with_prefix(prefix)):
+            monitor.default_registry().reset(k)
+    yield
+
+
+# -- detector -----------------------------------------------------------------
+
+class TestLossSpikeDetector:
+    def test_warmup_never_spikes(self):
+        d = LossSpikeDetector(warmup_steps=10, z_threshold=1.0)
+        for i in range(10):
+            z, spike = d.update(100.0 if i == 5 else 1.0)
+            assert not spike
+        assert d.warmed_up
+
+    def test_spike_after_warmup_upward_only(self):
+        d = LossSpikeDetector(warmup_steps=5, z_threshold=4.0)
+        for v in [1.0, 1.1, 0.9, 1.05, 0.95, 1.0]:
+            d.update(v)
+        z, spike = d.update(50.0)
+        assert spike and z > 4.0
+        # a crash *downward* is good news, not divergence
+        z, spike = d.update(0.0)
+        assert not spike
+
+    def test_spike_excluded_from_statistics(self):
+        d = LossSpikeDetector(warmup_steps=3, z_threshold=3.0)
+        for v in [1.0, 1.1, 0.9, 1.0]:
+            d.update(v)
+        mean_before = d.mean
+        _, spike = d.update(500.0)
+        assert spike
+        assert d.mean == mean_before  # the anomaly didn't drag the baseline
+
+    def test_non_finite_is_inf_spike_without_stat_update(self):
+        d = LossSpikeDetector(warmup_steps=2)
+        d.update(1.0)
+        mean_before = d.mean
+        z, spike = d.update(float("nan"))
+        assert spike and math.isinf(z)
+        assert d.mean == mean_before
+        z, spike = d.update(float("inf"))
+        assert spike and math.isinf(z)
+
+    def test_reset_and_state_roundtrip(self):
+        d = LossSpikeDetector(warmup_steps=2)
+        for v in [1.0, 2.0, 3.0]:
+            d.update(v)
+        state = d.state_dict()
+        d2 = LossSpikeDetector(warmup_steps=2)
+        d2.load_state_dict(state)
+        assert d2.mean == d.mean and d2.std == d.std and d2.warmed_up
+        d.reset()
+        assert d.mean is None and not d.warmed_up
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="alpha"):
+            LossSpikeDetector(alpha=0.0)
+        with pytest.raises(ValueError, match="z_threshold"):
+            LossSpikeDetector(z_threshold=-1.0)
+
+
+# -- policy -------------------------------------------------------------------
+
+class TestPolicyEngine:
+    def test_ladder_rungs(self):
+        p = PolicyEngine(("skip_step", "rollback", "halt"), tolerance=1)
+        assert p.decide(1) == "skip_step"
+        assert p.decide(2) == "rollback"
+        assert p.decide(3) == "halt"
+        assert p.decide(99) == "halt"  # clamps at the last rung
+
+    def test_tolerance_stretches_rungs(self):
+        p = PolicyEngine(("skip_step", "halt"), tolerance=3)
+        assert [p.decide(n) for n in range(1, 8)] == \
+            ["skip_step"] * 3 + ["halt"] * 4
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="unknown sentinel action"):
+            SentinelConfig(ladder=("skip_step", "explode"))
+        with pytest.raises(ValueError, match="at least one"):
+            SentinelConfig(ladder=())
+        with pytest.raises(ValueError, match="check_every"):
+            SentinelConfig(check_every=0)
+        with pytest.raises(ValueError, match="tolerance"):
+            SentinelConfig(tolerance=0)
+
+
+# -- guard --------------------------------------------------------------------
+
+class TestStepGuard:
+    def test_finite_probe(self):
+        g = StepGuard()
+        finite, loss = g.probe([jnp.ones(4), jnp.zeros((2, 2))],
+                               jnp.float32(1.5))
+        assert finite and loss == pytest.approx(1.5)
+
+    def test_nan_grad_flips_flag(self):
+        g = StepGuard()
+        finite, _ = g.probe([jnp.ones(4),
+                             jnp.array([1.0, jnp.nan])], jnp.float32(1.0))
+        assert not finite
+
+    def test_inf_loss_flips_flag(self):
+        g = StepGuard()
+        finite, _ = g.probe([jnp.ones(4)], jnp.float32(jnp.inf))
+        assert not finite
+
+    def test_grads_only_probe(self):
+        g = StepGuard()
+        finite, loss = g.probe([jnp.ones(3)])
+        assert finite and loss is None
+
+    def test_one_host_sync_per_probe(self):
+        before = monitor.stat_get("sentinel.host_syncs")
+        g = StepGuard()
+        for _ in range(5):
+            g.probe([jnp.ones(4)], jnp.float32(1.0))
+        assert monitor.stat_get("sentinel.host_syncs") == before + 5
+
+    def test_check_every(self):
+        g = StepGuard(check_every=3)
+        assert [g.should_check(s) for s in range(7)] == \
+            [True, False, False, True, False, False, True]
+        with pytest.raises(ValueError, match="check_every"):
+            StepGuard(check_every=0)
+
+
+# -- quarantine ---------------------------------------------------------------
+
+class TestQuarantine:
+    def test_dump_and_read_roundtrip(self, tmp_path):
+        root = str(tmp_path / "q")
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        y = np.ones(2, np.float32)
+        entry = quarantine_batch(root, 7, ([x], [y]), ["non_finite"],
+                                 loss=float("nan"), z=None)
+        assert entry and os.path.basename(entry) == "step_7"
+        meta, arrays = read_quarantine(entry)
+        assert meta["step"] == 7 and meta["reasons"] == ["non_finite"]
+        assert meta["loss"] is None or math.isnan(meta["loss"])
+        np.testing.assert_array_equal(arrays["x0"], x.numpy())
+        np.testing.assert_array_equal(arrays["y0"], y)
+
+    def test_metadata_only_when_no_batch(self, tmp_path):
+        root = str(tmp_path / "q")
+        entry = quarantine_batch(root, 3, None, ["loss_spike(z=9.00)"],
+                                 loss=123.0, z=9.0)
+        meta, arrays = read_quarantine(entry)
+        assert meta["z"] == 9.0 and arrays == {}
+        assert not os.path.exists(os.path.join(entry, "inputs.npz"))
+
+    def test_cap_drops_and_counts(self, tmp_path):
+        root = str(tmp_path / "q")
+        for step in range(3):
+            quarantine_batch(root, step, None, ["r"], max_entries=2)
+        entries = sorted(n for n in os.listdir(root)
+                         if n.startswith("step_"))
+        assert entries == ["step_0", "step_1"]
+        assert monitor.stat_get("sentinel.quarantine_dropped") == 1
+
+    def test_unset_root_is_noop(self):
+        assert quarantine_batch(None, 0, None, ["r"]) is None
+
+
+# -- health stamps + rollback -------------------------------------------------
+
+class TestHealthStamps:
+    def test_write_read_roundtrip(self, tmp_path):
+        d = str(tmp_path / "ck")
+        save_sharded({"a": jnp.arange(3.0)}, d)
+        write_health_stamp(d, False, step=12, reason="nan grads")
+        stamp = read_health_stamp(d)
+        assert stamp["healthy"] is False
+        assert stamp["step"] == 12 and stamp["reason"] == "nan grads"
+
+    def test_missing_stamp_reads_healthy(self, tmp_path):
+        d = str(tmp_path / "ck")
+        save_sharded({"a": jnp.arange(3.0)}, d)
+        assert read_health_stamp(d) == {"healthy": True}
+
+    def test_corrupt_stamp_reads_healthy(self, tmp_path):
+        d = tmp_path / "ck"
+        d.mkdir()
+        (d / HEALTH_STAMP_FILE).write_text("{not json")
+        assert read_health_stamp(str(d))["healthy"] is True
+        (d / HEALTH_STAMP_FILE).write_text("[1, 2]")
+        assert read_health_stamp(str(d))["healthy"] is True
+
+
+def _lin_job(tmp_path, path="snaps"):
+    paddle.seed(7)
+    net = nn.Linear(4, 2)
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    rb = CheckpointRollback(str(tmp_path / path), model=net, optimizer=opt)
+    return net, opt, rb
+
+
+def _train_step(net, opt):
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    loss = paddle.mean(net(x) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+class TestCheckpointRollback:
+    def test_restore_newest_healthy(self, tmp_path):
+        net, opt, rb = _lin_job(tmp_path)
+        rb.snapshot(1)
+        w1 = net.weight.numpy().copy()
+        _train_step(net, opt)
+        rb.snapshot(2)
+        w2 = net.weight.numpy().copy()
+        _train_step(net, opt)
+        assert rb.restore_newest_healthy() == 2
+        np.testing.assert_array_equal(net.weight.numpy(), w2)
+        assert not np.array_equal(w1, w2)
+
+    def test_unhealthy_stamped_newest_is_skipped(self, tmp_path):
+        """The ISSUE's core case: newest snapshot is integrity-VALID but
+        health-stamped unhealthy — restore must fall back past it."""
+        net, opt, rb = _lin_job(tmp_path)
+        rb.snapshot(1)
+        w1 = net.weight.numpy().copy()
+        _train_step(net, opt)
+        rb.snapshot(2)
+        rb.mark_unhealthy(2, reason="divergence detected after save")
+        # the unhealthy snapshot still passes checksum verification
+        from paddle_tpu.incubate.checkpoint import verify_checkpoint
+        verify_checkpoint(os.path.join(rb.path, "snap_2"))
+        assert rb.restore_newest_healthy() == 1
+        np.testing.assert_array_equal(net.weight.numpy(), w1)
+
+    def test_stampless_snapshot_restorable(self, tmp_path):
+        """Backward compat: pre-sentinel snapshots carry no stamp at all."""
+        net, opt, rb = _lin_job(tmp_path)
+        rb.snapshot(1)
+        os.remove(os.path.join(rb.path, "snap_1", HEALTH_STAMP_FILE))
+        assert rb.restore_newest_healthy() == 1
+
+    def test_corrupt_newest_falls_back(self, tmp_path):
+        net, opt, rb = _lin_job(tmp_path)
+        rb.snapshot(1)
+        _train_step(net, opt)
+        rb.snapshot(2)
+        shard = [f for f in os.listdir(os.path.join(rb.path, "snap_2"))
+                 if f.startswith("shards_")][0]
+        full = os.path.join(rb.path, "snap_2", shard)
+        with open(full, "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([b[0] ^ 0xFF]))
+        with pytest.warns(UserWarning, match="not intact"):
+            assert rb.restore_newest_healthy() == 1
+
+    def test_gc_keeps_unhealthy_out_of_budget(self, tmp_path):
+        net, opt, rb = _lin_job(tmp_path)
+        rb.keep_last = 2
+        rb.snapshot(1)
+        rb.snapshot(2, healthy=False, reason="bad")
+        rb.snapshot(3)
+        rb.snapshot(4)
+        rb.snapshot(5)
+        # healthy budget is {4, 5}; unhealthy 2 is retained (not counted)
+        assert rb.steps() == [2, 4, 5]
+
+    def test_nothing_usable_returns_none(self, tmp_path):
+        net, opt, rb = _lin_job(tmp_path)
+        assert rb.restore_newest_healthy() is None
+        rb.snapshot(1, healthy=False)
+        assert rb.restore_newest_healthy() is None
+
+
+class TestTrainEpochRangeHealthAware:
+    def test_restore_skips_unhealthy_stamped_epoch(self, tmp_path):
+        paddle.seed(11)
+        net = nn.Linear(4, 2)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        r = TrainEpochRange(5, "jobH", model=net, optimizer=opt,
+                            checkpoint_path=str(tmp_path / "auto"))
+        weights = {}
+        for epoch in [0, 1, 2]:
+            _train_step(net, opt)
+            r.save(epoch)
+            weights[epoch] = net.weight.numpy().copy()
+        r.mark_unhealthy(2, reason="sentinel: diverged during epoch 3")
+        net2 = nn.Linear(4, 2)
+        opt2 = optim.SGD(learning_rate=0.1, parameters=net2.parameters())
+        with pytest.warns(UserWarning, match="stamped unhealthy"):
+            r2 = TrainEpochRange(5, "jobH", model=net2, optimizer=opt2,
+                                 checkpoint_path=str(tmp_path / "auto"))
+        assert r2.restored_epoch == 1
+        np.testing.assert_array_equal(net2.weight.numpy(), weights[1])
+
+    def test_restore_without_stamps_unchanged(self, tmp_path):
+        paddle.seed(11)
+        net = nn.Linear(4, 2)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        r = TrainEpochRange(5, "jobN", model=net, optimizer=opt,
+                            checkpoint_path=str(tmp_path / "auto"))
+        _train_step(net, opt)
+        r.save(0)
+        r2 = TrainEpochRange(5, "jobN", model=nn.Linear(4, 2),
+                             checkpoint_path=str(tmp_path / "auto"))
+        assert r2.restored_epoch == 0
+
+
+# -- fault-injector parser hardening ------------------------------------------
+
+class TestFaultInjectorParser:
+    def test_whitespace_is_stripped(self):
+        fi = FaultInjector(" grads : 2 : nan , loss:1:nan ")
+        assert fi.armed("grads") and fi.armed("loss")
+        assert fi.fire("loss") == "nan"
+        assert fi.fire("grads") is None and fi.fire("grads") == "nan"
+
+    def test_empty_segment_rejected(self):
+        with pytest.raises(ValueError, match="bad PADDLE_TPU_FAULT_SPEC"):
+            FaultInjector("grads::nan")
+        with pytest.raises(ValueError, match="bad PADDLE_TPU_FAULT_SPEC"):
+            FaultInjector(":1:nan")
+        with pytest.raises(ValueError, match="bad PADDLE_TPU_FAULT_SPEC"):
+            FaultInjector("grads:1:")
+
+    def test_occurrence_zero_rejected(self):
+        with pytest.raises(ValueError, match="1-based"):
+            FaultInjector("grads:0:nan")
+
+    def test_duplicate_site_occurrence_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            FaultInjector("step:3:crash,step:3:raise")
+
+    def test_same_site_different_occurrence_ok(self):
+        fi = FaultInjector("step:1:nan,step:3:crash")
+        assert fi.fire("step") == "nan"
+        assert fi.fire("step") is None
+
+
+# -- GradScaler telemetry + state round-trip ----------------------------------
+
+class TestGradScalerSatellite:
+    def _scaler_after_inf(self):
+        net = nn.Linear(4, 2)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       decr_every_n_nan_or_inf=1)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = paddle.mean(net(x) ** 2)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        for p in opt._parameter_list:
+            if p._grad is not None:
+                p._grad = jnp.full_like(p._grad, jnp.inf)
+        scaler.step(opt)
+        scaler.update()
+        return scaler
+
+    def test_found_inf_counter_and_scale_gauge(self):
+        before = monitor.stat_get("amp.found_inf_steps")
+        scaler = self._scaler_after_inf()
+        assert monitor.stat_get("amp.found_inf_steps") == before + 1
+        assert monitor.stat_get("amp.loss_scale") == scaler._scale == 512.0
+
+    def test_state_dict_emits_both_key_styles(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        scaler._good_steps = 5
+        scaler._bad_steps = 2
+        state = scaler.state_dict()
+        assert state["good_steps"] == state["incr_count"] == 5
+        assert state["bad_steps"] == state["decr_count"] == 2
+        assert state["use_dynamic_loss_scaling"] is True
+        assert state["found_inf"] is False
+
+    def test_roundtrip_restores_counters(self):
+        a = paddle.amp.GradScaler(init_loss_scaling=8.0, incr_ratio=3.0,
+                                  decr_ratio=0.25, incr_every_n_steps=7)
+        a._good_steps, a._bad_steps = 6, 1
+        b = paddle.amp.GradScaler()
+        b.load_state_dict(a.state_dict())
+        assert b._scale == 8.0 and b._incr_ratio == 3.0
+        assert b._decr_ratio == 0.25 and b._incr_every_n == 7
+        assert b._good_steps == 6 and b._bad_steps == 1
+        # counter continuity: one more good step triggers the increase
+        # exactly where the pre-restore scaler would have
+        b._found_inf = False
+        b.update()
+        assert b._good_steps == 0 and b._scale == 24.0
+
+    def test_reference_key_style_loads(self):
+        b = paddle.amp.GradScaler()
+        b.load_state_dict({"scale": 16.0, "incr_count": 3, "decr_count": 1,
+                           "use_dynamic_loss_scaling": False})
+        assert b._scale == 16.0 and b._good_steps == 3
+        assert b._bad_steps == 1 and b._dynamic is False
+
+
+# -- the Sentinel end-to-end (in-process) -------------------------------------
+
+def _sentinel_job(tmp_path, **cfg_kw):
+    paddle.seed(3)
+    net = nn.Linear(4, 2)
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    rb = CheckpointRollback(str(tmp_path / "snaps"), model=net,
+                            optimizer=opt)
+    cfg_kw.setdefault("warmup_steps", 1000)  # only test NaN paths
+    s = Sentinel(SentinelConfig(**cfg_kw), optimizer=opt, rollback=rb)
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 2), np.float32))
+
+    def step(poison=False):
+        loss = paddle.mean((net(x) - y) ** 2)
+        loss.backward()
+        if poison:
+            sentinel.poison_grads(opt)
+        s.observe(loss=loss, batch=([x], [y]))
+        opt.step()
+        opt.clear_grad()
+        return s.last_report
+
+    return net, opt, rb, s, step
+
+
+class TestSentinel:
+    def test_healthy_steps_approve_and_count_syncs(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(tmp_path)
+        syncs0 = monitor.stat_get("sentinel.host_syncs")
+        for _ in range(4):
+            r = step()
+            assert not r.anomalous
+        # exactly ONE host sync per guarded healthy step
+        assert monitor.stat_get("sentinel.host_syncs") == syncs0 + 4
+
+    def test_nan_grads_skip_update_params_unchanged(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(tmp_path)
+        step()
+        w = net.weight.numpy().copy()
+        r = step(poison=True)
+        assert r.anomalous and r.action == "skip_step"
+        assert r.reasons == ["non_finite"]
+        np.testing.assert_array_equal(net.weight.numpy(), w)
+        assert monitor.stat_get("sentinel.nan_steps") == 1
+        assert monitor.stat_get("sentinel.skipped_steps") == 1
+        # a healthy step resets the consecutive count
+        r = step()
+        assert not r.anomalous and s._consecutive == 0
+
+    def test_full_ladder_escalation(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(
+            tmp_path, quarantine_dir=str(tmp_path / "q"))
+        step()
+        rb.snapshot(1)
+        w_good = net.weight.numpy().copy()
+        assert step(poison=True).action == "skip_step"
+        r = step(poison=True)
+        assert r.action == "quarantine_batch"
+        assert os.path.isdir(str(tmp_path / "q" / "step_2"))
+        r = step(poison=True)
+        assert r.action == "rollback" and r.rolled_back_to == 1
+        np.testing.assert_array_equal(net.weight.numpy(), w_good)
+        assert monitor.stat_get("sentinel.rollbacks") == 1
+
+    def test_halt_exits_with_divergence_code(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(
+            tmp_path, ladder=("halt",))
+        step()
+        with pytest.raises(SystemExit) as ei:
+            step(poison=True)
+        assert ei.value.code == sentinel.DIVERGENCE_EXIT_CODE == 119
+        assert monitor.stat_get("sentinel.halts") == 1
+
+    def test_rollback_without_adapter_degrades_to_skip(self, tmp_path):
+        paddle.seed(3)
+        net = nn.Linear(4, 2)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        s = Sentinel(SentinelConfig(ladder=("rollback",),
+                                    warmup_steps=1000), optimizer=opt)
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        loss = paddle.mean(net(x) ** 2)
+        loss.backward()
+        sentinel.poison_grads(opt)
+        w = net.weight.numpy().copy()
+        with pytest.warns(UserWarning, match="no rollback adapter"):
+            opt.step()
+        np.testing.assert_array_equal(net.weight.numpy(), w)
+
+    def test_check_every_amortizes_probes(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(tmp_path, check_every=3)
+        checks0 = monitor.stat_get("sentinel.checks")
+        for _ in range(6):
+            step()
+        assert monitor.stat_get("sentinel.checks") == checks0 + 2
+
+    def test_lr_rescale_on_rollback(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(
+            tmp_path, ladder=("rollback",), lr_rescale=0.5)
+        step()
+        rb.snapshot(1)
+        step(poison=True)
+        assert opt.get_lr() == pytest.approx(0.05)
+
+    def test_feed_loss_spike_detection(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(tmp_path, warmup_steps=3,
+                                              z_threshold=4.0)
+        for v in [1.0, 1.1, 0.9, 1.0, 1.05]:
+            assert s.feed_loss(v) is None
+        report = s.feed_loss(100.0)
+        assert report is not None and report.action == "skip_step"
+        assert "loss_spike" in report.reasons[0]
+        assert monitor.stat_get("sentinel.spike_steps") == 1
+
+    def test_feed_loss_no_double_count_after_approve_step(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(tmp_path)
+        step(poison=True)
+        assert s._consecutive == 1
+        # hapi flow: the callback feeds the same step's (NaN) loss after
+        # the in-step probe already escalated it — must not count twice
+        assert s.feed_loss(float("nan")) is None
+        assert s._consecutive == 1
+
+    def test_fault_injected_nan_at_exact_step(self, tmp_path, monkeypatch):
+        from paddle_tpu.utils import resilience
+        monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", "grads:2:nan")
+        resilience._reset_fault_injector_for_tests()
+        try:
+            net, opt, rb, s, step = _sentinel_job(tmp_path)
+            assert not step().anomalous           # fire 1: no rule
+            r = step()                            # fire 2: poisons grads
+            assert r.anomalous and r.reasons == ["non_finite"]
+            assert not step().anomalous           # fire 3: clean again
+        finally:
+            monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC")
+            resilience._reset_fault_injector_for_tests()
+
+    def test_detach_restores_unguarded_step(self, tmp_path):
+        net, opt, rb, s, step = _sentinel_job(tmp_path)
+        checks0 = monitor.stat_get("sentinel.checks")
+        step()
+        s.detach()
+        step()
+        assert monitor.stat_get("sentinel.checks") == checks0 + 1
+
+
+# -- monitor helper -----------------------------------------------------------
+
+def test_stats_with_prefix():
+    monitor.stat_add("sentinel.x", 2)
+    monitor.stat_add("sentinel.y", 1)
+    monitor.stat_add("other.z", 9)
+    view = monitor.stats_with_prefix("sentinel.")
+    assert view["sentinel.x"] == 2 and view["sentinel.y"] == 1
+    assert "other.z" not in view
+    monitor.default_registry().reset("other.z")
+
+
+# -- AnomalyGuardCallback through Model.fit -----------------------------------
+
+class TestAnomalyGuardCallback:
+    def _fit(self, tmp_path, spec=None, monkeypatch=None, epochs=2):
+        from paddle_tpu.utils import resilience
+        from paddle_tpu.hapi.callbacks import AnomalyGuardCallback
+        from paddle_tpu.static import InputSpec
+        if spec is not None:
+            monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", spec)
+        resilience._reset_fault_injector_for_tests()
+        try:
+            paddle.seed(5)
+            net = nn.Linear(4, 2)
+            model = paddle.Model(net, inputs=[InputSpec([None, 4], "float32")],
+                                 labels=[InputSpec([None, 2], "float32")])
+            opt = optim.SGD(learning_rate=0.05,
+                            parameters=net.parameters())
+            model.prepare(opt, nn.loss.MSELoss())
+            cb = AnomalyGuardCallback(save_dir=str(tmp_path / "guard"))
+            xs = np.random.RandomState(0).randn(16, 4).astype("float32")
+            ys = np.zeros((16, 2), np.float32)
+            model.fit(list(zip(xs, ys)), batch_size=4, epochs=epochs,
+                      verbose=0, callbacks=[cb])
+            return net, model, cb
+        finally:
+            if spec is not None:
+                monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC")
+            resilience._reset_fault_injector_for_tests()
+
+    def test_clean_fit_snapshots_healthy(self, tmp_path):
+        net, model, cb = self._fit(tmp_path)
+        snaps = cb.rollback.steps()
+        assert snaps, "epoch-end snapshots expected"
+        for s in snaps:
+            d = os.path.join(cb.rollback.path, f"snap_{s}")
+            assert read_health_stamp(d)["healthy"] is True
+
+    def test_injected_nan_step_is_skipped_and_training_finishes(
+            self, tmp_path, monkeypatch):
+        net, model, cb = self._fit(tmp_path, spec="grads:3:nan",
+                                   monkeypatch=monkeypatch)
+        assert np.all(np.isfinite(net.weight.numpy()))
+        assert cb.sentinel.anomalies >= 1
+        assert monitor.stat_get("sentinel.nan_steps") >= 1
+
+    def test_anomalous_epoch_snapshot_stamped_unhealthy(self, tmp_path,
+                                                        monkeypatch):
+        net, model, cb = self._fit(tmp_path, spec="grads:2:nan",
+                                   monkeypatch=monkeypatch, epochs=1)
+        snaps = cb.rollback.steps()
+        assert snaps
+        d = os.path.join(cb.rollback.path, f"snap_{snaps[-1]}")
+        assert read_health_stamp(d)["healthy"] is False

@@ -26,6 +26,11 @@ HOT_PREFIXES = (
     "paddle_tpu/optimizer/",
     "paddle_tpu/amp/",
     "paddle_tpu/hapi/model.py",
+    # the sentinel's hot half: probe + policy run inside every guarded
+    # optimizer step (its quarantine/rollback modules are cold anomaly
+    # paths where host copies are deliberate)
+    "paddle_tpu/sentinel/guard.py",
+    "paddle_tpu/sentinel/policy.py",
 )
 
 SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}

@@ -25,6 +25,7 @@ from ..core import Finding, Project, SourceFile
 CHECKED_DIRS = (
     "paddle_tpu/distributed",
     "paddle_tpu/incubate/checkpoint",
+    "paddle_tpu/sentinel",
     "paddle_tpu/serving",
     "paddle_tpu/utils",
 )
