@@ -149,6 +149,13 @@ class StatRegistry:
         with self._lock:
             return {k: h.summary() for k, h in self._hists.items()}
 
+    def histograms_with_prefix(self, prefix: str) -> Dict[str, Dict[str, float]]:
+        """Histogram summaries under a dotted namespace (``serving.llm.``…)
+        — the /statsz shape for one subsystem's distributions."""
+        with self._lock:
+            return {k: h.summary() for k, h in self._hists.items()
+                    if k.startswith(prefix)}
+
     def print_stats(self):
         for k, v in sorted(self.stats().items()):
             print(f"STAT {k} = {v}")
@@ -191,6 +198,11 @@ def stat_quantile(name: str, q: float, default: float = 0.0) -> float:
 def stats_with_prefix(prefix: str) -> Dict[str, Number]:
     """Default-registry view of one subsystem's counters (``sentinel.``…)."""
     return _REGISTRY.stats_with_prefix(prefix)
+
+
+def histograms_with_prefix(prefix: str) -> Dict[str, Dict[str, float]]:
+    """Default-registry view of one subsystem's histogram summaries."""
+    return _REGISTRY.histograms_with_prefix(prefix)
 
 
 def device_memory_stats(device=None) -> Dict[str, Number]:

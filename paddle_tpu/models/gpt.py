@@ -253,6 +253,96 @@ def gpt_pipeline_fns(model: "GPTForCausalLM", num_stages: int):
     }
 
 
+#: how many tokens each decode runs between host-side "all rows hit eos?"
+#: probes — the probe is a device->host sync, so amortizing it keeps decode
+#: device-bound; frozen rows keep emitting eos, so the only cost of a late
+#: stop is trimmed-off work, never wrong tokens.
+_EOS_CHECK_EVERY = 8
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _trim_generated(gen: "np.ndarray", eos_token_id) -> int:
+    """Columns of the generated block to keep: the step at which every row
+    had emitted eos, plus one — exactly where the per-token-checking loop
+    used to break. Rows that never emit eos keep the full budget."""
+    import numpy as np
+    if eos_token_id is None or gen.shape[1] == 0:
+        return gen.shape[1]
+    hits = gen == eos_token_id
+    if not hits.any(axis=1).all():
+        return gen.shape[1]
+    return int(hits.argmax(axis=1).max()) + 1
+
+
+def _gpt_generate_static(model, ids, max_length, decode_strategy, top_k,
+                         temperature, eos_token_id):
+    """Static-slot decode: prefill once, then ONE compiled decode step per
+    token over fixed [B, max_seq] shapes (paddle_tpu.serving.llm.decode) —
+    no per-token retrace, no per-token host sync. Token-for-token
+    equivalent to the concat-cache path (same math, same sampling recipe,
+    same per-step generator keys)."""
+    import numpy as np
+    from ..core import generator as _gen
+    from ..core.tensor import Tensor
+    import jax
+    import jax.numpy as jnp
+    from ..serving.llm.decode import (GPTStaticDecoder, SamplingParams,
+                                      pack_sampling)
+
+    b, lin = int(ids.shape[0]), int(ids.shape[1])
+    max_pos = model.gpt.config.max_position_embeddings
+    # pow2-rounded shapes so repeat calls with nearby lengths reuse the
+    # compiled step (the shape pair keys the executable)
+    max_seq = min(_next_pow2(lin + int(max_length)), max_pos)
+    lp = min(_next_pow2(lin), max_seq)
+    do_sample = decode_strategy == "sampling" and top_k != 1
+    dec = GPTStaticDecoder(
+        model, max_top_k=int(top_k) if do_sample and top_k else 0)
+    kv = dec.new_kv(b, max_seq)
+    params = dec.params()
+    samp = SamplingParams(
+        do_sample=do_sample, temperature=float(temperature),
+        top_k=int(top_k) if do_sample else 0, eos_token_id=eos_token_id,
+        max_new_tokens=int(max_length))
+    svecs = pack_sampling([samp] * b)
+    fixed_key = jax.random.PRNGKey(0)   # greedy consumes no generator keys
+
+    padded = np.zeros((b, lp), np.int32)
+    padded[:, :lin] = np.asarray(jax.device_get(ids))  # noqa: PTA002 -- one prompt download to build the padded prefill batch (admission-time, not per-token)
+    finished = jnp.zeros((b,), jnp.bool_)
+    key = _gen.next_key() if do_sample else fixed_key
+    nxt, finished = dec.prefill(
+        kv, params, jnp.asarray(padded),
+        jnp.full((b,), lin, jnp.int32), jnp.arange(b, dtype=jnp.int32),
+        finished, svecs, key)
+    gen = jnp.zeros((b, int(max_length)), jnp.int32).at[:, 0].set(nxt)
+    last = nxt
+    steps = 1
+    for t in range(1, int(max_length)):
+        key = _gen.next_key() if do_sample else fixed_key
+        nxt, finished = dec.decode_step(kv, params, finished, last, svecs,
+                                        key)
+        last = nxt
+        gen = gen.at[:, t].set(nxt)
+        steps = t + 1
+        if eos_token_id is not None and t % _EOS_CHECK_EVERY == 0:
+            # the amortized finish probe: one [B]-bool reduce every
+            # _EOS_CHECK_EVERY tokens instead of a sync per token
+            if bool(np.asarray(jax.device_get(jnp.all(finished)))):  # noqa: PTA002 -- deliberate amortized early-exit probe; frozen rows emit eos so late detection only trims work
+                break
+    gen_h = np.asarray(jax.device_get(gen[:, :steps]))  # noqa: PTA002 -- single end-of-generate download of the token matrix (the return value)
+    keep = _trim_generated(gen_h, eos_token_id)
+    out = np.concatenate(
+        [np.asarray(jax.device_get(ids)), gen_h[:, :keep]], axis=1)  # noqa: PTA002 -- stitching the host return value
+    return Tensor(jnp.asarray(out, jnp.int32))
+
+
 def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
                   top_k=1, temperature=1.0, eos_token_id=None,
                   use_cache=True):
@@ -260,11 +350,15 @@ def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
     PaddleNLP GenerationMixin.generate — greedy / top-k sampling; the
     beam form lives in nn.BeamSearchDecoder/dynamic_decode).
 
-    ``use_cache=True`` (default) runs incremental decoding over the
-    per-layer KV caches (each step attends new token vs cached prefix —
-    O(T) work per token); ``use_cache=False`` recomputes the full prefix
-    each step (O(T^2), kept as the reference for testing). Returns ids
-    [B, input_len + max_length]."""
+    ``use_cache=True`` (default) decodes through the static-slot KV cache:
+    prefill writes the prompt K/V into preallocated ``[B, max_seq]``
+    buffers and every token then reuses ONE compiled decode step — no
+    shape growth, no per-token retrace. ``use_cache="concat"`` keeps the
+    legacy concat-grown MHA cache (incremental but retraces per length);
+    ``use_cache=False`` recomputes the full prefix each step (O(T^2), the
+    testing reference). All three are token-identical. Returns ids
+    [B, input_len + n_generated] (n_generated < max_length only when
+    every row emitted ``eos_token_id``)."""
     import numpy as np
     from ..core import generator as _gen
     from ..core.tensor import Tensor
@@ -278,12 +372,26 @@ def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
             f"nn.BeamSearchDecoder + dynamic_decode")
     ids = input_ids._data if isinstance(input_ids, Tensor) else \
         jnp.asarray(np.asarray(input_ids), jnp.int32)
+    c = model.gpt.config
+    if use_cache is True:
+        # static-slot fast path needs the deterministic eval math (the
+        # compiled step has no dropout) and room in the position table;
+        # otherwise fall through to the concat cache below
+        dropout_off = (not getattr(model, "training", False)) or (
+            c.hidden_dropout_prob == 0.0 and c.attention_dropout_prob == 0.0)
+        if dropout_off and ids.shape[1] + int(max_length) <= \
+                c.max_position_embeddings and int(max_length) >= 1:
+            return _gpt_generate_static(
+                model, ids, max_length, decode_strategy, top_k,
+                temperature, eos_token_id)
+        use_cache = "concat"
     finished = jnp.zeros((ids.shape[0],), jnp.bool_)
     cache = None
     if use_cache:
         cache = model.gpt.gen_cache(Tensor(ids))
     step_input = ids
-    for _ in range(int(max_length)):
+    n_steps = int(max_length)
+    for step in range(n_steps):
         if use_cache:
             logits, cache = model(Tensor(step_input), cache=cache)
         else:
@@ -306,8 +414,17 @@ def _gpt_generate(model, input_ids, max_length=32, decode_strategy="greedy",
             finished = finished | (nxt == eos_token_id)
         ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
         step_input = nxt[:, None]          # cache path: one new token
-        if eos_token_id is not None and bool(jnp.all(finished)):
-            break
+        if eos_token_id is not None and step % _EOS_CHECK_EVERY == \
+                _EOS_CHECK_EVERY - 1:
+            # amortized early-exit probe (was a per-token host sync);
+            # overshoot columns are frozen eos and trimmed below
+            if bool(jnp.all(finished)):  # noqa: PTA002 -- deliberate amortized device->host probe, every _EOS_CHECK_EVERY tokens
+                break
+    if eos_token_id is not None and n_steps > 0:
+        lin = int(ids.shape[1]) - (step + 1)   # step = last loop index run
+        full = np.asarray(jax.device_get(ids))  # noqa: PTA002 -- end-of-generate download to trim frozen-eos overshoot (the return value is host-bound anyway)
+        keep = _trim_generated(full[:, lin:], eos_token_id)
+        return Tensor(jnp.asarray(full[:, :lin + keep], jnp.int32))
     return Tensor(ids)
 
 

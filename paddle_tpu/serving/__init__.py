@@ -16,6 +16,11 @@ Quick start::
     engine.drain()                                  # graceful shutdown
 
 Or over HTTP: ``python -m paddle_tpu.serving serve --model /path/to/model``.
+
+LLM generation serving (static-slot KV cache + continuous batching) lives
+in the lazily imported :mod:`paddle_tpu.serving.llm` submodule — see its
+docstring and docs/serving.md "LLM serving"; the CLI entry point is
+``python -m paddle_tpu.serving serve-llm``.
 """
 from __future__ import annotations
 
@@ -33,5 +38,17 @@ __all__ = [
     "ExecutableCache", "default_cache", "signature_of", "BatchQueue",
     "DynamicBatcher", "Batch", "InferenceRequest", "Deadline",
     "DeadlineExceeded", "EngineDraining", "QueueFull", "RequestTooLarge",
-    "ServingError",
+    "ServingError", "llm",
 ]
+
+
+def __getattr__(name):
+    # `serving.llm` pulls in jax at import time (compiled decode programs);
+    # keep classifier serving importable without that cost by loading the
+    # LLM submodule on first access.
+    if name == "llm":
+        import importlib
+        mod = importlib.import_module(".llm", __name__)
+        globals()["llm"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -37,7 +37,37 @@ def main(argv=None) -> int:
                     help="default per-request deadline")
     sv.add_argument("--oversize", choices=("split", "reject"),
                     default="split")
+
+    lv = sub.add_parser(
+        "serve-llm",
+        help="serve GPT generation (continuous batching) over HTTP")
+    lv.add_argument("--state-dict", default=None,
+                    help="framework_io.save'd GPTForCausalLM state dict "
+                         "(omit for a randomly initialized model — smoke "
+                         "tests only)")
+    lv.add_argument("--vocab-size", type=int, default=50304)
+    lv.add_argument("--hidden-size", type=int, default=768)
+    lv.add_argument("--num-layers", type=int, default=12)
+    lv.add_argument("--num-heads", type=int, default=12)
+    lv.add_argument("--max-positions", type=int, default=1024)
+    lv.add_argument("--host", default="127.0.0.1")
+    lv.add_argument("--port", type=int, default=8500)
+    lv.add_argument("--num-slots", type=int, default=8)
+    lv.add_argument("--max-seq", type=int, default=512)
+    lv.add_argument("--prefill-buckets", default="",
+                    help="comma-separated prompt buckets (default: powers "
+                         "of two up to --max-seq)")
+    lv.add_argument("--max-queue", type=int, default=256)
+    lv.add_argument("--deadline-s", type=float, default=None,
+                    help="default per-request deadline")
+    lv.add_argument("--max-new-tokens", type=int, default=64,
+                    help="default generation budget per request")
+    lv.add_argument("--no-warmup", action="store_true",
+                    help="skip the ahead-of-time decode/prefill compiles")
     args = ap.parse_args(argv)
+
+    if args.cmd == "serve-llm":
+        return _serve_llm(args)
 
     from . import Engine, EngineConfig
     from .http import serve_forever
@@ -61,6 +91,46 @@ def main(argv=None) -> int:
               f"delay={cfg.max_batch_delay * 1000:.1f}ms)", flush=True)
 
     serve_forever(engine, args.host, args.port, quiet=False, ready_cb=_ready)
+    engine.drain()
+    print("paddle_tpu.serving: drained, bye", flush=True)
+    return 0
+
+
+def _serve_llm(args) -> int:
+    from ..models.gpt import GPTConfig, GPTForCausalLM
+    from .http import serve_forever
+    from .llm import LLMEngine, LLMEngineConfig
+
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=args.vocab_size, hidden_size=args.hidden_size,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        max_position_embeddings=args.max_positions,
+        hidden_dropout_prob=0.0, attention_dropout_prob=0.0))
+    model.eval()
+    if args.state_dict:
+        from .. import framework_io
+        model.set_state_dict(framework_io.load(args.state_dict))
+    else:
+        print("paddle_tpu.serving: WARNING serving a randomly initialized "
+              "model (--state-dict not given)", flush=True)
+
+    cfg = LLMEngineConfig(
+        num_slots=args.num_slots, max_seq=args.max_seq,
+        prefill_buckets=_parse_int_list(args.prefill_buckets) or None,
+        max_queue=args.max_queue, default_deadline=args.deadline_s,
+        default_max_new_tokens=args.max_new_tokens,
+        warmup=not args.no_warmup)
+    engine = LLMEngine(model, cfg)
+    engine.install_drain_signal_handler()
+
+    def _ready(httpd):
+        host, port = httpd.server_address[:2]
+        print(f"paddle_tpu.serving: LLM listening on http://{host}:{port} "
+              f"(slots={cfg.num_slots}, max_seq={cfg.max_seq}, "
+              f"prefill_buckets={list(cfg.prefill_buckets)})", flush=True)
+
+    serve_forever(None, args.host, args.port, quiet=False, ready_cb=_ready,
+                  llm_engine=engine)
     engine.drain()
     print("paddle_tpu.serving: drained, bye", flush=True)
     return 0

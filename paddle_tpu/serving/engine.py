@@ -64,7 +64,78 @@ class EngineConfig:
         self.stat_prefix = stat_prefix
 
 
-class Engine:
+class DrainableEngineBase:
+    """Drain/preemption/signal plumbing shared by the classifier
+    :class:`Engine` and the LLM :class:`~paddle_tpu.serving.llm.LLMEngine`.
+
+    Subclasses call :meth:`_init_serving_base` in ``__init__``, own a
+    ``BatchQueue`` in ``self._queue``, and run a single worker thread that
+    polls :attr:`draining` — ``_on_drain_signal`` is flag-only
+    (async-signal-safe: closing the queue takes its lock, which the
+    interrupted thread may hold), and the worker performs the actual
+    ``queue.close()`` at its next poll point.
+    """
+
+    def _init_serving_base(self, registry: Optional[_mon.StatRegistry],
+                           stat_prefix: str):
+        self._registry = registry or _mon.default_registry()
+        self._prefix = stat_prefix
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._guard: Optional[PreemptionGuard] = None
+        self._signal_chain: Optional[ChainedSignalHandler] = None
+
+    @property
+    def registry(self) -> _mon.StatRegistry:
+        return self._registry
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def arm_preemption(self, guard: Optional[PreemptionGuard] = None):
+        """Begin a graceful drain when ``guard`` observes preemption. With
+        no argument a fresh guard is installed (chained signal handlers)."""
+        self._guard = guard if guard is not None else PreemptionGuard()
+        return self._guard
+
+    def install_drain_signal_handler(self, signals=None):
+        """Arm SIGTERM/SIGINT (or ``signals``) to trigger drain, chaining —
+        not replacing — any handler already installed (e.g. a
+        PreemptionGuard's)."""
+        if self._signal_chain is not None and self._signal_chain.installed:
+            return self._signal_chain
+        kwargs = {} if signals is None else {"signals": tuple(signals)}
+        self._signal_chain = ChainedSignalHandler(
+            self._on_drain_signal, **kwargs)
+        self._signal_chain.install()
+        return self._signal_chain
+
+    def _on_drain_signal(self, signum, frame):
+        """Async-signal-safe drain trigger: only sets the flag. Closing the
+        queue takes its lock — if the signal lands while the interrupted
+        thread holds that lock, a close() here would self-deadlock — so the
+        worker loop performs the close at its next poll."""
+        self._draining.set()
+
+    def begin_drain(self):
+        """Stop admission and let the worker flush the queue (non-blocking).
+        Thread-safe, but NOT for signal context: closing the queue acquires
+        its lock — signal handlers must go through ``_on_drain_signal``."""
+        self._draining.set()
+        self._queue.close()
+
+    def _stat_add(self, name: str, v):
+        self._registry.add(f"{self._prefix}.{name}", v)
+
+    def _stat_set(self, name: str, v):
+        self._registry.set(f"{self._prefix}.{name}", v)
+
+    def _stat_observe(self, name: str, v):
+        self._registry.observe(f"{self._prefix}.{name}", v)
+
+
+class Engine(DrainableEngineBase):
     """submit()/submit_many()/drain() over a batched, cached model.
 
     ``model`` may be:
@@ -78,8 +149,7 @@ class Engine:
                  registry: Optional[_mon.StatRegistry] = None,
                  cache: Optional[ExecutableCache] = None):
         self._config = config or EngineConfig()
-        self._registry = registry or _mon.default_registry()
-        self._prefix = self._config.stat_prefix
+        self._init_serving_base(registry, self._config.stat_prefix)
         self._model_fn, self._cache, self._model_key, self._wrap_in_cache = \
             self._resolve_model(model, cache)
         self._queue = BatchQueue(max_size=self._config.max_queue)
@@ -88,10 +158,6 @@ class Engine:
             max_batch_delay=self._config.max_batch_delay)
         self._inflight: set = set()
         self._inflight_lock = threading.Lock()
-        self._draining = threading.Event()
-        self._stopped = threading.Event()
-        self._guard: Optional[PreemptionGuard] = None
-        self._signal_chain: Optional[ChainedSignalHandler] = None
         self._worker = threading.Thread(
             target=self._worker_loop, name="paddle-tpu-serving-worker",
             daemon=True)
@@ -108,8 +174,12 @@ class Engine:
             # default ExecutableCache; reuse that cache for stats so the
             # engine's recompile counter reflects reality.
             pred_cache = getattr(model, "_exec_cache", None)
+            # pick the first cache that EXISTS (`is not None`), not the
+            # first truthy one — an empty ExecutableCache has len() == 0
+            # and is falsy, so `or`-chaining would silently drop it
+            use = cache if cache is not None else pred_cache
             return (lambda arrays: run(arrays)), \
-                (cache or pred_cache or default_cache()), \
+                (use if use is not None else default_cache()), \
                 ("predictor", id(model)), False
         if callable(model):
             fn = model
@@ -120,7 +190,8 @@ class Engine:
             # plain callables get an engine-local cache; a miss marks the
             # first time a padded signature is seen (== a jit compile when
             # fn is jitted)
-            return _call, (cache or ExecutableCache()), \
+            return _call, \
+                (cache if cache is not None else ExecutableCache()), \
                 ("callable", id(fn)), True
         raise TypeError(
             f"model must be a Predictor, artifact path prefix, or callable; "
@@ -132,16 +203,8 @@ class Engine:
         return self._config
 
     @property
-    def registry(self) -> _mon.StatRegistry:
-        return self._registry
-
-    @property
     def cache(self) -> ExecutableCache:
         return self._cache
-
-    @property
-    def draining(self) -> bool:
-        return self._draining.is_set()
 
     def submit(self, inputs: Sequence[np.ndarray],
                deadline: Optional[Union[Deadline, float]] = None):
@@ -180,38 +243,6 @@ class Engine:
         return [self.submit(inputs, deadline=deadline)
                 for inputs in requests]
 
-    def arm_preemption(self, guard: Optional[PreemptionGuard] = None):
-        """Begin a graceful drain when ``guard`` observes preemption. With
-        no argument a fresh guard is installed (chained signal handlers)."""
-        self._guard = guard if guard is not None else PreemptionGuard()
-        return self._guard
-
-    def install_drain_signal_handler(self, signals=None):
-        """Arm SIGTERM/SIGINT (or ``signals``) to trigger drain, chaining —
-        not replacing — any handler already installed (e.g. a
-        PreemptionGuard's)."""
-        if self._signal_chain is not None and self._signal_chain.installed:
-            return self._signal_chain
-        kwargs = {} if signals is None else {"signals": tuple(signals)}
-        self._signal_chain = ChainedSignalHandler(
-            self._on_drain_signal, **kwargs)
-        self._signal_chain.install()
-        return self._signal_chain
-
-    def _on_drain_signal(self, signum, frame):
-        """Async-signal-safe drain trigger: only sets the flag. Closing the
-        queue takes its lock — if the signal lands while the interrupted
-        thread holds that lock, a close() here would self-deadlock — so the
-        worker loop performs the close at its next poll."""
-        self._draining.set()
-
-    def begin_drain(self):
-        """Stop admission and let the worker flush the queue (non-blocking).
-        Thread-safe, but NOT for signal context: closing the queue acquires
-        its lock — signal handlers must go through ``_on_drain_signal``."""
-        self._draining.set()
-        self._queue.close()
-
     def drain(self, timeout: Optional[float] = None) -> List:
         """Graceful drain: stop admission, flush every queued request, wait
         for the worker, and return the futures of all requests that were
@@ -238,10 +269,8 @@ class Engine:
         """Scalar stats + histogram summaries + cache counters (the
         ``/statsz`` payload)."""
         pre = self._prefix + "."
-        scalars = {k: v for k, v in self._registry.stats().items()
-                   if k.startswith(pre)}
-        hists = {k: v for k, v in self._registry.histograms().items()
-                 if k.startswith(pre)}
+        scalars = self._registry.stats_with_prefix(pre)
+        hists = self._registry.histograms_with_prefix(pre)
         return {"stats": scalars, "histograms": hists,
                 "executable_cache": self._cache.stats(),
                 "draining": self.draining,
@@ -251,15 +280,6 @@ class Engine:
     def _forget_future(self, fut):
         with self._inflight_lock:
             self._inflight.discard(fut)
-
-    def _stat_add(self, name: str, v):
-        self._registry.add(f"{self._prefix}.{name}", v)
-
-    def _stat_set(self, name: str, v):
-        self._registry.set(f"{self._prefix}.{name}", v)
-
-    def _stat_observe(self, name: str, v):
-        self._registry.observe(f"{self._prefix}.{name}", v)
 
     def _worker_loop(self):
         poll = max(0.01, self._config.max_batch_delay)

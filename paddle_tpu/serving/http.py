@@ -1,18 +1,30 @@
-"""Stdlib-only HTTP front-end over an :class:`Engine`.
+"""Stdlib-only HTTP front-end over an :class:`Engine` and/or
+:class:`~paddle_tpu.serving.llm.LLMEngine`.
 
 Endpoints:
   * ``POST /predict`` — body ``{"inputs": [nested-list, ...],
     "dtypes": ["float32", ...] (optional), "deadline_s": float (optional)}``;
     responds ``{"outputs": [...], "shapes": [...], "req_ms": float}``.
-  * ``GET /healthz`` — ``{"status": "ok"|"draining"}`` (503 while
-    draining, so load balancers stop routing here during preemption).
+  * ``POST /generate`` — body ``{"prompt": [token ids],
+    "max_new_tokens": int, "do_sample": bool, "temperature": float,
+    "top_k": int, "eos_token_id": int, "deadline_s": float,
+    "stream": bool}``. Non-streaming responds ``{"tokens": [...],
+    "finish_reason": "stop"|"length", "req_ms": float}``; with
+    ``"stream": true`` the body is newline-delimited JSON — one
+    ``{"token": t}`` line per generated token as the decode tick produces
+    it, then a final ``{"done": true, "finish_reason": ...}`` line (the
+    response is close-delimited, so readers consume until EOF).
+  * ``GET /healthz`` — ``{"status": "ok"|"draining"}`` (503 while either
+    engine drains, so load balancers stop routing here during preemption).
   * ``GET /statsz`` — the engine's full stats payload: scalar counters,
-    latency/fill histograms (p50/p95/p99), executable-cache hit/miss/evict.
+    latency/fill histograms (p50/p95/p99), executable-cache hit/miss/evict;
+    with an LLM engine attached, its payload (slot occupancy, TTFT/TPOT,
+    tokens/s) rides along under ``"llm"``.
 
 Threading model: ``ThreadingHTTPServer`` handles each connection on its
-own thread; handlers block on the request future, while the engine's
-single worker thread does the batching — concurrent POSTs are exactly what
-gives the batcher something to coalesce.
+own thread; handlers block on the request future (or the token stream),
+while each engine's single worker thread does the batching — concurrent
+POSTs are exactly what gives the batchers something to coalesce.
 """
 from __future__ import annotations
 
@@ -29,14 +41,17 @@ from .request import DeadlineExceeded, ServingError
 class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, addr, engine, quiet: bool = True):
+    def __init__(self, addr, engine, quiet: bool = True, llm_engine=None):
+        if engine is None and llm_engine is None:
+            raise ValueError("need an engine and/or an llm_engine")
         self.engine = engine
+        self.llm_engine = llm_engine
         self.quiet = quiet
         super().__init__(addr, _Handler)
 
 
 class _Handler(BaseHTTPRequestHandler):
-    # one engine per server process; found via self.server
+    # engines per server process; found via self.server
 
     def log_message(self, fmt, *args):
         if not self.server.quiet:
@@ -52,25 +67,42 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         engine = self.server.engine
+        llm = self.server.llm_engine
         if self.path == "/healthz":
-            if engine.draining:
+            draining = any(e.draining for e in (engine, llm)
+                           if e is not None)
+            if draining:
                 self._send_json(503, {"status": "draining"})
             else:
                 self._send_json(200, {"status": "ok"})
         elif self.path == "/statsz":
-            self._send_json(200, engine.stats())
+            payload = engine.stats() if engine is not None else {}
+            if llm is not None:
+                payload["llm"] = llm.stats()
+            self._send_json(200, payload)
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
+    def _read_payload(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
     def do_POST(self):
-        if self.path != "/predict":
+        if self.path == "/predict":
+            self._do_predict()
+        elif self.path == "/generate":
+            self._do_generate()
+        else:
             self._send_json(404, {"error": f"no route {self.path}"})
-            return
+
+    def _do_predict(self):
         engine = self.server.engine
+        if engine is None:
+            self._send_json(503, {"error": "no classifier engine mounted"})
+            return
         t0 = time.monotonic()
         try:
-            n = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(n) or b"{}")
+            payload = self._read_payload()
             raw_inputs = payload["inputs"]
             dtypes = payload.get("dtypes") or ["float32"] * len(raw_inputs)
             arrays = [np.asarray(a, dtype=np.dtype(d))
@@ -92,25 +124,90 @@ class _Handler(BaseHTTPRequestHandler):
             "req_ms": (time.monotonic() - t0) * 1000.0,
         })
 
+    def _do_generate(self):
+        llm = self.server.llm_engine
+        if llm is None:
+            self._send_json(503, {"error": "no LLM engine mounted"})
+            return
+        t0 = time.monotonic()
+        try:
+            payload = self._read_payload()
+            stream = bool(payload.get("stream", False))
+            req = llm.submit(
+                payload["prompt"],
+                max_new_tokens=payload.get("max_new_tokens"),
+                do_sample=bool(payload.get("do_sample", False)),
+                temperature=float(payload.get("temperature", 1.0)),
+                top_k=int(payload.get("top_k", 0)),
+                eos_token_id=payload.get("eos_token_id"),
+                deadline=payload.get("deadline_s"),
+                stream=stream)
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        except ServingError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        timeout = payload.get("timeout_s", 120.0)
+        if not stream:
+            try:
+                out = req.result(timeout=timeout)
+            except DeadlineExceeded as e:
+                self._send_json(504, {"error": str(e)})
+                return
+            except ServingError as e:
+                self._send_json(503, {"error": str(e)})
+                return
+            self._send_json(200, {
+                "tokens": out["tokens"],
+                "finish_reason": out["finish_reason"],
+                "req_ms": (time.monotonic() - t0) * 1000.0,
+            })
+            return
+        # streaming: NDJSON, close-delimited (no Content-Length)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        self.end_headers()
+
+        def _line(obj):
+            self.wfile.write((json.dumps(obj) + "\n").encode("utf-8"))
+            self.wfile.flush()
+
+        try:
+            for tok in req.iter_tokens(timeout=timeout):
+                _line({"token": int(tok)})
+            _line({"done": True, "finish_reason": req.finish_reason,
+                   "req_ms": (time.monotonic() - t0) * 1000.0})
+        except BaseException as e:  # mid-stream failure -> error line
+            try:
+                _line({"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass  # client went away; nothing left to tell it
+
 
 def make_server(engine, host: str = "127.0.0.1", port: int = 8500,
-                quiet: bool = True) -> ServingHTTPServer:
+                quiet: bool = True, llm_engine=None) -> ServingHTTPServer:
     """Bind (port 0 picks a free one; see ``server.server_address``)."""
-    return ServingHTTPServer((host, port), engine, quiet=quiet)
+    return ServingHTTPServer((host, port), engine, quiet=quiet,
+                             llm_engine=llm_engine)
 
 
 def serve_forever(engine, host: str = "127.0.0.1", port: int = 8500,
                   quiet: bool = False,
-                  ready_cb: Optional[callable] = None):
-    """Blocking serve loop; shuts the listener down once a drain begins and
-    the queue has flushed."""
-    httpd = make_server(engine, host, port, quiet=quiet)
+                  ready_cb: Optional[callable] = None, llm_engine=None):
+    """Blocking serve loop; shuts the listener down once every mounted
+    engine's drain completes (queue flushed, in-flight sequences done)."""
+    httpd = make_server(engine, host, port, quiet=quiet,
+                        llm_engine=llm_engine)
     if ready_cb is not None:
         ready_cb(httpd)
     import threading
 
     def _watch_drain():
-        engine._stopped.wait()
+        for e in (engine, llm_engine):
+            if e is not None:
+                e._stopped.wait()
         httpd.shutdown()
 
     threading.Thread(target=_watch_drain, daemon=True).start()

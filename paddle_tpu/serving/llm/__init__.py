@@ -1,0 +1,55 @@
+"""paddle_tpu.serving.llm: static-slot KV-cache decode + continuous batching.
+
+The LLM half of the serving stack. Classifier serving (the parent package)
+batches *requests*; LLM serving batches *sequences in flight*: every decode
+tick advances all active sequences by one token through ONE compiled XLA
+program, and sequences join (prefill into a free slot) or leave (eos /
+length / deadline) the in-flight batch between ticks — continuous batching.
+
+Three layers:
+
+* :class:`StaticKVCache` (``kvcache.py``) — preallocated
+  ``[num_slots, num_layers, max_seq, heads, head_dim]`` K/V slot buffers
+  with per-slot lengths, updated functionally via
+  ``lax.dynamic_update_slice``; slot alloc/free/reset is host-side
+  bookkeeping so the device arrays never change shape.
+* :class:`GPTStaticDecoder` (``decode.py``) — pure-jax prefill +
+  ``decode_step`` over the extracted GPT parameter pytree: greedy and
+  temperature/top-k sampling, per-slot eos masking, all on device. Shapes
+  are fixed by (num_slots, max_seq), so after warmup one executable serves
+  every token of every request.
+* :class:`LLMEngine` / :class:`ContinuousBatcher` (``scheduler.py``) — the
+  serving loop: bounded admission through the shared :class:`BatchQueue`,
+  per-request :class:`Deadline`, bucketed prefill through the shape-keyed
+  :class:`ExecutableCache`, token streaming, and graceful drain chained
+  with preemption (SIGTERM finishes in-flight sequences).
+
+Quick start::
+
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    engine = LLMEngine(GPTForCausalLM(cfg),
+                       LLMEngineConfig(num_slots=8, max_seq=512))
+    req = engine.submit([1, 2, 3], max_new_tokens=32)
+    print(req.future.result()["tokens"])
+    engine.drain()
+
+Over HTTP: ``python -m paddle_tpu.serving serve-llm ...`` exposes
+``POST /generate`` (optionally streaming newline-delimited JSON tokens).
+See docs/serving.md "LLM serving".
+"""
+from __future__ import annotations
+
+from .kvcache import StaticKVCache  # noqa: F401
+from .decode import (  # noqa: F401
+    GPTDecodeSpec, GPTStaticDecoder, SamplingParams, extract_gpt_params,
+    pack_sampling)
+from .scheduler import (  # noqa: F401
+    ContinuousBatcher, GenerationRequest, LLMEngine, LLMEngineConfig)
+
+__all__ = [
+    "StaticKVCache", "GPTDecodeSpec", "GPTStaticDecoder", "SamplingParams",
+    "extract_gpt_params", "pack_sampling", "ContinuousBatcher",
+    "GenerationRequest", "LLMEngine", "LLMEngineConfig",
+]

@@ -1,0 +1,370 @@
+"""Pure-jax prefill + single-compile decode step for GPT models.
+
+The concat-cache ``generate`` retraces every token because the KV shapes
+grow; here the whole decode tick is one jitted function over fixed
+``[num_slots, ...]`` shapes — greedy/temperature/top-k sampling and eos
+masking included — so XLA fuses it once and reuses it for every token of
+every request ("Operator Fusion in XLA", arxiv 2301.13062). Parameters
+are passed as a pytree argument (not baked into the trace), so training
+and serving can share one executable across checkpoint reloads.
+
+The math mirrors the framework's dense eval path operation-for-operation
+(``nn.transformer.MultiHeadAttention`` dense branch, ``F.layer_norm``,
+``F.gelu(approximate=False)``, tied-embedding logits, and the sampling
+recipe of ``models.gpt._gpt_generate``), so static-slot decode emits the
+same tokens as the reference concat-cache path — the equivalence test in
+``tests/test_llm_serving.py`` asserts it token-for-token.
+
+Per-slot sampling state travels as device vectors (``temperature``,
+``top_k``, ``do_sample``, ``eos``; eos < 0 means "no eos"), so requests
+with different sampling settings share the single compiled step.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..cache import ExecutableCache
+from .kvcache import StaticKVCache, append_token_kv, valid_mask, \
+    write_prompt_kv
+
+
+@dataclass(frozen=True)
+class GPTDecodeSpec:
+    """The static facts the compiled decode program is specialized on.
+
+    Frozen + hashable: it keys the process-wide jit-function caches, so
+    two engines (or ``generate`` calls) over same-shaped models share one
+    traced program family.
+    """
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    max_position_embeddings: int
+    ln_epsilon: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_model(cls, model) -> "GPTDecodeSpec":
+        c = model.gpt.config
+        return cls(vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+                   num_layers=c.num_layers, num_heads=c.num_heads,
+                   max_position_embeddings=c.max_position_embeddings)
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decode settings (host side; the scheduler packs them
+    into the per-slot device vectors)."""
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    eos_token_id: Optional[int] = None
+    max_new_tokens: int = 32
+
+    def clamped_temperature(self) -> float:
+        # same guard the reference generate applies host-side
+        return max(float(self.temperature), 1e-6)
+
+
+def extract_gpt_params(model) -> Dict[str, Any]:
+    """The GPT parameter pytree as raw jnp arrays (references, not copies —
+    re-extract after an optimizer step to pick up new values)."""
+    gpt = model.gpt
+    layers = []
+    for lyr in gpt.decoder.layers:
+        a = lyr.self_attn
+        layers.append({
+            "qw": a.q_proj.weight._data, "qb": a.q_proj.bias._data,
+            "kw": a.k_proj.weight._data, "kb": a.k_proj.bias._data,
+            "vw": a.v_proj.weight._data, "vb": a.v_proj.bias._data,
+            "ow": a.out_proj.weight._data, "ob": a.out_proj.bias._data,
+            "w1": lyr.linear1.weight._data, "b1": lyr.linear1.bias._data,
+            "w2": lyr.linear2.weight._data, "b2": lyr.linear2.bias._data,
+            "n1w": lyr.norm1.weight._data, "n1b": lyr.norm1.bias._data,
+            "n2w": lyr.norm2.weight._data, "n2b": lyr.norm2.bias._data,
+        })
+    return {
+        "tok": gpt.word_embeddings.weight._data,
+        "pos": gpt.position_embeddings.weight._data,
+        "fnw": gpt.decoder.norm.weight._data,
+        "fnb": gpt.decoder.norm.bias._data,
+        "layers": tuple(layers),
+    }
+
+
+# -- building blocks (must mirror the framework eval ops exactly) -----------
+
+def _layer_norm(x, w, b, eps):
+    # mirrors F.layer_norm: mean/var over the last axis, rsqrt, scale+shift
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def _sample(lraw, temperature, top_k, do_sample, key, max_top_k):
+    """Greedy argmax / temperature+top-k categorical, vectorized per slot.
+
+    ``lraw``: [S, V] float32 last-token logits. Mirrors the reference
+    ``_gpt_generate`` recipe: greedy ignores temperature; sampling divides
+    by (pre-clamped) temperature, masks everything below the k-th logit to
+    -1e9 when ``top_k > 0``, then draws ``jax.random.categorical(key, ·)``.
+    ``max_top_k`` is the static top-k width; per-slot ``top_k`` selects the
+    effective threshold inside it.
+    """
+    greedy = jnp.argmax(lraw, axis=-1).astype(jnp.int32)
+    lt = lraw / temperature[:, None]
+    if max_top_k > 0:
+        vals = jax.lax.top_k(lt, max_top_k)[0]            # [S, maxK] desc
+        kidx = jnp.clip(top_k, 1, max_top_k) - 1
+        kth = jnp.take_along_axis(vals, kidx[:, None], axis=-1)
+        filtered = jnp.where(lt < kth, -1e9, lt)
+        lt = jnp.where((top_k > 0)[:, None], filtered, lt)
+    sampled = jax.random.categorical(key, lt, axis=-1).astype(jnp.int32)
+    return jnp.where(do_sample, sampled, greedy)
+
+
+def _block_decode(spec, lp, h, kb, vb, positions, mask, scale):
+    """One pre-norm transformer block for a single new token per slot.
+
+    ``h``: [S, E]; ``kb``/``vb``: this layer's [S, max_seq, H, D] cache;
+    returns (h, kb, vb) with the token's K/V written at ``positions``.
+    """
+    s = h.shape[0]
+    x = _layer_norm(h, lp["n1w"], lp["n1b"], spec.ln_epsilon)
+    q = (x @ lp["qw"] + lp["qb"]).reshape(s, spec.num_heads, spec.head_dim)
+    kn = (x @ lp["kw"] + lp["kb"]).reshape(s, spec.num_heads, spec.head_dim)
+    vn = (x @ lp["vw"] + lp["vb"]).reshape(s, spec.num_heads, spec.head_dim)
+    kb, vb = append_token_kv(kb, vb, kn, vn, positions)
+    qh = (q * scale)[:, :, None, :]                       # [S, H, 1, D]
+    kt = jnp.transpose(kb, (0, 2, 1, 3))                  # [S, H, max, D]
+    vt = jnp.transpose(vb, (0, 2, 1, 3))
+    prod = jnp.matmul(qh, jnp.swapaxes(kt, -1, -2))       # [S, H, 1, max]
+    weights = jax.nn.softmax(prod + mask, axis=-1)
+    out = jnp.matmul(weights, vt)                         # [S, H, 1, D]
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(s, spec.hidden_size)
+    h = h + (out @ lp["ow"] + lp["ob"])
+    x = _layer_norm(h, lp["n2w"], lp["n2b"], spec.ln_epsilon)
+    ffn = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
+    return h + (ffn @ lp["w2"] + lp["b2"]), kb, vb
+
+
+def _block_prefill(spec, lp, h, mask, scale):
+    """One pre-norm block over a whole [B, L, E] prompt; returns
+    (h, k, v) with K/V in cache layout [B, L, H, D]."""
+    b, l = h.shape[0], h.shape[1]
+    x = _layer_norm(h, lp["n1w"], lp["n1b"], spec.ln_epsilon)
+
+    def heads(t):                                         # [B, L, H, D]
+        return t.reshape(b, l, spec.num_heads, spec.head_dim)
+
+    q = heads(x @ lp["qw"] + lp["qb"])
+    k = heads(x @ lp["kw"] + lp["kb"])
+    v = heads(x @ lp["vw"] + lp["vb"])
+    qh = jnp.transpose(q * scale, (0, 2, 1, 3))           # [B, H, L, D]
+    kh = jnp.transpose(k, (0, 2, 1, 3))
+    vh = jnp.transpose(v, (0, 2, 1, 3))
+    prod = jnp.matmul(qh, jnp.swapaxes(kh, -1, -2))       # [B, H, L, L]
+    weights = jax.nn.softmax(prod + mask, axis=-1)
+    out = jnp.matmul(weights, vh)                         # [B, H, L, D]
+    out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, l, spec.hidden_size)
+    h = h + (out @ lp["ow"] + lp["ob"])
+    x = _layer_norm(h, lp["n2w"], lp["n2b"], spec.ln_epsilon)
+    ffn = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
+    return h + (ffn @ lp["w2"] + lp["b2"]), k, v
+
+
+# -- the compiled programs ---------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def get_decode_step(spec: GPTDecodeSpec, max_top_k: int):
+    """THE decode step: jitted once per (spec, max_top_k); each distinct
+    (num_slots, max_seq) shape pair traces exactly once (the attached
+    ``trace_counter["traces"]`` counts Python-body executions == XLA
+    traces — the compile-counter tests assert it stays flat after warmup).
+
+    step(params, kbuf, vbuf, lengths, finished, last_tokens,
+         temperature, top_k, do_sample, eos, key)
+      -> (kbuf, vbuf, lengths+1, finished, next_tokens)
+
+    All slots advance unconditionally (inactive slots compute masked
+    garbage that the scheduler discards — uniform shapes are what keep the
+    program unique); per-slot eos semantics match the reference generate:
+    finished rows keep emitting their eos token.
+    """
+    counter = {"traces": 0}
+    scale = 1.0 / np.sqrt(spec.head_dim)
+    max_pos = spec.max_position_embeddings
+
+    def _step(params, kbuf, vbuf, lengths, finished, last_tokens,
+              temperature, top_k, do_sample, eos, key):
+        counter["traces"] += 1
+        max_seq = kbuf.shape[2]
+        positions = lengths                       # write position per slot
+        posc = jnp.clip(positions, 0, max_pos - 1)
+        h = params["tok"][last_tokens] + params["pos"][posc]      # [S, E]
+        mask = valid_mask(positions, max_seq, h.dtype)
+        new_k, new_v = [], []
+        for li, lp in enumerate(params["layers"]):
+            h, kb, vb = _block_decode(spec, lp, h, kbuf[:, li], vbuf[:, li],
+                                      positions, mask, scale)
+            new_k.append(kb)
+            new_v.append(vb)
+        kbuf = jnp.stack(new_k, axis=1)
+        vbuf = jnp.stack(new_v, axis=1)
+        h = _layer_norm(h, params["fnw"], params["fnb"], spec.ln_epsilon)
+        lraw = (h @ params["tok"].T).astype(jnp.float32)          # [S, V]
+        nxt = _sample(lraw, temperature, top_k, do_sample, key, max_top_k)
+        nxt = jnp.where(finished & (eos >= 0), eos, nxt)
+        finished = finished | ((nxt == eos) & (eos >= 0))
+        return kbuf, vbuf, lengths + 1, finished, nxt
+
+    fn = jax.jit(_step)
+    fn.trace_counter = counter
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def get_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
+    """Bucketed prefill: run the whole (right-padded) prompt batch through
+    the causal stack, write its K/V into the target slots, set their
+    lengths, and sample the first generated token. One trace per
+    (batch, prompt_bucket) shape — a small closed set when prompts are
+    padded to buckets.
+
+    prefill(params, tokens[B, Lp], true_lens[B], kbuf, vbuf, lengths,
+            finished, slot_ids[B], temperature[B], top_k[B], do_sample[B],
+            eos[B], key)
+      -> (kbuf, vbuf, lengths, finished, next_tokens[B])
+
+    Right-padding is safe under the causal mask: real position i only
+    attends j <= i < true_len, and the junk K/V written at
+    [true_len, Lp) is masked by the slot length until later tokens
+    overwrite it.
+    """
+    counter = {"traces": 0}
+    scale = 1.0 / np.sqrt(spec.head_dim)
+
+    def _prefill(params, tokens, true_lens, kbuf, vbuf, lengths, finished,
+                 slot_ids, temperature, top_k, do_sample, eos, key):
+        counter["traces"] += 1
+        b, lp_len = tokens.shape
+        pos = jnp.arange(lp_len, dtype=jnp.int32)
+        h = params["tok"][tokens] + params["pos"][pos][None]   # [B, L, E]
+        # the same additive causal triu the dense path materialises
+        mask = jnp.triu(jnp.full((lp_len, lp_len), -1e9, h.dtype),
+                        1)[None, None]
+        kcs, vcs = [], []
+        for lp in params["layers"]:
+            h, k, v = _block_prefill(spec, lp, h, mask, scale)
+            kcs.append(k)
+            vcs.append(v)
+        kbuf, vbuf = write_prompt_kv(
+            kbuf, vbuf, jnp.stack(kcs, axis=1), jnp.stack(vcs, axis=1),
+            slot_ids)
+        lengths = lengths.at[slot_ids].set(true_lens)
+        h = _layer_norm(h, params["fnw"], params["fnb"], spec.ln_epsilon)
+        last = jnp.take_along_axis(
+            h, (true_lens - 1)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]                                      # [B, E]
+        lraw = (last @ params["tok"].T).astype(jnp.float32)
+        nxt = _sample(lraw, temperature, top_k, do_sample, key, max_top_k)
+        finished = finished.at[slot_ids].set((nxt == eos) & (eos >= 0))
+        return kbuf, vbuf, lengths, finished, nxt
+
+    fn = jax.jit(_prefill)
+    fn.trace_counter = counter
+    return fn
+
+
+def pack_sampling(params_list: Sequence[SamplingParams]):
+    """Host-side SamplingParams -> the per-slot device vectors the compiled
+    step consumes (eos -1 disables eos handling for that slot)."""
+    temps = [p.clamped_temperature() for p in params_list]
+    eoses = [-1 if p.eos_token_id is None else int(p.eos_token_id)
+             for p in params_list]
+    temp = np.asarray(temps, np.float32)  # noqa: PTA002 -- packs host-side SamplingParams fields (python scalars), no device value involved
+    topk = np.asarray([int(p.top_k) for p in params_list], np.int32)  # noqa: PTA002 -- host python scalars
+    do_s = np.asarray([bool(p.do_sample) for p in params_list], np.bool_)  # noqa: PTA002 -- host python scalars
+    eos = np.asarray(eoses, np.int32)  # noqa: PTA002 -- host python scalars
+    return (jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(do_s),
+            jnp.asarray(eos))
+
+
+class GPTStaticDecoder:
+    """Object façade over the compiled prefill/decode programs for one
+    GPT model: parameter extraction, KV-cache construction, and
+    ExecutableCache-audited access to the jitted functions (a cache miss
+    marks the first time a shape signature is seen == one XLA trace, the
+    same accounting the classifier Engine uses)."""
+
+    def __init__(self, model, max_top_k: int = 64,
+                 exec_cache: Optional[ExecutableCache] = None):
+        self.spec = GPTDecodeSpec.from_model(model)
+        self._model = model
+        self.max_top_k = max(0, min(int(max_top_k), self.spec.vocab_size))
+        # NOT `exec_cache or ...`: an empty ExecutableCache has len() == 0
+        # and is falsy, which would silently orphan the engine's cache.
+        self.exec_cache = (exec_cache if exec_cache is not None
+                           else ExecutableCache())
+        self._key = ("gpt-static", self.spec, self.max_top_k)
+
+    def params(self):
+        return extract_gpt_params(self._model)
+
+    def new_kv(self, num_slots: int, max_seq: int) -> StaticKVCache:
+        if max_seq > self.spec.max_position_embeddings:
+            raise ValueError(
+                f"max_seq {max_seq} exceeds the model's "
+                f"{self.spec.max_position_embeddings} positions")
+        dtype = self._model.gpt.word_embeddings.weight._data.dtype
+        return StaticKVCache(num_slots, self.spec.num_layers, max_seq,
+                             self.spec.num_heads, self.spec.head_dim,
+                             dtype=dtype)
+
+    # -- compiled-program access --------------------------------------------
+    def decode_fn(self, num_slots: int, max_seq: int):
+        """The single decode step; the ExecutableCache key carries the
+        shape pair so its miss counter mirrors XLA traces."""
+        return self.exec_cache.get_or_compile(
+            self._key + ("decode", num_slots, max_seq),
+            lambda: get_decode_step(self.spec, self.max_top_k))
+
+    def prefill_fn(self, batch: int, prompt_len: int):
+        return self.exec_cache.get_or_compile(
+            self._key + ("prefill", batch, prompt_len),
+            lambda: get_prefill_fn(self.spec, self.max_top_k))
+
+    # -- convenience wrappers ------------------------------------------------
+    def prefill(self, kv: StaticKVCache, params, tokens, true_lens,
+                slot_ids, finished, samp_vecs, key):
+        """Run bucketed prefill for ``tokens`` [B, Lp] into ``slot_ids``;
+        updates ``kv`` in place (functionally) and returns
+        (next_tokens[B] device, finished[S] device)."""
+        fn = self.prefill_fn(tokens.shape[0], tokens.shape[1])
+        k, v, lengths, finished, nxt = fn(
+            params, tokens, true_lens, kv.k, kv.v, kv.lengths, finished,
+            slot_ids, *samp_vecs, key)
+        kv.swap(k, v, lengths)
+        return nxt, finished
+
+    def decode_step(self, kv: StaticKVCache, params, finished, last_tokens,
+                    samp_vecs, key):
+        """Advance every slot one token; updates ``kv`` and returns
+        (next_tokens[S] device, finished[S] device)."""
+        fn = self.decode_fn(kv.num_slots, kv.max_seq)
+        k, v, lengths, finished, nxt = fn(
+            params, kv.k, kv.v, kv.lengths, finished, last_tokens,
+            *samp_vecs, key)
+        kv.swap(k, v, lengths)
+        return nxt, finished

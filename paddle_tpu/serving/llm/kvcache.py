@@ -1,0 +1,175 @@
+"""StaticKVCache: preallocated slot-structured KV buffers for decode.
+
+The concat-grown cache (``MultiHeadAttention.Cache``) changes shape every
+token, so XLA specializes a new executable per length — the per-token
+recompile flagged in ROADMAP item 1. This cache fixes every shape up
+front: K and V live in ``[num_slots, num_layers, max_seq, heads,
+head_dim]`` buffers, a sequence occupies one *slot* row for its whole
+lifetime, and all writes are functional ``lax.dynamic_update_slice``
+updates inside the jitted prefill/decode programs — the arrays never
+change shape, so one compiled decode step serves every token of every
+request (LazyTensor's keep-one-program-hot discipline, arxiv 2102.13267).
+
+Slot lifecycle (host-side bookkeeping; device arrays are only ever
+*replaced* by the functional step outputs):
+
+    free ──alloc()──> active ──free()──> free
+                (prefill writes [0, L))   (buffers keep stale rows; the
+                                           per-slot length masks them and
+                                           the next prefill overwrites)
+
+The length vector lives on device (it is an input of the compiled step);
+``alloc``/``free`` only mutate the host free-list, so slot churn costs no
+host↔device traffic beyond the admission-time prompt upload.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SlotsExhausted(RuntimeError):
+    """alloc() called with every slot in use (callers should gate on
+    :attr:`StaticKVCache.free_slots` instead of catching this)."""
+
+
+class StaticKVCache:
+    """Preallocated per-slot KV storage + per-slot length/position state.
+
+    ``k``/``v``: ``[num_slots, num_layers, max_seq, heads, head_dim]``
+    device arrays. ``lengths``: ``[num_slots]`` int32 device vector — the
+    number of valid cache rows per slot (== the absolute position the next
+    token will be written at). Both are replaced wholesale by the outputs
+    of the jitted prefill/decode functions; this object is the host-side
+    holder that threads them from tick to tick.
+    """
+
+    def __init__(self, num_slots: int, num_layers: int, max_seq: int,
+                 num_heads: int, head_dim: int, dtype="float32"):
+        if num_slots < 1 or max_seq < 2:
+            raise ValueError(
+                f"need num_slots >= 1 and max_seq >= 2, got "
+                f"{num_slots}/{max_seq}")
+        self.num_slots = int(num_slots)
+        self.num_layers = int(num_layers)
+        self.max_seq = int(max_seq)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.num_slots, self.num_layers, self.max_seq,
+                 self.num_heads, self.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
+        self._free: List[int] = list(range(self.num_slots))
+        self._active: set = set()
+
+    # -- slot lifecycle (host side) -----------------------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._active))
+
+    def alloc(self) -> int:
+        """Claim a free slot (lowest-index first, so short-lived tests are
+        deterministic). The caller must prefill before decoding it."""
+        if not self._free:
+            raise SlotsExhausted(
+                f"all {self.num_slots} KV slots are in use")
+        slot = self._free.pop(0)
+        self._active.add(slot)
+        return slot
+
+    def free(self, slot: int):
+        """Return a slot to the pool. Stale K/V rows stay in the buffers —
+        they are masked by the length vector and overwritten by the next
+        occupant's prefill, so no device work is needed."""
+        if slot not in self._active:
+            raise ValueError(f"slot {slot} is not active")
+        self._active.discard(slot)
+        self._free.append(slot)
+        self._free.sort()
+
+    def reset(self):
+        """Free every slot and zero the length vector (buffers are left as
+        is — lengths gate validity). For tests and engine restarts."""
+        self._free = list(range(self.num_slots))
+        self._active.clear()
+        self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
+
+    # -- functional state threading -----------------------------------------
+    def swap(self, k, v, lengths):
+        """Install the arrays returned by a jitted prefill/decode call.
+        Shape-checked: a shape change would mean a recompile upstream."""
+        assert k.shape == self.k.shape and v.shape == self.v.shape, \
+            (k.shape, self.k.shape)
+        self.k, self.v, self.lengths = k, v, lengths
+
+    def host_lengths(self) -> np.ndarray:
+        """One deliberate device->host fetch of the per-slot lengths (used
+        by tests and ``/statsz``, never by the per-tick hot path — the
+        scheduler tracks lengths on host from the tokens it already
+        fetched)."""
+        return np.asarray(jax.device_get(self.lengths))  # noqa: PTA002 -- deliberate observability fetch (tests, /statsz); the tick loop never calls this
+
+    def __repr__(self):
+        return (f"StaticKVCache(slots={self.num_slots}, "
+                f"layers={self.num_layers}, max_seq={self.max_seq}, "
+                f"heads={self.num_heads}, head_dim={self.head_dim}, "
+                f"active={len(self._active)})")
+
+
+# -- functional update kernels (used inside jitted programs) ----------------
+
+def append_token_kv(kb, vb, k_new, v_new, positions):
+    """Write one new token's K/V for every slot at that slot's position
+    (one layer's buffers — decode updates layer *l*'s cache before layer
+    *l* attends, so the update is interleaved with the forward pass).
+
+    ``kb``/``vb``: ``[S, max_seq, H, D]``; ``k_new``/``v_new``:
+    ``[S, H, D]`` (the current token's projections); ``positions``:
+    ``[S]`` int32. A vmapped ``lax.dynamic_update_slice`` over the slot
+    axis — per-slot starts are traced values, so XLA lowers this to one
+    scatter, keeping the decode step a single fused program.
+    """
+    def _one(row_k, row_v, kn, vn, pos):
+        # row_*: [max_seq, H, D]; kn/vn: [H, D]
+        start = (pos, 0, 0)
+        return (jax.lax.dynamic_update_slice(row_k, kn[None], start),
+                jax.lax.dynamic_update_slice(row_v, vn[None], start))
+
+    return jax.vmap(_one)(kb, vb, k_new, v_new, positions)
+
+
+def write_prompt_kv(k_buf, v_buf, k_prompt, v_prompt, slot_ids):
+    """Write whole-prompt K/V into the given slots at offset 0.
+
+    ``k_prompt``/``v_prompt``: ``[B, L_layers, L_prompt, H, D]``;
+    ``slot_ids``: length-B int sequence (static Python ints or traced
+    scalars). B is static, so the loop unrolls into B
+    ``dynamic_update_slice`` ops — prefill batches are small (usually 1
+    per admission) and each op writes one contiguous slot row.
+    """
+    b = k_prompt.shape[0]
+    for i in range(b):
+        start = (slot_ids[i], 0, 0, 0, 0)
+        k_buf = jax.lax.dynamic_update_slice(k_buf, k_prompt[i][None], start)
+        v_buf = jax.lax.dynamic_update_slice(v_buf, v_prompt[i][None], start)
+    return k_buf, v_buf
+
+
+def valid_mask(lengths, max_seq, dtype=jnp.float32):
+    """Additive attention mask ``[S, 1, 1, max_seq]``: 0 where the cache
+    row index is <= the slot's current position (the just-written token
+    attends to itself and the whole valid prefix), -1e9 beyond — the same
+    finite -1e9 the dense path uses, so softmax zeros stale rows exactly
+    (exp(-1e9) underflows to 0.0 in f32)."""
+    idx = jnp.arange(max_seq, dtype=jnp.int32)[None, :]        # [1, max_seq]
+    ok = idx <= lengths[:, None]                               # [S, max_seq]
+    return jnp.where(ok, 0.0, -1e9).astype(dtype)[:, None, None, :]

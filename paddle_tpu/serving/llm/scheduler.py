@@ -1,0 +1,537 @@
+"""ContinuousBatcher + LLMEngine: the token-level serving loop.
+
+Classifier serving dispatches whole requests; LLM serving schedules at
+token granularity. One worker thread runs ticks of the SINGLE compiled
+decode step over all KV slots; between ticks, sequences join (bucketed
+prefill into a free slot, straight off the shared :class:`BatchQueue`) or
+leave (eos / length budget / mid-stream deadline eviction) — continuous
+batching in the Orca sense: admission never waits for the current batch
+to finish, and a finished sequence's slot is reusable on the very next
+tick.
+
+Host<->device traffic per tick is exactly one fetch: the ``[num_slots]``
+next-token vector, which streaming delivery needs on host anyway. Slot
+bookkeeping, finish detection, and deadline eviction are all host-side
+reads of that vector plus counters the scheduler already tracks, so the
+device never round-trips for control flow.
+
+Drain semantics match the classifier engine: ``begin_drain`` (or SIGTERM
+through the chained handler) stops admission, and the worker keeps
+ticking until every in-flight sequence finishes and the queue is flushed
+— preemption never strands a future mid-generation.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _pyqueue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import monitor as _mon
+from ..buckets import pow2_buckets
+from ..cache import ExecutableCache
+from ..engine import DrainableEngineBase
+from ..queue import BatchQueue
+from ..request import (Deadline, DeadlineExceeded, EngineDraining,
+                       RequestTooLarge)
+from .decode import GPTStaticDecoder, SamplingParams, pack_sampling
+from .kvcache import StaticKVCache
+
+_REQ_IDS = itertools.count(1)
+_STREAM_END = object()
+
+
+class GenerationRequest:
+    """One queued generation: prompt + sampling params + result future.
+
+    Duck-types the queue contract of :class:`InferenceRequest` (``expired``
+    / ``fail_expired`` / ``future``) so the shared :class:`BatchQueue`
+    admission and head-of-line deadline eviction apply unchanged. The
+    future resolves to ``{"tokens": [...], "finish_reason": ...}``; with
+    ``stream=True``, :meth:`iter_tokens` yields tokens as ticks produce
+    them.
+    """
+
+    __slots__ = ("req_id", "prompt", "sampling", "deadline", "future",
+                 "t_enqueue", "t_first_token", "tokens", "finish_reason",
+                 "_stream_q", "_clock")
+
+    def __init__(self, prompt, sampling: SamplingParams,
+                 deadline: Optional[Deadline] = None, stream: bool = False,
+                 clock=time.monotonic):
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)  # noqa: PTA002 -- admission-time conversion of the caller's host-side prompt (list/ndarray), not a device value
+        if arr.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        self.req_id = next(_REQ_IDS)
+        self.prompt = arr
+        self.sampling = sampling
+        self.deadline = deadline
+        from concurrent.futures import Future
+        self.future = Future()
+        self._clock = clock
+        self.t_enqueue = clock()
+        self.t_first_token: Optional[float] = None
+        self.tokens: List[int] = []
+        self.finish_reason: Optional[str] = None
+        self._stream_q = _pyqueue.Queue() if stream else None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.size)
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and self.deadline.expired()
+
+    @property
+    def nrows(self) -> int:          # queue/stats compatibility
+        return 1
+
+    def fail(self, exc: BaseException) -> bool:
+        if self.future.done():
+            return False
+        self.future.set_exception(exc)
+        if self._stream_q is not None:
+            self._stream_q.put(exc)
+            self._stream_q.put(_STREAM_END)
+        return True
+
+    def fail_expired(self) -> bool:
+        return self.fail(DeadlineExceeded(
+            f"generation request {self.req_id} exceeded its "
+            f"{self.deadline.seconds}s deadline"))
+
+    def _emit(self, tok: int):
+        if self.t_first_token is None:
+            self.t_first_token = self._clock()
+        self.tokens.append(tok)
+        if self._stream_q is not None:
+            self._stream_q.put(tok)
+
+    def _finish(self, reason: str):
+        self.finish_reason = reason
+        if not self.future.done():
+            self.future.set_result(
+                {"tokens": list(self.tokens), "finish_reason": reason,
+                 "req_id": self.req_id})
+        if self._stream_q is not None:
+            self._stream_q.put(_STREAM_END)
+
+    def iter_tokens(self, timeout: Optional[float] = None):
+        """Yield tokens as they are generated (``stream=True`` requests
+        only); raises the failure exception on eviction/drain-abort."""
+        if self._stream_q is None:
+            raise ValueError("request was not submitted with stream=True")
+        while True:
+            item = self._stream_q.get(timeout=timeout)
+            if item is _STREAM_END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        return self.future.result(timeout)
+
+
+class LLMEngineConfig:
+    """Tunables for the LLM serving engine (see docs/serving.md)."""
+
+    def __init__(self,
+                 num_slots: int = 8,
+                 max_seq: int = 256,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 256,
+                 admission_block: bool = True,
+                 admission_timeout: Optional[float] = 2.0,
+                 default_deadline: Optional[float] = None,
+                 default_max_new_tokens: int = 64,
+                 max_top_k: int = 64,
+                 idle_poll: float = 0.01,
+                 warmup: bool = True,
+                 seed: int = 0,
+                 stat_prefix: str = "serving.llm"):
+        self.num_slots = int(num_slots)
+        self.max_seq = int(max_seq)
+        if prefill_buckets is None:
+            prefill_buckets = pow2_buckets(self.max_seq,
+                                           start=min(8, self.max_seq))
+        buckets = tuple(sorted(set(int(b) for b in prefill_buckets)))
+        if not buckets or buckets[0] < 1 or buckets[-1] > self.max_seq:
+            raise ValueError(
+                f"prefill buckets must lie in [1, max_seq={self.max_seq}]; "
+                f"got {buckets}")
+        self.prefill_buckets = buckets
+        self.max_queue = int(max_queue)
+        self.admission_block = bool(admission_block)
+        self.admission_timeout = admission_timeout
+        self.default_deadline = default_deadline
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.max_top_k = int(max_top_k)
+        self.idle_poll = float(idle_poll)
+        self.warmup = bool(warmup)
+        self.seed = int(seed)
+        self.stat_prefix = stat_prefix
+
+    @property
+    def max_prompt_len(self) -> int:
+        """Longest admissible prompt: must fit a bucket AND leave room for
+        at least one generated token in the slot."""
+        return min(self.prefill_buckets[-1], self.max_seq - 1)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise RequestTooLarge(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket ({self.prefill_buckets[-1]})")
+
+
+class ContinuousBatcher:
+    """Slot-level scheduling state + the per-tick device interaction.
+
+    Owns the :class:`StaticKVCache`, the per-slot device vectors
+    (``finished``, ``last_tokens``, packed sampling params), and the
+    slot -> request table. ``admit`` prefms prefill + first-token
+    delivery; ``tick`` advances every active sequence one token and
+    retires finished/evicted slots. Single-threaded by design: only the
+    engine worker calls into it.
+    """
+
+    def __init__(self, decoder: GPTStaticDecoder, config: LLMEngineConfig,
+                 registry: _mon.StatRegistry, clock=time.monotonic):
+        self.decoder = decoder
+        self.config = config
+        self._registry = registry
+        self._prefix = config.stat_prefix
+        self._clock = clock
+        self.kv = decoder.new_kv(config.num_slots, config.max_seq)
+        self._params = decoder.params()
+        self._reqs: Dict[int, GenerationRequest] = {}
+        self._slot_samp: List[SamplingParams] = [
+            SamplingParams() for _ in range(config.num_slots)]
+        self._samp_vecs = pack_sampling(self._slot_samp)
+        self._finished = jnp.zeros((config.num_slots,), jnp.bool_)
+        self._last = jnp.zeros((config.num_slots,), jnp.int32)
+        self._rng = jax.random.PRNGKey(config.seed)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def active(self) -> int:
+        return len(self._reqs)
+
+    @property
+    def free_slots(self) -> int:
+        return self.kv.free_slots
+
+    def refresh_params(self):
+        """Re-extract model parameters (after a checkpoint reload)."""
+        self._params = self.decoder.params()
+
+    # -- internals -----------------------------------------------------------
+    def _next_key(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _stat_add(self, name, v):
+        self._registry.add(f"{self._prefix}.{name}", v)
+
+    def _stat_set(self, name, v):
+        self._registry.set(f"{self._prefix}.{name}", v)
+
+    def _stat_observe(self, name, v):
+        self._registry.observe(f"{self._prefix}.{name}", v)
+
+    # -- scheduling ----------------------------------------------------------
+    def admit(self, req: GenerationRequest):
+        """Prefill ``req`` into a free slot and deliver its first token.
+        The caller guarantees ``free_slots > 0`` and a bucket-fitting
+        prompt (``submit`` validated both)."""
+        t0 = self._clock()
+        slot = self.kv.alloc()
+        self._reqs[slot] = req
+        self._slot_samp[slot] = req.sampling
+        self._samp_vecs = pack_sampling(self._slot_samp)
+        lp = self.config.bucket_for(req.prompt_len)
+        padded = np.zeros((1, lp), np.int32)
+        padded[0, :req.prompt_len] = req.prompt
+        nxt, self._finished = self.decoder.prefill(
+            self.kv, self._params, jnp.asarray(padded),
+            jnp.asarray([req.prompt_len], jnp.int32),
+            jnp.asarray([slot], jnp.int32), self._finished,
+            pack_sampling([req.sampling]), self._next_key())
+        self._last = self._last.at[jnp.asarray([slot])].set(nxt)
+        # The admission-time fetch of the first generated token: streaming
+        # TTFT requires it on host, and it doubles as the finish probe.
+        tok = int(np.asarray(jax.device_get(nxt))[0])  # noqa: PTA002 -- one [1]-token fetch per admission; first-token delivery (TTFT) needs the value on host
+        now = self._clock()
+        self._stat_observe("prefill_ms", (now - t0) * 1000.0)
+        self._stat_observe("ttft_ms", (now - req.t_enqueue) * 1000.0)
+        self._stat_add("prefills", 1)
+        req._emit(tok)
+        self._stat_add("tokens_generated", 1)
+        self._maybe_finish(slot, req, tok)
+
+    def tick(self) -> int:
+        """One decode tick: advance every slot one token through THE
+        compiled step, deliver tokens, retire finished slots. Returns the
+        number of active sequences advanced."""
+        if not self._reqs:
+            return 0
+        t0 = self._clock()
+        nxt, self._finished = self.decoder.decode_step(
+            self.kv, self._params, self._finished, self._last,
+            self._samp_vecs, self._next_key())
+        self._last = nxt
+        # THE one host fetch of the tick: the [num_slots] next-token
+        # vector. Streaming delivery and host-side finish detection both
+        # consume it, so this sync is the feature, not an accident.
+        toks = np.asarray(jax.device_get(nxt))  # noqa: PTA002 -- the single per-tick [num_slots] fetch; token streaming requires host delivery
+        n = len(self._reqs)
+        dt = max(self._clock() - t0, 1e-9)
+        self._stat_observe("decode_tick_ms", dt * 1000.0)
+        self._stat_observe("tpot_ms", dt * 1000.0)
+        self._stat_add("tokens_generated", n)
+        self._stat_set("tokens_per_sec", n / dt)
+        for slot, req in list(self._reqs.items()):
+            if req.expired:
+                self._evict(slot, req)
+                continue
+            tok = int(toks[slot])
+            req._emit(tok)
+            self._maybe_finish(slot, req, tok)
+        return n
+
+    def _maybe_finish(self, slot: int, req: GenerationRequest, tok: int):
+        s = req.sampling
+        if s.eos_token_id is not None and tok == int(s.eos_token_id):
+            self._release(slot, req, "stop")
+        elif len(req.tokens) >= s.max_new_tokens:
+            self._release(slot, req, "length")
+        elif req.prompt_len + len(req.tokens) >= self.config.max_seq:
+            self._release(slot, req, "length")
+
+    def _release(self, slot: int, req: GenerationRequest, reason: str):
+        del self._reqs[slot]
+        self.kv.free(slot)
+        req._finish(reason)
+        self._stat_add("completed", 1)
+        self._stat_observe("request_latency_ms",
+                           (self._clock() - req.t_enqueue) * 1000.0)
+
+    def _evict(self, slot: int, req: GenerationRequest):
+        """Mid-stream deadline eviction: the slot is reclaimed and the
+        future fails — a stalled consumer cannot pin a slot forever."""
+        del self._reqs[slot]
+        self.kv.free(slot)
+        req.fail(DeadlineExceeded(
+            f"generation request {req.req_id} exceeded its "
+            f"{req.deadline.seconds}s deadline after "
+            f"{len(req.tokens)} tokens"))
+        self._stat_add("evicted_midstream", 1)
+
+    def abort_all(self, exc_factory):
+        """Fail every in-flight sequence (forced shutdown, not drain)."""
+        for slot, req in list(self._reqs.items()):
+            del self._reqs[slot]
+            self.kv.free(slot)
+            req.fail(exc_factory(req))
+
+    # -- warmup --------------------------------------------------------------
+    def warmup(self):
+        """Compile the decode step and every prefill bucket up front so no
+        request pays a trace. Runs dummy work through the real buffers,
+        then resets slot state — junk K/V is masked by the zeroed
+        lengths."""
+        t0 = self._clock()
+        samp = pack_sampling([SamplingParams()])
+        for lp in self.config.prefill_buckets:
+            self.decoder.prefill(
+                self.kv, self._params, jnp.zeros((1, lp), jnp.int32),
+                jnp.asarray([lp], jnp.int32), jnp.asarray([0], jnp.int32),
+                self._finished, samp, self._next_key())
+        nxt, _ = self.decoder.decode_step(
+            self.kv, self._params, self._finished, self._last,
+            self._samp_vecs, self._next_key())
+        nxt.block_until_ready()  # noqa: PTA002 -- warmup barrier: ensure compiles finish before serving starts
+        self.kv.reset()
+        self._finished = jnp.zeros((self.config.num_slots,), jnp.bool_)
+        self._last = jnp.zeros((self.config.num_slots,), jnp.int32)
+        self._stat_set("warmup_ms", (self._clock() - t0) * 1000.0)
+
+
+class LLMEngine(DrainableEngineBase):
+    """submit()/drain() continuous-batching generation over one GPT model.
+
+    Construction compiles (optionally) and starts the worker thread; from
+    then on every decode tick reuses the one compiled step. Graceful
+    drain — explicit, SIGTERM via :meth:`install_drain_signal_handler`,
+    or preemption via :meth:`arm_preemption` — stops admission and
+    finishes every in-flight AND queued sequence before the worker exits.
+    """
+
+    def __init__(self, model, config: Optional[LLMEngineConfig] = None,
+                 registry: Optional[_mon.StatRegistry] = None,
+                 cache: Optional[ExecutableCache] = None):
+        self._config = config or LLMEngineConfig()
+        self._init_serving_base(registry, self._config.stat_prefix)
+        # `is not None`, not truthiness: an empty ExecutableCache has
+        # len() == 0 and is falsy, so `cache or ...` would drop it.
+        self._cache = cache if cache is not None else ExecutableCache()
+        self._decoder = GPTStaticDecoder(
+            model, max_top_k=self._config.max_top_k, exec_cache=self._cache)
+        self._batcher = ContinuousBatcher(
+            self._decoder, self._config, self._registry)
+        self._queue = BatchQueue(max_size=self._config.max_queue)
+        if self._config.warmup:
+            self._batcher.warmup()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="paddle-tpu-llm-worker",
+            daemon=True)
+        self._worker.start()
+
+    # -- public API ----------------------------------------------------------
+    @property
+    def config(self) -> LLMEngineConfig:
+        return self._config
+
+    @property
+    def cache(self) -> ExecutableCache:
+        return self._cache
+
+    @property
+    def decoder(self) -> GPTStaticDecoder:
+        return self._decoder
+
+    def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
+               do_sample: bool = False, temperature: float = 1.0,
+               top_k: int = 0, eos_token_id: Optional[int] = None,
+               deadline: Optional[Union[Deadline, float]] = None,
+               stream: bool = False) -> GenerationRequest:
+        """Enqueue one prompt; returns the :class:`GenerationRequest`
+        (``.future`` for the full result, ``.iter_tokens()`` when
+        ``stream=True``)."""
+        if self._draining.is_set():
+            self._stat_add("rejected_draining", 1)
+            raise EngineDraining("engine is draining; submit rejected")
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)  # noqa: PTA002 -- admission-time conversion of the caller's host-side prompt, not a device value
+        if arr.size > self._config.max_prompt_len:
+            self._stat_add("rejected_oversize", 1)
+            raise RequestTooLarge(
+                f"prompt of {arr.size} tokens exceeds max_prompt_len="
+                f"{self._config.max_prompt_len} (largest prefill bucket "
+                f"capped at max_seq-1)")
+        if top_k > self._decoder.max_top_k:
+            raise ValueError(
+                f"top_k={top_k} exceeds the engine's compiled "
+                f"max_top_k={self._decoder.max_top_k}")
+        if max_new_tokens is None:
+            max_new_tokens = self._config.default_max_new_tokens
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if deadline is None and self._config.default_deadline is not None:
+            deadline = self._config.default_deadline
+        if deadline is not None and not isinstance(deadline, Deadline):
+            deadline = Deadline(float(deadline))
+        samp = SamplingParams(
+            do_sample=bool(do_sample), temperature=float(temperature),
+            top_k=int(top_k), eos_token_id=eos_token_id,
+            max_new_tokens=int(max_new_tokens))
+        req = GenerationRequest(arr, samp, deadline=deadline, stream=stream)
+        try:
+            self._queue.put(req, block=self._config.admission_block,
+                            timeout=self._config.admission_timeout)
+        except Exception:
+            self._stat_add("rejected_queue_full", 1)
+            raise
+        self._stat_set("queue_depth", len(self._queue))
+        return req
+
+    def generate(self, prompt, **kw) -> dict:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(prompt, **kw).result()
+
+    def drain(self, timeout: Optional[float] = None) -> List:
+        """Graceful drain: stop admission, finish every in-flight and
+        queued sequence, stop the worker. Returns the requests that were
+        in flight when the drain began (all resolved on return)."""
+        inflight = list(self._batcher._reqs.values())
+        self.begin_drain()
+        self._stopped.wait(timeout)
+        if self._signal_chain is not None:
+            self._signal_chain.uninstall()
+        self._stat_set("queue_depth", 0)
+        return inflight
+
+    close = drain
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()
+        return False
+
+    def stats(self) -> dict:
+        """Scalar stats + histogram summaries + cache counters + slot
+        occupancy (the ``/statsz`` payload for the LLM engine)."""
+        return {
+            "stats": self._registry.stats_with_prefix(self._prefix),
+            "histograms":
+                self._registry.histograms_with_prefix(self._prefix),
+            "executable_cache": self._cache.stats(),
+            "draining": self.draining,
+            "queue_depth": len(self._queue),
+            "slots": {"total": self._config.num_slots,
+                      "in_use": self._batcher.active,
+                      "free": self._batcher.free_slots},
+        }
+
+    # -- worker --------------------------------------------------------------
+    def _worker_loop(self):
+        cfg = self._config
+        try:
+            while True:
+                if self._guard is not None and self._guard.preempted \
+                        and not self._draining.is_set():
+                    self._stat_add("preemption_drains", 1)
+                    self.begin_drain()
+                elif self._draining.is_set() and not self._queue.closed:
+                    # flag set by the async-signal-safe handler; complete
+                    # the drain outside signal context
+                    self._queue.close()
+                free = self._batcher.free_slots
+                if free > 0:
+                    timeout = 0.0 if self._batcher.active else cfg.idle_poll
+                    for req in self._queue.take_many(free, timeout=timeout):
+                        self._batcher.admit(req)
+                self._stat_set("queue_depth", len(self._queue))
+                self._stat_set("deadline_evicted_queued",
+                               self._queue.evicted_expired)
+                self._stat_set("slots_in_use", self._batcher.active)
+                if self._batcher.active:
+                    self._batcher.tick()
+                elif self._draining.is_set() and len(self._queue) == 0:
+                    break
+                self._publish_cache_stats()
+        except BaseException as e:  # worker death must not strand futures
+            self._batcher.abort_all(
+                lambda req, e=e: RuntimeError(
+                    f"LLM worker died while request {req.req_id} was in "
+                    f"flight: {e!r}"))
+            raise
+        finally:
+            self._stopped.set()
+
+    def _publish_cache_stats(self):
+        s = self._cache.stats()
+        self._stat_set("cache.hits", s["hits"])
+        self._stat_set("cache.misses", s["misses"])
+        self._stat_set("recompiles", s["misses"])

@@ -115,3 +115,24 @@ class BatchQueue:
                 if remaining is not None and remaining <= 0:
                     return None
                 self._not_empty.wait(remaining)
+
+    def take_many(self, max_n: int, timeout: Optional[float] = None,
+                  fits: Optional[Callable[[InferenceRequest], bool]] = None
+                  ) -> list:
+        """Pop up to ``max_n`` requests: block (per ``take`` semantics) for
+        the first, then greedily drain without waiting. Used by the LLM
+        scheduler to admit a burst of sequences into free slots in one
+        tick. Returns a possibly-empty list."""
+        out: list = []
+        if max_n < 1:
+            return out
+        first = self.take(timeout=timeout, fits=fits)
+        if first is None:
+            return out
+        out.append(first)
+        while len(out) < max_n:
+            nxt = self.take(timeout=0, fits=fits)
+            if nxt is None:
+                break
+            out.append(nxt)
+        return out

@@ -31,6 +31,11 @@ HOT_PREFIXES = (
     # paths where host copies are deliberate)
     "paddle_tpu/sentinel/guard.py",
     "paddle_tpu/sentinel/policy.py",
+    # LLM serving decode tick: every token of every request flows through
+    # here, so an accidental sync multiplies by tokens/sec. The two
+    # sanctioned fetches (per-tick token vector, admission-time first
+    # token) carry noqa justifications.
+    "paddle_tpu/serving/llm/",
 )
 
 SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}

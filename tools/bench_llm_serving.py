@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Offered-load sweep for the LLM serving engine — decode throughput,
+TTFT/TPOT tails, and recompile count per load level, printed as one JSON
+document (same shape as tools/bench_serving.py).
+
+    python -m tools.bench_llm_serving                    # synthetic GPT
+    python -m tools.bench_llm_serving --loads 2,8,0      # 0 = unthrottled
+    python -m tools.bench_llm_serving --no-baseline      # skip the
+                                                         # static-vs-concat
+                                                         # comparison
+
+Each sweep drives ``--requests`` mixed-length prompts at the offered rate
+(requests/s; 0 = as fast as submission allows) through a fresh
+:class:`~paddle_tpu.serving.llm.LLMEngine` with its own StatRegistry, so
+the latency histograms and cache counters are per-sweep. Headline
+numbers: ``throughput_tok_s`` (generated tokens/s), ``ttft_p50_ms`` /
+``ttft_p95_ms`` (time to first token), ``tpot_p50_ms`` / ``tpot_p95_ms``
+(per-output-token tick latency), and ``recompiles`` — the NEW executable
+compiles during the sweep, which should be zero after warmup (the
+one-compiled-decode-step claim, measurable).
+
+The ``baseline`` section times ``model.generate`` at batch
+``--baseline-batch`` through the static-slot KV cache (``use_cache=True``)
+and the legacy concat-grown cache (``use_cache="concat"``), cold (first
+call, includes tracing) and warm (steady state). The acceptance bar is
+``warm_speedup >= 3`` on CPU.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from concurrent.futures import wait
+
+
+def _synthetic_gpt(vocab, hidden, layers, heads, max_pos, seed=0):
+    """A small random-weight GPT: real attention shapes, real KV traffic,
+    fast enough that the sweep measures scheduling, not matmuls."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=max_pos,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def run_sweep(engine, requests, offered_qps, prompt_lens, max_new, vocab,
+              seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab,
+                           size=prompt_lens[i % len(prompt_lens)])
+               .astype(np.int32) for i in range(requests)]
+    gap = 0.0 if not offered_qps else 1.0 / offered_qps
+    reg = engine.registry
+    misses0 = engine.cache.stats()["misses"]
+    t0 = time.monotonic()
+    reqs = []
+    for i, p in enumerate(prompts):
+        reqs.append(engine.submit(p, max_new_tokens=max_new))
+        if gap:
+            # pace submissions to the offered rate (absolute schedule so
+            # slow submits don't silently lower the offered load)
+            sleep_until = t0 + (i + 1) * gap
+            pause = sleep_until - time.monotonic()
+            if pause > 0:
+                time.sleep(pause)
+    wait([r.future for r in reqs], timeout=600)
+    wall = time.monotonic() - t0
+    errors = sum(1 for r in reqs
+                 if r.future.done() and r.future.exception() is not None)
+    pre = engine.config.stat_prefix
+    tokens = reg.get(f"{pre}.tokens_generated")
+    return {
+        "offered_qps": offered_qps or None,
+        "requests": requests,
+        "errors": errors,
+        "wall_s": round(wall, 4),
+        "throughput_rps": round(requests / wall, 2),
+        "throughput_tok_s": round(tokens / wall, 2),
+        "tokens_generated": tokens,
+        "ttft_p50_ms": round(reg.quantile(f"{pre}.ttft_ms", 0.50), 3),
+        "ttft_p95_ms": round(reg.quantile(f"{pre}.ttft_ms", 0.95), 3),
+        "tpot_p50_ms": round(reg.quantile(f"{pre}.tpot_ms", 0.50), 3),
+        "tpot_p95_ms": round(reg.quantile(f"{pre}.tpot_ms", 0.95), 3),
+        "p50_ms": round(reg.quantile(f"{pre}.request_latency_ms", 0.50), 3),
+        "p95_ms": round(reg.quantile(f"{pre}.request_latency_ms", 0.95), 3),
+        "p99_ms": round(reg.quantile(f"{pre}.request_latency_ms", 0.99), 3),
+        "prefills": reg.get(f"{pre}.prefills"),
+        "completed": reg.get(f"{pre}.completed"),
+        "evicted_midstream": reg.get(f"{pre}.evicted_midstream"),
+        "recompiles": engine.cache.stats()["misses"] - misses0,
+        "cache": engine.cache.stats(),
+    }
+
+
+def run_baseline(model, batch, prompt_len, new_tokens, vocab, seed=0):
+    """Static-slot vs concat-grown decode through the SAME
+    ``model.generate`` entry point: cold (includes tracing) and warm
+    (steady-state) wall time, batch ``batch`` greedy decode."""
+    import numpy as np
+    import paddle_tpu as paddle
+    rng = np.random.RandomState(seed)
+    ids = paddle.to_tensor(
+        rng.randint(0, vocab, size=(batch, prompt_len)).astype("int64"))
+    ntok = batch * new_tokens
+    out = {"batch": batch, "prompt_len": prompt_len,
+           "new_tokens": new_tokens}
+    for key, mode in (("static", True), ("concat", "concat")):
+        t0 = time.monotonic()
+        model.generate(ids, max_length=new_tokens, use_cache=mode)
+        cold = time.monotonic() - t0
+        t0 = time.monotonic()
+        model.generate(ids, max_length=new_tokens, use_cache=mode)
+        warm = time.monotonic() - t0
+        out[key] = {
+            "cold_s": round(cold, 4),
+            "warm_s": round(warm, 4),
+            "cold_tok_s": round(ntok / cold, 1),
+            "warm_tok_s": round(ntok / warm, 1),
+        }
+    out["cold_speedup"] = round(
+        out["concat"]["cold_s"] / out["static"]["cold_s"], 2)
+    out["warm_speedup"] = round(
+        out["concat"]["warm_s"] / out["static"]["warm_s"], 2)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--loads", default="4,16,0",
+                    help="comma-separated offered loads in req/s; 0 = "
+                         "unthrottled")
+    ap.add_argument("--prompt-lens", default="4,8,12,16",
+                    help="prompt token counts, cycled")
+    ap.add_argument("--max-new", type=int, default=32,
+                    help="generated tokens per request")
+    ap.add_argument("--num-slots", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--share-engine", action="store_true",
+                    help="reuse one engine across sweeps (recompiles go to "
+                         "zero after the first)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the static-vs-concat model.generate timing")
+    ap.add_argument("--baseline-batch", type=int, default=8)
+    ap.add_argument("--baseline-new", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    model = _synthetic_gpt(args.vocab, args.hidden, args.layers, args.heads,
+                           max_pos=max(args.max_seq,
+                                       args.baseline_new + 32))
+    prompt_lens = [int(s) for s in args.prompt_lens.split(",") if s.strip()]
+    loads = [float(x) for x in args.loads.split(",") if x.strip()]
+
+    def make_engine():
+        return LLMEngine(model, LLMEngineConfig(
+            num_slots=args.num_slots, max_seq=args.max_seq,
+            max_queue=max(1024, args.requests),
+            default_max_new_tokens=args.max_new),
+            registry=StatRegistry())
+
+    engine = make_engine() if args.share_engine else None
+    sweeps = []
+    for i, qps in enumerate(loads):
+        eng = engine if engine is not None else make_engine()
+        if engine is not None:
+            eng.registry.reset()
+        sweeps.append(run_sweep(eng, args.requests, qps, prompt_lens,
+                                args.max_new, args.vocab, seed=i))
+        if engine is None:
+            eng.drain()
+    if engine is not None:
+        engine.drain()
+
+    doc = {"bench": "llm-serving", "model": "synthetic-gpt",
+           "vocab": args.vocab, "hidden": args.hidden,
+           "layers": args.layers, "heads": args.heads,
+           "num_slots": args.num_slots, "max_seq": args.max_seq,
+           "max_new": args.max_new,
+           "share_engine": bool(args.share_engine), "sweeps": sweeps}
+    if not args.no_baseline:
+        doc["baseline"] = run_baseline(
+            model, args.baseline_batch,
+            prompt_len=max(prompt_lens), new_tokens=args.baseline_new,
+            vocab=args.vocab)
+    json.dump(doc, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
