@@ -1,0 +1,100 @@
+"""Auditable-entrypoint registry for the trace-level analyzer.
+
+The AST tier of ``tools.analyze`` sees source text; the trace tier
+(PTA009/PTA010) needs *programs*: the actual step functions the framework
+jits, plus representative arguments to trace them with. Runtime modules
+register those here at import time — cheaply, as lazy factories — and
+``tools/analyze/trace`` imports this module (under ``JAX_PLATFORMS=cpu``)
+to enumerate them.
+
+An entrypoint factory returns an :class:`AuditSpec`:
+
+- ``fn`` — the RAW (un-jitted) python step function. The auditor wraps it
+  in its own counting ``jax.jit`` so trace counts are observable.
+- ``make_args(variant)`` — builds a FRESH tuple of positional arguments
+  for the call. ``variant`` (0 or 1) must perturb array *values* but keep
+  every shape/dtype/static identical: a correct entrypoint traces once
+  across variants; a retrace is a PTA010 finding. Fresh arrays per call
+  matter because ``jit_kwargs`` may donate input buffers.
+- ``jit_kwargs`` — the kwargs production code passes to ``jax.jit``
+  (``donate_argnums``, ``static_argnums``, ...), so the audited program
+  is the deployed program.
+- ``tags`` — e.g. ``("train",)`` enables the donated-buffer-opportunity
+  check; ``("serving",)`` marks latency paths.
+
+Registration is import-time metadata only: nothing is built until the
+auditor calls the factory, so production imports stay fast.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+@dataclass
+class AuditSpec:
+    """One concrete auditable program, built lazily by a factory."""
+    fn: Callable
+    make_args: Callable[[int], tuple]
+    jit_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class AuditEntrypoint:
+    name: str
+    factory: Callable[[], AuditSpec]
+    tags: Tuple[str, ...] = ()
+    path: str = ""    # repo-relative posix path of the registration site
+    line: int = 0
+
+    def build(self) -> AuditSpec:
+        return self.factory()
+
+
+_REGISTRY: Dict[str, AuditEntrypoint] = {}
+
+
+def _site_of(factory) -> Tuple[str, int]:
+    """repo-relative path + line of the factory definition, so trace
+    findings anchor to the code that registered the entrypoint."""
+    try:
+        src = inspect.getsourcefile(factory)
+        line = factory.__code__.co_firstlineno
+    except (TypeError, AttributeError):
+        return "", 0
+    if not src:
+        return "", 0
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    rel = os.path.relpath(os.path.abspath(src), root)
+    return rel.replace(os.sep, "/"), line
+
+
+def register_entrypoint(name: str, factory: Callable[[], AuditSpec],
+                        tags: Tuple[str, ...] = (),
+                        path: Optional[str] = None,
+                        line: Optional[int] = None) -> None:
+    """Idempotent: re-registering a name replaces the entry (module
+    reloads in tests)."""
+    auto_path, auto_line = _site_of(factory)
+    _REGISTRY[name] = AuditEntrypoint(
+        name=name, factory=factory, tags=tuple(tags),
+        path=path if path is not None else auto_path,
+        line=line if line is not None else auto_line)
+
+
+def entrypoints() -> Dict[str, AuditEntrypoint]:
+    return dict(_REGISTRY)
+
+
+def load_default_entrypoints() -> Dict[str, AuditEntrypoint]:
+    """Import every module that registers an auditable entrypoint and
+    return the populated registry. Safe to call repeatedly."""
+    # each import triggers the module-level register_entrypoint() calls
+    from ..hapi import model as _hapi_model            # noqa: F401
+    from ..static import executor as _executor         # noqa: F401
+    from ..serving import engine as _engine            # noqa: F401
+    from ..serving.llm import decode as _decode        # noqa: F401
+    return entrypoints()

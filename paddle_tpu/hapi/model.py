@@ -842,3 +842,63 @@ def summary(net: Layer, input_size=None, dtypes=None):
     print(f"Non-trainable params: {total - trainable}")
     print("-" * 64)
     return {"total_params": total, "trainable_params": trainable}
+
+
+# -- trace-audit registration (tools/analyze/trace, PTA009/PTA010) -----------
+
+def _audit_hapi_train_spec():
+    """The fused hapi train step (fwd + grad + optimizer update, donated
+    param/opt buffers) built by Model._build_train_step on a tiny Linear
+    regression — the production step-compilation path, minimally sized."""
+    import numpy as np
+    from ..core import audit
+    from ..core.tensor import stable_uid
+    from .. import nn, optimizer as optim
+    from .. import ops as _ops
+
+    net = nn.Linear(5, 2)
+    model = Model(net)
+
+    def mse(pred, y):
+        return _ops.mean((pred - y) ** 2)
+
+    opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=mse)
+    x_shape, y_shape = (4, 5), (4, 2)
+    sig = ((((x_shape), "float32"), ((y_shape), "float32")), False)
+    ts = model._get_train_step(sig)
+    for p in ts["trainable"]:
+        if stable_uid(p) not in opt._state:
+            opt._state[stable_uid(p)] = opt._init_state(p)
+    base_train = [np.asarray(p._data)  # noqa: PTA002 -- audit-factory setup: one-time host snapshot of the init params, not a step-path sync
+                  for p in ts["trainable"]]
+    base_fixed = [np.asarray(ts["state"][i]._data)  # noqa: PTA002 -- audit-factory setup: one-time host snapshot, not a step-path sync
+                  for i in ts["fixed_pos"]]
+    base_states = jax.tree_util.tree_map(
+        np.asarray, [opt._state[stable_uid(p)] for p in ts["trainable"]])
+
+    def make_args(variant):
+        # fresh arrays per call: donate_argnums=(0, 2) consumes them
+        rng = np.random.default_rng(5 + variant)
+        train_raws = [jnp.asarray(b) for b in base_train]
+        fixed_raws = [jnp.asarray(b) for b in base_fixed]
+        opt_states = jax.tree_util.tree_map(jnp.asarray, base_states)
+        x_raws = [jnp.asarray(rng.standard_normal(x_shape), jnp.float32)]
+        y_raws = [jnp.asarray(rng.standard_normal(y_shape), jnp.float32)]
+        key = jax.random.PRNGKey(variant)
+        lr = jnp.asarray(0.1, jnp.float32)
+        step_no = jnp.asarray(1.0, jnp.float32)
+        return (train_raws, fixed_raws, opt_states, x_raws, y_raws, key,
+                lr, step_no)
+
+    return audit.AuditSpec(fn=ts["raw_step"], make_args=make_args,
+                           jit_kwargs={"donate_argnums": (0, 2)})
+
+
+def _register_audit_entrypoints():
+    from ..core import audit
+    audit.register_entrypoint("hapi_train_step", _audit_hapi_train_spec,
+                              tags=("train",))
+
+
+_register_audit_entrypoints()

@@ -142,7 +142,11 @@ class StaticFunction:
                 gs, gi = vjp(tuple(cots))
                 return list(gs) + list(gi)
             bwd_jit = jax.jit(bwd, static_argnums=(5,))
-            entry = {"fwd": fwd_jit, "bwd": bwd_jit, "meta": meta}
+            # py_fn: raw un-jitted fwd, kept for the trace auditor
+            # (tools/analyze/trace) so it can re-jit under a trace counter
+            entry = {"fwd": fwd_jit, "bwd": bwd_jit, "meta": meta,
+                     "py_fn": fwd,
+                     "jit_kwargs": {"static_argnums": (3,)}}
             self._cache[key] = entry
         meta = entry["meta"]
 

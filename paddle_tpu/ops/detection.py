@@ -646,11 +646,11 @@ def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
             # scores [A,H,W] / deltas [4A,H,W] flatten in (H,W,A) order to
             # line up with anchor_generator's [H,W,A,4] layout (reference
             # transposes with axis{0,2,3,1} the same way)
-            if s.ndim == 3:
+            if s.ndim == 3:  # noqa: PTA008 -- rank dispatch between the reference's two documented score layouts; rank is fixed per op signature, not per batch
                 s_f = jnp.transpose(s, (1, 2, 0)).reshape(-1)
             else:
                 s_f = s.reshape(-1)
-            if d.ndim == 3:
+            if d.ndim == 3:  # noqa: PTA008 -- same two-layout rank dispatch for deltas; both forms are traced deliberately
                 d_r = d.reshape(-1, 4, d.shape[-2], d.shape[-1])
                 d_f = jnp.transpose(d_r, (2, 3, 0, 1)).reshape(-1, 4)
             else:

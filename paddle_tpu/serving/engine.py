@@ -389,3 +389,44 @@ class Engine(DrainableEngineBase):
                 "latency_ms", (time.monotonic() - req.t_enqueue) * 1000.0)
             self._stat_add("completed", 1)
             req.future.set_result(outs)
+
+
+# -- trace-audit registration (tools/analyze/trace, PTA009/PTA010) -----------
+
+def _audit_serving_predict_spec():
+    """The engine's hot path for a callable model: a functionalized Layer
+    forward jitted per padded signature. Audited on a tiny Linear so the
+    program is small but structurally the production one."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..core import audit
+    from ..jit.functionalize import build_pure
+    from .. import nn
+
+    lin = nn.Linear(6, 3)
+    params = list(lin.parameters())
+    pure, _meta = build_pure(lin.forward, params)
+    base_params = [np.asarray(p._data) for p in params]
+
+    def predict(param_raws, x, key):
+        # static_kwargs pinned to None: the serving engine calls the
+        # forward with positional arrays only
+        return pure(list(param_raws), (x,), key, None)
+
+    def make_args(variant):
+        rng = np.random.default_rng(77 + variant)
+        param_raws = [jnp.asarray(b) for b in base_params]
+        x = jnp.asarray(rng.standard_normal((4, 6)), jnp.float32)
+        return (param_raws, x, jax.random.PRNGKey(variant))
+
+    return audit.AuditSpec(fn=predict, make_args=make_args, jit_kwargs={})
+
+
+def _register_audit_entrypoints():
+    from ..core import audit
+    audit.register_entrypoint("serving_predict", _audit_serving_predict_spec,
+                              tags=("serving",))
+
+
+_register_audit_entrypoints()

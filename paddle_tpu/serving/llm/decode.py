@@ -186,29 +186,18 @@ def _block_prefill(spec, lp, h, mask, scale):
 
 # -- the compiled programs ---------------------------------------------------
 
-@functools.lru_cache(maxsize=64)
-def get_decode_step(spec: GPTDecodeSpec, max_top_k: int):
-    """THE decode step: jitted once per (spec, max_top_k); each distinct
-    (num_slots, max_seq) shape pair traces exactly once (the attached
-    ``trace_counter["traces"]`` counts Python-body executions == XLA
-    traces — the compile-counter tests assert it stays flat after warmup).
+def build_decode_step(spec: GPTDecodeSpec, max_top_k: int):
+    """The RAW (un-jitted) decode step — the auditable program.
 
-    step(params, kbuf, vbuf, lengths, finished, last_tokens,
-         temperature, top_k, do_sample, eos, key)
-      -> (kbuf, vbuf, lengths+1, finished, next_tokens)
-
-    All slots advance unconditionally (inactive slots compute masked
-    garbage that the scheduler discards — uniform shapes are what keep the
-    program unique); per-slot eos semantics match the reference generate:
-    finished rows keep emitting their eos token.
+    Split out of :func:`get_decode_step` so the trace auditor
+    (tools/analyze/trace, PTA009/PTA010) can wrap the same function in its
+    own counting jit without disturbing the production lru-cached wrapper.
     """
-    counter = {"traces": 0}
     scale = 1.0 / np.sqrt(spec.head_dim)
     max_pos = spec.max_position_embeddings
 
     def _step(params, kbuf, vbuf, lengths, finished, last_tokens,
               temperature, top_k, do_sample, eos, key):
-        counter["traces"] += 1
         max_seq = kbuf.shape[2]
         positions = lengths                       # write position per slot
         posc = jnp.clip(positions, 0, max_pos - 1)
@@ -229,35 +218,43 @@ def get_decode_step(spec: GPTDecodeSpec, max_top_k: int):
         finished = finished | ((nxt == eos) & (eos >= 0))
         return kbuf, vbuf, lengths + 1, finished, nxt
 
+    return _step
+
+
+@functools.lru_cache(maxsize=64)
+def get_decode_step(spec: GPTDecodeSpec, max_top_k: int):
+    """THE decode step: jitted once per (spec, max_top_k); each distinct
+    (num_slots, max_seq) shape pair traces exactly once (the attached
+    ``trace_counter["traces"]`` counts Python-body executions == XLA
+    traces — the compile-counter tests assert it stays flat after warmup).
+
+    step(params, kbuf, vbuf, lengths, finished, last_tokens,
+         temperature, top_k, do_sample, eos, key)
+      -> (kbuf, vbuf, lengths+1, finished, next_tokens)
+
+    All slots advance unconditionally (inactive slots compute masked
+    garbage that the scheduler discards — uniform shapes are what keep the
+    program unique); per-slot eos semantics match the reference generate:
+    finished rows keep emitting their eos token.
+    """
+    counter = {"traces": 0}
+    raw = build_decode_step(spec, max_top_k)
+
+    def _step(*args):
+        counter["traces"] += 1
+        return raw(*args)
+
     fn = jax.jit(_step)
     fn.trace_counter = counter
     return fn
 
 
-@functools.lru_cache(maxsize=64)
-def get_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
-    """Bucketed prefill: run the whole (right-padded) prompt batch through
-    the causal stack, write its K/V into the target slots, set their
-    lengths, and sample the first generated token. One trace per
-    (batch, prompt_bucket) shape — a small closed set when prompts are
-    padded to buckets.
-
-    prefill(params, tokens[B, Lp], true_lens[B], kbuf, vbuf, lengths,
-            finished, slot_ids[B], temperature[B], top_k[B], do_sample[B],
-            eos[B], key)
-      -> (kbuf, vbuf, lengths, finished, next_tokens[B])
-
-    Right-padding is safe under the causal mask: real position i only
-    attends j <= i < true_len, and the junk K/V written at
-    [true_len, Lp) is masked by the slot length until later tokens
-    overwrite it.
-    """
-    counter = {"traces": 0}
+def build_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
+    """The RAW (un-jitted) prefill — see :func:`build_decode_step`."""
     scale = 1.0 / np.sqrt(spec.head_dim)
 
     def _prefill(params, tokens, true_lens, kbuf, vbuf, lengths, finished,
                  slot_ids, temperature, top_k, do_sample, eos, key):
-        counter["traces"] += 1
         b, lp_len = tokens.shape
         pos = jnp.arange(lp_len, dtype=jnp.int32)
         h = params["tok"][tokens] + params["pos"][pos][None]   # [B, L, E]
@@ -281,6 +278,34 @@ def get_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
         nxt = _sample(lraw, temperature, top_k, do_sample, key, max_top_k)
         finished = finished.at[slot_ids].set((nxt == eos) & (eos >= 0))
         return kbuf, vbuf, lengths, finished, nxt
+
+    return _prefill
+
+
+@functools.lru_cache(maxsize=64)
+def get_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
+    """Bucketed prefill: run the whole (right-padded) prompt batch through
+    the causal stack, write its K/V into the target slots, set their
+    lengths, and sample the first generated token. One trace per
+    (batch, prompt_bucket) shape — a small closed set when prompts are
+    padded to buckets.
+
+    prefill(params, tokens[B, Lp], true_lens[B], kbuf, vbuf, lengths,
+            finished, slot_ids[B], temperature[B], top_k[B], do_sample[B],
+            eos[B], key)
+      -> (kbuf, vbuf, lengths, finished, next_tokens[B])
+
+    Right-padding is safe under the causal mask: real position i only
+    attends j <= i < true_len, and the junk K/V written at
+    [true_len, Lp) is masked by the slot length until later tokens
+    overwrite it.
+    """
+    counter = {"traces": 0}
+    raw = build_prefill_fn(spec, max_top_k)
+
+    def _prefill(*args):
+        counter["traces"] += 1
+        return raw(*args)
 
     fn = jax.jit(_prefill)
     fn.trace_counter = counter
@@ -368,3 +393,94 @@ class GPTStaticDecoder:
             *samp_vecs, key)
         kv.swap(k, v, lengths)
         return nxt, finished
+
+
+# -- trace-audit registration (tools/analyze/trace, PTA009/PTA010) -----------
+
+_AUDIT_SPEC = GPTDecodeSpec(vocab_size=32, hidden_size=8, num_layers=1,
+                            num_heads=2, max_position_embeddings=64)
+_AUDIT_TOP_K = 4
+
+
+def _audit_params(rng):
+    """A synthetic tiny GPT parameter pytree matching extract_gpt_params'
+    layout; values vary with the rng so PTA010's perturbed variants share
+    shapes but not data."""
+    spec = _AUDIT_SPEC
+    e, v, p = spec.hidden_size, spec.vocab_size, spec.max_position_embeddings
+
+    def arr(*shape):
+        return jnp.asarray(rng.standard_normal(shape) * 0.02, jnp.float32)
+
+    layer = {
+        "qw": arr(e, e), "qb": arr(e), "kw": arr(e, e), "kb": arr(e),
+        "vw": arr(e, e), "vb": arr(e), "ow": arr(e, e), "ob": arr(e),
+        "w1": arr(e, 4 * e), "b1": arr(4 * e), "w2": arr(4 * e, e),
+        "b2": arr(e), "n1w": arr(e), "n1b": arr(e), "n2w": arr(e),
+        "n2b": arr(e),
+    }
+    return {"tok": arr(v, e), "pos": arr(p, e), "fnw": arr(e),
+            "fnb": arr(e), "layers": (layer,)}
+
+
+def _audit_decode_spec():
+    from ...core import audit
+    spec = _AUDIT_SPEC
+    slots, max_seq, layers = 2, 16, spec.num_layers
+    hd = spec.head_dim
+
+    def make_args(variant):
+        rng = np.random.default_rng(1234 + variant)
+        kv_shape = (slots, layers, max_seq, spec.num_heads, hd)
+        return (_audit_params(rng),
+                jnp.zeros(kv_shape, jnp.float32),
+                jnp.zeros(kv_shape, jnp.float32),
+                jnp.asarray([3, 1], jnp.int32),           # lengths
+                jnp.zeros((slots,), bool),                # finished
+                jnp.asarray(rng.integers(0, spec.vocab_size, slots),
+                            jnp.int32),                   # last_tokens
+                jnp.ones((slots,), jnp.float32),          # temperature
+                jnp.zeros((slots,), jnp.int32),           # top_k
+                jnp.zeros((slots,), bool),                # do_sample
+                jnp.full((slots,), -1, jnp.int32),        # eos
+                jax.random.PRNGKey(variant))
+    return audit.AuditSpec(fn=build_decode_step(spec, _AUDIT_TOP_K),
+                           make_args=make_args)
+
+
+def _audit_prefill_spec():
+    from ...core import audit
+    spec = _AUDIT_SPEC
+    slots, max_seq, layers, b, lp = 2, 16, spec.num_layers, 2, 4
+    hd = spec.head_dim
+
+    def make_args(variant):
+        rng = np.random.default_rng(4321 + variant)
+        kv_shape = (slots, layers, max_seq, spec.num_heads, hd)
+        return (_audit_params(rng),
+                jnp.asarray(rng.integers(0, spec.vocab_size, (b, lp)),
+                            jnp.int32),                   # tokens
+                jnp.asarray([lp, lp - 1], jnp.int32),     # true_lens
+                jnp.zeros(kv_shape, jnp.float32),
+                jnp.zeros(kv_shape, jnp.float32),
+                jnp.zeros((slots,), jnp.int32),           # lengths
+                jnp.zeros((slots,), bool),                # finished
+                jnp.asarray([0, 1], jnp.int32),           # slot_ids
+                jnp.ones((b,), jnp.float32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), bool),
+                jnp.full((b,), -1, jnp.int32),
+                jax.random.PRNGKey(100 + variant))
+    return audit.AuditSpec(fn=build_prefill_fn(spec, _AUDIT_TOP_K),
+                           make_args=make_args)
+
+
+def _register_audit_entrypoints():
+    from ...core import audit
+    audit.register_entrypoint("llm_decode_step", _audit_decode_spec,
+                              tags=("serving", "decode"))
+    audit.register_entrypoint("llm_prefill", _audit_prefill_spec,
+                              tags=("serving", "prefill"))
+
+
+_register_audit_entrypoints()

@@ -207,7 +207,10 @@ class Executor:
                 _replay(program, env, param_env)
                 effects = [env[id(v)] for _, v in program._state_effects]
                 return fetch_from(env, param_env), effects
-            return jax.jit(infer_step)
+            jitted = jax.jit(infer_step)
+            jitted.raw_step = infer_step  # trace-audit hook (core.audit)
+            jitted.audit_jit_kwargs = {}
+            return jitted
 
         trainable = [p for p in params if not p.stop_gradient]
 
@@ -232,7 +235,10 @@ class Executor:
                 gmap = {id(p): g for p, g in zip(trainable, grads)}
                 effects = [env[id(v)] for _, v in program._state_effects]
                 return fetch_from(env, param_env, gmap), effects
-            return jax.jit(grad_step)
+            jitted = jax.jit(grad_step)
+            jitted.raw_step = grad_step  # trace-audit hook (core.audit)
+            jitted.audit_jit_kwargs = {}
+            return jitted
 
         optimizer = opt
         reg_coeffs = [optimizer._regularized_grad(p, None) for p in trainable]
@@ -273,4 +279,77 @@ class Executor:
             return (fetch_from(env, param_env, gmap), new_params, new_states,
                     effects)
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        jitted = jax.jit(train_step, donate_argnums=(0, 1))
+        # trace-audit hook: the auditor (tools/analyze/trace) re-jits the
+        # RAW step under its own trace counter with the same jit kwargs,
+        # so the audited program is exactly the deployed one
+        jitted.raw_step = train_step
+        jitted.audit_jit_kwargs = {"donate_argnums": (0, 1)}
+        return jitted
+
+
+# -- trace-audit registration (tools/analyze/trace, PTA009/PTA010) -----------
+
+def _audit_executor_train_spec():
+    """A minimal static Program (Linear + MSE + SGD.minimize) compiled by
+    the real Executor; the audited fn is the raw train_step the Executor
+    jits with donated param/opt buffers."""
+    from ..core import audit
+    from ..ops.dispatch import enable_static, disable_static
+    from .. import nn, optimizer as optim
+    import paddle_tpu as paddle
+    from .graph import Program, program_guard, data
+
+    enable_static()
+    try:
+        main, startup = Program(), Program()
+        with program_guard(main, startup):
+            x = data("x", [4, 3], "float32")
+            y = data("y", [4, 1], "float32")
+            lin = nn.Linear(3, 1)
+            pred = lin(x)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = optim.SGD(0.1)
+            opt.minimize(loss)
+        exe = Executor()
+        exe.run(startup)
+        feed_names = ("x", "y")
+        params = main.all_parameters()
+        opt_obj = main._optimizer
+        feed_vals = {"x": jnp.zeros((4, 3), jnp.float32),
+                     "y": jnp.zeros((4, 1), jnp.float32)}
+        entry = exe._compile(main, feed_names, [loss], params, opt_obj,
+                             feed_vals)
+        for p in params:
+            if stable_uid(p) not in opt_obj._state:
+                opt_obj._state[stable_uid(p)] = opt_obj._init_state(p)
+        base_params = [np.asarray(p._data) for p in params]
+        base_states = jax.tree_util.tree_map(
+            np.asarray, [opt_obj._state[stable_uid(p)] for p in params])
+    finally:
+        disable_static()
+
+    def make_args(variant):
+        # fresh arrays every call: donate_argnums=(0, 1) consumes them
+        rng = np.random.default_rng(11 + variant)
+        param_raws = [jnp.asarray(b) for b in base_params]
+        opt_states = jax.tree_util.tree_map(jnp.asarray, base_states)
+        feeds = [jnp.asarray(rng.standard_normal((4, 3)), jnp.float32),
+                 jnp.asarray(rng.standard_normal((4, 1)), jnp.float32)]
+        lr = jnp.asarray(0.1, jnp.float32)
+        step_no = jnp.asarray(1.0, jnp.float32)
+        return (param_raws, opt_states, feeds, lr, step_no)
+
+    from ..core import audit as _audit
+    return _audit.AuditSpec(fn=entry.raw_step, make_args=make_args,
+                            jit_kwargs=dict(entry.audit_jit_kwargs))
+
+
+def _register_audit_entrypoints():
+    from ..core import audit
+    audit.register_entrypoint("executor_train_step",
+                              _audit_executor_train_spec,
+                              tags=("train", "static"))
+
+
+_register_audit_entrypoints()

@@ -346,8 +346,42 @@ def test_driver_rule_selection(tmp_path):
 
 def test_all_rules_have_distinct_codes():
     codes = [r.code for r in ALL_RULES]
-    assert len(codes) == len(set(codes)) == 7
+    assert len(codes) == len(set(codes)) == 10
     assert codes == sorted(codes)
+
+
+def test_trace_tier_rules_are_not_in_the_default_selection():
+    """PTA009/PTA010 compile registered entrypoints — they must only run
+    when named explicitly via --only/--rule."""
+    import argparse
+
+    from tools.analyze.__main__ import select_rules
+
+    ns = argparse.Namespace(only=None, skip=[])
+    default_codes = {r.code for r in select_rules(ns)}
+    assert "PTA008" in default_codes
+    assert "PTA009" not in default_codes
+    assert "PTA010" not in default_codes
+    for r in ALL_RULES:
+        assert r.tier in ("ast", "trace"), r.code
+        assert (r.tier == "trace") == (r.code in ("PTA009", "PTA010"))
+
+    ns = argparse.Namespace(only=["PTA009,PTA010"], skip=["PTA010"])
+    assert [r.code for r in select_rules(ns)] == ["PTA009"]
+
+
+def test_only_flag_comma_and_repeat_forms(tmp_path):
+    (tmp_path / "paddle_tpu" / "ops").mkdir(parents=True)
+    (tmp_path / "paddle_tpu" / "ops" / "m.py").write_text(
+        "import numpy as np\n\n\ndef op(x):\n    return np.asarray(x)\n")
+    proc = _driver(["--root", str(tmp_path), "--baseline", "none",
+                    "--only", "PTA002,PTA003", "--json", "paddle_tpu"])
+    assert json.loads(proc.stdout)["rules"] == ["PTA002", "PTA003"]
+    proc = _driver(["--root", str(tmp_path), "--baseline", "none",
+                    "--only", "PTA002", "--skip", "PTA002", "--json",
+                    "paddle_tpu"])
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["rules"] == []
 
 
 # -- acceptance gates ---------------------------------------------------------
@@ -397,3 +431,164 @@ def test_seeded_tracer_leak_in_scratch_copy_fails_the_gate(tmp_path):
     # the seed can also pull existing methods named `item` into the
     # reachable set (name-based over-approximation); nothing else may leak
     assert all(f["rule"] == "PTA001" for f in new), new
+
+
+# -- PTA008 recompile risk ----------------------------------------------------
+
+SHAPE_BRANCH = """\
+    import jax
+
+    @jax.jit
+    def entry(x):
+        if x.shape[0] > 8:
+            return x * 2
+        return x
+
+    def helper(d):
+        # rank dispatch in a shared helper is deliberate — not flagged
+        if d.ndim == 3:
+            return d[0]
+        return d
+"""
+
+
+def test_pta008_flags_shape_branch_in_jit_entry_only(tmp_path):
+    _, fs = _run(tmp_path, {"paddle_tpu/m.py": SHAPE_BRANCH}, ["PTA008"])
+    assert len(fs) == 1
+    assert fs[0].severity == "warning"
+    assert "x.shape" in fs[0].message and "entry" in fs[0].message
+
+
+SHAPE_WHILE = """\
+    import jax
+
+    @jax.jit
+    def entry(x):
+        return helper(x)
+
+    def helper(x):
+        while x.shape[0] > 1:
+            x = x[::2]
+        return x
+"""
+
+
+def test_pta008_while_on_shape_is_an_error_anywhere_reachable(tmp_path):
+    _, fs = _run(tmp_path, {"paddle_tpu/m.py": SHAPE_WHILE}, ["PTA008"])
+    assert len(fs) == 1
+    assert fs[0].severity == "error"
+    assert "unrolls at trace time" in fs[0].message
+
+
+JIT_IN_LOOP = """\
+    import jax
+
+    def sweep(fns, x):
+        outs = []
+        for f in fns:
+            outs.append(jax.jit(f)(x))
+        return outs
+
+    def fallback(f, x):
+        while True:  # single-pass "try" idiom — not flagged
+            g = jax.jit(f)
+            break
+        return g(x)
+"""
+
+
+def test_pta008_jit_in_loop_error_but_single_pass_idiom_ok(tmp_path):
+    _, fs = _run(tmp_path, {"paddle_tpu/m.py": JIT_IN_LOOP}, ["PTA008"])
+    assert len(fs) == 1
+    assert fs[0].severity == "error"
+    assert "fresh traced function every iteration" in fs[0].message
+    assert fs[0].line == 6
+
+
+STATIC_ARGS = """\
+    import jax
+
+    def make(f, n):
+        return jax.jit(f, static_argnums=tuple(range(n)))  # computed
+
+    g = jax.jit(lambda x, cfg: x, static_argnums=(1,))
+
+
+    def call():
+        return g(1.0, {"k": 2})  # unhashable dict in a static slot
+"""
+
+
+def test_pta008_static_argnums_hygiene(tmp_path):
+    _, fs = _run(tmp_path, {"paddle_tpu/m.py": STATIC_ARGS}, ["PTA008"])
+    assert len(fs) == 2
+    assert all(f.severity == "error" for f in fs)
+    msgs = " | ".join(f.message for f in fs)
+    assert "computed static_argnums" in msgs
+    assert "unhashable dict" in msgs
+
+
+SCALAR_FEED = """\
+    import jax
+
+    @jax.jit
+    def step(tok):
+        return tok + 1
+
+    def decode_loop(tok, n):
+        for _ in range(n):
+            tok = step(tok)
+            cur = int(tok.item())  # device sync every token
+        return cur
+
+    def config_loop(cfgs, x):
+        for c in cfgs:
+            x = step(x)
+            scale = float(c)  # host float of a python config — fine
+        return x, scale
+"""
+
+
+def test_pta008_scalar_feed_loop_flags_item_not_config_floats(tmp_path):
+    _, fs = _run(tmp_path, {"paddle_tpu/m.py": SCALAR_FEED}, ["PTA008"])
+    assert len(fs) == 1
+    assert fs[0].severity == "warning"
+    assert ".item()" in fs[0].message or "int()" in fs[0].message
+    assert fs[0].line == 10
+
+
+def test_pta008_repo_run_is_clean():
+    proc = _driver(["--only", "PTA008", "--strict", "--baseline", "none",
+                    "paddle_tpu", "tools"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- noqa justification policing (PTA005) -------------------------------------
+
+NOQA_HOT = """\
+    def f(x):
+        a = x.numpy()  # noqa: PTA002 -- boundary: converting for host metrics
+        b = x.numpy()  # noqa: PTA002
+        c = x.numpy()  # noqa
+        return a, b, c
+"""
+
+
+def test_pta005_requires_justified_noqa_in_hot_paths(tmp_path):
+    _, fs = _run(tmp_path, {"paddle_tpu/ops/m.py": NOQA_HOT},
+                 ["PTA005"])
+    project = _mini(tmp_path, {"paddle_tpu/ops/m.py": NOQA_HOT})
+    findings = run_rules(project, [RULES["PTA005"]])
+    kept, suppressed = filter_noqa(project, findings)
+    # line 2 is justified; line 3 (bare code) and line 4 (blanket) are
+    # PTA005 findings that the noqa comments themselves cannot suppress
+    assert len(kept) == 2, [f.message for f in kept]
+    assert {f.line for f in kept} == {3, 4}
+    assert all(f.rule == "PTA005" for f in kept)
+
+
+def test_pta005_noqa_policing_only_in_hot_prefixes(tmp_path):
+    project = _mini(tmp_path, {"paddle_tpu/utils/m.py": NOQA_HOT})
+    findings = run_rules(project, [RULES["PTA005"]])
+    kept, _ = filter_noqa(project, findings)
+    assert kept == []

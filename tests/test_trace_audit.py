@@ -1,0 +1,331 @@
+"""Tier-1 tests for the trace-level audit (tools/analyze/trace +
+PTA009/PTA010).
+
+Three layers:
+
+- pure passes against hand-built jaxprs/HLO text (no registry, fast);
+- seeded :class:`AuditSpec` fixtures proving each trace check fires on
+  its bug class (retrace, host transfer, captured large constant, missed
+  donation) and stays quiet on the corrected program;
+- the acceptance negatives: the repo's REGISTERED entrypoints — the
+  PR-6 static decode step, serving predict, the donated-buffer Executor
+  train step — audit clean with exactly one trace each.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax                                              # noqa: E402
+import jax.numpy as jnp                                 # noqa: E402
+
+from paddle_tpu.core.audit import (AuditSpec,           # noqa: E402
+                                   load_default_entrypoints)
+from tools.analyze import trace as trace_mod            # noqa: E402
+from tools.analyze.trace import (EntrypointStats,       # noqa: E402
+                                 TraceReport, audit_spec, passes,
+                                 run_audit)
+from tools.analyze.rules.pta009_trace_fusion import (   # noqa: E402
+    RULE as PTA009)
+from tools.analyze.rules.pta010_retrace_sentinel import (  # noqa: E402
+    RULE as PTA010)
+
+
+# -- pure passes --------------------------------------------------------------
+
+HLO_SNIPPET = """\
+HloModule jit_step
+
+%fused_computation (param_0: f32[4,2]) -> f32[4,2] {
+  %param_0 = f32[4,2]{1,0} parameter(0)
+  ROOT %multiply.1 = f32[4,2]{1,0} multiply(%param_0, %param_0)
+}
+
+ENTRY %main (p0: f32[4,2]) -> f32[4,2] {
+  %p0 = f32[4,2]{1,0} parameter(0)
+  %copy.2 = f32[4,2]{0,1} copy(%p0)
+  %fusion.1 = f32[4,2]{1,0} fusion(%copy.2), kind=kLoop
+  %custom-call.3 = f32[4,2]{1,0} custom-call(%fusion.1), custom_call_target="x"
+  ROOT %copy.4 = f32[4,2]{1,0} copy(%custom-call.3)
+}
+"""
+
+
+def test_parse_hlo_stats_counts_opcodes():
+    stats = passes.parse_hlo_stats(HLO_SNIPPET)
+    assert stats["copies"] == 2
+    assert stats["fusions"] == 1
+    assert stats["custom_calls"] == 1
+    # parameter(...) lines count as instructions too
+    assert stats["instructions"] >= 6
+    assert stats["host_transfers"] == 0
+
+
+def test_scan_transfers_sees_device_put_and_callbacks():
+    def with_dp(x):
+        return jax.device_put(x) + 1.0
+
+    def with_cb(x):
+        y = jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1.0
+
+    def clean(x):
+        return x * 2.0 + 1.0
+
+    x = jnp.zeros((3,))
+    assert passes.scan_transfers(jax.make_jaxpr(with_dp)(x)) \
+        == ["device_put"]
+    assert "pure_callback" in passes.scan_transfers(
+        jax.make_jaxpr(with_cb)(x))
+    assert passes.scan_transfers(jax.make_jaxpr(clean)(x)) == []
+
+
+def test_scan_large_consts_flags_captured_tensor_in_loop_body():
+    big = jnp.ones((200, 200))  # 40000 elements > 16384 threshold
+
+    def leaky(x):
+        def body(c, _):
+            return c + big.sum(), None
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    def fixed(x, table):
+        def body(c, _):
+            return c + table.sum(), None
+        out, _ = jax.lax.scan(body, x, None, length=3)
+        return out
+
+    hits = passes.scan_large_consts(jax.make_jaxpr(leaky)(jnp.asarray(0.)))
+    assert len(hits) == 1
+    assert hits[0]["control_flow"] == "scan"
+    assert hits[0]["elements"] == 40000
+    # same table passed as an ARGUMENT is not a captured const
+    assert passes.scan_large_consts(
+        jax.make_jaxpr(fixed)(jnp.asarray(0.), big)) == []
+
+
+def test_donation_opportunities_matches_in_out_avals():
+    def train_ish(params, x):
+        g = x.sum()
+        return [p - 0.1 * g for p in params]
+
+    closed = jax.make_jaxpr(train_ish)(
+        [jnp.zeros((4, 4)), jnp.zeros((4,))], jnp.ones((8,)))
+    don = passes.donation_opportunities(closed)
+    assert don["donatable_inputs"] == 2
+    assert don["total_inputs"] == 3
+    assert don["donatable_bytes"] == (16 + 4) * 4
+
+
+# -- seeded AuditSpec fixtures -----------------------------------------------
+
+def test_retrace_fixture_fires_and_stable_spec_does_not():
+    # BUG under test: the arg shape depends on the variant, so the second
+    # call misses the jit cache — the class of bug PR 6 fixed by hand
+    leaky = AuditSpec(
+        fn=lambda x: x * 2.0,
+        make_args=lambda v: (jnp.zeros((4 + v, 3), jnp.float32),))
+    st = audit_spec("retrace_fixture", leaky)
+    assert st.error == ""
+    assert st.trace_count == 2
+    assert not st.fingerprint_stable
+
+    stable = AuditSpec(
+        fn=lambda x: x * 2.0,
+        make_args=lambda v: (jnp.full((4, 3), float(v), jnp.float32),))
+    st = audit_spec("stable_fixture", stable)
+    assert st.error == ""
+    assert st.trace_count == 1
+    assert st.fingerprint_stable
+    assert st.hlo["instructions"] > 0
+
+
+def test_host_transfer_fixture_recorded_in_stats():
+    spec = AuditSpec(
+        fn=lambda x: jax.device_put(x) + 1.0,
+        make_args=lambda v: (jnp.full((3,), float(v)),))
+    st = audit_spec("transfer_fixture", spec)
+    assert st.error == ""
+    assert st.transfers == ["device_put"]
+
+
+def test_donation_check_only_runs_for_undonated_train_specs():
+    def step(params, x):
+        return [p - 0.1 * x.sum() for p in params]
+
+    def make_args(v):
+        return ([jnp.zeros((4, 4)), jnp.zeros((4,))],
+                jnp.full((8,), float(v)))
+
+    undonated = audit_spec("train_fixture",
+                           AuditSpec(fn=step, make_args=make_args),
+                           tags=("train",))
+    assert undonated.donation["donatable_inputs"] == 2
+    donated = audit_spec(
+        "train_fixture_donated",
+        AuditSpec(fn=step, make_args=make_args,
+                  jit_kwargs={"donate_argnums": (0,)}),
+        tags=("train",))
+    assert donated.donation is None
+    untagged = audit_spec("infer_fixture",
+                          AuditSpec(fn=step, make_args=make_args))
+    assert untagged.donation is None
+
+
+def test_broken_factory_is_reported_not_raised():
+    class _Exploding:
+        name = "boom"
+        tags = ()
+        path = "paddle_tpu/x.py"
+        line = 1
+
+        def build(self):
+            raise RuntimeError("factory exploded")
+
+    st = trace_mod.audit_entrypoint("boom", _Exploding())
+    assert "factory exploded" in st.error
+    assert st.trace_count == -1
+
+
+# -- rule synthesis: stats -> findings ----------------------------------------
+
+def _report_with(**overrides):
+    st = EntrypointStats(name="ep", tags=("train",),
+                         path="paddle_tpu/x.py", line=7)
+    st.trace_count = 1
+    st.fingerprints = ["aa", "aa"]
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    return TraceReport(platform="cpu", entrypoint_stats={"ep": st})
+
+
+def _findings(rule, report, monkeypatch):
+    monkeypatch.setattr(trace_mod, "_LAST", report)
+    return rule.finalize(None)
+
+
+def test_pta010_findings_from_stats(monkeypatch):
+    fs = _findings(PTA010, _report_with(trace_count=3), monkeypatch)
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "traced 3x" in fs[0].message
+    assert fs[0].path == "paddle_tpu/x.py" and fs[0].line == 7
+    assert fs[0].anchor == "trace:ep:retrace"
+
+    fs = _findings(PTA010, _report_with(fingerprints=["aa", "bb"],
+                                        fingerprint_stable=False),
+                   monkeypatch)
+    assert len(fs) == 1 and "different programs" in fs[0].message
+
+    assert _findings(PTA010, _report_with(), monkeypatch) == []
+
+
+def test_pta009_findings_from_stats(monkeypatch):
+    fs = _findings(PTA009, _report_with(transfers=["device_put"] * 2),
+                   monkeypatch)
+    assert len(fs) == 1 and fs[0].severity == "error"
+    assert "2 `device_put`" in fs[0].message
+
+    fs = _findings(
+        PTA009,
+        _report_with(donation={"donatable_inputs": 4, "total_inputs": 6,
+                               "donatable_bytes": 2 << 20}),
+        monkeypatch)
+    assert len(fs) == 1 and fs[0].severity == "warning"
+    assert "donate_argnums" in fs[0].message
+
+    fs = _findings(
+        PTA009,
+        _report_with(hlo={"instructions": 100, "copies": 30,
+                          "fusions": 5, "custom_calls": 0,
+                          "host_transfers": 0}),
+        monkeypatch)
+    assert len(fs) == 1 and fs[0].severity == "warning"
+    assert "splitting fusions" in fs[0].message
+    # below the 20% ratio or the 50-instruction floor: quiet
+    assert _findings(
+        PTA009,
+        _report_with(hlo={"instructions": 100, "copies": 10}),
+        monkeypatch) == []
+    assert _findings(
+        PTA009,
+        _report_with(hlo={"instructions": 20, "copies": 19}),
+        monkeypatch) == []
+
+    fs = _findings(
+        PTA009,
+        _report_with(large_consts=[{"control_flow": "while",
+                                    "elements": 65536,
+                                    "dtype": "float32",
+                                    "shape": [256, 256]}]),
+        monkeypatch)
+    assert len(fs) == 1 and "65536 elements" in fs[0].message
+
+    assert _findings(PTA009, _report_with(), monkeypatch) == []
+
+
+def test_rules_surface_runner_import_failure(monkeypatch):
+    broken = TraceReport(platform="unavailable", entrypoint_stats={},
+                         error="Traceback ...\nModuleNotFoundError: jax")
+    for rule in (PTA009, PTA010):
+        fs = _findings(rule, broken, monkeypatch)
+        assert len(fs) == 1 and fs[0].severity == "error"
+        assert "ModuleNotFoundError: jax" in fs[0].message
+
+
+# -- acceptance: the registered entrypoints audit clean -----------------------
+
+ACCEPTANCE_ENTRYPOINTS = ("llm_decode_step", "serving_predict",
+                          "executor_train_step")
+
+
+def test_default_registry_names_and_sites():
+    eps = load_default_entrypoints()
+    assert set(ACCEPTANCE_ENTRYPOINTS) <= set(eps)
+    assert {"hapi_train_step", "llm_prefill"} <= set(eps)
+    for ep in eps.values():
+        assert ep.path.startswith("paddle_tpu/"), ep
+        assert ep.line > 0
+
+
+def test_registered_entrypoints_trace_once_and_stay_on_device():
+    report = run_audit(names=list(ACCEPTANCE_ENTRYPOINTS))
+    assert report.error == ""
+    assert set(report.entrypoint_stats) == set(ACCEPTANCE_ENTRYPOINTS)
+    for name, st in report.entrypoint_stats.items():
+        assert st.error == "", f"{name}: {st.error}"
+        assert st.trace_count == 1, \
+            f"{name} traced {st.trace_count}x — jit cache key unstable"
+        assert st.fingerprint_stable, name
+        assert st.transfers == [], name
+        assert st.large_consts == [], name
+        assert st.hlo["instructions"] > 0, name
+    payload = report.stats_payload()
+    assert payload["version"] == 1
+    assert json.dumps(payload)  # must serialize as-is for --trace-report
+
+
+@pytest.mark.slow
+def test_driver_trace_tier_end_to_end(tmp_path):
+    """`--only PTA009,PTA010 --trace-report` over the real repo: exits 0
+    and writes a payload covering every registered entrypoint."""
+    out = tmp_path / "trace_audit.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--strict",
+         "--only", "PTA009,PTA010", "--trace-report", str(out),
+         "paddle_tpu"],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    assert set(payload["entrypoints"]) >= set(ACCEPTANCE_ENTRYPOINTS)
+    for name, st in payload["entrypoints"].items():
+        assert st["trace_count"] == 1, (name, st)

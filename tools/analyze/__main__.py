@@ -38,12 +38,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--root", default=None,
                     help="repo root for relative paths and the baseline "
                          "(default: autodetected from this file)")
-    ap.add_argument("--rule", action="append", default=None,
-                    metavar="PTA###", help="run only these rules "
-                    "(repeatable)")
+    ap.add_argument("--only", "--rule", action="append", default=None,
+                    dest="only", metavar="PTA###[,PTA###]",
+                    help="run only these rules (repeatable or "
+                         "comma-separated). The slow trace tier "
+                         "(PTA009/PTA010, compiles code) ONLY runs when "
+                         "selected here.")
     ap.add_argument("--skip", action="append", default=[],
-                    metavar="PTA###", help="disable these rules "
-                    "(repeatable)")
+                    metavar="PTA###[,PTA###]", help="disable these rules "
+                    "(repeatable or comma-separated)")
     ap.add_argument("--baseline", default=None, metavar="FILE",
                     help=f"baseline file relative to root (default: "
                          f"{DEFAULT_BASELINE}; 'none' disables)")
@@ -62,21 +65,36 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--strict", action="store_true",
                     help="warnings gate the exit code too (default: only "
                          "error-severity findings do)")
+    ap.add_argument("--trace-report", default=None, metavar="FILE",
+                    help="write the trace tier's per-entrypoint audit "
+                         "stats (trace counts, transfers, fusion stats) "
+                         "to FILE as json — requires selecting PTA009 "
+                         "and/or PTA010 via --only")
     ap.add_argument("--list-rules", action="store_true")
     return ap
 
 
+def _split_codes(specs) -> list:
+    out = []
+    for spec in specs or []:
+        out.extend(c.strip() for c in spec.split(",") if c.strip())
+    return out
+
+
 def select_rules(args) -> list:
     by_code = rules_by_code()
-    if args.rule:
-        unknown = [c for c in args.rule if c.upper() not in by_code]
+    only = _split_codes(args.only)
+    if only:
+        unknown = [c for c in only if c.upper() not in by_code]
         if unknown:
             raise SystemExit(f"unknown rule(s): {', '.join(unknown)} "
                              f"(known: {', '.join(sorted(by_code))})")
-        rules = [by_code[c.upper()] for c in args.rule]
+        rules = [by_code[c.upper()] for c in only]
     else:
-        rules = list(ALL_RULES)
-    skip = {c.upper() for c in args.skip}
+        # default run = fast AST tier only; the trace tier compiles every
+        # registered entrypoint and must be opted into explicitly
+        rules = [r for r in ALL_RULES if r.tier == "ast"]
+    skip = {c.upper() for c in _split_codes(args.skip)}
     return [r for r in rules if r.code not in skip]
 
 
@@ -84,7 +102,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for r in ALL_RULES:
-            print(f"{r.code}  {r.name}: {r.description}")
+            tier = "" if r.tier == "ast" else f" [{r.tier} tier]"
+            print(f"{r.code}  {r.name}{tier}: {r.description}")
         return 0
 
     root = os.path.abspath(args.root) if args.root else _repo_root()
@@ -100,6 +119,22 @@ def main(argv=None) -> int:
     findings = run_rules(project, rules)
     findings, suppressed = filter_noqa(project, findings)
 
+    if args.trace_report:
+        from .trace import last_report
+        report = last_report()
+        if report is None:
+            print("--trace-report: no trace-tier rule ran (select PTA009/"
+                  "PTA010 via --only)", file=sys.stderr)
+        else:
+            tr_path = (args.trace_report if os.path.isabs(args.trace_report)
+                       else os.path.join(root, args.trace_report))
+            with open(tr_path, "w") as fh:
+                json.dump(report.stats_payload(), fh, indent=1,
+                          sort_keys=True)
+                fh.write("\n")
+            print(f"wrote trace audit ({len(report.entrypoint_stats)} "
+                  f"entrypoint(s)) to {os.path.relpath(tr_path, root)}")
+
     if args.write_baseline:
         if baseline_path is None:
             print("--write-baseline requires a baseline file", file=sys.stderr)
@@ -111,6 +146,11 @@ def main(argv=None) -> int:
         return 0
 
     baseline = load_baseline(baseline_path) if baseline_path else {}
+    # under --only/--skip, entries from unselected rules are invisible,
+    # not expired — don't report them as stale
+    selected_codes = {r.code for r in rules}
+    baseline = {fp: e for fp, e in baseline.items()
+                if e.get("rule") in selected_codes}
     new, baselined, expired = split_findings(findings, baseline)
     new_ids = {id(x) for x in new}
     # warnings only gate under --strict; errors always do

@@ -25,7 +25,8 @@ from typing import Dict, List, Optional, Tuple
 #: matches an inline suppression: `# noqa` (all rules) or `# noqa: PTA001`
 #: or `# noqa: PTA001,PTA004 -- justification text`
 _NOQA_RE = re.compile(
-    r"#\s*noqa\b(?::\s*(?P<codes>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?",
+    r"#\s*noqa\b(?::\s*(?P<codes>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?"
+    r"(?:\s*--\s*(?P<why>\S.*))?",
     re.IGNORECASE)
 
 _ALL_CODES = "__all__"
@@ -69,10 +70,12 @@ class SourceFile:
                 self.tree = ast.parse(self.text, filename=abspath)
             except SyntaxError as e:
                 self.parse_error = (e.lineno or 0, e.msg or "syntax error")
-        self.noqa: Dict[int, set] = self._parse_noqa()
+        #: line -> suppressed codes; line -> bool(justification present)
+        self.noqa: Dict[int, set] = {}
+        self.noqa_justified: Dict[int, bool] = {}
+        self._parse_noqa()
 
-    def _parse_noqa(self) -> Dict[int, set]:
-        out: Dict[int, set] = {}
+    def _parse_noqa(self):
         for i, ln in enumerate(self.lines, 1):
             if "noqa" not in ln:
                 continue
@@ -81,10 +84,10 @@ class SourceFile:
                 continue
             codes = m.group("codes")
             if codes:
-                out[i] = {c.strip().upper() for c in codes.split(",")}
+                self.noqa[i] = {c.strip().upper() for c in codes.split(",")}
             else:
-                out[i] = {_ALL_CODES}
-        return out
+                self.noqa[i] = {_ALL_CODES}
+            self.noqa_justified[i] = bool(m.group("why"))
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -106,7 +109,16 @@ class SourceFile:
 
     def is_suppressed(self, f: Finding) -> bool:
         codes = self.noqa.get(f.line)
-        return bool(codes) and (_ALL_CODES in codes or f.rule in codes)
+        if not codes:
+            return False
+        if f.rule in codes:
+            return True
+        # A blanket codeless `# noqa` suppresses everything EXCEPT findings
+        # about the noqa comment itself (anchor "noqa-hygiene:*") — a bare
+        # suppression must not be able to silence the rule that polices
+        # bare suppressions.
+        return (_ALL_CODES in codes
+                and not f.anchor.startswith("noqa-hygiene:"))
 
 
 class Project:
@@ -253,6 +265,206 @@ def write_baseline(path: str, findings: List[Finding]):
 
 
 # -- shared AST helpers (used by several rules) -------------------------------
+
+#: attribute reads that are trace-static python values even on a traced
+#: array (jax shapes/dtypes are concrete at trace time)
+STATIC_VALUE_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize"}
+
+#: builtins whose *result* is a host python value. If their argument is a
+#: traced value that is its own bug (PTA001's cast check flags it); for
+#: static-ness purposes the result is host-side either way.
+STATIC_RESULT_BUILTINS = {
+    "int", "float", "bool", "str", "len", "min", "max", "abs", "round",
+    "sum", "tuple", "list", "sorted", "range", "enumerate", "zip",
+    "divmod", "pow", "isinstance", "getattr", "hasattr",
+}
+
+
+def is_static_host_expr(node: ast.AST, static_names=frozenset()) -> bool:
+    """True when ``node`` provably evaluates to a host python value
+    (int/float/tuple/...), never a traced array.
+
+    Used by PTA001/PTA002 to stop flagging ``np.sqrt(head_dim)``-style
+    numpy-on-static-shapes calls: constants, ``.shape``/``.ndim`` reads,
+    ``len()``/``int()`` results, arithmetic over those, and names proven
+    static by local assignment analysis (``static_names``).
+    Conservative: anything unrecognized is NOT static.
+    """
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in static_names
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(is_static_host_expr(e, static_names) for e in node.elts)
+    if isinstance(node, ast.Starred):
+        return is_static_host_expr(node.value, static_names)
+    if isinstance(node, ast.UnaryOp):
+        return is_static_host_expr(node.operand, static_names)
+    if isinstance(node, ast.BinOp):
+        return (is_static_host_expr(node.left, static_names)
+                and is_static_host_expr(node.right, static_names))
+    if isinstance(node, ast.BoolOp):
+        return all(is_static_host_expr(v, static_names) for v in node.values)
+    if isinstance(node, ast.Compare):
+        return (is_static_host_expr(node.left, static_names)
+                and all(is_static_host_expr(c, static_names)
+                        for c in node.comparators))
+    if isinstance(node, ast.IfExp):
+        return all(is_static_host_expr(n, static_names)
+                   for n in (node.test, node.body, node.orelse))
+    if isinstance(node, ast.Attribute):
+        return node.attr in STATIC_VALUE_ATTRS
+    if isinstance(node, ast.Subscript):
+        # x.shape[0], static_tuple[i] — indexing a static container is
+        # static regardless of how exotic the index expression is
+        return is_static_host_expr(node.value, static_names)
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in STATIC_RESULT_BUILTINS:
+            return True
+        if isinstance(f, ast.Attribute):
+            base = dotted_name(f.value)
+            if base in ("np", "numpy", "math"):
+                # np.log2(static) etc: numpy math over provably-static
+                # inputs yields a host scalar/array of static data
+                return (all(is_static_host_expr(a, static_names)
+                            for a in node.args)
+                        and all(is_static_host_expr(k.value, static_names)
+                                for k in node.keywords))
+    return False
+
+
+def static_local_names(func_node: ast.AST, params) -> set:
+    """Names inside ``func_node`` provably bound only to static host
+    values: fixpoint over simple assignments and for-targets; any name
+    that is a parameter or has a non-static binding is excluded."""
+    candidates: Dict[str, List[ast.AST]] = {}
+    poisoned = set(params)
+
+    def _targets(t):
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from _targets(e)
+
+    for node in walk_own_body(func_node):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    candidates.setdefault(tgt.id, []).append(node.value)
+                else:  # tuple unpack etc — too clever, poison all names
+                    poisoned.update(_targets(tgt))
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) and node.value is not None:
+                candidates.setdefault(node.target.id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign):
+            poisoned.update(_targets(node.target))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            poisoned.update(_targets(node.target))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    poisoned.update(_targets(item.optional_vars))
+        elif isinstance(node, (ast.NamedExpr,)):
+            poisoned.update(_targets(node.target))
+        elif isinstance(node, ast.comprehension):
+            poisoned.update(_targets(node.target))
+
+    static: set = set()
+    for _ in range(len(candidates) + 1):
+        grew = False
+        for name, values in candidates.items():
+            if name in static or name in poisoned:
+                continue
+            if all(is_static_host_expr(v, static) for v in values):
+                static.add(name)
+                grew = True
+        if not grew:
+            break
+    return static
+
+
+def _binding_target_names(t):
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _binding_target_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _binding_target_names(t.value)
+
+
+_TAINT_MUTATORS = {"append", "extend", "insert", "add", "update"}
+
+
+def tainted_local_names(func_node: ast.AST, params,
+                        static_names=frozenset()) -> set:
+    """Names that may hold *traced* values: the function's parameters plus
+    anything transitively bound from them — via assignment, for-targets,
+    augmented assignment, or in-place container mutation
+    (``xs.append(tainted)``).
+
+    A binding whose RHS is a provably-static host expression
+    (:func:`is_static_host_expr`, e.g. ``h = x.shape[2]``) does NOT
+    propagate taint even though it mentions a tainted name: shape reads
+    are concrete at trace time. Closure variables from enclosing scopes
+    are never tainted — under jit they are captured python constants,
+    not tracers.
+    """
+    bindings: List[Tuple[list, ast.AST]] = []
+    for node in walk_own_body(func_node):
+        if isinstance(node, ast.Assign):
+            names = [n for t in node.targets
+                     for n in _binding_target_names(t)]
+            bindings.append((names, node.value))
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                bindings.append(
+                    (list(_binding_target_names(node.target)), node.value))
+        elif isinstance(node, ast.AugAssign):
+            bindings.append(
+                (list(_binding_target_names(node.target)), node.value))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bindings.append(
+                (list(_binding_target_names(node.target)), node.iter))
+        elif isinstance(node, ast.comprehension):
+            bindings.append(
+                (list(_binding_target_names(node.target)), node.iter))
+        elif isinstance(node, ast.NamedExpr):
+            bindings.append(
+                (list(_binding_target_names(node.target)), node.value))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and isinstance(node.func.value, ast.Name)
+              and node.func.attr in _TAINT_MUTATORS and node.args):
+            bindings.append(([node.func.value.id], node.args[0]))
+
+    tainted = set(params)
+
+    def _mentions_tainted(expr):
+        return any(isinstance(n, ast.Name) and n.id in tainted
+                   for n in ast.walk(expr))
+
+    for _ in range(len(bindings) + 1):
+        grew = False
+        for names, rhs in bindings:
+            if all(n in tainted for n in names):
+                continue
+            if (not is_static_host_expr(rhs, static_names)
+                    and _mentions_tainted(rhs)):
+                tainted.update(names)
+                grew = True
+        if not grew:
+            break
+    return tainted
+
+
+def mentions_any_name(expr: ast.AST, names) -> bool:
+    """True if the expression subtree reads any of the given names."""
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(expr))
+
 
 def dotted_name(node: ast.AST) -> str:
     """Flatten Name/Attribute chains: jax.lax.scan -> "jax.lax.scan"."""

@@ -8,8 +8,14 @@ from .pta004_op_registry import RULE as PTA004      # noqa: F401
 from .pta005_api_hygiene import RULE as PTA005      # noqa: F401
 from .pta006_lock_discipline import RULE as PTA006  # noqa: F401
 from .pta007_signal_safety import RULE as PTA007    # noqa: F401
+from .pta008_recompile_risk import RULE as PTA008   # noqa: F401
+from .pta009_trace_fusion import RULE as PTA009     # noqa: F401
+from .pta010_retrace_sentinel import RULE as PTA010  # noqa: F401
 
-ALL_RULES = [PTA001, PTA002, PTA003, PTA004, PTA005, PTA006, PTA007]
+# PTA009/PTA010 are tier="trace": they compile registered entrypoints and
+# run only when selected via --only (see __main__.select_rules)
+ALL_RULES = [PTA001, PTA002, PTA003, PTA004, PTA005, PTA006, PTA007,
+             PTA008, PTA009, PTA010]
 
 
 def rules_by_code():

@@ -13,6 +13,10 @@ class Rule:
     #: default severity for this rule's findings ("error" | "warning");
     #: individual findings may override via SourceFile.finding(severity=...)
     severity: str = "error"
+    #: "ast" rules run by default (fast, stdlib-only); "trace" rules
+    #: compile code under JAX_PLATFORMS=cpu and only run when selected
+    #: explicitly via --only/--rule (see tools/analyze/trace/)
+    tier: str = "ast"
 
     def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
         return []

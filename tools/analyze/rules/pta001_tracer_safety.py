@@ -25,7 +25,9 @@ import ast
 from typing import List, Set
 
 from .base import Rule
-from ..core import Finding, Project, SourceFile, dotted_name
+from ..core import (Finding, Project, SourceFile, dotted_name,
+                    is_static_host_expr, mentions_any_name,
+                    static_local_names, tainted_local_names)
 
 HOST_METHODS = {"item", "numpy", "tolist", "block_until_ready"}
 
@@ -53,7 +55,9 @@ def _param_names(func_node) -> Set[str]:
     return names
 
 
-def _host_forcing(node: ast.AST, params: Set[str]) -> str:
+def _host_forcing(node: ast.AST, params: Set[str],
+                  static_names=frozenset(),
+                  tainted=None) -> str:
     """Return a description if ``node`` is a host-forcing call, else ''."""
     if not isinstance(node, ast.Call):
         return ""
@@ -63,8 +67,24 @@ def _host_forcing(node: ast.AST, params: Set[str]) -> str:
             return f".{f.attr}() host-materializes a traced value"
         base = dotted_name(f.value)
         if base in ("np", "numpy") and f.attr not in NP_SAFE_ATTRS:
-            return (f"np.{f.attr}() materializes its arguments on host "
-                    f"(use jnp inside traced code)")
+            # static-shape-numpy heuristic: np math is only host-forcing
+            # when an argument may hold a *traced* value — i.e. derives
+            # from the function's parameters (taint) and is not a
+            # provably-static host expression (.shape/.ndim reads,
+            # len()/int() results, arithmetic over those). Closure
+            # variables are python constants under trace, so
+            # `np.sqrt(ar)` over an enclosing-scope aspect-ratio list and
+            # `np.sqrt(self.head_dim)` stay clean, while `np.asarray(x)`
+            # on a parameter still flags.
+            taint_set = params if tainted is None else tainted
+            def _risky(a):
+                return (not is_static_host_expr(a, static_names)
+                        and mentions_any_name(a, taint_set))
+            if (any(_risky(a) for a in node.args)
+                    or any(_risky(k.value) for k in node.keywords)):
+                return (f"np.{f.attr}() materializes its arguments on host "
+                        f"(use jnp inside traced code)")
+            return ""
     elif isinstance(f, ast.Name) and f.id in CASTS and len(node.args) == 1:
         arg = node.args[0]
         if not isinstance(arg, ast.Constant):
@@ -87,6 +107,8 @@ class TracerSafetyRule(Rule):
         for fi in graph.reachable():
             sf = fi.file
             params = _param_names(fi.node)
+            statics = static_local_names(fi.node, params)
+            tainted = tainted_local_names(fi.node, params, statics)
             via = (f" [jit-reachable via {fi.reachable_from}]"
                    if fi.reachable_from != fi.qualname
                    else " [jit entry point]")
@@ -96,7 +118,7 @@ class TracerSafetyRule(Rule):
             for node in self._own_body(fi.node):
                 if isinstance(node, (ast.If, ast.While)):
                     for sub in ast.walk(node.test):
-                        why = _host_forcing(sub, params)
+                        why = _host_forcing(sub, params, statics, tainted)
                         if why:
                             flagged_calls.add(id(sub))
                             kind = ("while" if isinstance(node, ast.While)
@@ -109,7 +131,7 @@ class TracerSafetyRule(Rule):
             for node in self._own_body(fi.node):
                 if id(node) in flagged_calls:
                     continue
-                why = _host_forcing(node, params)
+                why = _host_forcing(node, params, statics, tainted)
                 if why:
                     findings.append(sf.finding(
                         self.code, node,

@@ -19,7 +19,8 @@ import ast
 from typing import List
 
 from .base import Rule
-from ..core import Finding, Project, SourceFile, dotted_name
+from ..core import (Finding, Project, SourceFile, dotted_name,
+                    is_static_host_expr)
 
 HOT_PREFIXES = (
     "paddle_tpu/ops/",
@@ -43,14 +44,11 @@ NP_MATERIALIZERS = {"asarray", "array", "ascontiguousarray", "copy"}
 
 
 def _is_static_literal(node: ast.AST) -> bool:
-    """Literals / containers of literals can't be device values."""
-    if isinstance(node, ast.Constant):
-        return True
-    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
-        return all(_is_static_literal(e) for e in node.elts)
-    if isinstance(node, ast.UnaryOp):
-        return _is_static_literal(node.operand)
-    return False
+    """Provably-host expressions (literals, ``.shape`` reads, ``len()``
+    results, arithmetic over those) can't be device values — the shared
+    static-shape-numpy heuristic from core (no local-name context at this
+    per-file walk, so only syntactically-evident static values pass)."""
+    return is_static_host_expr(node)
 
 
 class HostSyncRule(Rule):

@@ -15,7 +15,7 @@ import ast
 from typing import List
 
 from .base import Rule
-from ..core import Finding, Project, SourceFile
+from ..core import _ALL_CODES, Finding, Project, SourceFile
 
 API_PREFIX = "paddle_tpu/"
 
@@ -58,13 +58,15 @@ def _has_future_annotations(tree: ast.AST) -> bool:
 class ApiHygieneRule(Rule):
     code = "PTA005"
     name = "api-hygiene"
-    description = ("mutable default arguments and missing `from __future__ "
-                   "import annotations` in public API modules")
+    description = ("mutable default arguments, missing `from __future__ "
+                   "import annotations`, and unjustified `# noqa: PTA002` "
+                   "in hot-path modules")
 
     def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
         if API_PREFIX not in sf.relpath:
             return []
         findings: List[Finding] = []
+        findings.extend(self._check_noqa_justifications(sf))
         for node in ast.walk(sf.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
@@ -83,6 +85,35 @@ class ApiHygieneRule(Rule):
                 "module uses type annotations without `from __future__ "
                 "import annotations` (eager evaluation at import time)",
                 anchor="no-future-annotations"))
+        return findings
+
+    def _check_noqa_justifications(self, sf: SourceFile) -> List[Finding]:
+        """Every host-sync suppression in a hot-path module must say *why*
+        the concrete value is semantically required: `# noqa: PTA002 --
+        reason`. A bare `# noqa: PTA002` (or a codeless blanket `# noqa`)
+        silently sanctions a pipeline stall for the next reader."""
+        # local import: HOT_PREFIXES is owned by the host-sync rule
+        from .pta002_host_sync import HOT_PREFIXES
+        if not sf.relpath.startswith(HOT_PREFIXES):
+            return []
+        findings: List[Finding] = []
+        for line, codes in sorted(sf.noqa.items()):
+            if sf.noqa_justified.get(line):
+                continue
+            if _ALL_CODES in codes:
+                findings.append(sf.finding(
+                    self.code, line,
+                    "blanket `# noqa` in a hot-path module — suppress the "
+                    "specific rule with a justification: "
+                    "`# noqa: PTA002 -- reason`",
+                    anchor=f"noqa-hygiene:blanket:{sf.line_text(line)}"))
+            elif "PTA002" in codes:
+                findings.append(sf.finding(
+                    self.code, line,
+                    "`# noqa: PTA002` without a justification — hot-path "
+                    "host syncs must document why a concrete value is "
+                    "required: `# noqa: PTA002 -- reason`",
+                    anchor=f"noqa-hygiene:PTA002:{sf.line_text(line)}"))
         return findings
 
 

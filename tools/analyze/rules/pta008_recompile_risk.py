@@ -1,0 +1,312 @@
+"""PTA008: recompile-risk lint — the static half of the trace tier.
+
+``jax.jit`` retraces whenever the cache key changes: a new input shape, a
+new static-argument value, or a brand-new wrapped function object. The
+trace tier's PTA010 sentinel *measures* retraces; this rule flags the
+source patterns that cause them (the bug class PR 6 fixed by hand in the
+LLM decode path — see docs/static_analysis.md "Trace-level analysis"):
+
+- **shape-branch**: an ``if``/``while`` in a jit *entry* function whose
+  test reads a traced parameter's ``.shape``/``.ndim``/``len()`` — every
+  distinct shape traces a new executable, so shape-dependent control flow
+  in a step function multiplies executables under batch churn (warning;
+  rank dispatch in shared helpers deeper in the call tree is deliberate
+  and not flagged). A ``while`` on shapes anywhere jit-reachable is
+  flagged too: it unrolls at trace time.
+- **scalar-feed loop**: a host-side ``for``/``while`` loop that both
+  calls a jitted entry function and coerces device values
+  (``.item()``/``int()``/``float()``) per iteration — the per-token sync
+  pattern (warning).
+- **jit-in-loop**: ``jax.jit(...)`` (or a ``@jit``-decorated ``def``)
+  inside a loop body — each iteration creates a fresh function object
+  with its own trace cache, so nothing is ever reused (error).
+- **static-argnums hygiene**: computed ``static_argnums``/
+  ``static_argnames`` values, and call sites passing unhashable literals
+  (``list``/``dict``/``set``) in a static position — unhashables raise
+  at the cache lookup; a fresh object per call retraces every call
+  (error).
+
+Suppress intentional cases with ``# noqa: PTA008 -- <why the trace-cache
+key is stable here>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .base import Rule
+from ..core import (Finding, Project, SourceFile, dotted_name,
+                    tainted_local_names, walk_own_body)
+
+#: callables whose invocation inside a loop body builds a new traced
+#: function object per iteration
+_JIT_BUILDERS = {"jit", "pjit"}
+
+_COERCIONS = {"int", "float"}
+
+_SHAPE_ATTRS = {"shape", "ndim"}
+
+
+def _param_names(func_node) -> Set[str]:
+    a = func_node.args
+    names = {x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _shape_read_on(node: ast.AST, tainted) -> str:
+    """'x.shape'-style description if ``node`` reads a traced value's
+    shape, else ''."""
+    if (isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS
+            and isinstance(node.value, ast.Name)
+            and node.value.id in tainted):
+        return f"{node.value.id}.{node.attr}"
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "len" and len(node.args) == 1
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in tainted):
+        return f"len({node.args[0].id})"
+    return ""
+
+
+def _is_coercion(node: ast.AST) -> str:
+    """Definite device->host reads (`.item()`/`.numpy()`/`.tolist()`).
+    Bare ``float()``/``int()`` are NOT flagged here: on host-side loops
+    they usually coerce python config values; the traced-value variants
+    are PTA001's cast check."""
+    if not isinstance(node, ast.Call):
+        return ""
+    f = node.func
+    if isinstance(f, ast.Attribute) and not node.args \
+            and f.attr in ("item", "numpy", "tolist"):
+        return f".{f.attr}()"
+    if isinstance(f, ast.Name) and f.id in _COERCIONS \
+            and len(node.args) == 1:
+        inner = node.args[0]
+        # float(x.item()) / int(np.asarray(loss)) — coercion of an
+        # explicit materialization
+        if isinstance(inner, ast.Call) and _is_coercion(inner):
+            return f"{f.id}()"
+    return ""
+
+
+def _single_pass_loop(loop) -> bool:
+    """`while True: ... break` — the labeled-break/"single-pass try"
+    idiom; the body runs at most once, so per-iteration churn does not
+    apply."""
+    if not isinstance(loop, ast.While):
+        return False
+    test_true = (isinstance(loop.test, ast.Constant)
+                 and loop.test.value is True)
+    return test_true and isinstance(loop.body[-1],
+                                    (ast.Break, ast.Return, ast.Raise))
+
+
+def _static_positions(call: ast.Call):
+    """(argnums, ok) for a jit/pjit call's static_argnums keyword; ok is
+    False when the value is computed (not a literal int / tuple-of-int)."""
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant):
+            if isinstance(v.value, int) and kw.arg == "static_argnums":
+                return [v.value], True
+            return [], isinstance(v.value, (int, str))
+        if isinstance(v, (ast.Tuple, ast.List)):
+            if all(isinstance(e, ast.Constant) for e in v.elts):
+                if kw.arg == "static_argnums":
+                    return [e.value for e in v.elts
+                            if isinstance(e.value, int)], True
+                return [], True
+            return [], False
+        if isinstance(v, ast.Name):
+            # a named module-level constant — unverifiable but common
+            return [], True
+        return [], False
+    return [], True
+
+
+def _is_unhashable_literal(node: ast.AST) -> str:
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("list", "dict", "set"):
+        return node.func.id
+    return ""
+
+
+class RecompileRiskRule(Rule):
+    code = "PTA008"
+    name = "recompile-risk"
+    description = ("patterns that churn the jit trace cache: shape-"
+                   "dependent branching in entry functions, per-iteration "
+                   "host coercions feeding jitted calls, jit() inside "
+                   "loops, unhashable/computed static_argnums")
+    severity = "warning"
+
+    # -- per-file checks: jit-in-loop + static_argnums hygiene ---------------
+
+    def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        findings: List[Finding] = []
+        static_fns = {}  # local name -> static argnum positions
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)) \
+                    and not _single_pass_loop(node):
+                findings.extend(self._check_loop_body(sf, node))
+            if not isinstance(node, ast.Call):
+                continue
+            tail = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            if tail not in _JIT_BUILDERS:
+                continue
+            positions, ok = _static_positions(node)
+            if not ok:
+                findings.append(sf.finding(
+                    self.code, node,
+                    f"computed static_argnums/static_argnames on "
+                    f"`{dotted_name(node.func)}` — the static positions "
+                    f"must be literal so readers (and this lint) can see "
+                    f"which arguments key the trace cache",
+                    severity="error"))
+        # second pass: map `g = jax.jit(f, static_argnums=...)` to call
+        # sites passing unhashable literals in a static slot
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                tail = (dotted_name(node.value.func) or "").rsplit(
+                    ".", 1)[-1]
+                if tail in _JIT_BUILDERS:
+                    positions, ok = _static_positions(node.value)
+                    if ok and positions:
+                        static_fns[node.targets[0].id] = positions
+        if static_fns:
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in static_fns):
+                    continue
+                for pos in static_fns[node.func.id]:
+                    if pos < len(node.args):
+                        kind = _is_unhashable_literal(node.args[pos])
+                        if kind:
+                            findings.append(sf.finding(
+                                self.code, node.args[pos],
+                                f"unhashable {kind} passed in static "
+                                f"position {pos} of `{node.func.id}` — "
+                                f"static arguments key the trace cache "
+                                f"and must be hashable (use a tuple or "
+                                f"a frozen dataclass)",
+                                severity="error"))
+        return findings
+
+    def _check_loop_body(self, sf: SourceFile, loop) -> List[Finding]:
+        findings: List[Finding] = []
+        body = loop.body + getattr(loop, "orelse", [])
+        for stmt in body:
+            for node in ast.walk(stmt):
+                jit_site = None
+                if isinstance(node, ast.Call):
+                    tail = (dotted_name(node.func) or "").rsplit(
+                        ".", 1)[-1]
+                    if tail in _JIT_BUILDERS and (
+                            node.args or node.keywords):
+                        jit_site = dotted_name(node.func)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        d = dec.func if isinstance(dec, ast.Call) else dec
+                        if (dotted_name(d) or "").rsplit(
+                                ".", 1)[-1] in _JIT_BUILDERS:
+                            jit_site = f"@{dotted_name(d)} def {node.name}"
+                if jit_site:
+                    findings.append(sf.finding(
+                        self.code, node,
+                        f"`{jit_site}` inside a loop body creates a fresh "
+                        f"traced function every iteration — its trace "
+                        f"cache is never reused; hoist the jit() out of "
+                        f"the loop",
+                        severity="error"))
+        return findings
+
+    # -- callgraph checks: shape-branch + scalar-feed loops ------------------
+
+    def finalize(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        graph = project.callgraph
+        jit_root_names = {fi.qualname for fi in graph.functions
+                          if fi.root_via is not None}
+
+        for fi in graph.reachable():
+            params = _param_names(fi.node)
+            tainted = tainted_local_names(fi.node, params)
+            is_root = fi.root_via is not None
+            for node in walk_own_body(fi.node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                reads = [r for sub in ast.walk(node.test)
+                         for r in [_shape_read_on(sub, tainted)] if r]
+                if not reads:
+                    continue
+                if isinstance(node, ast.While):
+                    findings.append(fi.file.finding(
+                        self.code, node,
+                        f"`while` on `{reads[0]}` in jit-reachable "
+                        f"`{fi.qualname}` unrolls at trace time — each "
+                        f"iteration is inlined into the program; use "
+                        f"lax.while_loop/fori_loop",
+                        severity="error"))
+                elif is_root:
+                    findings.append(fi.file.finding(
+                        self.code, node,
+                        f"jit entry `{fi.qualname}` branches on "
+                        f"`{reads[0]}` — every distinct input shape "
+                        f"traces a new executable; pad/bucket shapes at "
+                        f"the boundary or move the dispatch outside the "
+                        f"jitted step", severity="warning"))
+
+        # host-side loops that coerce device scalars while driving a
+        # jitted callee: the per-token sync pattern
+        for fi in graph.functions:
+            if fi.reachable_from is not None:
+                continue  # inside jit the coercion is PTA001's business
+            for loop in walk_own_body(fi.node):
+                if not isinstance(loop, (ast.For, ast.While)) \
+                        or _single_pass_loop(loop):
+                    continue
+                calls_jit_root = None
+                coercion = None
+                for stmt in loop.body:
+                    for node in ast.walk(stmt):
+                        if isinstance(node, ast.Call):
+                            for tgt in graph.callee_targets(
+                                    fi, node, precise_only=True):
+                                if tgt.qualname in jit_root_names:
+                                    calls_jit_root = tgt.qualname
+                            c = _is_coercion(node)
+                            if c:
+                                coercion = (node, c)
+                if calls_jit_root and coercion:
+                    node, what = coercion
+                    findings.append(fi.file.finding(
+                        self.code, node,
+                        f"loop in `{fi.qualname}` coerces a device value "
+                        f"with {what} every iteration while driving "
+                        f"jitted `{calls_jit_root}` — each coercion is a "
+                        f"host sync on the step path; batch the reads or "
+                        f"keep the value on device", severity="warning"))
+        return findings
+
+
+RULE = RecompileRiskRule()

@@ -1,0 +1,114 @@
+"""PTA009: trace-level fusion & host-transfer audit.
+
+Runs every registered auditable entrypoint (``paddle_tpu.core.audit``)
+under ``JAX_PLATFORMS=cpu``, captures its jaxpr, and flags program
+properties no AST rule can see:
+
+- **host transfer in compiled region** (error): ``device_put``/
+  ``pure_callback``/``io_callback`` primitives inside the traced step —
+  each one stalls the device stream mid-program, the round-trip PTA002
+  can only guess at from source text.
+- **large closed-over constant** (warning): a ``while``/``cond``/``scan``
+  body capturing a tensor of >= 16K elements as a trace constant — it is
+  baked into every executable instead of flowing through as an argument
+  or loop carry.
+- **donation opportunity** (warning): a train-tagged step compiled
+  without ``donate_argnums`` whose inputs are shape/dtype-matched by its
+  outputs — the parameter set is double-buffered for no reason.
+- **copy-split fusion** (warning): the compiled HLO is more than 20%
+  ``copy`` instructions (min 50 instructions) — layout-changing copies
+  are splitting what should be fused elementwise chains.
+
+Findings anchor at the ``register_entrypoint`` site with stable
+``trace:<name>:<check>`` fingerprints, so they baseline and noqa like any
+AST finding. This tier compiles code: it only runs when selected
+explicitly (``--only PTA009``).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .base import Rule
+from ..core import Finding, Project
+
+
+class TraceFusionRule(Rule):
+    code = "PTA009"
+    name = "trace-fusion-transfer"
+    tier = "trace"
+    description = ("trace-level audit of registered entrypoints: host "
+                   "transfers inside compiled regions, large constants "
+                   "captured by control-flow bodies, missed buffer-"
+                   "donation opportunities (runs only via --only)")
+    severity = "warning"
+
+    def finalize(self, project: Project) -> List[Finding]:
+        from ..trace import get_report
+        report = get_report()
+        findings: List[Finding] = []
+        if report.error:
+            findings.append(Finding(
+                self.code, "tools/analyze/trace/__init__.py", 1, 0,
+                f"trace audit could not run (jax/paddle_tpu import "
+                f"failed): {report.error.strip().splitlines()[-1]}",
+                anchor="trace:runner:unavailable", severity="error"))
+            return findings
+        for name, st in sorted(report.entrypoint_stats.items()):
+            loc = (st.path or "tools/analyze/trace/__init__.py",
+                   st.line or 1)
+            if st.error:
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"entrypoint `{name}` failed to build/trace: "
+                    f"{st.error.strip().splitlines()[-1]}",
+                    anchor=f"trace:{name}:error", severity="error"))
+                continue
+            for prim in sorted(set(st.transfers)):
+                n = st.transfers.count(prim)
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"entrypoint `{name}` has {n} `{prim}` "
+                    f"primitive(s) inside its compiled region — a host "
+                    f"round-trip on the step path; keep data on device "
+                    f"or move the callback outside the jitted step",
+                    anchor=f"trace:{name}:transfer:{prim}",
+                    severity="error"))
+            for lc in st.large_consts:
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"entrypoint `{name}`: a `{lc['control_flow']}` body "
+                    f"captures a {lc['dtype']}{lc['shape']} constant "
+                    f"({lc['elements']} elements) — baked into every "
+                    f"traced executable; pass it as an argument or loop "
+                    f"carry instead",
+                    anchor=(f"trace:{name}:large-const:"
+                            f"{lc['control_flow']}:{lc['elements']}"),
+                    severity="warning"))
+            instrs = st.hlo.get("instructions", 0)
+            copies = st.hlo.get("copies", 0)
+            if instrs >= 50 and copies / instrs > 0.20:
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"entrypoint `{name}` compiles to {copies} copy "
+                    f"instructions out of {instrs} "
+                    f"({100 * copies // instrs}%) — layout-changing "
+                    f"copies are splitting fusions; check for transposes/"
+                    f"reshapes between elementwise ops",
+                    anchor=f"trace:{name}:copy-split",
+                    severity="warning"))
+            don = st.donation
+            if don and don.get("donatable_inputs", 0) > 0:
+                mib = don["donatable_bytes"] / (1024 * 1024)
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"train entrypoint `{name}` donates no buffers but "
+                    f"{don['donatable_inputs']} of "
+                    f"{don['total_inputs']} inputs are shape/dtype-"
+                    f"matched by outputs ({mib:.2f} MiB) — pass "
+                    f"donate_argnums to reuse them in place",
+                    anchor=f"trace:{name}:donation",
+                    severity="warning"))
+        return findings
+
+
+RULE = TraceFusionRule()

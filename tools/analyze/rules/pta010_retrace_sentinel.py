@@ -1,0 +1,81 @@
+"""PTA010: retrace sentinel.
+
+For every registered auditable entrypoint, the trace runner jits the RAW
+step under a counting wrapper (with the entrypoint's own jit kwargs) and
+calls it twice with value-perturbed but shape/dtype-identical arguments.
+A correct step traces exactly once; a second trace means the jit cache
+key depends on something it shouldn't — a python scalar that changes per
+batch, an unhashed container identity, a fresh closure per call. This is
+the measured counterpart of PTA008 (which flags the *source patterns*
+that cause retraces), and the regression guard for the class of bug PR 6
+fixed in the LLM decode path.
+
+The runner also lowers each variant and hashes the StableHLO text: a
+stable trace count with an unstable executable fingerprint means the
+program itself changed between calls (e.g. a captured constant differs),
+which would recompile on a real device even when the python-level cache
+hits.
+
+Compiles code — runs only when selected (``--only PTA010``).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .base import Rule
+from ..core import Finding, Project
+
+
+class RetraceSentinelRule(Rule):
+    code = "PTA010"
+    name = "retrace-sentinel"
+    tier = "trace"
+    description = ("compile each registered entrypoint twice with value-"
+                   "perturbed same-shape inputs; fail on a second trace "
+                   "or an unstable executable fingerprint (runs only via "
+                   "--only)")
+    severity = "error"
+
+    def finalize(self, project: Project) -> List[Finding]:
+        from ..trace import get_report
+        report = get_report()
+        findings: List[Finding] = []
+        if report.error:
+            findings.append(Finding(
+                self.code, "tools/analyze/trace/__init__.py", 1, 0,
+                f"retrace sentinel could not run (jax/paddle_tpu import "
+                f"failed): {report.error.strip().splitlines()[-1]}",
+                anchor="trace:runner:unavailable", severity="error"))
+            return findings
+        for name, st in sorted(report.entrypoint_stats.items()):
+            loc = (st.path or "tools/analyze/trace/__init__.py",
+                   st.line or 1)
+            if st.error:
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"entrypoint `{name}` failed to build/trace: "
+                    f"{st.error.strip().splitlines()[-1]}",
+                    anchor=f"trace:{name}:error", severity="error"))
+                continue
+            if st.trace_count != 1:
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"entrypoint `{name}` traced {st.trace_count}x "
+                    f"across two calls with identical shapes/dtypes — "
+                    f"the jit cache key is unstable (python-scalar "
+                    f"argument, per-call closure, or unhashable static); "
+                    f"expected exactly 1 trace",
+                    anchor=f"trace:{name}:retrace", severity="error"))
+            elif not st.fingerprint_stable:
+                findings.append(Finding(
+                    self.code, loc[0], loc[1], 0,
+                    f"entrypoint `{name}` lowers to different programs "
+                    f"for value-perturbed same-shape inputs "
+                    f"({st.fingerprints[0]} vs {st.fingerprints[1]}) — "
+                    f"an input value is being baked into the executable "
+                    f"as a constant",
+                    anchor=f"trace:{name}:fingerprint", severity="error"))
+        return findings
+
+
+RULE = RetraceSentinelRule()

@@ -1,0 +1,197 @@
+"""Trace-level audit runner: the dynamic half of ``tools.analyze``.
+
+The AST tier reads source text; this tier runs the *programs*. It imports
+the repo's registered auditable entrypoints (``paddle_tpu.core.audit`` —
+hapi train step, static Executor step, serving predict, LLM
+prefill/decode), captures each one's jaxpr and lowered HLO under
+``JAX_PLATFORMS=cpu``, and records per-entrypoint stats that the trace
+rules (PTA009 fusion/transfer audit, PTA010 retrace sentinel) turn into
+findings anchored at the registration site.
+
+The audit compiles real code, so it only runs when a trace rule is
+selected explicitly (``--only PTA009,PTA010``) and its result is memoized
+per process — both rules read one report. ``PTA_TRACE_ENTRYPOINTS``
+(comma-separated names) restricts which entrypoints run, for CI shards
+and focused debugging.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import traceback
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import passes
+
+
+@dataclass
+class EntrypointStats:
+    """Everything the audit learned about one entrypoint."""
+    name: str
+    tags: Tuple[str, ...] = ()
+    path: str = ""   # registration site (repo-relative)
+    line: int = 0
+    error: str = ""  # build/trace failure — other fields are then partial
+    trace_count: int = -1           # jit traces across the two variants
+    fingerprints: List[str] = field(default_factory=list)
+    fingerprint_stable: bool = True
+    transfers: List[str] = field(default_factory=list)
+    large_consts: List[Dict[str, Any]] = field(default_factory=list)
+    donation: Optional[Dict[str, Any]] = None  # set when check applies
+    hlo: Dict[str, int] = field(default_factory=dict)
+
+    def payload(self) -> Dict[str, Any]:
+        return {
+            "tags": list(self.tags), "path": self.path, "line": self.line,
+            "error": self.error, "trace_count": self.trace_count,
+            "fingerprints": self.fingerprints,
+            "fingerprint_stable": self.fingerprint_stable,
+            "transfers": self.transfers,
+            "large_consts": self.large_consts,
+            "donation": self.donation, "hlo": self.hlo,
+        }
+
+
+@dataclass
+class TraceReport:
+    platform: str
+    entrypoint_stats: Dict[str, EntrypointStats]
+    error: str = ""  # registry-level failure (jax/paddle_tpu unimportable)
+
+    def stats_payload(self) -> Dict[str, Any]:
+        return {
+            "version": 1,
+            "platform": self.platform,
+            "error": self.error,
+            "entrypoints": {n: s.payload()
+                            for n, s in sorted(
+                                self.entrypoint_stats.items())},
+        }
+
+
+_LAST: Optional[TraceReport] = None
+
+
+def last_report() -> Optional[TraceReport]:
+    return _LAST
+
+
+def get_report() -> TraceReport:
+    """Run the audit once per process; PTA009 and PTA010 share it."""
+    global _LAST
+    if _LAST is None:
+        _LAST = run_audit()
+    return _LAST
+
+
+def _reset_for_tests() -> None:
+    global _LAST
+    _LAST = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+
+def run_audit(names: Optional[List[str]] = None) -> TraceReport:
+    """Build + trace every registered entrypoint. Never raises: failures
+    are recorded per-entrypoint (or report-level for import failures) so
+    one broken entrypoint doesn't hide the rest."""
+    # must win the race with the first jax import: tracing on an
+    # accelerator would make the audit a TPU job
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = _repo_root()
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    try:
+        import jax
+        try:
+            # some images install accelerator plugins that override the
+            # env var; the config knob wins if no backend is live yet
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already initialized — platform field records it
+        from paddle_tpu.core import audit as _audit
+        eps = _audit.load_default_entrypoints()
+        platform = jax.default_backend()
+    except Exception:
+        return TraceReport(platform="unavailable", entrypoint_stats={},
+                           error=traceback.format_exc(limit=3))
+
+    if names is None:
+        env = os.environ.get("PTA_TRACE_ENTRYPOINTS", "")
+        names = [n.strip() for n in env.split(",") if n.strip()] or None
+    stats: Dict[str, EntrypointStats] = {}
+    for name, ep in sorted(eps.items()):
+        if names is not None and name not in names:
+            continue
+        stats[name] = audit_entrypoint(name, ep)
+    return TraceReport(platform=platform, entrypoint_stats=stats)
+
+
+def audit_spec(name: str, spec, tags: Tuple[str, ...] = (),
+               path: str = "", line: int = 0) -> EntrypointStats:
+    """Audit one already-built AuditSpec (the test seam: fixtures hand in
+    synthetic specs without touching the registry)."""
+    import jax
+
+    st = EntrypointStats(name=name, tags=tuple(tags), path=path, line=line)
+    try:
+        # -- static program analysis (jaxpr level) -------------------------
+        mj_kwargs = {}
+        if "static_argnums" in spec.jit_kwargs:
+            mj_kwargs["static_argnums"] = spec.jit_kwargs["static_argnums"]
+        closed = jax.make_jaxpr(spec.fn, **mj_kwargs)(*spec.make_args(0))
+        st.transfers = passes.scan_transfers(closed)
+        st.large_consts = passes.scan_large_consts(closed)
+        if "train" in st.tags and "donate_argnums" not in spec.jit_kwargs:
+            st.donation = passes.donation_opportunities(closed)
+
+        # -- retrace sentinel (PTA010) --------------------------------------
+        counter = {"n": 0}
+
+        def _counting(*a):
+            counter["n"] += 1
+            return spec.fn(*a)
+
+        jitted = jax.jit(_counting, **spec.jit_kwargs)
+        with warnings.catch_warnings():
+            # CPU ignores donate_argnums with a warning; irrelevant here
+            warnings.simplefilter("ignore")
+            jitted(*spec.make_args(0))
+            jitted(*spec.make_args(1))
+            # record BEFORE the lowers below: .lower() traces again
+            st.trace_count = counter["n"]
+
+            # executable fingerprint per variant — same program must lower
+            # to byte-identical StableHLO when only array values change
+            # (.lower() re-traces on every call regardless of the cache)
+            fresh = jax.jit(spec.fn, **spec.jit_kwargs)
+            for variant in (0, 1):
+                text = fresh.lower(*spec.make_args(variant)).as_text()
+                st.fingerprints.append(
+                    hashlib.sha1(text.encode()).hexdigest()[:16])
+            st.fingerprint_stable = (st.fingerprints[0]
+                                     == st.fingerprints[1])
+
+            # -- post-XLA census (fusion/copy stats) ------------------------
+            compiled = fresh.lower(*spec.make_args(0)).compile()
+            st.hlo = passes.parse_hlo_stats(compiled.as_text())
+    except Exception:
+        st.error = traceback.format_exc(limit=3)
+    return st
+
+
+def audit_entrypoint(name: str, ep) -> EntrypointStats:
+    try:
+        spec = ep.build()
+    except Exception:
+        st = EntrypointStats(name=name, tags=tuple(ep.tags), path=ep.path,
+                             line=ep.line)
+        st.error = traceback.format_exc(limit=3)
+        return st
+    return audit_spec(name, spec, tags=ep.tags, path=ep.path, line=ep.line)

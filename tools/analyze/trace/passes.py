@@ -1,0 +1,170 @@
+"""Pure analysis passes over jaxprs and HLO text.
+
+Everything here is a function of a (closed) jaxpr or an HLO dump — no
+registry, no jit, no I/O — so each pass is unit-testable against tiny
+hand-built programs (tests/test_trace_audit.py) without touching the
+entrypoint machinery.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterator, List, Tuple
+
+#: primitives whose presence inside a compiled region means a host
+#: round-trip (or a host callback that blocks the device stream)
+HOST_TRANSFER_PRIMITIVES = {
+    "device_put", "pure_callback", "io_callback", "debug_callback",
+    "callback",
+}
+
+#: structured-control-flow primitives whose closed-over consts become
+#: baked-in program constants (re-materialized per executable)
+CONTROL_FLOW_PRIMITIVES = {"while", "cond", "scan"}
+
+#: a closed-over const at/above this many elements inside a control-flow
+#: body is worth a finding (64KiB of f32)
+LARGE_CONST_ELEMENTS = 16384
+
+
+def _sub_jaxprs(value: Any) -> Iterator[Any]:
+    """Yield every (open) jaxpr reachable from an eqn param value —
+    ClosedJaxpr, bare Jaxpr, or lists/tuples of either (cond branches)."""
+    if value is None:
+        return
+    if isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+        return
+    inner = getattr(value, "jaxpr", None)  # ClosedJaxpr
+    if inner is not None and hasattr(inner, "eqns"):
+        yield value  # yield the CLOSED jaxpr: callers may want .consts
+        return
+    if hasattr(value, "eqns"):  # bare Jaxpr
+        yield value
+
+
+def _open(j: Any):
+    return getattr(j, "jaxpr", j)
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """All equations in a (closed) jaxpr, recursing into sub-jaxprs
+    carried in equation params (pjit bodies, scan/while/cond branches)."""
+    for eqn in _open(jaxpr).eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def scan_transfers(jaxpr: Any) -> List[str]:
+    """Names of host-transfer/callback primitives anywhere in the
+    program, one entry per occurrence."""
+    return [eqn.primitive.name for eqn in iter_eqns(jaxpr)
+            if eqn.primitive.name in HOST_TRANSFER_PRIMITIVES]
+
+
+def _record_const(out: List[Dict[str, Any]], kind: str, const: Any,
+                  threshold: int) -> None:
+    size = int(getattr(const, "size", 0) or 0)
+    if size >= threshold:
+        out.append({
+            "control_flow": kind,
+            "elements": size,
+            "dtype": str(getattr(const, "dtype", "?")),
+            "shape": list(getattr(const, "shape", ())),
+        })
+
+
+def scan_large_consts(jaxpr: Any,
+                      threshold: int = LARGE_CONST_ELEMENTS
+                      ) -> List[Dict[str, Any]]:
+    """Closed-over constants of ``while``/``cond``/``scan`` bodies with
+    ``size >= threshold`` elements. Large captured constants are baked
+    into every executable that traces the loop — they should be loop
+    carries or explicit arguments instead.
+
+    Tracing hoists body-captured arrays to the TOP-LEVEL jaxpr's consts
+    and threads them into the control-flow equation as plain operands, so
+    the check is "a top-level constvar feeds a while/cond/scan directly";
+    older-style consts embedded in the branch ClosedJaxprs are covered
+    too. Consts reaching a loop through intermediate equations are not
+    attributed (one-hop only — precise enough for the audit, cheap enough
+    for every entrypoint)."""
+    out: List[Dict[str, Any]] = []
+    closed_const_of = {}  # id(Var) keys: Literal operands may be unhashable
+    open_j = _open(jaxpr)
+    for var, val in zip(getattr(open_j, "constvars", ()),
+                        getattr(jaxpr, "consts", ())):
+        closed_const_of[id(var)] = val
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name not in CONTROL_FLOW_PRIMITIVES:
+            continue
+        for invar in eqn.invars:
+            if id(invar) in closed_const_of:
+                _record_const(out, eqn.primitive.name,
+                              closed_const_of[id(invar)], threshold)
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                for const in getattr(sub, "consts", ()):
+                    _record_const(out, eqn.primitive.name, const, threshold)
+    return out
+
+
+def donation_opportunities(jaxpr: Any) -> Dict[str, Any]:
+    """How many inputs could be donated: inputs whose (shape, dtype)
+    matches an output's. A train step that updates parameters in place
+    but donates nothing pays double-buffering for the whole parameter
+    set; the matched byte count quantifies the waste."""
+    closed = jaxpr
+    open_j = _open(closed)
+    key = lambda v: (tuple(getattr(v.aval, "shape", ())),
+                     str(getattr(v.aval, "dtype", "?")))
+    outs: Dict[Tuple, int] = {}
+    for v in open_j.outvars:
+        k = key(v)
+        outs[k] = outs.get(k, 0) + 1
+    matched, matched_bytes = 0, 0
+    for v in open_j.invars:
+        k = key(v)
+        if outs.get(k, 0) > 0:
+            outs[k] -= 1
+            matched += 1
+            aval = v.aval
+            nbytes = 1
+            for d in getattr(aval, "shape", ()):
+                nbytes *= int(d)
+            itemsize = getattr(getattr(aval, "dtype", None), "itemsize", 4)
+            matched_bytes += nbytes * itemsize
+    return {"donatable_inputs": matched, "donatable_bytes": matched_bytes,
+            "total_inputs": len(open_j.invars)}
+
+
+# one HLO instruction: `[ROOT] %name = type opcode(...)`
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*\S+\s+([a-z][\w\-]*)\(")
+
+
+def parse_hlo_stats(text: str) -> Dict[str, int]:
+    """Opcode census of an HLO dump (``compiled.as_text()``): total
+    instruction count plus the opcodes the fusion audit cares about —
+    ``fusion`` (more is better: bigger fused regions), ``copy`` (layout
+    churn splitting fusions), ``custom-call``, host-transfer ops."""
+    stats = {"instructions": 0, "fusions": 0, "copies": 0,
+             "custom_calls": 0, "host_transfers": 0}
+    for line in text.splitlines():
+        m = _HLO_INSTR_RE.match(line)
+        if not m:
+            continue
+        op = m.group(1)
+        stats["instructions"] += 1
+        if op == "fusion":
+            stats["fusions"] += 1
+        elif op == "copy":
+            stats["copies"] += 1
+        elif op == "custom-call":
+            stats["custom_calls"] += 1
+        elif op in ("copy-start", "copy-done", "send", "recv",
+                    "outfeed", "infeed"):
+            stats["host_transfers"] += 1
+    return stats

@@ -41,6 +41,10 @@ def main():
     ap.add_argument("--files", nargs="*", help="restrict to these files")
     ap.add_argument("--no-analyze", action="store_true",
                     help="skip the static-analysis gate")
+    ap.add_argument("--trace-audit", action="store_true",
+                    help="also run the trace tier (PTA009/PTA010): "
+                         "compiles every registered entrypoint under "
+                         "JAX_PLATFORMS=cpu and writes trace_audit.json")
     args = ap.parse_args()
 
     if not args.no_analyze:
@@ -54,6 +58,21 @@ def main():
              "--format", "sarif", "--output", "analysis.sarif",
              "paddle_tpu"], cwd=REPO)
         print(f"static analysis: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.trace_audit:
+        # Opt-in: compiles real programs, so it is not part of the default
+        # gate. Forces CPU so the audit never grabs an accelerator that a
+        # concurrent training job owns.
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.analyze", "--strict",
+             "--only", "PTA009,PTA010",
+             "--trace-report", "trace_audit.json", "paddle_tpu"],
+            cwd=REPO, env=env)
+        print(f"trace audit: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
