@@ -112,6 +112,7 @@ from .distributed import DataParallel  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import amp  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import observability  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from . import text  # noqa: E402,F401

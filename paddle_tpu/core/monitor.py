@@ -9,9 +9,13 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Dict, Union
+from typing import Dict, Tuple, Union
 
 Number = Union[int, float]
+
+#: sorted ``(key, value)`` pairs — the canonical identity of one labeled
+#: time series (insertion-order-insensitive, hashable).
+LabelSet = Tuple[Tuple[str, str], ...]
 
 #: default reservoir bound per histogram — old samples roll off so quantiles
 #: track recent behaviour (a sliding window, not all-time).
@@ -88,28 +92,53 @@ class StatRegistry:
         self._lock = threading.Lock()
         self._stats: Dict[str, Number] = {}
         self._hists: Dict[str, _Histogram] = {}
+        # labeled gauges: name -> {sorted (k, v) label tuple -> value}
+        self._labeled: Dict[str, Dict[LabelSet, Number]] = {}
+        # exposition kind per scalar stat: "counter" (add) | "gauge" (set).
+        # First writer wins so a stat that is both add()ed and set() keeps a
+        # stable TYPE line across scrapes.
+        self._kinds: Dict[str, str] = {}
 
     def add(self, name: str, value: Number) -> Number:
         with self._lock:
             self._stats[name] = self._stats.get(name, 0) + value
+            self._kinds.setdefault(name, "counter")
             return self._stats[name]
 
     def set(self, name: str, value: Number):
         with self._lock:
             self._stats[name] = value
+            self._kinds.setdefault(name, "gauge")
 
     def get(self, name: str, default: Number = 0) -> Number:
         with self._lock:
             return self._stats.get(name, default)
+
+    def set_labeled(self, name: str, labels: Dict[str, str], value: Number):
+        """Gauge with label dimensions (one time series per label set),
+        e.g. ``set_labeled("serving.llm.slot_state", {"state": "busy"}, 3)``.
+        Labels are normalized to a sorted tuple so insertion order never
+        forks a series."""
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._labeled.setdefault(name, {})[key] = value
+
+    def labeled(self, name: str) -> Dict[LabelSet, Number]:
+        with self._lock:
+            return dict(self._labeled.get(name, {}))
 
     def reset(self, name: str = None):
         with self._lock:
             if name is None:
                 self._stats.clear()
                 self._hists.clear()
+                self._labeled.clear()
+                self._kinds.clear()
             else:
                 self._stats.pop(name, None)
                 self._hists.pop(name, None)
+                self._labeled.pop(name, None)
+                self._kinds.pop(name, None)
 
     def stats(self) -> Dict[str, Number]:
         with self._lock:
@@ -156,10 +185,29 @@ class StatRegistry:
             return {k: h.summary() for k, h in self._hists.items()
                     if k.startswith(prefix)}
 
+    def snapshot(self) -> Dict[str, Dict]:
+        """One internally-consistent view of every stat, histogram, labeled
+        series and kind, taken under a *single* lock acquisition.
+
+        ``stats()`` + ``histograms()`` back-to-back each lock separately, so
+        a concurrent ``observe``/``add`` between the two calls yields a
+        counter that disagrees with its histogram (e.g. ``requests`` ==
+        hist count + 1). Exposition (/metricsz, print_stats, flight dumps)
+        must use this instead."""
+        with self._lock:
+            return {
+                "stats": dict(self._stats),
+                "kinds": dict(self._kinds),
+                "histograms": {k: h.summary()
+                               for k, h in self._hists.items()},
+                "labeled": {k: dict(v) for k, v in self._labeled.items()},
+            }
+
     def print_stats(self):
-        for k, v in sorted(self.stats().items()):
+        snap = self.snapshot()
+        for k, v in sorted(snap["stats"].items()):
             print(f"STAT {k} = {v}")
-        for k, s in sorted(self.histograms().items()):
+        for k, s in sorted(snap["histograms"].items()):
             print(f"HIST {k} = count={s['count']} p50={s['p50']:.6g} "
                   f"p95={s['p95']:.6g} p99={s['p99']:.6g}")
 
@@ -182,6 +230,11 @@ def stat_set(name: str, value: Number):
 
 def stat_get(name: str, default: Number = 0) -> Number:
     return _REGISTRY.get(name, default)
+
+
+def stat_set_labeled(name: str, labels: Dict[str, str], value: Number):
+    """Labeled gauge on the default registry (one series per label set)."""
+    _REGISTRY.set_labeled(name, labels, value)
 
 
 def stat_observe(name: str, value: Number,

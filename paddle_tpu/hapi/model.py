@@ -9,6 +9,7 @@ compiled Program served, with the dygraph API surface.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import List, Optional
 
 import numpy as np
@@ -22,9 +23,26 @@ from ..nn.layer_base import Layer
 from ..metric import Metric
 from ..io import DataLoader, Dataset
 from ..jit.functionalize import trace_context, swap_params
+from ..observability import tracer as _otrace
 from .callbacks import config_callbacks
 from .. import framework_io
 
+
+
+def _mark_first_compile(tag, jitted):
+    """Wrap a jitted callable so its first invocation — where jax traces,
+    lowers and compiles — lands on the span timeline as ``jit/compile``.
+    Later calls pay one list check (~ns against a ms-scale step)."""
+    done = []
+
+    def call(*args):
+        if not done:
+            done.append(1)
+            with _otrace.span("jit/compile", {"fn": tag}):
+                return jitted(*args)
+        return jitted(*args)
+
+    return call
 
 
 def _effect_fixed_indices(ts):
@@ -55,7 +73,19 @@ class Model:
         self._metrics: List[Metric] = []
         self.stop_training = False
         self._eval_fns_max = 64         # LRU bound (cf. dispatch cache)
+        self._step_meter = None         # opt-in MFU meter (attach_step_meter)
         self._invalidate_compiled()
+
+    def attach_step_meter(self, meter=None):
+        """Opt into live MFU accounting: publishes ``train.mfu`` /
+        ``train.flops_per_step`` / ``train.step_ms`` per train_batch.
+        FLOPs come from one extra XLA cost-analysis compile per train-step
+        signature (docs/observability.md)."""
+        if meter is None:
+            from ..observability.stepmeter import StepMeter
+            meter = StepMeter(prefix="train")
+        self._step_meter = meter
+        return meter
 
     def _invalidate_compiled(self):
         """Drop every compiled program. The step/loop closures capture the
@@ -179,7 +209,9 @@ class Model:
             return loss, preds, list(grads), effects
 
         jitted = jax.jit(step, donate_argnums=(0, 2))
-        return {"fn": jitted, "grads_fn": jax.jit(grads_only),
+        return {"fn": _mark_first_compile("train_step", jitted),
+                "grads_fn": _mark_first_compile("train_grads",
+                                                jax.jit(grads_only)),
                 "raw_step": step, "fwd_loss": fwd_loss, "meta": meta,
                 "state": state, "trainable": trainable, "t_pos": t_pos,
                 "fixed_pos": fixed_pos}
@@ -530,6 +562,21 @@ class Model:
 
     def train_batch(self, inputs, labels=None, update=True):
         """One fused train step (reference: model.py train_batch)."""
+        meter = self._step_meter
+        if meter is None and not _otrace._ENABLED[0]:
+            return self._train_batch_impl(inputs, labels, update)
+        t0 = _time.perf_counter()
+        with _otrace.span("train/step"):
+            out = self._train_batch_impl(inputs, labels, update)
+        if meter is not None:
+            # the impl's float(loss) fetch synchronizes, so this wall time
+            # is real device+host step time, not async-dispatch time
+            ts = self._train_step_fn
+            meter.step(_time.perf_counter() - t0,
+                       flops=ts.get("flops") if ts else None)
+        return out
+
+    def _train_batch_impl(self, inputs, labels=None, update=True):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else (
             [labels] if labels is not None else [])
@@ -549,6 +596,17 @@ class Model:
         train_raws = [p._data for p in ts["trainable"]]
         fixed_raws = [ts["state"][i]._data for i in ts["fixed_pos"]]
         key = _gen.next_key()
+        if self._step_meter is not None and "flops" not in ts:
+            # once per compiled signature: XLA cost analysis of the fused
+            # step (paddle.flops convention — see observability.stepmeter)
+            from ..observability import stepmeter as _sm
+            lr0 = jnp.asarray(opt.get_lr(), jnp.float32)
+            st0 = jnp.asarray(1.0, jnp.float32)
+            with _otrace.span("observability/cost_analysis"):
+                ts["flops"] = _sm.compiled_flops(
+                    ts["raw_step"], train_raws, fixed_raws, opt_states,
+                    x_raws, y_raws, key, lr0, st0)
+            self._step_meter.set_flops_per_step(ts["flops"])
         if not update:
             # gradient accumulation (reference train_batch(update=False)):
             # accumulate into .grad, defer clip/regularize/step
@@ -717,30 +775,41 @@ class Model:
         self.stop_training = False
         cbks.on_train_begin()
         it = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                xs, ys = self._split_batch(batch)
-                self._last_batch = (xs, ys)  # for sentinel quarantine dumps
-                loss, metrics = self.train_batch(xs, ys)
-                logs = {"loss": loss}
-                for m, r in zip(self._metrics, metrics):
-                    logs[m.name() if isinstance(m.name(), str) else
-                         m.name()[0]] = r
-                cbks.on_train_batch_end(step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
+        try:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    xs, ys = self._split_batch(batch)
+                    self._last_batch = (xs, ys)  # for sentinel quarantine dumps
+                    loss, metrics = self.train_batch(xs, ys)
+                    logs = {"loss": loss}
+                    for m, r in zip(self._metrics, metrics):
+                        logs[m.name() if isinstance(m.name(), str) else
+                             m.name()[0]] = r
+                    cbks.on_train_batch_end(step, logs)
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        break
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    self.evaluate(eval_loader, verbose=verbose,
+                                  callbacks=cbks.callbacks, _inner=True)
+                if self.stop_training or (num_iters is not None
+                                          and it >= num_iters):
                     break
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, verbose=verbose,
-                              callbacks=cbks.callbacks, _inner=True)
-            if self.stop_training or (num_iters is not None and it >= num_iters):
-                break
+        except Exception as e:
+            # post-mortem timeline for the guarded loop; dump only when the
+            # flight recorder is armed (observability.enable / env)
+            from ..observability import flight as _flight
+            _flight.record_event("train_loop_exception",
+                                 {"error": f"{type(e).__name__}: {e}",
+                                  "iteration": it})
+            _flight.dump_if_armed("train_loop_exception")
+            raise
         cbks.on_train_end(logs)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from ...observability import tracer as _otrace
 from ...utils.resilience import fault_injector
 
 
@@ -98,6 +99,11 @@ def save_sharded(state, path: str, overwrite: bool = True):
     """Write ``state`` (nested dict/list of Tensors/arrays/scalars) as a
     sharded checkpoint directory. Safe to call from every process of a
     multi-host job — each writes its own files."""
+    with _otrace.span("checkpoint/save", {"path": path}):
+        return _save_sharded_impl(state, path, overwrite)
+
+
+def _save_sharded_impl(state, path: str, overwrite: bool):
     os.makedirs(path, exist_ok=True)
     proc = jax.process_index()
     flat = _flatten(state)
@@ -249,6 +255,11 @@ def load_sharded(path: str, mesh=None, return_tensor: bool = True,
 
     ``verify=True`` (default) checksum-verifies every shard archive first
     and raises :class:`CheckpointIntegrityError` on a torn checkpoint."""
+    with _otrace.span("checkpoint/load", {"path": path}):
+        return _load_sharded_impl(path, mesh, return_tensor, verify)
+
+
+def _load_sharded_impl(path: str, mesh, return_tensor: bool, verify: bool):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if fault_injector().fire("load") == "corrupt":

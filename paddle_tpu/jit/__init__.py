@@ -35,6 +35,7 @@ from ..core import dtypes as _dt
 from ..core import generator as _gen
 from ..ops.dispatch import apply
 from ..core import autograd_engine as _ag
+from ..observability import tracer as _otrace
 from .functionalize import build_pure
 
 
@@ -116,7 +117,8 @@ class StaticFunction:
                   and _is_float(l.dtype)]
 
         entry = self._cache.get(key)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             pure, meta = build_pure(self._fn, state)
 
             # fwd: one compiled XLA program (params, inputs, key) -> outs+effects
@@ -152,7 +154,16 @@ class StaticFunction:
 
         call_key = _gen.next_key()
         skw = _HashableKwargs(kwargs) if kwargs else None
-        out_raws = entry["fwd"](state_raws, in_raws, call_key, skw)
+        if fresh:
+            # first call on a new signature is where jax traces + lowers +
+            # compiles the fwd program — stamp it on the span timeline so
+            # recompile storms are visible next to train/step spans
+            with _otrace.span(
+                    "jit/compile",
+                    {"fn": getattr(self._fn, "__name__", "fn")}):
+                out_raws = entry["fwd"](state_raws, in_raws, call_key, skw)
+        else:
+            out_raws = entry["fwd"](state_raws, in_raws, call_key, skw)
 
         need_grad = _ag.is_grad_enabled() and (diff_s or diff_i)
         node = None

@@ -1,0 +1,163 @@
+"""Crash flight recorder: last-N events + spans + stats, dumped on failure.
+
+A bounded ring (GIL-atomic deque, same lock-free discipline as the span
+tracer) continuously records cheap structured events — sentinel verdicts,
+drain transitions, engine lifecycle. On a terminal event the ring is
+dumped as JSONL so the post-mortem has the timeline that led to the exit:
+
+* sentinel **halt** (exit 119) — ``paddle_tpu.sentinel.policy`` dumps
+  before ``sys.exit``
+* **unhandled exception** in guarded loops (hapi ``fit``, engine workers)
+* **SIGTERM drain** — via ``install_signal_dump()`` on the existing
+  ``ChainedSignalHandler`` chain, or the engines' drain path
+
+Dump format (``flight_<ts>_<pid>.jsonl``): line 1 is a header
+``{"schema": "paddle-tpu-flight/1", "reason": ...}``; then one line per
+recorded event (``{"kind": ...}``), then the last spans
+(``{"kind": "span", ...}``), and a final ``{"kind": "stats", ...}``
+registry snapshot.
+
+Dumping on crash paths is **opt-in** ("armed"): set ``PADDLE_TPU_FLIGHT=1``
+(or call ``arm()``; enabling tracing also arms) so ordinary test failures
+don't litter dump files. Recording into the ring is always on — it is two
+dict allocs per event.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..core import monitor as _monitor
+from . import tracer as _tracer
+
+SCHEMA = "paddle-tpu-flight/1"
+
+DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY", "512"))
+
+#: how many of the newest spans a dump includes
+DUMP_SPAN_LIMIT = 256
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._events = deque(maxlen=capacity)  # GIL-atomic append
+        self.armed = False
+        self.last_dump_path: Optional[str] = None
+
+    def record(self, kind: str, fields: Optional[Dict] = None):
+        ev = {"kind": kind, "wall_s": time.time()}
+        if fields:
+            ev.update(fields)
+        self._events.append(ev)
+
+    def events(self) -> List[Dict]:
+        return list(self._events)
+
+    def clear(self):
+        self._events.clear()
+
+    def dump(self, reason: str, directory: Optional[str] = None,
+             registry: Optional["_monitor.StatRegistry"] = None,
+             tracer: Optional["_tracer.SpanTracer"] = None) -> str:
+        """Write the flight JSONL; returns the path. Never raises (a
+        post-mortem writer must not mask the original failure) — on write
+        error it returns the path it attempted."""
+        directory = (directory
+                     or os.environ.get("PADDLE_TPU_FLIGHT_DIR")
+                     or os.getcwd())
+        ts = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(directory,
+                            f"flight_{ts}_{os.getpid()}.jsonl")
+        t = tracer if tracer is not None else _tracer.default_tracer()
+        reg = registry if registry is not None else _monitor.default_registry()
+        try:
+            os.makedirs(directory, exist_ok=True)
+            with open(path, "w") as f:
+                header = {
+                    "schema": SCHEMA,
+                    "reason": reason,
+                    "pid": os.getpid(),
+                    "wall_s": time.time(),
+                    "argv": list(sys.argv),
+                }
+                f.write(json.dumps(header, default=str) + "\n")
+                for ev in list(self._events):
+                    f.write(json.dumps(ev, default=str) + "\n")
+                for s in t.spans()[-DUMP_SPAN_LIMIT:]:
+                    rec = {"kind": "span"}
+                    rec.update(s)
+                    f.write(json.dumps(rec, default=str) + "\n")
+                snap = reg.snapshot()
+                f.write(json.dumps({"kind": "stats",
+                                    "stats": snap["stats"],
+                                    "histograms": snap["histograms"]},
+                                   default=str) + "\n")
+        except OSError as e:
+            sys.stderr.write(f"[paddle_tpu.flight] dump to {path} "
+                             f"failed: {e}\n")
+        self.last_dump_path = path
+        return path
+
+
+_RECORDER = FlightRecorder()
+
+
+def default_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def record_event(kind: str, fields: Optional[Dict] = None):
+    """Append one event to the default flight ring (always cheap/on)."""
+    _RECORDER.record(kind, fields)
+
+
+def arm():
+    """Enable crash-path dumps (sentinel halt / unhandled exception /
+    SIGTERM drain). Recording is always on; arming controls file output."""
+    _RECORDER.armed = True
+
+
+def disarm():
+    _RECORDER.armed = False
+
+
+def is_armed() -> bool:
+    return _RECORDER.armed
+
+
+def dump(reason: str, directory: Optional[str] = None) -> str:
+    return _RECORDER.dump(reason, directory=directory)
+
+
+def dump_if_armed(reason: str) -> Optional[str]:
+    """Crash-path hook: dump only when armed, never raise."""
+    if not _RECORDER.armed:
+        return None
+    return _RECORDER.dump(reason)
+
+
+def install_signal_dump(signum: Optional[int] = None):
+    """Chain a flight dump onto SIGTERM (preemption) via the shared
+    ChainedSignalHandler — previously-installed handlers (engine drain,
+    elastic supervisor) still run. Returns the handler (``uninstall()``
+    to remove); None off the main thread."""
+    import signal as _signal
+    from ..distributed.elastic import ChainedSignalHandler
+
+    sig = signum if signum is not None else _signal.SIGTERM
+
+    def _on_signal(s, frame):
+        record_event("signal", {"signum": s})
+        dump_if_armed("signal_%d" % s)
+
+    h = ChainedSignalHandler(_on_signal, signals=(sig,))
+    h.install()
+    return h if h.installed else None
+
+
+if os.environ.get("PADDLE_TPU_FLIGHT", "").lower() in ("1", "true", "on"):
+    arm()

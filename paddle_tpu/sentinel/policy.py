@@ -265,6 +265,13 @@ class Sentinel:
 
     # -- actions -------------------------------------------------------------
     def _apply(self, action: str, optimizer, report: AnomalyReport, batch):
+        # every escalation rung lands in the flight ring (always cheap);
+        # the file dump below happens only at halt
+        from ..observability import flight as _flight
+        _flight.record_event("sentinel", {
+            "action": action, "step": report.step,
+            "reasons": list(report.reasons), "loss": report.loss,
+            "z": report.z})
         if action in ("quarantine_batch", "halt"):
             quarantine_batch(self.config.quarantine_dir, report.step, batch,
                              report.reasons, loss=report.loss, z=report.z,
@@ -273,6 +280,10 @@ class Sentinel:
             report.rolled_back_to = self._do_rollback(optimizer)
         if action == "halt":
             _monitor.stat_add("sentinel.halts", 1)
+            dump_path = _flight.dump_if_armed("sentinel_halt")
+            if dump_path:
+                sys.stderr.write(
+                    f"[sentinel] flight recording: {dump_path}\n")
             sys.stderr.write(
                 f"[sentinel] halting at step {report.step}: "
                 f"{', '.join(report.reasons)} (escalation exhausted after "

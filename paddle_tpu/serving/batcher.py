@@ -15,6 +15,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
+from ..observability import tracer as _otrace
 from .buckets import BucketSpec
 from .queue import BatchQueue
 from .request import InferenceRequest
@@ -57,6 +58,12 @@ class DynamicBatcher:
         first = self._queue.take(timeout=timeout)
         if first is None:
             return None
+        # span covers the coalesce window only — the idle blocking take
+        # above would otherwise fill the trace ring with empty polls
+        with _otrace.span("serving/form_batch"):
+            return self._coalesce(first)
+
+    def _coalesce(self, first: InferenceRequest) -> Batch:
         spec = self._buckets
         if first.nrows > spec.max_batch:
             return Batch([first], bucket_rows=None,

@@ -12,8 +12,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from ..core import monitor as _mon
+from ..observability import tracer as _tracer
 
 #: signature element: ((dim, ...), dtype-string) per input array
 SigT = Tuple[Tuple[Tuple[int, ...], str], ...]
@@ -58,7 +62,16 @@ class ExecutableCache:
                 self.hits += 1
                 return entry
             self.misses += 1
-        compiled = compile_fn()
+        # compile hook: stamp every miss with its build duration (for jit
+        # entries this is trace+lower; XLA compile itself may still be
+        # deferred to first execution) — recompile pressure shows up as a
+        # `jit.compile_ms` histogram and on the span timeline.
+        t0 = time.perf_counter()
+        with _tracer.span("jit/compile", {"cache_key": repr(key)[:200]}):
+            compiled = compile_fn()
+        _mon.stat_observe("jit.compile_ms",
+                          (time.perf_counter() - t0) * 1e3)
+        _mon.stat_add("jit.cache_misses", 1)
         with self._lock:
             winner = self._entries.setdefault(key, compiled)
             self._entries.move_to_end(key)

@@ -25,6 +25,8 @@ import numpy as np
 
 from ..core import monitor as _mon
 from ..distributed.elastic import ChainedSignalHandler, PreemptionGuard
+from ..observability import flight as _flight
+from ..observability import tracer as _otrace
 from .batcher import Batch, DynamicBatcher
 from .buckets import BucketSpec, pad_rows, pad_seq, unpad_rows
 from .cache import ExecutableCache, default_cache, signature_of
@@ -84,6 +86,7 @@ class DrainableEngineBase:
         self._stopped = threading.Event()
         self._guard: Optional[PreemptionGuard] = None
         self._signal_chain: Optional[ChainedSignalHandler] = None
+        self._drain_signaled = False  # set (only) from _on_drain_signal
 
     @property
     def registry(self) -> _mon.StatRegistry:
@@ -115,7 +118,10 @@ class DrainableEngineBase:
         """Async-signal-safe drain trigger: only sets the flag. Closing the
         queue takes its lock — if the signal lands while the interrupted
         thread holds that lock, a close() here would self-deadlock — so the
-        worker loop performs the close at its next poll."""
+        worker loop performs the close at its next poll. The flight dump
+        happens on the worker thread for the same reason (file IO here
+        would run in signal context)."""
+        self._drain_signaled = True
         self._draining.set()
 
     def begin_drain(self):
@@ -304,6 +310,12 @@ class Engine(DrainableEngineBase):
                 self._execute(batch)
                 self._publish_cache_stats()
         finally:
+            if self._drain_signaled:
+                # SIGTERM-initiated drain: leave the post-mortem timeline
+                # (worker thread — never in signal context)
+                _flight.record_event("sigterm_drain",
+                                     {"engine": self._prefix})
+                _flight.dump_if_armed("sigterm_drain")
             self._stopped.set()
 
     def _publish_cache_stats(self):
@@ -328,6 +340,10 @@ class Engine(DrainableEngineBase):
         return [np.asarray(o) for o in outs]
 
     def _execute(self, batch: Batch):
+        with _otrace.span("serving/execute_batch"):
+            self._execute_inner(batch)
+
+    def _execute_inner(self, batch: Batch):
         t0 = time.monotonic()
         reqs = batch.requests
         try:

@@ -20,6 +20,9 @@ Endpoints:
     latency/fill histograms (p50/p95/p99), executable-cache hit/miss/evict;
     with an LLM engine attached, its payload (slot occupancy, TTFT/TPOT,
     tokens/s) rides along under ``"llm"``.
+  * ``GET /metricsz`` — the same registries in Prometheus text exposition
+    (format 0.0.4) for standard scrapers; see docs/observability.md for a
+    scrape-config example.
 
 Threading model: ``ThreadingHTTPServer`` handles each connection on its
 own thread; handlers block on the request future (or the token stream),
@@ -80,8 +83,27 @@ class _Handler(BaseHTTPRequestHandler):
             if llm is not None:
                 payload["llm"] = llm.stats()
             self._send_json(200, payload)
+        elif self.path == "/metricsz":
+            self._do_metricsz(engine, llm)
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
+
+    def _do_metricsz(self, engine, llm):
+        """Prometheus text exposition of every mounted engine's registry.
+        Engines usually share the default registry (one render); distinct
+        registries concatenate safely because their stat namespaces
+        (``serving.`` vs ``serving.llm.``) sanitize to disjoint families."""
+        from ..observability.metrics import CONTENT_TYPE, render_prometheus
+        regs = []
+        for e in (engine, llm):
+            if e is not None and all(e.registry is not r for r in regs):
+                regs.append(e.registry)
+        body = "".join(render_prometheus(r) for r in regs).encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _read_payload(self) -> dict:
         n = int(self.headers.get("Content-Length", 0))

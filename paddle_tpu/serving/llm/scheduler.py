@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 
 from ...core import monitor as _mon
+from ...observability import flight as _flight
+from ...observability import tracer as _otrace
 from ..buckets import pow2_buckets
 from ..cache import ExecutableCache
 from ..engine import DrainableEngineBase
@@ -156,6 +158,7 @@ class LLMEngineConfig:
                  idle_poll: float = 0.01,
                  warmup: bool = True,
                  seed: int = 0,
+                 measure_mfu: bool = False,
                  stat_prefix: str = "serving.llm"):
         self.num_slots = int(num_slots)
         self.max_seq = int(max_seq)
@@ -177,6 +180,9 @@ class LLMEngineConfig:
         self.idle_poll = float(idle_poll)
         self.warmup = bool(warmup)
         self.seed = int(seed)
+        # opt-in: publish `serving.llm.mfu` from XLA cost analysis of the
+        # decode step (costs one extra compile at the first tick)
+        self.measure_mfu = bool(measure_mfu)
         self.stat_prefix = stat_prefix
 
     @property
@@ -221,6 +227,9 @@ class ContinuousBatcher:
         self._finished = jnp.zeros((config.num_slots,), jnp.bool_)
         self._last = jnp.zeros((config.num_slots,), jnp.int32)
         self._rng = jax.random.PRNGKey(config.seed)
+        # decode-step FLOPs (measure_mfu): measured lazily at first tick
+        self._decode_flops: Optional[float] = None
+        self._peak_flops: Optional[float] = None
 
     # -- introspection -------------------------------------------------------
     @property
@@ -254,6 +263,10 @@ class ContinuousBatcher:
         """Prefill ``req`` into a free slot and deliver its first token.
         The caller guarantees ``free_slots > 0`` and a bucket-fitting
         prompt (``submit`` validated both)."""
+        with _otrace.span("serving.llm/prefill"):
+            self._admit_inner(req)
+
+    def _admit_inner(self, req: GenerationRequest):
         t0 = self._clock()
         slot = self.kv.alloc()
         self._reqs[slot] = req
@@ -285,6 +298,12 @@ class ContinuousBatcher:
         number of active sequences advanced."""
         if not self._reqs:
             return 0
+        with _otrace.span("serving.llm/decode_tick"):
+            return self._tick_inner()
+
+    def _tick_inner(self) -> int:
+        if self.config.measure_mfu and self._decode_flops is None:
+            self._measure_decode_flops()
         t0 = self._clock()
         nxt, self._finished = self.decoder.decode_step(
             self.kv, self._params, self._finished, self._last,
@@ -300,6 +319,10 @@ class ContinuousBatcher:
         self._stat_observe("tpot_ms", dt * 1000.0)
         self._stat_add("tokens_generated", n)
         self._stat_set("tokens_per_sec", n / dt)
+        if self._decode_flops:
+            # tick wall time includes the sanctioned token fetch, so this
+            # is delivered MFU, not device-only MFU
+            self._stat_set("mfu", self._decode_flops / dt / self._peak_flops)
         for slot, req in list(self._reqs.items()):
             if req.expired:
                 self._evict(slot, req)
@@ -308,6 +331,23 @@ class ContinuousBatcher:
             req._emit(tok)
             self._maybe_finish(slot, req, tok)
         return n
+
+    def _measure_decode_flops(self):
+        """XLA cost analysis of THE decode step (once, at first tick when
+        ``measure_mfu``): compiles the raw program a second time to read
+        its flops without executing. Failure disables MFU, never decode."""
+        from ...observability import stepmeter as _sm
+        from .decode import build_decode_step
+        raw = build_decode_step(self.decoder.spec, self.decoder.max_top_k)
+        with _otrace.span("observability/cost_analysis"):
+            flops = _sm.compiled_flops(
+                raw, self._params, self.kv.k, self.kv.v, self.kv.lengths,
+                self._finished, self._last, *self._samp_vecs,
+                jax.random.PRNGKey(0))
+        self._peak_flops = _sm.default_peak_flops()
+        self._decode_flops = flops if flops else 0.0
+        if flops:
+            self._stat_set("decode_flops_per_tick", flops)
 
     def _maybe_finish(self, slot: int, req: GenerationRequest, tok: int):
         s = req.sampling
@@ -522,12 +562,22 @@ class LLMEngine(DrainableEngineBase):
                     break
                 self._publish_cache_stats()
         except BaseException as e:  # worker death must not strand futures
+            _flight.record_event(
+                "llm_worker_death",
+                {"error": f"{type(e).__name__}: {e}",
+                 "active": self._batcher.active,
+                 "queued": len(self._queue)})
+            _flight.dump_if_armed("llm_worker_death")
             self._batcher.abort_all(
                 lambda req, e=e: RuntimeError(
                     f"LLM worker died while request {req.req_id} was in "
                     f"flight: {e!r}"))
             raise
         finally:
+            if self._drain_signaled:
+                _flight.record_event("sigterm_drain",
+                                     {"engine": self._prefix})
+                _flight.dump_if_armed("sigterm_drain")
             self._stopped.set()
 
     def _publish_cache_stats(self):

@@ -15,6 +15,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..observability import tracer as _otrace
 from .request import EngineDraining, InferenceRequest, QueueFull
 
 
@@ -61,6 +62,13 @@ class BatchQueue:
     # -- producer side ------------------------------------------------------
     def put(self, req: InferenceRequest, block: bool = True,
             timeout: Optional[float] = None):
+        # admission span: shows queue backpressure (blocked puts) on the
+        # timeline next to the worker's execute spans
+        with _otrace.span("serving/queue_put"):
+            self._put(req, block, timeout)
+
+    def _put(self, req: InferenceRequest, block: bool,
+             timeout: Optional[float]):
         with self._not_full:
             if self._closed:
                 raise EngineDraining("engine is draining; request rejected")

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, stable_uid
 from ..core import dtypes as _dt
+from ..observability import tracer as _otrace
 from .graph import Program, Variable, default_main_program
 
 
@@ -107,12 +108,23 @@ class Executor:
         params = program.all_parameters()
         opt = program._optimizer
         entry = self._cache.get(key) if use_program_cache else None
-        if entry is None:
-            entry = self._compile(program, feed_names, fetch_list, params, opt,
-                                  feed_vals)
+        fresh = entry is None
+        if fresh:
+            with _otrace.span("jit/compile", {"fn": "executor_program"}):
+                entry = self._compile(program, feed_names, fetch_list,
+                                      params, opt, feed_vals)
             if use_program_cache:
                 self._cache[key] = entry
 
+        # first entry() call traces+compiles the XLA program, so the fresh
+        # run's span contains that cost on the timeline
+        with _otrace.span("static/executor_run", {"fresh": fresh}
+                          if fresh else None):
+            return self._run_entry(entry, program, params, opt, feed_vals,
+                                   feed_names, return_numpy)
+
+    def _run_entry(self, entry, program, params, opt, feed_vals, feed_names,
+                   return_numpy):
         param_raws = [p._data for p in params]
         if opt is not None:
             for p in params:

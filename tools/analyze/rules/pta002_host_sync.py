@@ -37,6 +37,9 @@ HOT_PREFIXES = (
     # sanctioned fetches (per-tick token vector, admission-time first
     # token) carry noqa justifications.
     "paddle_tpu/serving/llm/",
+    # the telemetry layer sits INSIDE every hot path above (span enter/
+    # exit runs per step / per tick) — a host sync here taxes everything
+    "paddle_tpu/observability/",
 )
 
 SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}

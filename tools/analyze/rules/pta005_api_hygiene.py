@@ -21,6 +21,14 @@ API_PREFIX = "paddle_tpu/"
 
 _MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict"}
 
+#: span constructions that bypass the module-level ``_ENABLED`` gate in
+#: paddle_tpu/observability/tracer.py — in a hot path they allocate a Span
+#: (and run its enter/exit bookkeeping) even when tracing is disabled
+_UNGATED_SPAN_CALLS = {"Span", "SpanTracer", "span_always"}
+
+#: the tracer module itself owns the Span constructor
+_SPAN_CHECK_EXEMPT = ("paddle_tpu/observability/tracer.py",)
+
 
 def _is_mutable_default(node: ast.AST) -> bool:
     if isinstance(node, (ast.List, ast.Dict, ast.Set)):
@@ -59,14 +67,15 @@ class ApiHygieneRule(Rule):
     code = "PTA005"
     name = "api-hygiene"
     description = ("mutable default arguments, missing `from __future__ "
-                   "import annotations`, and unjustified `# noqa: PTA002` "
-                   "in hot-path modules")
+                   "import annotations`, unjustified `# noqa: PTA002` and "
+                   "ungated span construction in hot-path modules")
 
     def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
         if API_PREFIX not in sf.relpath:
             return []
         findings: List[Finding] = []
         findings.extend(self._check_noqa_justifications(sf))
+        findings.extend(self._check_span_fastpath(sf))
         for node in ast.walk(sf.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
@@ -85,6 +94,35 @@ class ApiHygieneRule(Rule):
                 "module uses type annotations without `from __future__ "
                 "import annotations` (eager evaluation at import time)",
                 anchor="no-future-annotations"))
+        return findings
+
+    def _check_span_fastpath(self, sf: SourceFile) -> List[Finding]:
+        """Spans opened in instrumented hot paths must go through the
+        module-level ``observability.span()`` helper, whose disabled path
+        is one list-index check and a shared no-op (mirroring
+        ``profiler._ACTIVE``). Direct ``Span(...)`` construction, private
+        ``SpanTracer(...)`` instances and ``span_always(...)`` all pay
+        allocation + stack bookkeeping on every call even with tracing
+        off — in a per-step/per-tick path that is a standing tax."""
+        # local import: HOT_PREFIXES is owned by the host-sync rule
+        from .pta002_host_sync import HOT_PREFIXES
+        if (not sf.relpath.startswith(HOT_PREFIXES)
+                or sf.relpath in _SPAN_CHECK_EXEMPT):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else "")
+            if name in _UNGATED_SPAN_CALLS:
+                findings.append(sf.finding(
+                    self.code, node,
+                    f"`{name}(...)` in a hot path bypasses the tracer's "
+                    f"zero-alloc disabled fast path — open spans via "
+                    f"`observability.span(...)` (module-level _ENABLED "
+                    f"gate)"))
         return findings
 
     def _check_noqa_justifications(self, sf: SourceFile) -> List[Finding]:

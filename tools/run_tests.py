@@ -44,7 +44,16 @@ def main():
     ap.add_argument("--trace-audit", action="store_true",
                     help="also run the trace tier (PTA009/PTA010): "
                          "compiles every registered entrypoint under "
-                         "JAX_PLATFORMS=cpu and writes trace_audit.json")
+                         "JAX_PLATFORMS=cpu and writes the trace report")
+    ap.add_argument("--trace-audit-output", default="trace_audit.json",
+                    help="where --trace-audit writes its report (default "
+                         "%(default)s, which .gitignore covers; keep "
+                         "custom paths out of the tree too)")
+    ap.add_argument("--bench-check", action="store_true",
+                    help="opt-in gate: compare the two newest BENCH_*.json "
+                         "via tools/check_bench_regression.py and fail on "
+                         "a >5%% throughput drop (same contract as the "
+                         "analyzer gate)")
     args = ap.parse_args()
 
     if not args.no_analyze:
@@ -70,9 +79,19 @@ def main():
         code = subprocess.call(
             [sys.executable, "-m", "tools.analyze", "--strict",
              "--only", "PTA009,PTA010",
-             "--trace-report", "trace_audit.json", "paddle_tpu"],
+             "--trace-report", args.trace_audit_output, "paddle_tpu"],
             cwd=REPO, env=env)
         print(f"trace audit: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.bench_check:
+        t0 = time.time()
+        code = subprocess.call(
+            [sys.executable, os.path.join("tools",
+                                          "check_bench_regression.py")],
+            cwd=REPO)
+        print(f"bench check: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 

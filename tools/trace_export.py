@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Export recorded paddle_tpu spans as Chrome/Perfetto trace JSON.
+
+Two modes:
+
+* **In-process** (the common one): call
+  ``paddle_tpu.observability.export_chrome_trace(path)`` from the program
+  that recorded the spans — the ring lives in that process.
+* **Flight-dump conversion** (this CLI): convert the span records inside a
+  crash ``flight_*.jsonl`` dump into a loadable trace::
+
+      python tools/trace_export.py flight_20260805_1201_17.jsonl \
+          -o trace.perfetto.json
+
+Load the output at https://ui.perfetto.dev or chrome://tracing.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="convert a flight_*.jsonl dump (or a raw span-record "
+                    "JSONL) to Chrome trace_event JSON")
+    ap.add_argument("input", help="flight_*.jsonl dump, or '-' for stdin")
+    ap.add_argument("-o", "--output", default="trace.perfetto.json",
+                    help="output trace path (default %(default)s)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability.export import to_trace_events
+
+    fh = sys.stdin if args.input == "-" else open(args.input)
+    spans, pid, other = [], 0, {}
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("schema"):        # flight header
+                pid = rec.get("pid", 0)
+                other = {"flight_reason": rec.get("reason")}
+            elif rec.get("kind") == "span" or (
+                    "kind" not in rec and "ts_ns" in rec):
+                spans.append(rec)
+    doc = {"traceEvents": to_trace_events(spans, pid=pid),
+           "displayTimeUnit": "ms", "otherData": other}
+    with open(args.output, "w") as f:
+        json.dump(doc, f)
+    print(f"wrote {len(spans)} spans -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
