@@ -24,6 +24,7 @@ from .parallel import (  # noqa: F401
 )
 from .elastic import (  # noqa: F401
     PreemptionGuard, PREEMPTION_EXIT_CODE, under_elastic_supervisor,
+    RestartBudget,
 )
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,

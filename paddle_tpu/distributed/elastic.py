@@ -170,6 +170,60 @@ class PreemptionGuard:
         return False
 
 
+class RestartBudget:
+    """Thread-safe restart accounting shared by the elastic supervisor and
+    the serving replica router.
+
+    One consumer (the supervisor) respawns a whole training job; the other
+    (the router's health loop) resurrects individual replica workers. Both
+    want the same semantics: a hard cap on non-preemption restarts plus
+    exponential backoff with ±20% jitter, capped — so thundering-herd
+    resurrections after a shared fault are decorrelated. ``try_consume``
+    atomically claims one restart (False when exhausted); ``pause`` derives
+    the backoff from how many restarts have been consumed so far.
+    """
+
+    def __init__(self, max_restarts: int = 3, backoff: float = 1.0,
+                 cap: float = 30.0, rng=None):
+        import random as _random
+        self.max_restarts = int(max_restarts)
+        self.backoff0 = float(backoff)
+        self.cap = float(cap)
+        self._rng = rng if rng is not None else _random.Random()
+        self._lock = threading.Lock()
+        self._used = 0
+
+    @property
+    def used(self) -> int:
+        with self._lock:
+            return self._used
+
+    @property
+    def remaining(self) -> int:
+        with self._lock:
+            return max(0, self.max_restarts - self._used)
+
+    def try_consume(self) -> bool:
+        """Atomically claim one restart; False when the budget is spent."""
+        with self._lock:
+            if self._used >= self.max_restarts:
+                return False
+            self._used += 1
+            return True
+
+    def pause(self) -> float:
+        """Backoff for the restart just consumed: ``backoff * 2**(used-1)``
+        capped, with ±20% jitter (same curve the supervisor always used)."""
+        with self._lock:
+            used = self._used
+        base = min(self.backoff0 * (2 ** max(0, used - 1)), self.cap)
+        return base * (1.0 + 0.2 * (2.0 * self._rng.random() - 1.0))
+
+    def __repr__(self):
+        return (f"RestartBudget(used={self.used}/{self.max_restarts}, "
+                f"backoff={self.backoff0}, cap={self.cap})")
+
+
 def maybe_auto_guard(guard: Optional[PreemptionGuard]) -> Optional[PreemptionGuard]:
     """Return ``guard``, or a fresh one when running under the elastic
     supervisor (which sets :data:`ELASTIC_ENV_VAR` in every child)."""

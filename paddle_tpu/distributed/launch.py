@@ -32,7 +32,7 @@ import time
 from typing import List, Optional
 
 from .elastic import (PREEMPTION_EXIT_CODE, DIVERGENCE_EXIT_CODE,
-                      ELASTIC_ENV_VAR)
+                      ELASTIC_ENV_VAR, RestartBudget)
 
 
 def _parse_args(argv=None):
@@ -221,9 +221,15 @@ class ElasticSupervisor:
         self._sleep = sleep
         self.extra_env = dict(extra_env or {})
         self.extra_env.setdefault(ELASTIC_ENV_VAR, "1")
-        self.restarts_used = 0
+        # shared accounting object — the serving replica Router reuses the
+        # same RestartBudget semantics for replica resurrection
+        self.budget = RestartBudget(self.max_restarts, self.backoff0)
         self._drain = False
         self._restart_counts = {}   # rank -> total respawns (incl. free)
+
+    @property
+    def restarts_used(self) -> int:
+        return self.budget.used
 
     def request_drain(self, signum=None, frame=None):
         self._drain = True
@@ -238,12 +244,6 @@ class ElasticSupervisor:
         return _spawn_rank(rank, dead._local_rank, self.endpoints,
                            self.script, self.script_args, self.log_dir,
                            self.extra_env, restart_num=n)
-
-    def _backoff_pause(self):
-        import random
-        delay = min(self.backoff0 * (2 ** max(0, self.restarts_used - 1)),
-                    30.0)
-        return delay * (1.0 + 0.2 * (2.0 * random.random() - 1.0))
 
     def run(self) -> int:
         alive = start_local_trainers(
@@ -295,15 +295,14 @@ class ElasticSupervisor:
                             f"terminating the job\n")
                         terminate_local_procs(alive, self.grace_period)
                         return ret
-                    if self.restarts_used >= self.max_restarts:
+                    if not self.budget.try_consume():
                         sys.stderr.write(
                             f"rank {p._rank} exited with code {ret}; "
                             f"restart budget ({self.max_restarts}) "
                             f"exhausted — terminating the job\n")
                         terminate_local_procs(alive, self.grace_period)
                         return ret
-                    self.restarts_used += 1
-                    pause = self._backoff_pause()
+                    pause = self.budget.pause()
                     sys.stderr.write(
                         f"rank {p._rank} exited with code {ret}; "
                         f"restarting in {pause:.2f}s "

@@ -18,5 +18,5 @@ and file write off the training thread (the orbax-style pattern).
 from .sharded import (save_sharded, load_sharded, AsyncSaver,  # noqa: F401
                       CheckpointIntegrityError, verify_checkpoint,
                       HEALTH_STAMP_FILE, write_health_stamp,
-                      read_health_stamp)
+                      read_health_stamp, newest_healthy_checkpoint)
 from .auto_checkpoint import TrainEpochRange, train_epoch_range  # noqa: F401

@@ -239,6 +239,67 @@ def read_health_stamp(path: str) -> Dict[str, Any]:
     return stamp
 
 
+def _is_checkpoint_dir(path: str) -> bool:
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return any(n.startswith("metadata_") and n.endswith(".json")
+               for n in names)
+
+
+def newest_healthy_checkpoint(root: str,
+                              verify: bool = True) -> Optional[str]:
+    """Walk ``root`` for the newest checkpoint that is health-stamped sane
+    (and, with ``verify``, passes the checksum sweep). The boot path of a
+    resurrecting serving replica: pick the freshest state the sentinel
+    vouched for, skipping newer-but-diverged saves.
+
+    ``root`` may itself be a checkpoint dir, or a directory of checkpoint
+    subdirs (``step_100/``, ``step_200/``, …). Candidates are ordered by
+    the numeric suffix in their name when one exists (``step_200`` >
+    ``step_100``), falling back to mtime. Unhealthy, unverifiable, or
+    corrupt candidates are skipped with a warning; returns None when
+    nothing survives.
+    """
+    import re
+    import warnings
+    if _is_checkpoint_dir(root):
+        cands = [root]
+    else:
+        try:
+            names = sorted(os.listdir(root))
+        except OSError:
+            return None
+        cands = [os.path.join(root, n) for n in names
+                 if _is_checkpoint_dir(os.path.join(root, n))]
+
+    def _order(p):
+        m = re.search(r"(\d+)$", os.path.basename(p.rstrip(os.sep)))
+        step = int(m.group(1)) if m else -1
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            mtime = 0.0
+        return (step, mtime)
+
+    for cand in sorted(cands, key=_order, reverse=True):
+        stamp = read_health_stamp(cand)
+        if not stamp.get("healthy", True):
+            warnings.warn(
+                f"skipping checkpoint {cand}: health stamp says unhealthy"
+                f" ({stamp.get('reason', 'no reason recorded')})")
+            continue
+        if verify:
+            try:
+                verify_checkpoint(cand)
+            except CheckpointIntegrityError as e:
+                warnings.warn(f"skipping checkpoint {cand}: {e}")
+                continue
+        return cand
+    return None
+
+
 def _meta_entries(m):
     """Entries map from a format-2 doc or a legacy format-1 bare map."""
     if isinstance(m, dict) and m.get("format") == 2:

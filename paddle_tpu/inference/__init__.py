@@ -32,6 +32,33 @@ class Config:
         self._prefix = prog_file
         self._params_file = params_file
         self._enable_memory_optim = True
+        # "auto": honor a .pdsharding.json sidecar when one exists;
+        # None: force replicated; dict: an explicit enable_sharding request
+        self._sharding_request = "auto"
+
+    def enable_sharding(self, mesh=None, mesh_axes=None, input_specs=None,
+                        param_specs=None, devices=None):
+        """Request GSPMD-partitioned execution (the TPU-era analog of the
+        multi-device knobs this Config otherwise stubs out).
+
+        Any argument left None is filled from the artifact's
+        ``.pdsharding.json`` sidecar at load; an explicit ``mesh`` wins
+        over ``mesh_axes`` + ``devices`` (which build a sub-mesh over the
+        first ``prod(sizes)`` of ``devices``). Mismatches between the spec
+        and the visible devices warn and fall back to replicated — see
+        :mod:`paddle_tpu.serving.sharding`."""
+        self._sharding_request = {
+            "mesh": mesh, "mesh_axes": mesh_axes,
+            "input_specs": input_specs, "param_specs": param_specs,
+            "devices": devices,
+        }
+        return self
+
+    def disable_sharding(self):
+        """Force replicated single-device execution, ignoring any
+        ``.pdsharding.json`` sidecar."""
+        self._sharding_request = None
+        return self
 
     def set_prog_file(self, path):
         self._prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
@@ -106,6 +133,54 @@ class Predictor:
         self._inputs: Dict[str, _IOHandle] = {
             n: _IOHandle() for n in self._input_names}
         self._outputs: List[_IOHandle] = []
+        # GSPMD partitioning: resolve the config request / sidecar into
+        # per-input + per-param NamedShardings (None -> replicated path)
+        self._sharding = self._resolve_sharding(config, prefix,
+                                                max(n_in, 0))
+        if self._sharding is not None:
+            self._params = [jax.device_put(p, s) for p, s in
+                            zip(self._params,
+                                self._sharding.param_shardings)]
+
+    def _resolve_sharding(self, config: Config, prefix: str, n_in: int):
+        """Bind the Config's sharding request (or the artifact sidecar)
+        to devices; warns and returns None on any mismatch so the
+        predictor falls back to replicated execution."""
+        from ..serving import sharding as _sh
+        req = getattr(config, "_sharding_request", "auto")
+        if req is None:
+            return None
+        side = _sh.load_sidecar(prefix)
+        if req == "auto":
+            if side is None:
+                return None
+            return _sh.resolve(side, n_inputs=n_in,
+                               n_params=len(self._params))
+        mesh = req.get("mesh")
+        mesh_axes = req.get("mesh_axes") or (side.mesh_axes if side
+                                             else None)
+        if mesh is None and not mesh_axes:
+            import warnings
+            warnings.warn(
+                "enable_sharding() given no mesh/mesh_axes and the "
+                "artifact has no sharding sidecar; serving replicated")
+            return None
+        inputs = req.get("input_specs")
+        if inputs is None and side is not None:
+            inputs = side.inputs
+        params = req.get("param_specs")
+        if params is None and side is not None:
+            params = side.params
+        spec = _sh.ShardingSpec(mesh_axes or {"_explicit_mesh": 1},
+                                inputs, params)
+        return _sh.resolve(spec, mesh=mesh, devices=req.get("devices"),
+                           n_inputs=n_in, n_params=len(self._params))
+
+    @property
+    def sharding(self):
+        """The active :class:`~paddle_tpu.serving.sharding
+        .ResolvedSharding`, or None when running replicated."""
+        return self._sharding
 
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
@@ -139,7 +214,13 @@ class Predictor:
     def _call_cached(self, xs):
         """Execute through the shape-keyed ExecutableCache: a jax.jit
         wrapper per input signature means one XLA compile per signature
-        (shape-polymorphic artifacts re-lower per shape otherwise)."""
+        (shape-polymorphic artifacts re-lower per shape otherwise).
+
+        Sharded predictors commit each input onto its NamedSharding and
+        append the sharding token to the cache key — replicas over
+        different device subsets share the process-wide default cache, so
+        the token (which includes device ids) is what keeps their
+        executables, and the unsharded 2-tuple keys, from colliding."""
         from ..serving.cache import signature_of
         sig = signature_of(xs)
         exported = self._exported
@@ -148,8 +229,13 @@ class Predictor:
             return jax.jit(lambda params, *xargs: exported.call(
                 params, *xargs))
 
-        fn = self._exec_cache.get_or_compile((self._model_key, sig),
-                                             _compile)
+        if self._sharding is None:
+            key = (self._model_key, sig)
+        else:
+            key = (self._model_key, sig, self._sharding.token)
+            xs = [jax.device_put(x, s) for x, s in
+                  zip(xs, self._sharding.input_shardings)]
+        fn = self._exec_cache.get_or_compile(key, _compile)
         outs = fn(self._params, *xs)
         return list(outs) if isinstance(outs, (list, tuple)) else [outs]
 

@@ -358,6 +358,29 @@ def save(layer, path, input_spec=None, **config):
                      "n_in": len(specs),
                      "out_treedef_children": None}, f, protocol=4)
 
+    sharding = config.get("sharding")
+    if sharding is not None:
+        # persist the sharding spec as a JSON sidecar so a replica can
+        # reconstruct NamedSharding on load without the model's Python
+        # code; the loader warns-and-falls-back on mesh shape mismatch
+        from ..serving.sharding import ShardingSpec, save_sidecar
+        if isinstance(sharding, dict):
+            sharding = ShardingSpec(
+                sharding.get("mesh_axes") or {},
+                sharding.get("inputs"), sharding.get("params"))
+        if sharding.inputs is not None \
+                and len(sharding.inputs) != len(specs):
+            raise ValueError(
+                f"sharding names {len(sharding.inputs)} input "
+                f"PartitionSpecs but input_spec has {len(specs)} entries")
+        if sharding.params is not None \
+                and len(sharding.params) != len(state):
+            raise ValueError(
+                f"sharding names {len(sharding.params)} param "
+                f"PartitionSpecs but the layer has {len(state)} "
+                f"params/buffers")
+        save_sidecar(path, sharding)
+
 
 class TranslatedLayer:
     """Loaded inference program (reference: fluid/dygraph/io.py

@@ -32,13 +32,20 @@ from .engine import Engine, EngineConfig  # noqa: F401
 from .request import (  # noqa: F401
     Deadline, DeadlineExceeded, EngineDraining, InferenceRequest,
     QueueFull, RequestTooLarge, ServingError)
+from .sharding import ShardingSpec, ResolvedSharding  # noqa: F401
+from .replica import Replica  # noqa: F401
+from .router import (  # noqa: F401
+    NoHealthyReplicas, Router, RouterConfig,
+    llm_replica_factory, predictor_replica_factory)
 
 __all__ = [
     "Engine", "EngineConfig", "BucketSpec", "pow2_buckets",
     "ExecutableCache", "default_cache", "signature_of", "BatchQueue",
     "DynamicBatcher", "Batch", "InferenceRequest", "Deadline",
     "DeadlineExceeded", "EngineDraining", "QueueFull", "RequestTooLarge",
-    "ServingError", "llm",
+    "ServingError", "ShardingSpec", "ResolvedSharding", "Replica",
+    "Router", "RouterConfig", "NoHealthyReplicas",
+    "llm_replica_factory", "predictor_replica_factory", "llm",
 ]
 
 

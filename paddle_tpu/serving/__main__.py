@@ -22,7 +22,14 @@ def main(argv=None) -> int:
     sv.add_argument("--model", required=True,
                     help="artifact path prefix (the X of X.pdmodel)")
     sv.add_argument("--host", default="127.0.0.1")
-    sv.add_argument("--port", type=int, default=8500)
+    sv.add_argument("--port", type=int, default=8500,
+                    help="0 binds an ephemeral port (printed on stdout as "
+                         "PADDLE_TPU_SERVING_PORT=<port>)")
+    sv.add_argument("--replicas", type=int, default=1,
+                    help=">1 serves through a health-aware replica router")
+    sv.add_argument("--model-parallel", type=int, default=1,
+                    help="devices per replica ('model' mesh axis size; "
+                         "GSPMD-partitioned predictor)")
     sv.add_argument("--buckets", default="",
                     help="comma-separated batch buckets (default: powers "
                          "of two up to --max-batch)")
@@ -51,7 +58,14 @@ def main(argv=None) -> int:
     lv.add_argument("--num-heads", type=int, default=12)
     lv.add_argument("--max-positions", type=int, default=1024)
     lv.add_argument("--host", default="127.0.0.1")
-    lv.add_argument("--port", type=int, default=8500)
+    lv.add_argument("--port", type=int, default=8500,
+                    help="0 binds an ephemeral port (printed on stdout as "
+                         "PADDLE_TPU_SERVING_PORT=<port>)")
+    lv.add_argument("--replicas", type=int, default=1,
+                    help=">1 serves through a health-aware replica router")
+    lv.add_argument("--model-parallel", type=int, default=1,
+                    help="devices per replica ('model' mesh axis size; "
+                         "KV slots sharded over it)")
     lv.add_argument("--num-slots", type=int, default=8)
     lv.add_argument("--max-seq", type=int, default=512)
     lv.add_argument("--prefill-buckets", default="",
@@ -81,14 +95,32 @@ def main(argv=None) -> int:
         default_deadline=args.deadline_s,
         oversize_policy=args.oversize,
     )
-    engine = Engine(args.model, cfg)
-    engine.install_drain_signal_handler()
 
     def _ready(httpd):
         host, port = httpd.server_address[:2]
         print(f"paddle_tpu.serving: listening on http://{host}:{port} "
               f"(buckets={list(cfg.buckets.batch_buckets)}, "
               f"delay={cfg.max_batch_delay * 1000:.1f}ms)", flush=True)
+        # machine-readable line for --port 0 callers (supervisors, tests)
+        print(f"PADDLE_TPU_SERVING_PORT={port}", flush=True)
+
+    if args.replicas > 1 or args.model_parallel > 1:
+        from .router import Router, RouterConfig, predictor_replica_factory
+        axes = ({"model": args.model_parallel}
+                if args.model_parallel > 1 else None)
+        router = Router(
+            predictor_replica_factory(args.model, cfg),
+            RouterConfig(num_replicas=args.replicas, model_axes=axes,
+                         kind="classifier"))
+        router.install_drain_signal_handler()
+        serve_forever(None, args.host, args.port, quiet=False,
+                      ready_cb=_ready, router=router)
+        router.drain()
+        print("paddle_tpu.serving: drained, bye", flush=True)
+        return 0
+
+    engine = Engine(args.model, cfg)
+    engine.install_drain_signal_handler()
 
     serve_forever(engine, args.host, args.port, quiet=False, ready_cb=_ready)
     engine.drain()
@@ -120,14 +152,32 @@ def _serve_llm(args) -> int:
         max_queue=args.max_queue, default_deadline=args.deadline_s,
         default_max_new_tokens=args.max_new_tokens,
         warmup=not args.no_warmup)
-    engine = LLMEngine(model, cfg)
-    engine.install_drain_signal_handler()
 
     def _ready(httpd):
         host, port = httpd.server_address[:2]
         print(f"paddle_tpu.serving: LLM listening on http://{host}:{port} "
               f"(slots={cfg.num_slots}, max_seq={cfg.max_seq}, "
               f"prefill_buckets={list(cfg.prefill_buckets)})", flush=True)
+        # machine-readable line for --port 0 callers (supervisors, tests)
+        print(f"PADDLE_TPU_SERVING_PORT={port}", flush=True)
+
+    if args.replicas > 1 or args.model_parallel > 1:
+        from .router import Router, RouterConfig, llm_replica_factory
+        axes = ({"model": args.model_parallel}
+                if args.model_parallel > 1 else None)
+        router = Router(
+            llm_replica_factory(lambda replica: model, cfg),
+            RouterConfig(num_replicas=args.replicas, model_axes=axes,
+                         kind="llm"))
+        router.install_drain_signal_handler()
+        serve_forever(None, args.host, args.port, quiet=False,
+                      ready_cb=_ready, router=router)
+        router.drain()
+        print("paddle_tpu.serving: drained, bye", flush=True)
+        return 0
+
+    engine = LLMEngine(model, cfg)
+    engine.install_drain_signal_handler()
 
     serve_forever(None, args.host, args.port, quiet=False, ready_cb=_ready,
                   llm_engine=engine)

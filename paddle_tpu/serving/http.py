@@ -44,11 +44,16 @@ from .request import DeadlineExceeded, ServingError
 class ServingHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, addr, engine, quiet: bool = True, llm_engine=None):
-        if engine is None and llm_engine is None:
-            raise ValueError("need an engine and/or an llm_engine")
+    def __init__(self, addr, engine, quiet: bool = True, llm_engine=None,
+                 router=None):
+        if engine is None and llm_engine is None and router is None:
+            raise ValueError("need an engine, an llm_engine, or a router")
         self.engine = engine
         self.llm_engine = llm_engine
+        # a Router routes /predict (kind="classifier") or /generate
+        # (kind="llm") across its replicas; /healthz and /statsz expose
+        # the aggregate + per-replica views
+        self.router = router
         self.quiet = quiet
         super().__init__(addr, _Handler)
 
@@ -71,10 +76,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         engine = self.server.engine
         llm = self.server.llm_engine
+        router = self.server.router
         if self.path == "/healthz":
-            draining = any(e.draining for e in (engine, llm)
+            draining = any(e.draining for e in (engine, llm, router)
                            if e is not None)
-            if draining:
+            if router is not None:
+                agg = router.healthz()
+                # degraded still serves (some replica is admissible);
+                # draining/unhealthy means stop routing here
+                code = 200 if agg["status"] in ("ok", "degraded") else 503
+                if draining:
+                    agg["status"] = "draining"
+                    code = 503
+                self._send_json(code, agg)
+            elif draining:
                 self._send_json(503, {"status": "draining"})
             else:
                 self._send_json(200, {"status": "ok"})
@@ -82,22 +97,30 @@ class _Handler(BaseHTTPRequestHandler):
             payload = engine.stats() if engine is not None else {}
             if llm is not None:
                 payload["llm"] = llm.stats()
+            if router is not None:
+                payload["router"] = router.stats()
             self._send_json(200, payload)
         elif self.path == "/metricsz":
-            self._do_metricsz(engine, llm)
+            self._do_metricsz(engine, llm, router)
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
-    def _do_metricsz(self, engine, llm):
+    def _do_metricsz(self, engine, llm, router=None):
         """Prometheus text exposition of every mounted engine's registry.
         Engines usually share the default registry (one render); distinct
         registries concatenate safely because their stat namespaces
-        (``serving.`` vs ``serving.llm.``) sanitize to disjoint families."""
+        (``serving.`` vs ``serving.llm.``) sanitize to disjoint families.
+        A router contributes its own registry plus every replica engine's
+        (identity-deduped — per-replica series carry ``replica`` labels)."""
         from ..observability.metrics import CONTENT_TYPE, render_prometheus
         regs = []
         for e in (engine, llm):
             if e is not None and all(e.registry is not r for r in regs):
                 regs.append(e.registry)
+        if router is not None:
+            for reg in router.registries():
+                if all(reg is not r for r in regs):
+                    regs.append(reg)
         body = "".join(render_prometheus(r) for r in regs).encode("utf-8")
         self.send_response(200)
         self.send_header("Content-Type", CONTENT_TYPE)
@@ -119,6 +142,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_predict(self):
         engine = self.server.engine
+        router = self.server.router
+        if engine is None and router is not None \
+                and router.kind == "classifier":
+            engine = router   # Router.submit has the Engine.submit shape
         if engine is None:
             self._send_json(503, {"error": "no classifier engine mounted"})
             return
@@ -148,6 +175,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _do_generate(self):
         llm = self.server.llm_engine
+        router = self.server.router
+        if llm is None and router is not None and router.kind == "llm":
+            llm = router      # Router.submit forwards LLMEngine.submit kwargs
         if llm is None:
             self._send_json(503, {"error": "no LLM engine mounted"})
             return
@@ -209,25 +239,27 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(engine, host: str = "127.0.0.1", port: int = 8500,
-                quiet: bool = True, llm_engine=None) -> ServingHTTPServer:
+                quiet: bool = True, llm_engine=None,
+                router=None) -> ServingHTTPServer:
     """Bind (port 0 picks a free one; see ``server.server_address``)."""
     return ServingHTTPServer((host, port), engine, quiet=quiet,
-                             llm_engine=llm_engine)
+                             llm_engine=llm_engine, router=router)
 
 
 def serve_forever(engine, host: str = "127.0.0.1", port: int = 8500,
                   quiet: bool = False,
-                  ready_cb: Optional[callable] = None, llm_engine=None):
+                  ready_cb: Optional[callable] = None, llm_engine=None,
+                  router=None):
     """Blocking serve loop; shuts the listener down once every mounted
     engine's drain completes (queue flushed, in-flight sequences done)."""
     httpd = make_server(engine, host, port, quiet=quiet,
-                        llm_engine=llm_engine)
+                        llm_engine=llm_engine, router=router)
     if ready_cb is not None:
         ready_cb(httpd)
     import threading
 
     def _watch_drain():
-        for e in (engine, llm_engine):
+        for e in (engine, llm_engine, router):
             if e is not None:
                 e._stopped.wait()
         httpd.shutdown()

@@ -334,7 +334,8 @@ class GPTStaticDecoder:
     same accounting the classifier Engine uses)."""
 
     def __init__(self, model, max_top_k: int = 64,
-                 exec_cache: Optional[ExecutableCache] = None):
+                 exec_cache: Optional[ExecutableCache] = None,
+                 mesh=None, slot_axis: str = "model"):
         self.spec = GPTDecodeSpec.from_model(model)
         self._model = model
         self.max_top_k = max(0, min(int(max_top_k), self.spec.vocab_size))
@@ -342,10 +343,29 @@ class GPTStaticDecoder:
         # and is falsy, which would silently orphan the engine's cache.
         self.exec_cache = (exec_cache if exec_cache is not None
                            else ExecutableCache())
+        # GSPMD: with a mesh, params are replicated onto it and KV slots
+        # shard over `slot_axis` (see StaticKVCache). The mesh token —
+        # axis names + shape + device ids — joins the cache key so two
+        # replica decoders over different device subsets sharing one
+        # ExecutableCache never collide (and neither collides with the
+        # unsharded key).
+        self.mesh = mesh
+        self.slot_axis = slot_axis
         self._key = ("gpt-static", self.spec, self.max_top_k)
+        self._param_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..sharding import mesh_token
+            self._key = self._key + (mesh_token(mesh),)
+            self._param_sharding = NamedSharding(mesh, PartitionSpec())
 
     def params(self):
-        return extract_gpt_params(self._model)
+        p = extract_gpt_params(self._model)
+        if self._param_sharding is not None:
+            sh = self._param_sharding
+            p = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), p)
+        return p
 
     def new_kv(self, num_slots: int, max_seq: int) -> StaticKVCache:
         if max_seq > self.spec.max_position_embeddings:
@@ -355,7 +375,8 @@ class GPTStaticDecoder:
         dtype = self._model.gpt.word_embeddings.weight._data.dtype
         return StaticKVCache(num_slots, self.spec.num_layers, max_seq,
                              self.spec.num_heads, self.spec.head_dim,
-                             dtype=dtype)
+                             dtype=dtype, mesh=self.mesh,
+                             slot_axis=self.slot_axis)
 
     # -- compiled-program access --------------------------------------------
     def decode_fn(self, num_slots: int, max_seq: int):

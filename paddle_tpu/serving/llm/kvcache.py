@@ -48,7 +48,8 @@ class StaticKVCache:
     """
 
     def __init__(self, num_slots: int, num_layers: int, max_seq: int,
-                 num_heads: int, head_dim: int, dtype="float32"):
+                 num_heads: int, head_dim: int, dtype="float32",
+                 mesh=None, slot_axis: str = "model"):
         if num_slots < 1 or max_seq < 2:
             raise ValueError(
                 f"need num_slots >= 1 and max_seq >= 2, got "
@@ -59,11 +60,38 @@ class StaticKVCache:
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = jnp.dtype(dtype)
+        self.mesh = mesh
+        self.slot_axis = slot_axis
         shape = (self.num_slots, self.num_layers, self.max_seq,
                  self.num_heads, self.head_dim)
-        self.k = jnp.zeros(shape, self.dtype)
-        self.v = jnp.zeros(shape, self.dtype)
-        self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
+        if mesh is not None:
+            # GSPMD: shard the slot axis over the model axis of the mesh.
+            # Slot rows are independent (attention never crosses slots),
+            # so this partitioning is bitwise-identical to single-device
+            # decode — each device owns whole slots, no reduction is split.
+            from jax.sharding import NamedSharding, PartitionSpec
+            axis_size = int(mesh.shape[slot_axis])
+            if self.num_slots % axis_size:
+                raise ValueError(
+                    f"num_slots={self.num_slots} must divide evenly over "
+                    f"mesh axis {slot_axis!r} (size {axis_size})")
+            self._kv_sharding = NamedSharding(mesh,
+                                              PartitionSpec(slot_axis))
+            self._len_sharding = NamedSharding(mesh,
+                                               PartitionSpec(slot_axis))
+            self.k = jax.device_put(jnp.zeros(shape, self.dtype),
+                                    self._kv_sharding)
+            self.v = jax.device_put(jnp.zeros(shape, self.dtype),
+                                    self._kv_sharding)
+            self.lengths = jax.device_put(
+                jnp.zeros((self.num_slots,), jnp.int32),
+                self._len_sharding)
+        else:
+            self._kv_sharding = None
+            self._len_sharding = None
+            self.k = jnp.zeros(shape, self.dtype)
+            self.v = jnp.zeros(shape, self.dtype)
+            self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
         self._free: List[int] = list(range(self.num_slots))
         self._active: set = set()
 
@@ -101,7 +129,10 @@ class StaticKVCache:
         is — lengths gate validity). For tests and engine restarts."""
         self._free = list(range(self.num_slots))
         self._active.clear()
-        self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
+        lengths = jnp.zeros((self.num_slots,), jnp.int32)
+        if self._len_sharding is not None:
+            lengths = jax.device_put(lengths, self._len_sharding)
+        self.lengths = lengths
 
     # -- functional state threading -----------------------------------------
     def swap(self, k, v, lengths):

@@ -419,14 +419,16 @@ class LLMEngine(DrainableEngineBase):
 
     def __init__(self, model, config: Optional[LLMEngineConfig] = None,
                  registry: Optional[_mon.StatRegistry] = None,
-                 cache: Optional[ExecutableCache] = None):
+                 cache: Optional[ExecutableCache] = None,
+                 mesh=None, slot_axis: str = "model"):
         self._config = config or LLMEngineConfig()
         self._init_serving_base(registry, self._config.stat_prefix)
         # `is not None`, not truthiness: an empty ExecutableCache has
         # len() == 0 and is falsy, so `cache or ...` would drop it.
         self._cache = cache if cache is not None else ExecutableCache()
         self._decoder = GPTStaticDecoder(
-            model, max_top_k=self._config.max_top_k, exec_cache=self._cache)
+            model, max_top_k=self._config.max_top_k, exec_cache=self._cache,
+            mesh=mesh, slot_axis=slot_axis)
         self._batcher = ContinuousBatcher(
             self._decoder, self._config, self._registry)
         self._queue = BatchQueue(max_size=self._config.max_queue)
@@ -522,10 +524,15 @@ class LLMEngine(DrainableEngineBase):
     def stats(self) -> dict:
         """Scalar stats + histogram summaries + cache counters + slot
         occupancy (the ``/statsz`` payload for the LLM engine)."""
+        # NB: trailing dot — a bare startswith(self._prefix) would leak a
+        # sibling engine's "serving.llm.replica1.*" counters into the
+        # "serving.llm.replica0" payload (and vice versa) when several
+        # in-process replicas share one registry.
+        pre = self._prefix + "."
         return {
-            "stats": self._registry.stats_with_prefix(self._prefix),
+            "stats": self._registry.stats_with_prefix(pre),
             "histograms":
-                self._registry.histograms_with_prefix(self._prefix),
+                self._registry.histograms_with_prefix(pre),
             "executable_cache": self._cache.stats(),
             "draining": self.draining,
             "queue_depth": len(self._queue),

@@ -1,0 +1,293 @@
+"""Replica: one health-tracked serving worker over a device subset.
+
+A :class:`Replica` wraps one engine (the classifier
+:class:`~paddle_tpu.serving.engine.Engine` or the LLM
+:class:`~paddle_tpu.serving.llm.LLMEngine`) plus the state the
+:class:`~paddle_tpu.serving.router.Router` needs to route around it:
+
+* a lifecycle state machine — STARTING → HEALTHY → DRAINING → DEAD, with
+  DEAD → STARTING on :meth:`resurrect`;
+* outstanding-request accounting (the router dispatches to the replica
+  with the fewest requests in flight);
+* a health verdict (:meth:`healthz`) combining the lifecycle state, the
+  engine's drain flag, queue depth against a threshold, and an optional
+  external ``health_source`` (typically the numerical-anomaly sentinel's
+  ``healthy`` predicate);
+* health-stamped boot — when ``checkpoint_root`` is given, each (re)start
+  records the :func:`~paddle_tpu.incubate.checkpoint.sharded
+  .newest_healthy_checkpoint` pick in :attr:`boot_checkpoint` *before*
+  calling the engine factory, so the factory can restore exactly the
+  state the sentinel vouched for.
+
+The engine factory is ``factory(replica) -> engine``: it reads
+``replica.mesh`` (the replica's device sub-mesh, for GSPMD partitioning)
+and ``replica.boot_checkpoint`` and returns a started engine. Factories
+for the two engine kinds live in :mod:`paddle_tpu.serving.router`.
+
+Lock discipline: every mutable attribute (``_state``, ``_engine``,
+``_outstanding``, ``_dispatched``, ``_completed``, ``_restarts``,
+``_unhealthy_reason``, ``_boot_checkpoint``) is read and written under
+``self._lock``; engine calls (submit/drain — they take the engine's own
+locks) happen outside it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from .request import EngineDraining
+
+#: lifecycle states (plain strings so /healthz payloads serialize as-is)
+STARTING = "STARTING"
+HEALTHY = "HEALTHY"
+DRAINING = "DRAINING"
+DEAD = "DEAD"
+
+
+class Replica:
+    """One engine worker + the router-facing health/accounting shell."""
+
+    def __init__(self, replica_id: int,
+                 engine_factory: Callable[["Replica"], object], *,
+                 devices=None, mesh=None,
+                 checkpoint_root: Optional[str] = None,
+                 restart_budget=None,
+                 unhealthy_queue_depth: Optional[int] = None,
+                 health_source: Optional[Callable[[], bool]] = None,
+                 registry=None, clock=time.monotonic):
+        self.replica_id = int(replica_id)
+        #: StatRegistry the engine factory should hand its engine, so all
+        #: replicas of one router publish into one scrape (per-replica
+        #: stat prefixes keep their namespaces apart — see LLMEngine.stats)
+        self.registry = registry
+        self.devices = tuple(devices) if devices is not None else None
+        self.mesh = mesh
+        self.checkpoint_root = checkpoint_root
+        self.restart_budget = restart_budget
+        self.unhealthy_queue_depth = unhealthy_queue_depth
+        self._health_source = health_source
+        self._clock = clock
+        self._factory = engine_factory
+        self._lock = threading.Lock()
+        self._state = STARTING
+        self._engine = None
+        self._outstanding = 0
+        self._dispatched = 0
+        self._completed = 0
+        self._restarts = 0
+        self._unhealthy_reason: Optional[str] = None
+        self._boot_checkpoint: Optional[str] = None
+        self._boot()
+
+    # -- boot / resurrect ----------------------------------------------------
+    def _boot(self):
+        """Pick the boot checkpoint, build the engine, go HEALTHY. Raises
+        whatever the factory raises (first construction fails fast;
+        :meth:`resurrect` catches)."""
+        ckpt = None
+        if self.checkpoint_root is not None:
+            from ..incubate.checkpoint.sharded import newest_healthy_checkpoint
+            ckpt = newest_healthy_checkpoint(self.checkpoint_root)
+        with self._lock:
+            self._boot_checkpoint = ckpt
+            self._state = STARTING
+        engine = self._factory(self)
+        with self._lock:
+            self._engine = engine
+            self._state = HEALTHY
+            self._unhealthy_reason = None
+
+    def resurrect(self, consume_budget: bool = True) -> bool:
+        """Bring a DEAD replica back through a fresh health-stamped boot.
+
+        With ``consume_budget`` (the default for direct callers), one
+        restart is claimed from :attr:`restart_budget` first — False when
+        the budget is spent. The router's health loop claims the budget
+        itself (to schedule the backoff pause) and passes
+        ``consume_budget=False``. A factory failure warns, leaves the
+        replica DEAD, and returns False.
+        """
+        with self._lock:
+            if self._state != DEAD:
+                return False
+        if consume_budget and self.restart_budget is not None \
+                and not self.restart_budget.try_consume():
+            return False
+        try:
+            self._boot()
+        except Exception as e:
+            warnings.warn(
+                f"replica {self.replica_id} failed to resurrect: {e!r}")
+            with self._lock:
+                self._state = DEAD
+            return False
+        with self._lock:
+            self._restarts += 1
+        return True
+
+    # -- dispatch ------------------------------------------------------------
+    @property
+    def engine(self):
+        with self._lock:
+            return self._engine
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    @property
+    def admissible(self) -> bool:
+        """May the router hand this replica a request right now?"""
+        with self._lock:
+            if self._state != HEALTHY or self._unhealthy_reason is not None:
+                return False
+            engine = self._engine
+        return engine is not None and not engine.draining
+
+    @property
+    def boot_checkpoint(self) -> Optional[str]:
+        """The checkpoint the current engine instance booted from (None
+        when no ``checkpoint_root`` was configured or nothing survived the
+        newest-healthy walk)."""
+        with self._lock:
+            return self._boot_checkpoint
+
+    def submit(self, *args, **kwargs):
+        """Forward to the engine's ``submit``, with outstanding-request
+        accounting. Returns whatever the engine returns (a Future for the
+        classifier engine, a GenerationRequest for the LLM engine)."""
+        with self._lock:
+            if self._state != HEALTHY or self._unhealthy_reason is not None:
+                raise EngineDraining(
+                    f"replica {self.replica_id} is {self._state}"
+                    + (f" ({self._unhealthy_reason})"
+                       if self._unhealthy_reason else ""))
+            engine = self._engine
+        out = engine.submit(*args, **kwargs)
+        fut = out if isinstance(out, Future) else out.future
+        with self._lock:
+            self._outstanding += 1
+            self._dispatched += 1
+        fut.add_done_callback(self._on_done)
+        return out
+
+    def _on_done(self, _fut):
+        with self._lock:
+            self._outstanding = max(0, self._outstanding - 1)
+            self._completed += 1
+
+    # -- health --------------------------------------------------------------
+    def mark_unhealthy(self, reason: str):
+        """External verdict (sentinel divergence, operator action): stop
+        admitting; the router's next sweep drains this replica."""
+        with self._lock:
+            self._unhealthy_reason = str(reason)
+
+    def queue_depth(self) -> int:
+        engine = self.engine
+        if engine is None:
+            return 0
+        try:
+            return len(engine._queue)
+        except Exception:
+            return 0
+
+    def healthz(self) -> dict:
+        """The per-replica health verdict: state + every reason it is not
+        serving (empty ``reasons`` == healthy)."""
+        with self._lock:
+            state = self._state
+            reason = self._unhealthy_reason
+            engine = self._engine
+            outstanding = self._outstanding
+            restarts = self._restarts
+            boot = self._boot_checkpoint
+        reasons = []
+        if state != HEALTHY:
+            reasons.append(f"state={state}")
+        if reason is not None:
+            reasons.append(f"marked_unhealthy: {reason}")
+        if engine is not None and engine.draining and state == HEALTHY:
+            reasons.append("engine_draining")
+        depth = self.queue_depth()
+        if self.unhealthy_queue_depth is not None \
+                and depth > self.unhealthy_queue_depth:
+            reasons.append(
+                f"queue_depth {depth} > {self.unhealthy_queue_depth}")
+        if self._health_source is not None:
+            try:
+                if not self._health_source():
+                    reasons.append("health_source")
+            except Exception as e:
+                reasons.append(f"health_source_error: {e!r}")
+        return {
+            "replica": self.replica_id,
+            "state": state,
+            "healthy": not reasons,
+            "reasons": reasons,
+            "queue_depth": depth,
+            "outstanding": outstanding,
+            "restarts": restarts,
+            "boot_checkpoint": boot,
+        }
+
+    # -- drain ---------------------------------------------------------------
+    def begin_drain(self):
+        """Stop admission and start the engine's graceful drain
+        (non-blocking; :meth:`poll_drained` observes completion)."""
+        with self._lock:
+            if self._state in (DRAINING, DEAD):
+                return
+            self._state = DRAINING
+            engine = self._engine
+        if engine is not None:
+            engine.begin_drain()
+
+    def poll_drained(self) -> bool:
+        """True once the engine worker has stopped; flips DRAINING → DEAD
+        on first observation."""
+        with self._lock:
+            if self._state == DEAD:
+                return True
+            if self._state != DRAINING:
+                return False
+            engine = self._engine
+        if engine is None or engine._stopped.is_set():
+            with self._lock:
+                self._state = DEAD
+            return True
+        return False
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Blocking drain: begin + wait for the engine worker to stop."""
+        self.begin_drain()
+        engine = self.engine
+        if engine is not None:
+            engine._stopped.wait(timeout)
+        return self.poll_drained()
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self._state,
+                "outstanding": self._outstanding,
+                "dispatched": self._dispatched,
+                "completed": self._completed,
+                "restarts": self._restarts,
+                "boot_checkpoint": self._boot_checkpoint,
+            }
+        out["queue_depth"] = self.queue_depth()
+        return out
+
+    def __repr__(self):
+        return (f"Replica(id={self.replica_id}, state={self.state}, "
+                f"outstanding={self.outstanding})")

@@ -1,0 +1,410 @@
+"""Health-aware replica router: N engine workers over device subsets.
+
+The serving-side complement of the elastic supervisor. Where the
+supervisor respawns training *processes*, the :class:`Router` runs N
+in-process :class:`~paddle_tpu.serving.replica.Replica` workers — each an
+engine over its own slice of the device pool, optionally GSPMD-partitioned
+over a per-replica sub-mesh — and keeps traffic flowing around the sick
+ones:
+
+* **dispatch** — least-outstanding-requests among admissible replicas
+  (rotating tie-break), retrying on a racing drain; raises
+  :class:`NoHealthyReplicas` only when every replica is out;
+* **health sweep** — a daemon thread polls each replica's
+  :meth:`~paddle_tpu.serving.replica.Replica.healthz` verdict, publishes
+  per-replica labeled gauges, drains replicas that turn unhealthy, and
+  resurrects DEAD ones through the shared
+  :class:`~paddle_tpu.distributed.elastic.RestartBudget` (exponential
+  backoff, same curve the supervisor uses) — each resurrection boots from
+  the newest health-stamped checkpoint;
+* **graceful drain** — SIGTERM (via the chained-handler substrate) or
+  :meth:`drain` fans ``begin_drain`` out to every replica and waits for
+  all engine workers to stop; in-flight futures all resolve.
+
+Device math: with ``model_axes={"model": 4}`` and 8 visible devices,
+``num_replicas=2`` gives each replica a 4-device sub-mesh — the 2×4
+replica-by-model layout. Without ``model_axes`` the pool is split evenly
+and replicas run single-device (mesh None).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..core import monitor as _mon
+from ..distributed.elastic import ChainedSignalHandler, RestartBudget
+from .replica import DEAD, DRAINING, HEALTHY, Replica
+from .request import EngineDraining, ServingError
+
+
+class NoHealthyReplicas(ServingError):
+    """Every replica is draining, dead, or marked unhealthy — the request
+    cannot be placed anywhere."""
+
+
+class RouterConfig:
+    """Tunables for the replica router (see docs/serving.md)."""
+
+    def __init__(self,
+                 num_replicas: int = 2,
+                 model_axes: Optional[Dict[str, int]] = None,
+                 kind: str = "classifier",
+                 health_interval: float = 0.2,
+                 unhealthy_queue_depth: Optional[int] = None,
+                 max_restarts: int = 3,
+                 restart_backoff: float = 1.0,
+                 restart_backoff_cap: float = 30.0,
+                 auto_resurrect: bool = True,
+                 checkpoint_root: Optional[str] = None,
+                 stat_prefix: str = "serving.router"):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        if kind not in ("classifier", "llm"):
+            raise ValueError(
+                f"kind must be 'classifier' or 'llm', got {kind!r}")
+        self.num_replicas = int(num_replicas)
+        self.model_axes = dict(model_axes) if model_axes else None
+        self.kind = kind
+        self.health_interval = float(health_interval)
+        self.unhealthy_queue_depth = unhealthy_queue_depth
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff = float(restart_backoff)
+        self.restart_backoff_cap = float(restart_backoff_cap)
+        self.auto_resurrect = bool(auto_resurrect)
+        self.checkpoint_root = checkpoint_root
+        self.stat_prefix = stat_prefix
+
+
+class Router:
+    """Dispatch facade over N health-tracked replicas.
+
+    ``engine_factory(replica) -> engine`` builds each replica's engine
+    (see :func:`predictor_replica_factory` / :func:`llm_replica_factory`);
+    it reads ``replica.mesh``, ``replica.registry`` and
+    ``replica.boot_checkpoint``.
+    """
+
+    def __init__(self, engine_factory: Callable[[Replica], object],
+                 config: Optional[RouterConfig] = None,
+                 registry: Optional[_mon.StatRegistry] = None,
+                 devices: Optional[Sequence] = None,
+                 health_source: Optional[Callable[[int], bool]] = None):
+        self._config = config or RouterConfig()
+        self._registry = registry or _mon.default_registry()
+        self._prefix = self._config.stat_prefix
+        self.budget = RestartBudget(self._config.max_restarts,
+                                    self._config.restart_backoff,
+                                    cap=self._config.restart_backoff_cap)
+        self._draining = threading.Event()
+        self._stopped = threading.Event()
+        self._signal_chain: Optional[ChainedSignalHandler] = None
+        self._drain_signaled = False   # set (only) from _on_drain_signal
+        self._rr = itertools.count()   # rotating tie-break for dispatch
+        self._resume_at: Dict[int, float] = {}  # health-thread-only
+        self._fanned_out = False                # health-thread-only
+        self.replicas: List[Replica] = []
+        for rid, sub in enumerate(self._split_devices(devices)):
+            mesh = None
+            if self._config.model_axes:
+                from ..distributed.mesh import build_mesh
+                mesh = build_mesh(dict(self._config.model_axes), devices=sub)
+            src = (None if health_source is None
+                   else (lambda r=rid: health_source(r)))
+            self.replicas.append(Replica(
+                rid, engine_factory, devices=sub, mesh=mesh,
+                checkpoint_root=self._config.checkpoint_root,
+                restart_budget=self.budget,
+                unhealthy_queue_depth=self._config.unhealthy_queue_depth,
+                health_source=src, registry=self._registry))
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="paddle-tpu-router-health",
+            daemon=True)
+        self._health_thread.start()
+
+    def _split_devices(self, devices) -> List[Optional[List]]:
+        """Contiguous per-replica device subsets. With ``model_axes`` each
+        replica gets exactly ``prod(sizes)`` devices (fail fast when the
+        pool is too small — a silently replicated "model-parallel" router
+        would void the capacity math); without, the pool is split evenly
+        (replicas may run single-device on the same default device when
+        the pool has fewer devices than replicas)."""
+        import jax
+        n = self._config.num_replicas
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if self._config.model_axes:
+            per = 1
+            for s in self._config.model_axes.values():
+                per *= int(s)
+            need = per * n
+            if need > len(devs):
+                raise ValueError(
+                    f"router needs {n} x {dict(self._config.model_axes)} "
+                    f"= {need} devices but only {len(devs)} are visible")
+            return [devs[i * per:(i + 1) * per] for i in range(n)]
+        if len(devs) >= n:
+            per = len(devs) // n
+            return [devs[i * per:(i + 1) * per] for i in range(n)]
+        return [None] * n
+
+    # -- dispatch ------------------------------------------------------------
+    @property
+    def config(self) -> RouterConfig:
+        return self._config
+
+    @property
+    def kind(self) -> str:
+        return self._config.kind
+
+    @property
+    def registry(self) -> _mon.StatRegistry:
+        return self._registry
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def _pick(self, tried) -> Optional[Replica]:
+        cands = [r for r in self.replicas
+                 if r.replica_id not in tried and r.admissible]
+        if not cands:
+            return None
+        low = min(r.outstanding for r in cands)
+        mins = [r for r in cands if r.outstanding == low]
+        return mins[next(self._rr) % len(mins)]
+
+    def submit(self, *args, **kwargs):
+        """Place one request on the least-loaded admissible replica.
+        Returns whatever that replica's engine returns (a Future for
+        classifier engines, a GenerationRequest for LLM engines). Retries
+        on a replica that starts draining between pick and submit; raises
+        :class:`NoHealthyReplicas` when no replica can take it."""
+        if self._draining.is_set():
+            self._registry.add(f"{self._prefix}.rejected_draining", 1)
+            raise EngineDraining("router is draining; submit rejected")
+        tried: set = set()
+        while True:
+            r = self._pick(tried)
+            if r is None:
+                self._registry.add(f"{self._prefix}.rejected_no_replica", 1)
+                raise NoHealthyReplicas(
+                    f"no admissible replica among {len(self.replicas)} "
+                    f"(states: {[x.state for x in self.replicas]})")
+            try:
+                out = r.submit(*args, **kwargs)
+            except EngineDraining:
+                # lost the race with a drain — route around it
+                tried.add(r.replica_id)
+                continue
+            self._registry.add(f"{self._prefix}.dispatched", 1)
+            return out
+
+    # -- health loop ---------------------------------------------------------
+    def _health_loop(self):
+        try:
+            while True:
+                if self._draining.is_set():
+                    if not self._fanned_out:
+                        for r in self.replicas:
+                            r.begin_drain()
+                        self._fanned_out = True
+                    if all(r.poll_drained() for r in self.replicas):
+                        break
+                else:
+                    self._sweep()
+                time.sleep(self._config.health_interval)
+        finally:
+            self._stopped.set()
+
+    def _sweep(self):
+        now = time.monotonic()
+        for r in self.replicas:
+            h = r.healthz()
+            rid = r.replica_id
+            labels = {"replica": str(rid)}
+            self._registry.set_labeled(
+                f"{self._prefix}.replica_healthy", labels,
+                1 if h["healthy"] else 0)
+            self._registry.set_labeled(
+                f"{self._prefix}.replica_outstanding", labels,
+                h["outstanding"])
+            self._registry.set_labeled(
+                f"{self._prefix}.replica_queue_depth", labels,
+                h["queue_depth"])
+            self._registry.set_labeled(
+                f"{self._prefix}.replica_restarts", labels, h["restarts"])
+            state = h["state"]
+            if state == HEALTHY and not h["healthy"]:
+                warnings.warn(
+                    f"router: draining replica {rid} "
+                    f"(reasons: {h['reasons']})")
+                r.begin_drain()
+                self._registry.add(
+                    f"{self._prefix}.drained_unhealthy", 1)
+            elif state == DRAINING:
+                r.poll_drained()
+            elif state == DEAD and self._config.auto_resurrect:
+                self._maybe_resurrect(r, now)
+
+    def _maybe_resurrect(self, r: Replica, now: float):
+        """Budgeted, backed-off resurrection (health-thread-only state).
+        The budget is claimed HERE — scheduling the pause needs the
+        post-consume count — so the replica is told not to claim again."""
+        rid = r.replica_id
+        due = self._resume_at.get(rid)
+        if due is None:
+            if self.budget.try_consume():
+                self._resume_at[rid] = now + self.budget.pause()
+            else:
+                warnings.warn(
+                    f"router: replica {rid} is DEAD and the restart "
+                    f"budget ({self.budget.max_restarts}) is exhausted; "
+                    f"it stays down")
+                self._resume_at[rid] = float("inf")
+            return
+        if now < due:
+            return
+        if r.resurrect(consume_budget=False):
+            del self._resume_at[rid]
+            self._registry.add(f"{self._prefix}.resurrections", 1)
+        else:
+            # boot failed — claim another restart for the retry, or park
+            if self.budget.try_consume():
+                self._resume_at[rid] = now + self.budget.pause()
+            else:
+                self._resume_at[rid] = float("inf")
+
+    # -- drain / signals -----------------------------------------------------
+    def install_drain_signal_handler(self, signals=None):
+        """Arm SIGTERM/SIGINT to begin a router-wide drain, chaining — not
+        replacing — whatever handler was installed before."""
+        if self._signal_chain is not None and self._signal_chain.installed:
+            return self._signal_chain
+        kwargs = {} if signals is None else {"signals": tuple(signals)}
+        self._signal_chain = ChainedSignalHandler(
+            self._on_drain_signal, **kwargs)
+        self._signal_chain.install()
+        return self._signal_chain
+
+    def _on_drain_signal(self, signum, frame):
+        """Flag-only (async-signal-safe): the health thread fans the drain
+        out to the replicas at its next tick — replica/engine drains take
+        queue locks the interrupted thread may hold."""
+        self._drain_signaled = True
+        self._draining.set()
+
+    def begin_drain(self):
+        """Stop admission; the health thread drains every replica."""
+        self._draining.set()
+
+    def drain(self, timeout: Optional[float] = None):
+        """Graceful router-wide drain: stop admission, drain every
+        replica, wait for all engine workers to stop."""
+        self.begin_drain()
+        self._stopped.wait(timeout)
+        if self._signal_chain is not None:
+            self._signal_chain.uninstall()
+
+    close = drain
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()
+        return False
+
+    # -- observability -------------------------------------------------------
+    def healthz(self) -> dict:
+        """Aggregate health: ``ok`` (all healthy) / ``degraded`` (some) /
+        ``unhealthy`` (none admissible) / ``draining``."""
+        reps = [r.healthz() for r in self.replicas]
+        if self._draining.is_set():
+            status = "draining"
+        elif all(h["healthy"] for h in reps):
+            status = "ok"
+        elif any(r.admissible for r in self.replicas):
+            status = "degraded"
+        else:
+            status = "unhealthy"
+        return {"status": status, "kind": self.kind, "replicas": reps}
+
+    def stats(self) -> dict:
+        """Router counters + per-replica accounting + the balance factor
+        (max dispatched / mean dispatched — 1.0 is a perfectly even
+        spread)."""
+        per = {str(r.replica_id): r.stats() for r in self.replicas}
+        dispatched = [p["dispatched"] for p in per.values()]
+        mean = sum(dispatched) / max(1, len(dispatched))
+        balance = (max(dispatched) / mean) if mean > 0 else 1.0
+        return {
+            "stats": self._registry.stats_with_prefix(self._prefix + "."),
+            "replicas": per,
+            "num_replicas": len(self.replicas),
+            "draining": self.draining,
+            "total_dispatched": sum(dispatched),
+            "balance_factor": balance,
+        }
+
+    def registries(self) -> List[_mon.StatRegistry]:
+        """Every distinct StatRegistry behind this router (identity-
+        deduped) — the /metricsz render set."""
+        out = [self._registry]
+        for r in self.replicas:
+            engine = r.engine
+            reg = getattr(engine, "registry", None)
+            if reg is not None and all(reg is not x for x in out):
+                out.append(reg)
+        return out
+
+    def __repr__(self):
+        return (f"Router(kind={self.kind}, replicas={len(self.replicas)}, "
+                f"draining={self.draining})")
+
+
+# -- engine factories ---------------------------------------------------------
+
+def predictor_replica_factory(model_prefix: str,
+                              config=None) -> Callable[[Replica], object]:
+    """Factory for classifier replicas: each builds a Predictor over the
+    ``jit.save`` artifact at ``model_prefix`` (GSPMD-partitioned over the
+    replica's sub-mesh when one exists — the artifact's sharding sidecar
+    supplies the PartitionSpecs) wrapped in an
+    :class:`~paddle_tpu.serving.engine.Engine` with a per-replica stat
+    prefix."""
+    import copy
+
+    def factory(replica: Replica):
+        from ..inference import Config as InferConfig, create_predictor
+        from .engine import Engine, EngineConfig
+        ic = InferConfig(model_prefix)
+        if replica.mesh is not None:
+            ic.enable_sharding(mesh=replica.mesh)
+        pred = create_predictor(ic)
+        cfg = copy.copy(config) if config is not None else EngineConfig()
+        cfg.stat_prefix = f"{cfg.stat_prefix}.replica{replica.replica_id}"
+        return Engine(pred, cfg, registry=replica.registry)
+    return factory
+
+
+def llm_replica_factory(model_factory: Callable[[Replica], object],
+                        config=None) -> Callable[[Replica], object]:
+    """Factory for LLM replicas: ``model_factory(replica)`` builds (or
+    restores — ``replica.boot_checkpoint`` names the newest health-stamped
+    checkpoint) the GPT model; each replica gets an
+    :class:`~paddle_tpu.serving.llm.LLMEngine` over its sub-mesh with a
+    per-replica stat prefix (the trailing-dot namespace fix in
+    ``LLMEngine.stats`` is what keeps two of these from sharing
+    counters)."""
+    import copy
+
+    def factory(replica: Replica):
+        from .llm import LLMEngine, LLMEngineConfig
+        cfg = copy.copy(config) if config is not None else LLMEngineConfig()
+        cfg.stat_prefix = f"{cfg.stat_prefix}.replica{replica.replica_id}"
+        model = model_factory(replica)
+        return LLMEngine(model, cfg, registry=replica.registry,
+                         mesh=replica.mesh)
+    return factory

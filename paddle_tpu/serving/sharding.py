@@ -1,0 +1,258 @@
+"""GSPMD sharding substrate for serving: serializable specs + resolution.
+
+Training already partitions through ``Mesh``/``NamedSharding``/
+``PartitionSpec`` (``distributed.mesh``); this module carries the same
+vocabulary to inference so a predictor artifact can be served
+model-parallel. Three layers:
+
+* :class:`ShardingSpec` — the JSON-serializable statement of intent
+  (ordered mesh axis sizes + per-input and optional per-param
+  ``PartitionSpec``s). ``jit.save(..., sharding=...)`` persists it as a
+  ``<prefix>.pdsharding.json`` sidecar next to the StableHLO artifact so a
+  replica can reconstruct ``NamedSharding`` on load without the model's
+  Python code.
+* :class:`ResolvedSharding` — the spec bound to concrete devices: a
+  ``Mesh``, one ``NamedSharding`` per input/param, and a hashable
+  ``token`` that joins the :class:`~paddle_tpu.serving.cache
+  .ExecutableCache` key. The token includes the *device ids*, not just
+  axis names/sizes: two replicas over different device subsets share the
+  process-wide default cache and must never collide on an executable
+  compiled for the other's devices (and neither may collide with the
+  unsharded key, which is a plain ``(model_key, sig)`` 2-tuple).
+* :func:`resolve` — binding with warn-and-fallback semantics: any
+  mismatch (mesh larger than the visible device count, spec axes unknown
+  to the mesh, input-count drift) warns and returns ``None``, and the
+  caller serves replicated — a stale sidecar must never brick a
+  predictor.
+"""
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: sidecar filename suffix, appended to the jit.save artifact prefix
+#: (sibling of ``<prefix>.pdmodel`` / ``<prefix>.pdiparams``)
+SIDECAR_SUFFIX = ".pdsharding.json"
+
+SIDECAR_FORMAT = 1
+
+
+def spec_to_lists(spec) -> Optional[List]:
+    """``PartitionSpec`` -> JSON-able nested lists (None stays None —
+    replicated)."""
+    if spec is None:
+        return None
+    return [list(ax) if isinstance(ax, (tuple, list)) else ax
+            for ax in tuple(spec)]
+
+
+def lists_to_spec(obj):
+    """JSON nested lists -> ``PartitionSpec`` (None -> fully replicated)."""
+    from jax.sharding import PartitionSpec
+    if obj is None:
+        return PartitionSpec()
+    return PartitionSpec(*[tuple(ax) if isinstance(ax, list) else ax
+                           for ax in obj])
+
+
+def _spec_axes(spec) -> Tuple[str, ...]:
+    """Flat mesh-axis names a PartitionSpec references."""
+    out = []
+    for ax in tuple(spec or ()):
+        if ax is None:
+            continue
+        if isinstance(ax, (tuple, list)):
+            out.extend(str(a) for a in ax)
+        else:
+            out.append(str(ax))
+    return tuple(out)
+
+
+def _spec_key(spec) -> Any:
+    """Hashable identity of one PartitionSpec (for cache tokens)."""
+    if spec is None:
+        return None
+    return tuple(tuple(ax) if isinstance(ax, (tuple, list)) else ax
+                 for ax in tuple(spec))
+
+
+def mesh_token(mesh) -> Tuple:
+    """Hashable identity of a Mesh: axis names + shape + flat device ids.
+
+    Device ids are load-bearing: replica 0's 4-device "model" mesh and
+    replica 1's are identical in name and shape but their executables are
+    pinned to disjoint devices."""
+    return (tuple(str(n) for n in mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+class ShardingSpec:
+    """Serializable sharding statement: ``mesh_axes`` (ordered
+    ``{name: size}``) plus per-input and optional per-param
+    ``PartitionSpec``s (entries may be None == replicated; ``inputs`` /
+    ``params`` may be None entirely == everything replicated)."""
+
+    def __init__(self, mesh_axes: Dict[str, int],
+                 inputs: Optional[Sequence] = None,
+                 params: Optional[Sequence] = None):
+        if not mesh_axes:
+            raise ValueError("mesh_axes must name at least one axis")
+        self.mesh_axes = {str(k): int(v) for k, v in mesh_axes.items()}
+        self.inputs = self._norm(inputs)
+        self.params = self._norm(params)
+
+    @staticmethod
+    def _norm(specs):
+        from jax.sharding import PartitionSpec
+        if specs is None:
+            return None
+        return [s if (s is None or isinstance(s, PartitionSpec))
+                else lists_to_spec(s) for s in specs]
+
+    def to_json_dict(self) -> dict:
+        return {
+            "format": SIDECAR_FORMAT,
+            "mesh_axes": self.mesh_axes,
+            "inputs": (None if self.inputs is None
+                       else [spec_to_lists(s) for s in self.inputs]),
+            "params": (None if self.params is None
+                       else [spec_to_lists(s) for s in self.params]),
+        }
+
+    @classmethod
+    def from_json_dict(cls, doc: dict) -> "ShardingSpec":
+        return cls(doc["mesh_axes"], doc.get("inputs"), doc.get("params"))
+
+    def __repr__(self):
+        return (f"ShardingSpec(mesh_axes={self.mesh_axes}, "
+                f"inputs={self.inputs}, params={self.params})")
+
+
+# -- sidecar IO ---------------------------------------------------------------
+
+def sidecar_path(prefix: str) -> str:
+    return prefix + SIDECAR_SUFFIX
+
+
+def save_sidecar(prefix: str, spec: ShardingSpec):
+    """Write the sharding sidecar next to the artifact (tmp+replace, same
+    torn-write discipline as the checkpoint health stamp)."""
+    final = sidecar_path(prefix)
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(spec.to_json_dict(), f, indent=1)
+    os.replace(tmp, final)
+
+
+def load_sidecar(prefix: str) -> Optional[ShardingSpec]:
+    """Read the sidecar if present; a malformed one warns and reads as
+    absent (the loader then serves replicated)."""
+    full = sidecar_path(prefix)
+    if not os.path.exists(full):
+        return None
+    try:
+        with open(full) as f:
+            doc = json.load(f)
+        return ShardingSpec.from_json_dict(doc)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        warnings.warn(
+            f"sharding sidecar {full} is unreadable ({e!r}); "
+            f"serving replicated")
+        return None
+
+
+# -- resolution ---------------------------------------------------------------
+
+class ResolvedSharding:
+    """A ShardingSpec bound to concrete devices: the Mesh, one
+    ``NamedSharding`` per input and per param, and the hashable ``token``
+    that joins the ExecutableCache key."""
+
+    def __init__(self, mesh, input_shardings: Tuple, param_shardings: Tuple,
+                 input_specs: Sequence, param_specs: Sequence):
+        self.mesh = mesh
+        self.input_shardings = tuple(input_shardings)
+        self.param_shardings = tuple(param_shardings)
+        self.token = ("sharded", mesh_token(mesh),
+                      tuple(_spec_key(s) for s in input_specs),
+                      tuple(_spec_key(s) for s in param_specs))
+
+    def __repr__(self):
+        return (f"ResolvedSharding(mesh={dict(self.mesh.shape)}, "
+                f"inputs={len(self.input_shardings)}, "
+                f"params={len(self.param_shardings)})")
+
+
+def build_submesh(mesh_axes: Dict[str, int],
+                  devices: Optional[Sequence] = None):
+    """Mesh over the first ``prod(sizes)`` of ``devices`` (default: all
+    visible). Returns None (with a warning) when too few devices exist —
+    the warn-and-fallback half of the sidecar contract."""
+    import jax
+    from jax.sharding import Mesh
+    devs = list(devices) if devices is not None else list(jax.devices())
+    names = tuple(mesh_axes.keys())
+    sizes = tuple(int(s) for s in mesh_axes.values())
+    total = int(np.prod(sizes))
+    if total > len(devs):
+        warnings.warn(
+            f"sharding spec wants a {dict(mesh_axes)} mesh "
+            f"({total} devices) but only {len(devs)} devices are "
+            f"available; falling back to replicated execution")
+        return None
+    return Mesh(np.array(devs[:total]).reshape(sizes), names)
+
+
+def resolve(spec: ShardingSpec, *, mesh=None,
+            devices: Optional[Sequence] = None,
+            n_inputs: Optional[int] = None,
+            n_params: Optional[int] = None) -> Optional[ResolvedSharding]:
+    """Bind ``spec`` to devices. Every mismatch warns and returns None so
+    the caller falls back to the replicated single-device path."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if mesh is None:
+        mesh = build_submesh(spec.mesh_axes, devices)
+        if mesh is None:
+            return None
+    mesh_names = set(str(n) for n in mesh.axis_names)
+
+    def _bind(specs, count, what):
+        if specs is not None and count is not None \
+                and len(specs) != count:
+            warnings.warn(
+                f"sharding spec names {len(specs)} {what} PartitionSpecs "
+                f"but the artifact has {count} {what}s; falling back to "
+                f"replicated execution")
+            return None
+        n = count if count is not None else len(specs or ())
+        bound = []
+        for i in range(n):
+            s = specs[i] if specs is not None and i < len(specs) else None
+            if s is None:
+                s = PartitionSpec()
+            unknown = [a for a in _spec_axes(s) if a not in mesh_names]
+            if unknown:
+                warnings.warn(
+                    f"{what} PartitionSpec {s} references mesh axes "
+                    f"{unknown} absent from mesh {dict(mesh.shape)}; "
+                    f"falling back to replicated execution")
+                return None
+            bound.append(s)
+        return bound
+
+    in_specs = _bind(spec.inputs, n_inputs, "input")
+    if in_specs is None:
+        return None
+    p_specs = _bind(spec.params, n_params, "param")
+    if p_specs is None:
+        return None
+    return ResolvedSharding(
+        mesh,
+        tuple(NamedSharding(mesh, s) for s in in_specs),
+        tuple(NamedSharding(mesh, s) for s in p_specs),
+        in_specs, p_specs)

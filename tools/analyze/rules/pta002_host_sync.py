@@ -37,6 +37,11 @@ HOT_PREFIXES = (
     # sanctioned fetches (per-tick token vector, admission-time first
     # token) carry noqa justifications.
     "paddle_tpu/serving/llm/",
+    # replica router dispatch path: submit/_pick run per request and the
+    # health sweep runs continuously; a host sync here stalls admission
+    # for every replica at once
+    "paddle_tpu/serving/router.py",
+    "paddle_tpu/serving/replica.py",
     # the telemetry layer sits INSIDE every hot path above (span enter/
     # exit runs per step / per tick) — a host sync here taxes everything
     "paddle_tpu/observability/",

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Offered-load sweep for the replica router — throughput scaling,
+per-replica balance, and the zero-post-warmup-recompiles invariant,
+printed as one JSON document.
+
+    python -m tools.bench_router                          # 1 vs 2 replicas
+    python -m tools.bench_router --replica-counts 1,2,4
+    python -m tools.bench_router --check-recompiles       # CI gate
+
+Each sweep drives ``--requests`` mixed-size requests (unthrottled, or at
+``--loads`` req/s) through a fresh :class:`~paddle_tpu.serving.Router`
+over N single-device replicas of a jitted synthetic MLP. A warmup pass
+covers every request size first, so the ``recompiles_post_warmup``
+counter isolates steady-state compiles — it must be ZERO (each replica
+engine compiled one executable per padded bucket during warmup and
+reuses it for every later request; a nonzero count means the cache key
+is unstable). ``--check-recompiles`` turns that invariant into an exit
+code for ``tools/run_tests.py --bench-router``.
+
+The throughput table is the capacity claim: N replicas = N engine worker
+threads batching independently, so unthrottled throughput should scale
+well above 1x (the acceptance bar is >=1.7x for 2 replicas) — reported
+as ``speedup_vs_1`` per sweep, but not gated here because absolute CPU
+throughput is machine-dependent.
+
+``--device-ms`` models per-batch accelerator execution: after the jitted
+compute, the model blocks that long with the GIL released — exactly how
+an engine worker behaves while a real device runs its batch. This is
+what makes replica scaling *measurable* here: on the CPU backend every
+in-process XLA execution serializes (single client work queue, and CI
+machines may have one core), so without it even a perfectly-balanced
+router shows 1x. The routing layer — dispatch, balance, cache keys — is
+what this bench is for; the model's FLOPs are stand-ins.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import sys
+import time
+from concurrent.futures import wait
+
+
+def _synthetic_model(dim: int = 64, device_ms: float = 2.0):
+    """A jitted 2-layer MLP plus ``device_ms`` of simulated accelerator
+    time per batch (a GIL-released block, like a real device wait)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    w1 = jnp.asarray(rng.randn(dim, 4 * dim).astype(np.float32))
+    w2 = jnp.asarray(rng.randn(4 * dim, dim).astype(np.float32))
+
+    @jax.jit
+    def compute(x):
+        return jnp.tanh(x @ w1) @ w2
+
+    def fn(x):
+        y = compute(x)
+        jax.block_until_ready(y)
+        if device_ms:
+            time.sleep(device_ms / 1000.0)  # GIL released: replicas overlap
+        return y
+
+    return fn, dim
+
+
+def _callable_factory(fn, base_cfg):
+    """Engine factory over a plain callable (the bench's synthetic MLP),
+    with the per-replica stat prefix the real factories apply."""
+    from paddle_tpu.serving.engine import Engine
+
+    def factory(replica):
+        cfg = copy.copy(base_cfg)
+        cfg.stat_prefix = f"{cfg.stat_prefix}.replica{replica.replica_id}"
+        return Engine(fn, cfg, registry=replica.registry)
+    return factory
+
+
+def _total_misses(router):
+    return sum(r.engine.cache.stats()["misses"] for r in router.replicas)
+
+
+def run_sweep(router, requests, offered_qps, sizes, dim, seed=0):
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    # draw sizes randomly, not cycled: a fixed cycle correlates with the
+    # router's rotating tie-break (e.g. 4 sizes over 2 replicas pins the
+    # big requests to one replica), skewing rows while request counts
+    # stay "balanced"
+    draw = [sizes[rng.randint(len(sizes))] for _ in range(requests)]
+    payloads = [rng.randn(s, dim).astype(np.float32) for s in draw]
+
+    # warmup: every engine must see every padded-batch signature it can
+    # meet later — each row bucket (coalesced batches pad up to the
+    # max-batch bucket too). One request at a time, waited, so requests
+    # don't coalesce into a shape that skips a bucket; the round-robin
+    # tie-break spreads the n same-size requests over the n idle replicas.
+    max_batch = router.replicas[0].engine.config.buckets.max_batch
+    for s in sorted(set(sizes) | {max_batch}):
+        for _ in router.replicas:
+            router.submit([rng.randn(s, dim).astype(np.float32)]) \
+                .result(timeout=120)
+    misses_after_warmup = _total_misses(router)
+
+    gap = 0.0 if not offered_qps else 1.0 / offered_qps
+    t0 = time.monotonic()
+    futs = []
+    for i, x in enumerate(payloads):
+        futs.append(router.submit([x]))
+        if gap:
+            # absolute schedule so slow submits don't lower the offered load
+            sleep_until = t0 + (i + 1) * gap
+            pause = sleep_until - time.monotonic()
+            if pause > 0:
+                time.sleep(pause)
+    wait(futs, timeout=300)
+    wall = time.monotonic() - t0
+    errors = sum(1 for f in futs if f.exception() is not None)
+    st = router.stats()
+    reg = router.registry
+    # per-replica latency histograms carry the replica prefix; merge by
+    # taking the worst (routers care about the slowest replica's tail)
+    p50 = max((reg.quantile(
+        f"serving.replica{r.replica_id}.latency_ms", 0.50) or 0.0)
+        for r in router.replicas)
+    p95 = max((reg.quantile(
+        f"serving.replica{r.replica_id}.latency_ms", 0.95) or 0.0)
+        for r in router.replicas)
+    return {
+        "replicas": len(router.replicas),
+        "offered_qps": offered_qps or None,
+        "requests": requests,
+        "errors": errors,
+        "wall_s": round(wall, 4),
+        "throughput_rps": round(requests / wall, 2),
+        "p50_ms": round(p50, 3),
+        "p95_ms": round(p95, 3),
+        "balance_factor": round(st["balance_factor"], 4),
+        "dispatched_per_replica": {
+            k: v["dispatched"] for k, v in st["replicas"].items()},
+        "recompiles_warmup": misses_after_warmup,
+        "recompiles_post_warmup": _total_misses(router)
+                                  - misses_after_warmup,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replica-counts", default="1,2",
+                    help="comma-separated replica counts to sweep")
+    ap.add_argument("--requests", type=int, default=128)
+    ap.add_argument("--loads", default="0",
+                    help="comma-separated offered loads in req/s; 0 = "
+                         "unthrottled")
+    ap.add_argument("--sizes", default="1,2,4,8",
+                    help="request row counts, cycled")
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--dim", type=int, default=64,
+                    help="synthetic model feature dim")
+    ap.add_argument("--device-ms", type=float, default=10.0,
+                    help="simulated accelerator time per batch (GIL-"
+                         "released; 0 disables)")
+    ap.add_argument("--check-recompiles", action="store_true",
+                    help="exit 1 if any sweep saw a post-warmup recompile")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.serving import EngineConfig, Router, RouterConfig
+
+    fn, dim = _synthetic_model(args.dim, device_ms=args.device_ms)
+    sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+    loads = [float(x) for x in args.loads.split(",") if x.strip()]
+    counts = [int(c) for c in args.replica_counts.split(",") if c.strip()]
+
+    sweeps = []
+    base_rps = {}
+    for n in counts:
+        for i, qps in enumerate(loads):
+            cfg = EngineConfig(max_batch=args.max_batch,
+                               max_batch_delay=args.max_delay_ms / 1000.0,
+                               max_queue=max(1024, args.requests))
+            router = Router(_callable_factory(fn, cfg),
+                            RouterConfig(num_replicas=n,
+                                         health_interval=0.1),
+                            registry=StatRegistry())
+            try:
+                res = run_sweep(router, args.requests, qps, sizes, dim,
+                                seed=i)
+            finally:
+                router.drain(timeout=60)
+            key = qps
+            if n == min(counts):
+                base_rps[key] = res["throughput_rps"]
+            base = base_rps.get(key)
+            res["speedup_vs_1"] = (round(res["throughput_rps"] / base, 3)
+                                   if base else None)
+            sweeps.append(res)
+
+    doc = {"bench": "router", "model": "synthetic-mlp", "dim": dim,
+           "device_ms": args.device_ms, "max_batch": args.max_batch,
+           "max_delay_ms": args.max_delay_ms, "sweeps": sweeps}
+    json.dump(doc, sys.stdout, indent=2)
+    print()
+    if args.check_recompiles:
+        bad = [s for s in sweeps if s["recompiles_post_warmup"] != 0]
+        if bad:
+            print(f"FAIL: {len(bad)} sweep(s) recompiled after warmup",
+                  file=sys.stderr)
+            return 1
+        print("OK: zero post-warmup recompiles in every sweep",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ _WEIGHT_HINTS = {
     "test_launch_spawn.py": 60, "test_nn_layers.py": 70,
     "test_detection_round3.py": 50, "test_sampled_segment_ops.py": 50,
     "test_serving.py": 40, "test_serving_http.py": 20,
+    "test_router_sharded.py": 60,
 }
 
 
@@ -54,6 +55,10 @@ def main():
                          "via tools/check_bench_regression.py and fail on "
                          "a >5%% throughput drop (same contract as the "
                          "analyzer gate)")
+    ap.add_argument("--bench-router", action="store_true",
+                    help="opt-in gate: run tools/bench_router.py "
+                         "--check-recompiles and fail if any replica "
+                         "engine recompiled after warmup")
     args = ap.parse_args()
 
     if not args.no_analyze:
@@ -92,6 +97,20 @@ def main():
                                           "check_bench_regression.py")],
             cwd=REPO)
         print(f"bench check: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.bench_router:
+        # Opt-in: drives real traffic through a replica router on the CPU
+        # backend and gates on the zero-post-warmup-recompiles invariant
+        # (throughput numbers print but are machine-dependent, not gated).
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_router",
+             "--requests", "192", "--check-recompiles"],
+            cwd=REPO, env=env)
+        print(f"bench router: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
