@@ -255,14 +255,22 @@ class FaultToleranceCallback(Callback):
     burning the restart budget. Also fires the FaultInjector ``step`` site
     each batch so kill-mid-step scenarios are scriptable in tests
     (``PADDLE_TPU_FAULT_SPEC="step:7:crash"``).
+
+    ``async_save=True`` routes periodic saves through the crash-consistent
+    :class:`~paddle_tpu.incubate.checkpoint.async_ckpt.AsyncCheckpointer`
+    (sharded format under ``save_dir/<tag>``, atomic commit, overlapped
+    with training); the preemption save and ``on_train_end`` drain the
+    writer so no snapshot is lost at exit.
     """
 
-    def __init__(self, save_dir, guard=None, save_freq=1):
+    def __init__(self, save_dir, guard=None, save_freq=1, async_save=False):
         super().__init__()
         self.save_dir = save_dir
         self.save_freq = max(1, int(save_freq))
         self._guard = guard
         self._epoch = 0
+        self._async_save = bool(async_save)
+        self._ckpt = None
 
     def _ensure_guard(self):
         if self._guard is None:
@@ -272,18 +280,50 @@ class FaultToleranceCallback(Callback):
 
     def on_train_begin(self, logs=None):
         self._ensure_guard()
+        if self._async_save and self._ckpt is None:
+            from ..incubate.checkpoint.async_ckpt import (
+                AsyncCheckpointer, cleanup_stale_staging)
+            if self.save_dir:
+                os.makedirs(self.save_dir, exist_ok=True)
+                cleanup_stale_staging(self.save_dir)
+            self._ckpt = AsyncCheckpointer()
 
-    def _save(self, tag):
+    def _ckpt_state(self):
+        state = {"model": dict(self.model.network.state_dict())}
+        if getattr(self.model, "_optimizer", None) is not None:
+            state["optimizer"] = dict(self.model._optimizer.state_dict())
+        return state
+
+    def _save(self, tag, drain=False):
         if self.model is None or not self.save_dir:
             return
         os.makedirs(self.save_dir, exist_ok=True)
-        self.model.save(os.path.join(self.save_dir, tag))
+        if self._ckpt is not None:
+            self._ckpt.save(self._ckpt_state(),
+                            os.path.join(self.save_dir, tag),
+                            step=self._epoch)
+            if drain:
+                self._ckpt.wait()
+        else:
+            self.model.save(os.path.join(self.save_dir, tag))
+
+    def restore(self, tag="latest"):
+        """Load an async-saved sharded checkpoint back into the model (the
+        counterpart of ``Model.load`` for ``async_save=True`` saves)."""
+        from ..incubate.checkpoint.sharded import load_sharded
+        state = load_sharded(os.path.join(self.save_dir, tag))
+        self.model.network.set_state_dict(state["model"])
+        if ("optimizer" in state
+                and getattr(self.model, "_optimizer", None) is not None):
+            self.model._optimizer.set_state_dict(state["optimizer"])
 
     def _poll(self):
         guard = self._ensure_guard()
         if guard.preempted:
+            # the final checkpoint must be durable before the exit, so the
+            # async path drains the writer inside the save_fn
             guard.exit_if_preempted(
-                save_fn=lambda: self._save("preempted"))
+                save_fn=lambda: self._save("preempted", drain=True))
 
     def on_train_batch_end(self, step, logs=None):
         from ..utils.resilience import fault_injector
@@ -295,6 +335,10 @@ class FaultToleranceCallback(Callback):
         if epoch % self.save_freq == 0:
             self._save("latest")
         self._poll()
+
+    def on_train_end(self, logs=None):
+        if self._ckpt is not None:
+            self._ckpt.wait()
 
 
 class AnomalyGuardCallback(Callback):
@@ -320,12 +364,13 @@ class AnomalyGuardCallback(Callback):
     """
 
     def __init__(self, save_dir=None, config=None, snapshot_freq=1,
-                 keep_last=2, attach_optimizer=True):
+                 keep_last=2, attach_optimizer=True, async_snapshots=False):
         super().__init__()
         self.save_dir = save_dir
         self.snapshot_freq = max(1, int(snapshot_freq))
         self.keep_last = keep_last
         self.attach_optimizer = attach_optimizer
+        self.async_snapshots = bool(async_snapshots)
         self._config = config
         self.sentinel = None
         self.rollback = None
@@ -344,7 +389,8 @@ class AnomalyGuardCallback(Callback):
                     os.path.join(self.save_dir, "snapshots"),
                     model=self.model.network,
                     optimizer=self.model._optimizer,
-                    keep_last=self.keep_last)
+                    keep_last=self.keep_last,
+                    async_save=self.async_snapshots)
             self.sentinel = Sentinel(cfg, rollback=self.rollback)
             self.sentinel.batch_getter = \
                 lambda: getattr(self.model, "_last_batch", None)
@@ -370,6 +416,10 @@ class AnomalyGuardCallback(Callback):
             reason=None if healthy else
             f"epoch {epoch} saw "
             f"{self.sentinel.anomalies - self._epoch_anomalies} anomalies")
+
+    def on_train_end(self, logs=None):
+        if self.rollback is not None:
+            self.rollback.wait()  # async snapshots must land before exit
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

@@ -13,10 +13,16 @@ metadata index. Each host writes only the array shards it can address
 with no cross-host traffic; load reassembles the global arrays and
 re-shards them onto the *current* mesh (which may have a different
 topology — resharding on restore). Async mode moves the device→host fetch
-and file write off the training thread (the orbax-style pattern).
+and file write off the training thread (the orbax-style pattern);
+``async_ckpt`` is the crash-consistent flavor: bounded-queue coalescing
+double-buffered snapshots published by a single atomic ``os.replace``
+(docs/fault_tolerance.md, "Async checkpointing").
 """
 from .sharded import (save_sharded, load_sharded, AsyncSaver,  # noqa: F401
                       CheckpointIntegrityError, verify_checkpoint,
-                      HEALTH_STAMP_FILE, write_health_stamp,
+                      HEALTH_STAMP_FILE, STAGING_SUFFIX, write_health_stamp,
                       read_health_stamp, newest_healthy_checkpoint)
+from .async_ckpt import (AsyncCheckpointer, AsyncCheckpointConfig,  # noqa: F401
+                         CommitError, SaveTicket, commit_checkpoint,
+                         cleanup_stale_staging)
 from .auto_checkpoint import TrainEpochRange, train_epoch_range  # noqa: F401

@@ -1,0 +1,559 @@
+"""Crash-consistent asynchronous checkpointing.
+
+The scaling tax this removes: a synchronous snapshot (sentinel rollback,
+epoch auto-checkpoint) blocks the train loop on a device→host fetch plus
+file I/O that grows with param count. Following LazyTensor's
+async-dispatch discipline (PAPERS.md — keep the accelerator busy while
+the host works), :class:`AsyncCheckpointer` moves the whole save off the
+step path:
+
+1. **Snapshot off the step path** — ``save()`` takes an *async on-device
+   copy* of each array (the fused optimizer step donates param buffers,
+   so a bare reference would be deleted under the writer) and starts the
+   device→host DMA with ``copy_to_host_async`` — non-blocking
+   double-buffering; the blocking materialization happens on the writer
+   thread.
+2. **Bounded queue + coalescing** — at most ``queue_depth`` snapshots
+   wait; when full, the *oldest unwritten* snapshot is superseded by the
+   newer one (its ticket reports ``superseded``) instead of ever
+   blocking the trainer.
+3. **Two-phase atomic commit** — shards, the sha256 manifest (with the
+   health stamp folded in — no stamp-after-rename window) and sidecars
+   land in a ``<path>.tmp`` staging dir, every file and the dir are
+   fsynced, then one ``os.replace`` publishes the checkpoint. Readers
+   (``load_sharded``, newest-healthy walks, elastic resume, replica
+   resurrection) can never observe a torn checkpoint: it either does
+   not exist yet or is complete.
+
+I/O failures retry on the writer thread with the existing backoff
+substrate (:func:`~paddle_tpu.utils.resilience.retry_call`) and then
+**degrade to skip-with-counter** (``ckpt.async.degraded_skips``) instead
+of killing the step loop — a full disk makes you lose a snapshot, not
+the job.
+
+Fault sites (chaos campaign, docs/fault_tolerance.md): ``ckpt_fetch``,
+``ckpt_shard_write``, ``ckpt_pre_rename``, ``ckpt_post_rename`` fire at
+the matching pipeline stage; actions ``kill_during_commit`` (hard exit),
+``torn_write`` (truncate the staged archive after checksumming),
+``disk_full`` (raise ENOSPC), ``slow_io`` (stall the writer) are
+interpreted here.
+
+PTA002 polices this file as a hot path: the *step-path* entry points
+(``save``/enqueue) must stay free of blocking I/O and device fetches;
+writer-thread internals carry ``noqa`` justifications.
+"""
+from __future__ import annotations
+
+import errno
+import json
+import os
+import shutil
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Set
+
+import numpy as np
+
+from ...core import monitor as _monitor
+from ...observability import flight as _flight
+from ...observability import tracer as _otrace
+from ...utils.resilience import RetryError, fault_injector, retry_call
+from .sharded import (HEALTH_STAMP_FILE, STAGING_SUFFIX, _flatten,
+                      _sha256_of, _slices_of, _spec_of)
+
+#: injected ``slow_io`` stall per fire (seconds); env-tunable so chaos
+#: tests can widen the commit window enough to land a real SIGKILL in it.
+SLOW_IO_SECONDS = float(os.environ.get("PADDLE_TPU_FAULT_SLOW_IO_S", "0.25"))
+
+
+class CommitError(RuntimeError):
+    """A checkpoint commit failed after exhausting its I/O retries."""
+
+
+def _fire(site: str, shard_path: Optional[str] = None):
+    """Count one FaultInjector occurrence of ``site`` and interpret the
+    checkpoint-flavored actions. ``crash``/``kill_during_commit`` (hard
+    exit) and ``raise`` are executed inside ``fire`` itself."""
+    action = fault_injector().fire(site)
+    if action is None:
+        return
+    if action == "disk_full":
+        raise OSError(errno.ENOSPC,
+                      f"injected disk_full at {site}")
+    if action == "slow_io":
+        time.sleep(SLOW_IO_SECONDS)  # noqa: PTA002 -- injected writer-thread stall; never reachable from save()
+    elif action == "torn_write" and shard_path is not None:
+        # simulate a write torn by power loss AFTER the checksum was
+        # recorded: the manifest claims the full digest, verification
+        # must catch the mismatch on load
+        size = os.path.getsize(shard_path)
+        with open(shard_path, "r+b") as f:  # noqa: PTA002 -- fault-injection corruption, writer thread only
+            f.truncate(max(1, size // 2))
+
+
+def _fsync_file(path: str):
+    fd = os.open(path, os.O_RDONLY)  # noqa: PTA002 -- durability fsync, writer thread only
+    try:
+        os.fsync(fd)  # noqa: PTA002 -- durability fsync, writer thread only
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str):
+    """fsync a directory so the rename/creat entries are durable; some
+    filesystems refuse dir fsync — that costs durability, not atomicity."""
+    try:
+        fd = os.open(path, os.O_RDONLY)  # noqa: PTA002 -- durability fsync, writer thread only
+    except OSError:
+        return
+    try:
+        os.fsync(fd)  # noqa: PTA002 -- durability fsync, writer thread only
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _materialize(flat: Dict[str, Any]):
+    """Device→host fetch: flat {key: raw} → (meta entries, shard blobs,
+    scalars). Mirrors ``sharded._save_sharded_impl``'s shard walk; runs
+    ONLY on the writer thread (or inside a sync ``commit_checkpoint``) —
+    never on the step path."""
+    import jax.numpy as jnp
+    meta: Dict[str, Any] = {}
+    blobs: Dict[str, np.ndarray] = {}
+    scalars: Dict[str, Any] = {}
+    for key, raw in flat.items():
+        if isinstance(raw, (int, float, str, bool, type(None))):
+            scalars[key] = raw
+            continue
+        if isinstance(raw, np.ndarray):
+            raw = jnp.asarray(raw)
+        entry = {"shape": list(raw.shape), "dtype": str(raw.dtype),
+                 "spec": _spec_of(raw), "shards": []}
+        for i, s in enumerate(getattr(raw, "addressable_shards", [])) or []:
+            blob_key = f"{key}|{i}"
+            blobs[blob_key] = np.asarray(s.data)  # noqa: PTA002 -- the writer-thread device->host fetch; sanctioned off the step path
+            entry["shards"].append(
+                {"blob": blob_key, "index": _slices_of(s, raw.ndim)})
+        if not entry["shards"]:
+            blob_key = f"{key}|0"
+            blobs[blob_key] = np.asarray(raw)  # noqa: PTA002 -- the writer-thread device->host fetch; sanctioned off the step path
+            entry["shards"].append({"blob": blob_key, "index": None})
+        meta[key] = entry
+    return meta, blobs, scalars
+
+
+def _health_doc(healthy: bool, step: Optional[int],
+                reason: Optional[str]) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {"healthy": bool(healthy), "time": time.time()}
+    if step is not None:
+        doc["step"] = int(step)
+    if reason is not None:
+        doc["reason"] = str(reason)
+    return doc
+
+
+def _write_staged(staging: str, meta, blobs, scalars, health,
+                  fsync: bool = True):
+    """Phase 1: write every checkpoint file into ``staging``. Layout is
+    byte-compatible with ``sharded.save_sharded`` (plus the manifest's
+    inline health doc), so every existing reader works unchanged."""
+    import jax
+    if os.path.isdir(staging):  # debris from a writer that died mid-stage
+        shutil.rmtree(staging, ignore_errors=True)  # noqa: PTA002 -- staging cleanup, writer thread only
+    os.makedirs(staging, exist_ok=True)  # noqa: PTA002 -- staging setup, writer thread only
+    proc = jax.process_index()
+    shards_name = f"shards_{proc}.npz"
+    shards_path = os.path.join(staging, shards_name)
+    with open(shards_path, "wb") as f:  # noqa: PTA002 -- shard archive write, writer thread only
+        np.savez(f, **blobs)  # noqa: PTA002 -- shard archive write, writer thread only
+    if fsync:
+        _fsync_file(shards_path)
+    digest = _sha256_of(shards_path)
+    # fire AFTER the checksum: torn_write must leave a manifest that
+    # claims the full digest so verify-on-load catches the tear
+    _fire("ckpt_shard_write", shards_path)
+    doc = {"format": 3,
+           "checksums": {shards_name: digest},
+           "health": dict(health),
+           "entries": meta}
+    for name, payload in ((f"metadata_{proc}.json", doc),
+                          (HEALTH_STAMP_FILE, dict(health)),
+                          ("scalars.json", scalars)):
+        p = os.path.join(staging, name)
+        with open(p, "w") as f:  # noqa: PTA002 -- manifest/sidecar write, writer thread only
+            json.dump(payload, f)
+        if fsync:
+            _fsync_file(p)
+    if fsync:
+        _fsync_dir(staging)
+
+
+def _publish(staging: str, final: str):
+    """Phase 2: the single atomic publish. A crash strictly before the
+    ``os.replace`` leaves only a ``*.tmp`` dir every reader skips; a
+    crash strictly after leaves a complete committed checkpoint."""
+    _fire("ckpt_pre_rename")
+    if os.path.isdir(final):
+        # re-saving over an existing checkpoint: drop the stale one first
+        # (os.replace cannot atomically swap non-empty dirs). The window
+        # where neither exists degrades readers to an OLDER committed
+        # checkpoint — safe, never torn.
+        shutil.rmtree(final)  # noqa: PTA002 -- stale-target removal, writer thread only
+    os.replace(staging, final)  # noqa: PTA002 -- the atomic publish, writer thread only
+    _fsync_dir(os.path.dirname(os.path.abspath(final)))
+    _fire("ckpt_post_rename")
+
+
+def commit_checkpoint(state, path: str, *, healthy: bool = True,
+                      step: Optional[int] = None,
+                      reason: Optional[str] = None,
+                      fsync: bool = True):
+    """Synchronous crash-consistent checkpoint commit.
+
+    Same layout as :func:`~paddle_tpu.incubate.checkpoint.save_sharded`
+    but published atomically: stage → fsync → one ``os.replace``. The
+    health stamp rides inside the same commit (manifest ``health`` key +
+    the ``health.json`` sidecar staged pre-rename), closing the
+    stamp-after-rename window the sidecar-only protocol had. Partial
+    writes are invisible by construction.
+
+    This is the cold-path entry (sentinel rollback snapshots, tests);
+    the train loop uses :class:`AsyncCheckpointer`, whose writer thread
+    lands in the same staging/publish code.
+    """
+    with _otrace.span("checkpoint/commit", {"path": path}):
+        from ...core.tensor import Tensor
+        flat = {k: (v._data if isinstance(v, Tensor) else v)
+                for k, v in _flatten(state).items()}
+        _fire("ckpt_fetch")
+        meta, blobs, scalars = _materialize(flat)
+        health = _health_doc(healthy, step, reason)
+        staging = path + STAGING_SUFFIX
+        _write_staged(staging, meta, blobs, scalars, health, fsync=fsync)
+        _publish(staging, path)
+    return path
+
+
+def cleanup_stale_staging(root: str,
+                          held: Optional[Set[str]] = None) -> List[str]:
+    """Remove orphaned ``*.tmp`` staging dirs under ``root`` — debris from
+    a writer killed mid-stage in a previous run. ``held`` protects paths a
+    live writer still owns. Returns the removed paths. Startup-only by
+    contract (checkpoint GC must never race an in-flight stage)."""
+    removed: List[str] = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return removed
+    for name in names:
+        full = os.path.join(root, name)
+        if not name.endswith(STAGING_SUFFIX) or not os.path.isdir(full):
+            continue
+        if held and full in held:
+            continue
+        shutil.rmtree(full, ignore_errors=True)  # noqa: PTA002 -- startup-only orphan sweep, never on the step path
+        removed.append(full)
+    if removed:
+        _monitor.stat_add("ckpt.async.stale_staging_cleaned", len(removed))
+    return removed
+
+
+class SaveTicket:
+    """Handle for one enqueued snapshot. ``wait()`` blocks until the
+    snapshot is committed, superseded, or degraded-skipped; ``error`` is
+    the terminal exception of a degraded/failed save (never raised on the
+    step path)."""
+
+    __slots__ = ("path", "step", "_done", "committed", "superseded",
+                 "error")
+
+    def __init__(self, path: str, step: Optional[int]):
+        self.path = path
+        self.step = step
+        self._done = threading.Event()
+        self.committed = False
+        self.superseded = False
+        self.error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """True once the ticket reached a terminal state."""
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _finish(self, *, committed: bool = False, superseded: bool = False,
+                error: Optional[BaseException] = None):
+        self.committed = committed
+        self.superseded = superseded
+        self.error = error
+        self._done.set()
+
+
+class _Pending:
+    """One queued snapshot: captured refs + commit metadata."""
+
+    __slots__ = ("flat", "path", "health", "on_commit", "ticket")
+
+    def __init__(self, flat, path, health, on_commit, ticket):
+        self.flat = flat
+        self.path = path
+        self.health = health
+        self.on_commit = on_commit
+        self.ticket = ticket
+
+
+class AsyncCheckpointConfig:
+    """Tunables for :class:`AsyncCheckpointer`."""
+
+    def __init__(self, queue_depth: int = 2, max_attempts: int = 3,
+                 backoff: float = 0.05, fsync: bool = True,
+                 degrade_on_failure: bool = True):
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.queue_depth = int(queue_depth)
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff = float(backoff)
+        self.fsync = bool(fsync)
+        self.degrade_on_failure = bool(degrade_on_failure)
+
+
+class AsyncCheckpointer:
+    """Overlapped, crash-consistent checkpoint writer.
+
+    ::
+
+        ckpt = AsyncCheckpointer()
+        for epoch in range(epochs):
+            train_one_epoch(...)
+            ckpt.save(state, f"{root}/epoch_{epoch}", step=epoch,
+                      on_commit=lambda e=epoch: commit_status(e))
+        ckpt.wait()     # or close(); SIGTERM paths drain the same way
+
+    Lock discipline (PTA006): ``_pending``, ``_in_flight``, ``_closed``
+    and ``_thread`` are only touched under ``self._cond``; commit work,
+    tickets and callbacks run outside it.
+    """
+
+    def __init__(self, config: Optional[AsyncCheckpointConfig] = None,
+                 registry: Optional[_monitor.StatRegistry] = None):
+        self._config = config or AsyncCheckpointConfig()
+        self._registry = registry or _monitor.default_registry()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[_Pending] = []
+        self._in_flight: Optional[_Pending] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def config(self) -> AsyncCheckpointConfig:
+        return self._config
+
+    # -- step-path side (must never block on I/O or device fetch) ----------
+    def save(self, state, path: str, *, step: Optional[int] = None,
+             healthy: bool = True, reason: Optional[str] = None,
+             on_commit: Optional[Callable[[], None]] = None) -> SaveTicket:
+        """Enqueue one snapshot of ``state`` for background commit to
+        ``path``. Takes donation-safe on-device copies and kicks off the
+        device→host DMA (both non-blocking dispatches) — the caller may
+        keep training immediately; later optimizer steps can neither
+        mutate nor delete the captured buffers.
+
+        Never raises for I/O trouble and never blocks on the queue: a
+        full queue supersedes the oldest unwritten snapshot instead."""
+        t0 = time.perf_counter()
+        import jax
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor
+        # double-buffer: the snapshot must own its bytes — the fused
+        # optimizer step DONATES param buffers (optimizer.py
+        # donate_argnums), so an aliased stash would be deleted under the
+        # writer. On accelerators that is an async on-device copy plus a
+        # device->host DMA kick, both non-blocking; the CPU backend runs
+        # those dispatches synchronously (two memcpys), so there the cheap
+        # donation-safe snapshot is ONE direct host memcpy instead.
+        on_cpu = jax.default_backend() == "cpu"
+        flat = {}
+        for k, v in _flatten(state).items():
+            raw = v._data if isinstance(v, Tensor) else v
+            if hasattr(raw, "copy_to_host_async"):
+                if on_cpu:
+                    raw = np.array(raw, copy=True)  # noqa: PTA002 -- CPU device memory IS host memory: one owned memcpy is the cheapest donation-safe snapshot (an on-device copy would dispatch synchronously here and cost two copies)
+                else:
+                    raw = jnp.array(raw, copy=True)
+                    raw.copy_to_host_async()
+            flat[k] = raw
+        ticket = SaveTicket(path, step)
+        item = _Pending(flat, path, _health_doc(healthy, step, reason),
+                        on_commit, ticket)
+        superseded: List[_Pending] = []
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointer is closed")
+            while len(self._pending) >= self._config.queue_depth:
+                superseded.append(self._pending.pop(0))
+            self._pending.append(item)
+            self._ensure_writer_locked()
+            depth = len(self._pending)
+            self._cond.notify_all()
+        for old in superseded:
+            old.ticket._finish(superseded=True)
+        reg = self._registry
+        reg.add("ckpt.async.saves", 1)
+        if superseded:
+            reg.add("ckpt.async.superseded", len(superseded))
+        reg.set("ckpt.async.queue_depth", depth)
+        reg.observe("ckpt.async.enqueue_ms",
+                    (time.perf_counter() - t0) * 1e3)
+        return ticket
+
+    def _ensure_writer_locked(self):
+        """(Re)start the writer thread; caller holds ``self._cond``. A
+        writer killed by an unexpected error is replaced on the next
+        save rather than silently dropping every later snapshot."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        if self._thread is not None:
+            self._registry.add("ckpt.async.writer_restarts", 1)
+        self._thread = threading.Thread(
+            target=self._run, name="paddle-tpu-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    # -- draining -----------------------------------------------------------
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every enqueued snapshot reached a terminal state
+        (committed, superseded, or degraded). The SIGTERM/preemption
+        drain path: an in-flight commit always finishes before exit."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: not self._pending and self._in_flight is None,
+                timeout)
+
+    def close(self, timeout: Optional[float] = None):
+        """Drain then stop the writer thread. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def held_paths(self) -> Set[str]:
+        """Final+staging paths the writer still owns — checkpoint GC must
+        skip these (a keep-budget sweep racing the writer would delete
+        the snapshot it is about to publish)."""
+        with self._cond:
+            items = list(self._pending)
+            if self._in_flight is not None:
+                items.append(self._in_flight)
+        out: Set[str] = set()
+        for it in items:
+            out.add(it.path)
+            out.add(it.path + STAGING_SUFFIX)
+        return out
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- writer thread ------------------------------------------------------
+    def _run(self):
+        clean_exit = False
+        try:
+            while True:
+                with self._cond:
+                    self._cond.wait_for(
+                        lambda: self._pending or self._closed)  # noqa: PTA006 -- wait_for evaluates the predicate while holding self._lock (the Condition's lock)
+                    if not self._pending:
+                        if self._closed:
+                            clean_exit = True
+                            return
+                        continue
+                    item = self._pending.pop(0)
+                    self._in_flight = item
+                    self._registry.set("ckpt.async.queue_depth",
+                                       len(self._pending))
+                try:
+                    self._process(item)
+                finally:
+                    with self._cond:
+                        self._in_flight = None
+                        self._cond.notify_all()
+        finally:
+            if not clean_exit:
+                # unexpected writer death (anything except a drained
+                # close) — post-mortem needs the event even when the
+                # exception text is lost to the daemon-thread abyss
+                self._registry.add("ckpt.async.writer_deaths", 1)
+                _flight.record_event("ckpt_writer_death", {})
+                _flight.dump_if_armed("ckpt_writer_death")
+
+    def _process(self, item: _Pending):
+        reg = self._registry
+        staging = item.path + STAGING_SUFFIX
+        try:
+            with _otrace.span("checkpoint/async_write",
+                              {"path": item.path}):
+                t0 = time.perf_counter()
+                _fire("ckpt_fetch")
+                meta, blobs, scalars = _materialize(item.flat)
+                t1 = time.perf_counter()
+                reg.observe("ckpt.async.fetch_ms", (t1 - t0) * 1e3)
+                retry_call(
+                    self._stage_and_publish,
+                    (item, meta, blobs, scalars),
+                    max_attempts=self._config.max_attempts,
+                    backoff=self._config.backoff,
+                    retry_on=(OSError,),
+                    on_retry=lambda a, e, p: (
+                        reg.add("ckpt.async.retries", 1),
+                        _flight.record_event(
+                            "ckpt_retry",
+                            {"path": item.path, "attempt": a,
+                             "error": repr(e)})))
+                t2 = time.perf_counter()
+                reg.observe("ckpt.async.write_ms", (t2 - t1) * 1e3)
+            reg.add("ckpt.async.commits", 1)
+            item.ticket._finish(committed=True)
+            if item.on_commit is not None:
+                item.on_commit()
+        except RetryError as e:
+            shutil.rmtree(staging, ignore_errors=True)  # noqa: PTA002 -- degraded-path cleanup, writer thread only
+            if not self._config.degrade_on_failure:
+                item.ticket._finish(error=e)
+                raise
+            reg.add("ckpt.async.degraded_skips", 1)
+            _flight.record_event("ckpt_degraded_skip",
+                                 {"path": item.path, "error": repr(e)})
+            _flight.dump_if_armed("ckpt_degraded_skip")
+            warnings.warn(
+                f"async checkpoint to {item.path} failed after "
+                f"{self._config.max_attempts} attempts and was skipped "
+                f"({e.__cause__!r}); training continues on the previous "
+                f"committed checkpoint")
+            item.ticket._finish(error=e)
+        except Exception as e:
+            # non-I/O failure (a leaf that can't serialize, a bug): the
+            # snapshot is lost but the writer and the train loop live on
+            shutil.rmtree(staging, ignore_errors=True)  # noqa: PTA002 -- failure-path cleanup, writer thread only
+            reg.add("ckpt.async.errors", 1)
+            _flight.record_event("ckpt_error",
+                                 {"path": item.path, "error": repr(e)})
+            warnings.warn(f"async checkpoint to {item.path} failed: {e!r}")
+            item.ticket._finish(error=e)
+
+    def _stage_and_publish(self, item: _Pending, meta, blobs, scalars):
+        t0 = time.perf_counter()
+        _write_staged(item.path + STAGING_SUFFIX, meta, blobs, scalars,
+                      item.health, fsync=self._config.fsync)
+        _publish(item.path + STAGING_SUFFIX, item.path)
+        self._registry.observe("ckpt.async.commit_ms",
+                               (time.perf_counter() - t0) * 1e3)

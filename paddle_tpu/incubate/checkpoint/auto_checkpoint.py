@@ -28,9 +28,10 @@ import sys
 import warnings
 from typing import Optional
 
-from .sharded import (save_sharded, load_sharded, AsyncSaver,
+from .sharded import (save_sharded, load_sharded,
                       CheckpointIntegrityError, read_health_stamp,
                       write_health_stamp)
+from .async_ckpt import AsyncCheckpointer, cleanup_stale_staging
 from ...utils.resilience import fault_injector
 
 
@@ -81,11 +82,16 @@ class TrainEpochRange:
                                  self.name)
         self._inter = max(1, int(save_checkpoint_inter))
         self._keep_last = keep_last
-        self._saver = AsyncSaver() if async_save else None
+        # async_save routes through the crash-consistent AsyncCheckpointer
+        # (async_ckpt.py): overlapped fetch+write, atomic os.replace commit
+        self._saver = AsyncCheckpointer() if async_save else None
         from ...distributed.elastic import maybe_auto_guard
         self._guard = maybe_auto_guard(preemption_guard)
         self.restored_epoch = -1
         self._last_saved = -1
+        # debris from a writer killed mid-stage in a previous run; startup
+        # only, so this can never race our own in-flight saves
+        cleanup_stale_staging(self._dir)
         self._restore()
 
     # -- persistence --------------------------------------------------------
@@ -194,11 +200,13 @@ class TrainEpochRange:
         ckpt = self._epoch_dir(epoch)
         self._last_saved = epoch
         if self._saver is not None:
-            # async: the fetch+write AND the status commit happen on the
-            # background thread — training overlaps the whole save, and
-            # AsyncSaver.save waits for any previous in-flight save first
-            self._saver.save(self._state(), ckpt,
-                             on_done=lambda: self._commit(epoch))
+            # async: fetch+write AND the status commit happen on the writer
+            # thread — training overlaps the whole save; a queue-full save
+            # supersedes the older unwritten snapshot instead of blocking,
+            # and status.json only ever references a published checkpoint
+            # (on_commit fires strictly after the atomic os.replace)
+            self._saver.save(self._state(), ckpt, step=epoch,
+                             on_commit=lambda: self._commit(epoch))
         else:
             save_sharded(self._state(), ckpt)
             self._commit(epoch)
@@ -206,13 +214,18 @@ class TrainEpochRange:
     def _gc(self, current):
         if self._keep_last is None:
             return
+        held = self._saver.held_paths() if self._saver is not None else ()
         for name in os.listdir(self._dir):
+            full = os.path.join(self._dir, name)
+            if full in held:
+                # the writer still owns this path (pending or staging) —
+                # sweeping it would delete the snapshot about to publish
+                continue
             e = _epoch_no(name)
             if e is None:
                 continue
             if e <= current - self._keep_last * self._inter:
-                shutil.rmtree(os.path.join(self._dir, name),
-                              ignore_errors=True)
+                shutil.rmtree(full, ignore_errors=True)
 
     # -- iteration ----------------------------------------------------------
     def get(self):

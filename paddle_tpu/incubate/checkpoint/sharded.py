@@ -10,7 +10,9 @@ Layout of a checkpoint directory::
 ``metadata_<proc>.json`` (format 2) is
 ``{"format": 2, "checksums": {"shards_<proc>.npz": "<sha256>"},
 "entries": {key: {shape, dtype, spec, shards}}}``; format-1 checkpoints
-(a bare ``{key: entry}`` map, no checksums) still load. The checksum is
+(a bare ``{key: entry}`` map, no checksums) still load. Format 3 (written
+by the atomic commit in ``async_ckpt``) adds a ``"health"`` doc so the
+health stamp publishes in the same ``os.replace`` as the shards. The checksum is
 verified on load — a flipped bit or truncated shard archive raises
 :class:`CheckpointIntegrityError` instead of silently restoring garbage,
 and ``TrainEpochRange._restore`` uses that signal to fall back to the
@@ -37,6 +39,14 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ...observability import tracer as _otrace
 from ...utils.resilience import fault_injector
+
+
+#: Suffix of the staging directory the atomic commit protocol
+#: (``incubate.checkpoint.async_ckpt``) writes into before its single
+#: ``os.replace`` publish. Every reader here — ``_is_checkpoint_dir``,
+#: ``newest_healthy_checkpoint``, the epoch/snapshot GC walks — must treat
+#: ``*.tmp`` paths as invisible: they are by definition uncommitted.
+STAGING_SUFFIX = ".tmp"
 
 
 class CheckpointIntegrityError(RuntimeError):
@@ -185,7 +195,7 @@ def verify_checkpoint(path: str):
         with open(os.path.join(path, fn)) as f:
             m = json.load(f)
         proc = fn[len("metadata_"):-len(".json")]
-        expect = (m.get("checksums", {}) if m.get("format") == 2
+        expect = (m.get("checksums", {}) if m.get("format") in (2, 3)
                   else {f"shards_{proc}.npz": None})
         for shards_name, digest in expect.items():
             full = os.path.join(path, shards_name)
@@ -224,22 +234,51 @@ def write_health_stamp(path: str, healthy: bool, step: Optional[int] = None,
 
 
 def read_health_stamp(path: str) -> Dict[str, Any]:
-    """Read the health stamp of checkpoint dir ``path``. Missing or
-    unparsable stamps read as ``{"healthy": True}`` — absence of evidence
-    of sickness is health (backward compat with stamp-less checkpoints)."""
+    """Read the health stamp of checkpoint dir ``path``.
+
+    Prefers the ``health.json`` sidecar (it is rewritable, so a retroactive
+    ``mark_unhealthy`` after commit still wins); when the sidecar is missing
+    or unparsable, falls back to the ``health`` doc format-3 metadata
+    carries inside the atomic commit (closing the old stamp-after-rename
+    window). With neither, reads as ``{"healthy": True}`` — absence of
+    evidence of sickness is health (backward compat with stamp-less
+    checkpoints)."""
     full = os.path.join(path, HEALTH_STAMP_FILE)
     try:
         with open(full) as f:
             stamp = json.load(f)
     except (OSError, ValueError):
-        return {"healthy": True}
+        stamp = _manifest_health(path)
     if not isinstance(stamp, dict):
         return {"healthy": True}
     stamp.setdefault("healthy", True)
     return stamp
 
 
+def _manifest_health(path: str) -> Dict[str, Any]:
+    """Health doc embedded in a format-3 metadata file, else healthy."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return {"healthy": True}
+    for fn in sorted(names):
+        if not (fn.startswith("metadata_") and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(path, fn)) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(m, dict) and isinstance(m.get("health"), dict):
+            return dict(m["health"])
+    return {"healthy": True}
+
+
 def _is_checkpoint_dir(path: str) -> bool:
+    # *.tmp is the async-commit staging dir: it holds metadata files but is
+    # by definition uncommitted — no restore walk may ever pick it up
+    if path.rstrip(os.sep).endswith(STAGING_SUFFIX):
+        return False
     try:
         names = os.listdir(path)
     except OSError:
@@ -301,8 +340,8 @@ def newest_healthy_checkpoint(root: str,
 
 
 def _meta_entries(m):
-    """Entries map from a format-2 doc or a legacy format-1 bare map."""
-    if isinstance(m, dict) and m.get("format") == 2:
+    """Entries map from a format-2/3 doc or a legacy format-1 bare map."""
+    if isinstance(m, dict) and m.get("format") in (2, 3):
         return m["entries"]
     return m
 

@@ -2,10 +2,14 @@
 
 A thin adapter over the sharded checkpoint format
 (``incubate/checkpoint/sharded.py``): periodic snapshots of model +
-optimizer state, each carrying the health-stamp sidecar the sentinel
-writes, and a restore that walks snapshots newest-first skipping anything
-stamped unhealthy or failing its shard checksums. A missing stamp means
-healthy (pre-sentinel checkpoints stay restorable — backward compat).
+optimizer state, each committed atomically with its health stamp
+(``incubate.checkpoint.async_ckpt.commit_checkpoint`` — the stamp rides
+inside the same ``os.replace`` as the shards, so a crash can never leave a
+committed-but-stampless snapshot), and a restore that walks snapshots
+newest-first skipping anything stamped unhealthy or failing its shard
+checksums. A missing stamp means healthy (pre-sentinel checkpoints stay
+restorable — backward compat). ``async_save=True`` moves the whole
+snapshot off the step path onto the shared writer thread.
 """
 from __future__ import annotations
 
@@ -16,8 +20,10 @@ from typing import List, Optional
 
 from ..core import monitor as _monitor
 from ..incubate.checkpoint.sharded import (
-    save_sharded, load_sharded, CheckpointIntegrityError,
+    load_sharded, CheckpointIntegrityError,
     write_health_stamp, read_health_stamp)
+from ..incubate.checkpoint.async_ckpt import (
+    AsyncCheckpointer, cleanup_stale_staging, commit_checkpoint)
 
 
 def _snap_no(name: str) -> Optional[int]:
@@ -36,11 +42,15 @@ class CheckpointRollback:
     """
 
     def __init__(self, path: str, model=None, optimizer=None,
-                 keep_last: int = 2):
+                 keep_last: int = 2, async_save: bool = False):
         self.path = str(path)
         self._model = model
         self._optimizer = optimizer
         self.keep_last = max(1, int(keep_last))
+        self._ckpt = AsyncCheckpointer() if async_save else None
+        # orphaned *.tmp staging dirs from a previous crashed run; startup
+        # only, so this can never race our own writer
+        cleanup_stale_staging(self.path)
 
     # -- save side -----------------------------------------------------------
     def _snap_dir(self, step: int) -> str:
@@ -56,12 +66,24 @@ class CheckpointRollback:
 
     def snapshot(self, step: int, healthy: bool = True,
                  reason: Optional[str] = None) -> str:
-        """Write one snapshot + its health stamp; GC old *healthy* ones."""
+        """Commit one snapshot with its health stamp folded into the same
+        atomic publish; GC old *healthy* ones. With ``async_save`` the whole
+        fetch+write runs on the writer thread and GC fires post-commit."""
         d = self._snap_dir(step)
-        save_sharded(self._state(), d)
-        write_health_stamp(d, healthy, step=step, reason=reason)
-        self._gc()
+        if self._ckpt is not None:
+            self._ckpt.save(self._state(), d, step=step, healthy=healthy,
+                            reason=reason, on_commit=self._gc)
+        else:
+            commit_checkpoint(self._state(), d, healthy=healthy, step=step,
+                              reason=reason)
+            self._gc()
         return d
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Drain any in-flight async snapshots (no-op when synchronous)."""
+        if self._ckpt is not None:
+            return self._ckpt.wait(timeout)
+        return True
 
     def steps(self) -> List[int]:
         if not os.path.isdir(self.path):
@@ -77,11 +99,15 @@ class CheckpointRollback:
             write_health_stamp(d, False, step=step, reason=reason)
 
     def _gc(self):
+        held = self._ckpt.held_paths() if self._ckpt is not None else ()
         healthy = [s for s in self.steps()
                    if read_health_stamp(self._snap_dir(s)).get("healthy",
                                                                True)]
         for s in healthy[:-self.keep_last]:
-            shutil.rmtree(self._snap_dir(s), ignore_errors=True)
+            d = self._snap_dir(s)
+            if d in held:  # the writer still owns it — never sweep
+                continue
+            shutil.rmtree(d, ignore_errors=True)
 
     # -- restore side --------------------------------------------------------
     def restore_newest_healthy(self) -> Optional[int]:
@@ -89,6 +115,7 @@ class CheckpointRollback:
         health-stamped healthy (missing stamp = healthy) and integrity-
         intact. Returns the restored step, or None when nothing usable is
         left."""
+        self.wait()  # a queued async snapshot may be the newest state
         for step in reversed(self.steps()):
             d = self._snap_dir(step)
             stamp = read_health_stamp(d)

@@ -89,7 +89,11 @@ class Replica:
         :meth:`resurrect` catches)."""
         ckpt = None
         if self.checkpoint_root is not None:
+            from ..incubate.checkpoint.async_ckpt import cleanup_stale_staging
             from ..incubate.checkpoint.sharded import newest_healthy_checkpoint
+            # a trainer killed mid-commit may have left *.tmp staging debris
+            # next to the committed checkpoints; sweep it before the walk
+            cleanup_stale_staging(self.checkpoint_root)
             ckpt = newest_healthy_checkpoint(self.checkpoint_root)
         with self._lock:
             self._boot_checkpoint = ckpt

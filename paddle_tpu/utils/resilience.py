@@ -146,10 +146,17 @@ class FaultInjector:
     then proceeds, so checksum verification can be exercised end to end).
 
     Verbatim actions interpreted by current call sites: ``corrupt`` at
-    ``save``/``load`` (checkpoint shard corruption, sharded.py) and ``nan``
+    ``save``/``load`` (checkpoint shard corruption, sharded.py), ``nan``
     at ``grads``/``loss`` (the numerical-anomaly sentinel poisons the
     corresponding values with NaN right before its health probe —
-    ``grads:5:nan`` makes step 5 diverge deterministically).
+    ``grads:5:nan`` makes step 5 diverge deterministically), and the
+    chaos-campaign actions at the ``ckpt_*`` commit-pipeline sites
+    (``incubate.checkpoint.async_ckpt``): ``torn_write`` truncates the
+    staged shard archive after checksumming, ``disk_full`` raises
+    ``ENOSPC``, ``slow_io`` stalls the writer
+    (``PADDLE_TPU_FAULT_SLOW_IO_S`` seconds). ``kill_during_commit`` is an
+    alias of ``crash`` (hard ``os._exit``), named so chaos specs read as
+    intent.
 
     Counters are per-process: a restarted trainer starts counting from zero
     again, which is exactly what makes "crash once, then succeed" scenarios
@@ -204,9 +211,9 @@ class FaultInjector:
         for occ, action in self._rules[site]:
             if occ != n:
                 continue
-            if action == "crash":
+            if action in ("crash", "kill_during_commit"):
                 sys.stderr.write(
-                    f"[FaultInjector] crash at {site}:{n}\n")
+                    f"[FaultInjector] {action} at {site}:{n}\n")
                 sys.stderr.flush()
                 os._exit(FAULT_CRASH_EXIT_CODE)
             if action == "raise":

@@ -1,0 +1,467 @@
+"""Crash-consistent async checkpointing (docs/fault_tolerance.md, "Async
+checkpointing"): atomic commit protocol, bounded-queue coalescing,
+retry-then-degrade, staging invisibility, GC guards, the chaos-campaign
+FaultInjector actions, and the ckpt.async.* observability surface. The
+SIGKILL subprocess matrix lives in tests/test_chaos_checkpoint.py."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.incubate.checkpoint import (
+    AsyncCheckpointConfig, AsyncCheckpointer, STAGING_SUFFIX,
+    CheckpointIntegrityError, TrainEpochRange, cleanup_stale_staging,
+    commit_checkpoint, load_sharded, newest_healthy_checkpoint,
+    read_health_stamp, save_sharded, verify_checkpoint, write_health_stamp)
+from paddle_tpu.incubate.checkpoint import async_ckpt as ac
+from paddle_tpu.utils.resilience import (FaultInjector, FaultInjected,
+                                         _reset_fault_injector_for_tests)
+
+
+@pytest.fixture
+def fault_spec(monkeypatch):
+    """Arm PADDLE_TPU_FAULT_SPEC for this test; always reset the process-
+    wide injector on both entry and exit."""
+    def arm(spec):
+        monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", spec)
+        _reset_fault_injector_for_tests()
+    _reset_fault_injector_for_tests()
+    yield arm
+    _reset_fault_injector_for_tests()
+
+
+def _state(scale=1.0):
+    return {"w": jnp.arange(16.0) * scale, "b": jnp.ones(3), "step": 1}
+
+
+class TestCommitProtocol:
+    def test_commit_roundtrip_and_no_staging_left(self, tmp_path):
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(), p, step=5)
+        verify_checkpoint(p)
+        out = load_sharded(p, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0))
+        assert out["step"] == 1
+        assert not os.path.exists(p + STAGING_SUFFIX)
+
+    def test_health_rides_the_commit(self, tmp_path):
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(), p, healthy=False, step=9, reason="nan")
+        stamp = read_health_stamp(p)
+        assert stamp["healthy"] is False and stamp["reason"] == "nan"
+        # the stamp is ALSO inside the manifest: removing the sidecar (the
+        # old non-atomic artifact) must not lose it
+        os.remove(os.path.join(p, "health.json"))
+        stamp = read_health_stamp(p)
+        assert stamp["healthy"] is False and stamp["reason"] == "nan"
+
+    def test_sidecar_overrides_manifest(self, tmp_path):
+        # retroactive mark-unhealthy (sentinel discovers the divergence
+        # after the commit) must win over the committed manifest health
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(), p, healthy=True)
+        write_health_stamp(p, False, reason="post-hoc divergence")
+        assert read_health_stamp(p)["healthy"] is False
+
+    def test_plain_save_sharded_still_reads_exactly_healthy(self, tmp_path):
+        # format-2 checkpoints have no health anywhere: the shim must return
+        # the exact legacy default (test_sentinel.py relies on it too)
+        p = str(tmp_path / "ck")
+        save_sharded(_state(), p)
+        assert read_health_stamp(p) == {"healthy": True}
+
+    def test_recommit_over_existing_checkpoint(self, tmp_path):
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(1.0), p)
+        commit_checkpoint(_state(2.0), p)
+        out = load_sharded(p, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0) * 2)
+
+    def test_staging_dir_is_invisible_to_readers(self, tmp_path):
+        committed = str(tmp_path / "snap_1")
+        commit_checkpoint(_state(), committed)
+        # a writer died mid-stage: full-looking checkpoint files inside a
+        # *.tmp dir, newer numeric suffix than the committed one
+        staging = str(tmp_path / ("snap_2" + STAGING_SUFFIX))
+        commit_checkpoint(_state(2.0), str(tmp_path / "scratch"))
+        os.rename(str(tmp_path / "scratch"), staging)
+        assert newest_healthy_checkpoint(str(tmp_path)) == committed
+        from paddle_tpu.incubate.checkpoint.sharded import _is_checkpoint_dir
+        assert not _is_checkpoint_dir(staging)
+
+    def test_cleanup_stale_staging(self, tmp_path):
+        keep = str(tmp_path / "snap_1")
+        commit_checkpoint(_state(), keep)
+        stale = str(tmp_path / ("snap_2" + STAGING_SUFFIX))
+        os.makedirs(stale)
+        held = str(tmp_path / ("snap_3" + STAGING_SUFFIX))
+        os.makedirs(held)
+        removed = cleanup_stale_staging(str(tmp_path), held={held})
+        assert removed == [stale]
+        assert os.path.isdir(held) and os.path.isdir(keep)
+
+
+class _BlockingWriter:
+    """Monkeypatch target for async_ckpt._write_staged: parks the writer
+    thread on an Event so queue behaviour is deterministic."""
+
+    def __init__(self, real):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+        self._real = real
+
+    def __call__(self, staging, meta, blobs, scalars, health, fsync=True):
+        self.entered.set()
+        assert self.release.wait(10), "test never released the writer"
+        return self._real(staging, meta, blobs, scalars, health, fsync=fsync)
+
+
+class TestAsyncCheckpointer:
+    def test_async_commit_roundtrip(self, tmp_path):
+        with AsyncCheckpointer() as ck:
+            t = ck.save(_state(), str(tmp_path / "ck"), step=3)
+            assert t.wait(30) and t.committed and t.error is None
+        out = load_sharded(str(tmp_path / "ck"), return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0))
+
+    def test_full_queue_supersedes_oldest(self, tmp_path, monkeypatch):
+        reg = StatRegistry()
+        blocker = _BlockingWriter(ac._write_staged)
+        monkeypatch.setattr(ac, "_write_staged", blocker)
+        ck = AsyncCheckpointer(AsyncCheckpointConfig(queue_depth=2),
+                               registry=reg)
+        tickets = [ck.save(_state(i), str(tmp_path / f"snap_{i}"))
+                   for i in range(1, 2)]
+        assert blocker.entered.wait(10)  # snap_1 is now in flight
+        for i in range(2, 6):  # 4 queued into depth 2 -> 2 superseded
+            tickets.append(ck.save(_state(i), str(tmp_path / f"snap_{i}")))
+        blocker.release.set()
+        ck.close(timeout=30)
+        flags = [(t.committed, t.superseded) for t in tickets]
+        assert flags == [(True, False),   # in-flight when the queue filled
+                         (False, True), (False, True),  # coalesced away
+                         (True, False), (True, False)]
+        assert reg.get("ckpt.async.superseded") == 2
+        assert reg.get("ckpt.async.commits") == 3
+        # superseded snapshots were never published
+        assert not os.path.exists(str(tmp_path / "snap_2"))
+        assert os.path.exists(str(tmp_path / "snap_5"))
+
+    def test_wait_blocks_until_in_flight_commit_lands(self, tmp_path,
+                                                      monkeypatch):
+        # regression: drain/SIGTERM must wait for the in-flight commit, not
+        # just an empty queue
+        blocker = _BlockingWriter(ac._write_staged)
+        monkeypatch.setattr(ac, "_write_staged", blocker)
+        with AsyncCheckpointer() as ck:
+            t = ck.save(_state(), str(tmp_path / "ck"))
+            assert blocker.entered.wait(10)
+            assert ck.wait(timeout=0.2) is False  # still in flight
+            blocker.release.set()
+            assert ck.wait(timeout=30) is True
+            assert t.committed
+        verify_checkpoint(str(tmp_path / "ck"))
+
+    def test_held_paths_cover_pending_and_staging(self, tmp_path,
+                                                  monkeypatch):
+        blocker = _BlockingWriter(ac._write_staged)
+        monkeypatch.setattr(ac, "_write_staged", blocker)
+        ck = AsyncCheckpointer(AsyncCheckpointConfig(queue_depth=2))
+        p1, p2 = str(tmp_path / "snap_1"), str(tmp_path / "snap_2")
+        ck.save(_state(), p1)
+        assert blocker.entered.wait(10)
+        ck.save(_state(), p2)
+        held = ck.held_paths()
+        assert {p1, p1 + STAGING_SUFFIX, p2,
+                p2 + STAGING_SUFFIX} <= held
+        blocker.release.set()
+        ck.close(timeout=30)
+        assert ck.held_paths() == set()
+
+    def test_save_after_close_raises(self, tmp_path):
+        ck = AsyncCheckpointer()
+        ck.close()
+        with pytest.raises(RuntimeError):
+            ck.save(_state(), str(tmp_path / "ck"))
+
+    def test_writer_death_recorded_and_respawned(self, tmp_path,
+                                                 monkeypatch):
+        # the synthetic SystemExit below is the *point* — keep pytest's
+        # thread excepthook from promoting it to a session-level warning
+        monkeypatch.setattr(threading, "excepthook", lambda args: None)
+        reg = StatRegistry()
+        ck = AsyncCheckpointer(registry=reg)
+        boom = {"armed": True}
+        real_process = ck._process
+
+        def exploding(item):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise SystemExit("synthetic writer death")
+            return real_process(item)
+
+        ck._process = exploding
+        t1 = ck.save(_state(), str(tmp_path / "a"))
+        deadline = time.monotonic() + 10
+        while reg.get("ckpt.async.writer_deaths") == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert reg.get("ckpt.async.writer_deaths") == 1
+        # the next save respawns the writer and commits normally
+        t2 = ck.save(_state(), str(tmp_path / "b"))
+        assert t2.wait(30) and t2.committed
+        assert reg.get("ckpt.async.writer_restarts") == 1
+        assert not t1.committed  # the dying writer took t1 with it
+        ck.close(timeout=30)
+
+    def test_observability_surface(self, tmp_path):
+        reg = StatRegistry()
+        with AsyncCheckpointer(registry=reg) as ck:
+            ck.save(_state(), str(tmp_path / "ck")).wait(30)
+        assert reg.get("ckpt.async.saves") == 1
+        assert reg.get("ckpt.async.commits") == 1
+        for hist in ("ckpt.async.enqueue_ms", "ckpt.async.fetch_ms",
+                     "ckpt.async.write_ms", "ckpt.async.commit_ms"):
+            assert reg.histogram(hist)["count"] >= 1
+        # histograms on the DEFAULT registry render into /metricsz
+        from paddle_tpu.core import monitor
+        from paddle_tpu.observability.metrics import render_prometheus
+        with AsyncCheckpointer() as ck:
+            ck.save(_state(), str(tmp_path / "ck2")).wait(30)
+        text = render_prometheus()
+        assert "paddle_tpu_ckpt_async_commits_total" in text
+        assert "paddle_tpu_ckpt_async_write_ms" in text
+
+
+class TestFaultActions:
+    def test_new_actions_parse_and_fire_verbatim(self):
+        fi = FaultInjector("ckpt_shard_write:2:torn_write,"
+                           "ckpt_fetch:1:disk_full,"
+                           "ckpt_pre_rename:1:slow_io,"
+                           "ckpt_post_rename:1:kill_during_commit")
+        assert fi.armed("ckpt_shard_write")
+        assert fi.fire("ckpt_shard_write") is None       # occurrence 1
+        assert fi.fire("ckpt_shard_write") == "torn_write"
+        assert fi.fire("ckpt_pre_rename") == "slow_io"
+        assert fi.fire("ckpt_fetch") == "disk_full"
+        # kill_during_commit is the crash alias — NOT fired here (it would
+        # os._exit the test process); the chaos matrix proves it end to end
+
+    def test_occurrence_counting_is_per_site(self):
+        fi = FaultInjector("ckpt_fetch:3:disk_full")
+        assert fi.fire("ckpt_fetch") is None
+        assert fi.fire("ckpt_shard_write") is None  # different site
+        assert fi.fire("ckpt_fetch") is None
+        assert fi.fire("ckpt_fetch") == "disk_full"
+        assert fi.fire("ckpt_fetch") is None        # one-shot
+
+    def test_disk_full_raises_enospc_at_site(self, tmp_path, fault_spec):
+        import errno
+        fault_spec("ckpt_shard_write:1:disk_full")
+        with pytest.raises(OSError) as ei:
+            commit_checkpoint(_state(), str(tmp_path / "ck"))
+        assert ei.value.errno == errno.ENOSPC
+        # nothing was published
+        assert newest_healthy_checkpoint(str(tmp_path)) is None
+
+    def test_torn_write_is_caught_by_verification(self, tmp_path,
+                                                  fault_spec):
+        fault_spec("ckpt_shard_write:1:torn_write")
+        p = str(tmp_path / "snap_2")
+        commit_checkpoint(_state(), p)  # publishes a torn archive
+        with pytest.raises(CheckpointIntegrityError):
+            verify_checkpoint(p)
+        with pytest.raises(CheckpointIntegrityError):
+            load_sharded(p)
+        # and the healthy-walk falls back past it (disarm via an EMPTY
+        # spec — a bare reset would re-parse the still-set env var and
+        # tear this write too)
+        fault_spec("")
+        good = str(tmp_path / "snap_1")
+        commit_checkpoint(_state(), good)
+        with pytest.warns(UserWarning, match="skipping checkpoint"):
+            assert newest_healthy_checkpoint(str(tmp_path)) == good
+
+    def test_slow_io_stalls_the_commit(self, tmp_path, fault_spec,
+                                       monkeypatch):
+        monkeypatch.setattr(ac, "SLOW_IO_SECONDS", 0.3)
+        fault_spec("ckpt_pre_rename:1:slow_io")
+        t0 = time.perf_counter()
+        commit_checkpoint(_state(), str(tmp_path / "ck"))
+        assert time.perf_counter() - t0 >= 0.3
+
+    def test_async_retries_transient_then_commits(self, tmp_path,
+                                                  fault_spec):
+        reg = StatRegistry()
+        fault_spec("ckpt_shard_write:1:disk_full")
+        cfg = AsyncCheckpointConfig(max_attempts=3, backoff=0.01)
+        with AsyncCheckpointer(cfg, registry=reg) as ck:
+            t = ck.save(_state(), str(tmp_path / "ck"))
+            assert t.wait(30) and t.committed
+        assert reg.get("ckpt.async.retries") == 1
+        assert reg.get("ckpt.async.degraded_skips") == 0
+        verify_checkpoint(str(tmp_path / "ck"))
+
+    def test_async_degrades_to_skip_after_retries(self, tmp_path,
+                                                  fault_spec):
+        reg = StatRegistry()
+        fault_spec("ckpt_shard_write:1:disk_full,"
+                   "ckpt_shard_write:2:disk_full,"
+                   "ckpt_shard_write:3:disk_full")
+        cfg = AsyncCheckpointConfig(max_attempts=3, backoff=0.01)
+        with AsyncCheckpointer(cfg, registry=reg) as ck:
+            with pytest.warns(UserWarning, match="skipped"):
+                t = ck.save(_state(), str(tmp_path / "snap_1"))
+                assert t.wait(30)
+                assert not t.committed and t.error is not None
+                # the step loop lives on: the NEXT save commits fine
+                t2 = ck.save(_state(), str(tmp_path / "snap_2"))
+                assert t2.wait(30) and t2.committed
+        assert reg.get("ckpt.async.degraded_skips") == 1
+        assert reg.get("ckpt.async.retries") == 2
+        assert not os.path.exists(str(tmp_path / "snap_1"))
+        assert not os.path.exists(str(tmp_path / "snap_1") + STAGING_SUFFIX)
+        verify_checkpoint(str(tmp_path / "snap_2"))
+
+
+class TestIntegration:
+    def test_train_epoch_range_async(self, tmp_path):
+        # same state whether saved sync or async+atomic
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as optim
+
+        def make():
+            paddle.seed(11)
+            net = nn.Linear(4, 2)
+            opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+            return net, opt
+
+        def epoch_step(net, opt):
+            x = paddle.ones((2, 4))
+            loss = paddle.mean(net(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+
+        net1, opt1 = make()
+        r1 = TrainEpochRange(4, "async_job", model=net1, optimizer=opt1,
+                             checkpoint_path=str(tmp_path / "a"),
+                             async_save=True)
+        for _ in r1:
+            epoch_step(net1, opt1)
+        r1.wait()
+
+        # resume run restores bit-identical params from the async commits
+        net2, opt2 = make()
+        r2 = TrainEpochRange(4, "async_job", model=net2, optimizer=opt2,
+                             checkpoint_path=str(tmp_path / "a"))
+        assert r2.restored_epoch == 3
+        np.testing.assert_array_equal(net1.weight.numpy(),
+                                      net2.weight.numpy())
+
+    def test_epoch_gc_skips_writer_held_paths(self, tmp_path):
+        r = TrainEpochRange(10, "gc_job",
+                            checkpoint_path=str(tmp_path / "g"))
+        held_dir = r._epoch_dir(1)
+        os.makedirs(held_dir)
+        os.makedirs(r._epoch_dir(2))
+
+        class FakeSaver:
+            def held_paths(self):
+                return {held_dir}
+        r._saver = FakeSaver()
+        r._keep_last = 1
+        r._gc(9)  # would normally sweep both epoch_1 and epoch_2
+        assert os.path.isdir(held_dir)          # writer-held: protected
+        assert not os.path.isdir(r._epoch_dir(2))
+
+    def test_rollback_atomic_snapshot_closes_stamp_window(self, tmp_path,
+                                                          fault_spec):
+        # kill between rename and (the former) stamp write: with the stamp
+        # folded into the commit there is no such window — prove the stamp
+        # is present the instant the snapshot dir exists
+        from paddle_tpu.sentinel.rollback import CheckpointRollback
+
+        class Store:
+            def __init__(self):
+                self.w = jnp.arange(4.0)
+
+            def state_dict(self):
+                return {"w": self.w}
+
+            def set_state_dict(self, s):
+                self.w = s["w"]
+
+        st = Store()
+        rb = CheckpointRollback(str(tmp_path / "snaps"), model=st,
+                                keep_last=2)
+        d = rb.snapshot(1, healthy=False, reason="spike")
+        assert os.path.isdir(d)
+        assert read_health_stamp(d)["healthy"] is False
+        assert json.load(open(os.path.join(
+            d, "metadata_0.json")))["health"]["reason"] == "spike"
+
+    def test_rollback_async_snapshots_restore(self, tmp_path):
+        from paddle_tpu.sentinel.rollback import CheckpointRollback
+
+        class Store:
+            def __init__(self):
+                self.w = jnp.zeros(4)
+
+            def state_dict(self):
+                return {"w": self.w}
+
+            def set_state_dict(self, s):
+                self.w = s["w"]
+
+        st = Store()
+        rb = CheckpointRollback(str(tmp_path / "snaps"), model=st,
+                                keep_last=2, async_save=True)
+        for step in (1, 2):
+            st.w = jnp.full((4,), float(step))
+            rb.snapshot(step)
+        st.w = jnp.full((4,), 99.0)  # diverged state
+        # restore waits for the queued async snapshots first
+        assert rb.restore_newest_healthy() == 2
+        np.testing.assert_allclose(np.asarray(st.w._data), np.full(4, 2.0))
+
+    def test_fault_tolerance_callback_async_save(self, tmp_path):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi.callbacks import FaultToleranceCallback
+
+        class FakeModel:
+            def __init__(self):
+                paddle.seed(3)
+                self.network = nn.Linear(4, 2)
+                self._optimizer = None
+
+        cb = FaultToleranceCallback(str(tmp_path / "ft"), guard=object(),
+                                    async_save=True)
+        cb._guard = None  # let on_train_begin build a real guard
+        cb.set_model(FakeModel())
+        cb.on_train_begin()
+        cb.on_epoch_end(0)
+        cb.on_train_end()
+        state = load_sharded(str(tmp_path / "ft" / "latest"))
+        np.testing.assert_array_equal(
+            state["model"]["weight"].numpy(), cb.model.network.weight.numpy())
+        cb._guard.uninstall()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(240)
+def test_async_hides_most_of_sync_overhead():
+    """ISSUE 10 acceptance bar: the async path hides >= 80% of the
+    synchronous checkpoint wall time from the train step (reduced scales
+    of the tools/bench_ckpt.py sweep; the CLI gate is --bench-ckpt)."""
+    from tools.bench_ckpt import run_bench
+    out = run_bench(scales=(1 << 18, 1 << 20), steps=10, save_every=2,
+                    step_ms=40.0)
+    assert out["hidden_fraction_overall"] >= 0.8, out

@@ -45,10 +45,27 @@ HOT_PREFIXES = (
     # the telemetry layer sits INSIDE every hot path above (span enter/
     # exit runs per step / per tick) — a host sync here taxes everything
     "paddle_tpu/observability/",
+    # the async checkpointer's save() runs on the step path by design —
+    # its whole value is that the fetch and the file I/O happen elsewhere.
+    # Besides the device-fetch checks, this file gets the blocking-I/O
+    # sub-check below; writer-thread internals carry noqa justifications.
+    "paddle_tpu/incubate/checkpoint/async_ckpt.py",
 )
 
 SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}
 NP_MATERIALIZERS = {"asarray", "array", "ascontiguousarray", "copy"}
+
+#: files where *blocking file I/O* is itself a hot-path finding (the async
+#: checkpointer promises an I/O-free step path); dotted call -> why
+BLOCKING_IO_FILES = ("paddle_tpu/incubate/checkpoint/async_ckpt.py",)
+BLOCKING_IO_CALLS = {
+    ("os", "replace"), ("os", "fsync"), ("os", "makedirs"),
+    ("os", "remove"), ("os", "rename"), ("os", "open"),
+    ("shutil", "rmtree"),
+    ("np", "savez"), ("numpy", "savez"),
+    ("np", "savez_compressed"), ("numpy", "savez_compressed"),
+    ("time", "sleep"),
+}
 
 
 def _is_static_literal(node: ast.AST) -> bool:
@@ -69,11 +86,28 @@ class HostSyncRule(Rule):
     def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
         if not sf.relpath.startswith(HOT_PREFIXES):
             return []
+        check_io = sf.relpath in BLOCKING_IO_FILES
         findings: List[Finding] = []
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
+            if check_io:
+                io_name = None
+                if isinstance(f, ast.Name) and f.id == "open":
+                    io_name = "open"
+                elif (isinstance(f, ast.Attribute)
+                        and (dotted_name(f.value), f.attr)
+                        in BLOCKING_IO_CALLS):
+                    io_name = f"{dotted_name(f.value)}.{f.attr}"
+                if io_name is not None:
+                    findings.append(sf.finding(
+                        self.code, node,
+                        f"{io_name}() is blocking I/O in the async "
+                        f"checkpointer — the step-path save() must stay "
+                        f"I/O-free; writer-thread calls need "
+                        f"`# noqa: PTA002 -- reason`"))
+                    continue
             if isinstance(f, ast.Attribute):
                 if f.attr == "block_until_ready":
                     findings.append(sf.finding(

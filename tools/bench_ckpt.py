@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Sync vs async checkpoint step-time overhead, printed as one JSON doc.
+
+    python -m tools.bench_ckpt                 # 3 param scales
+    python -m tools.bench_ckpt --check         # CI gate (>=80% hidden)
+
+Each scale runs the same synthetic train loop three ways: ``none`` (no
+checkpointing — the baseline), ``sync``
+(:func:`~paddle_tpu.incubate.checkpoint.commit_checkpoint` every
+``--save-every`` steps, blocking the loop) and ``async``
+(:class:`~paddle_tpu.incubate.checkpoint.AsyncCheckpointer`, the writer
+thread overlapping the loop). Every step simulates ``--step-ms`` of
+accelerator time with a GIL-released sleep (same trick as
+tools/bench_router.py) — that is the window a real TPU step gives the
+host, and it is what the async writer hides its I/O under.
+
+Per-save overhead is ``(loop_time(mode) - loop_time(none)) / n_saves``,
+measured over the steps loop only; the async mode's end-of-job drain is
+reported separately (``drain_ms``) because it happens once at exit, not
+on the step path. The headline number,
+
+    hidden_fraction = 1 - async_overhead / sync_overhead
+
+aggregated over all scales weighted by sync overhead, is the tentpole
+claim of docs/fault_tolerance.md "Async checkpointing": the async path
+must hide >= 80% of the synchronous checkpoint wall time from the train
+step. ``--check`` turns that into an exit code for
+``tools/run_tests.py --bench-ckpt``; the slow-lane budget test
+(tests/test_async_checkpoint.py) asserts the same bar in-process.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+DEFAULT_SCALES = (1 << 18, 1 << 20, 1 << 22)  # floats: 1 MiB, 4 MiB, 16 MiB
+
+
+def _make_step(n_params: int, step_ms: float):
+    """A jitted parameter update + ``step_ms`` of simulated device time."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def update(w):
+        return w * 0.999 + 0.001
+
+    w0 = jnp.ones((n_params,), jnp.float32)
+
+    def step(w):
+        w = update(w)
+        w.block_until_ready()
+        if step_ms:
+            time.sleep(step_ms / 1000.0)  # GIL released: the writer overlaps
+        return w
+
+    return step, w0
+
+
+def _run_mode(mode: str, n_params: int, steps: int, save_every: int,
+              step_ms: float, root: str):
+    """One timed loop; returns (loop_seconds, drain_seconds, n_saves,
+    superseded)."""
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.incubate.checkpoint import (AsyncCheckpointer,
+                                                commit_checkpoint)
+    step, w = _make_step(n_params, step_ms)
+    step(w)  # compile outside the timed region
+    reg = StatRegistry()
+    ckpt = AsyncCheckpointer(registry=reg) if mode == "async" else None
+    n_saves = 0
+    t0 = time.perf_counter()
+    for i in range(steps):
+        w = step(w)
+        if mode != "none" and (i + 1) % save_every == 0:
+            path = os.path.join(root, f"{mode}_{n_params}_{i}")
+            if ckpt is not None:
+                ckpt.save({"w": w}, path, step=i)
+            else:
+                commit_checkpoint({"w": w}, path, step=i)
+            n_saves += 1
+    loop_s = time.perf_counter() - t0
+    drain_s = 0.0
+    superseded = 0
+    if ckpt is not None:
+        t1 = time.perf_counter()
+        ckpt.wait()
+        drain_s = time.perf_counter() - t1
+        superseded = int(reg.get("ckpt.async.superseded", 0))
+        ckpt.close()
+    return loop_s, drain_s, n_saves, superseded
+
+
+def run_bench(scales=DEFAULT_SCALES, steps: int = 12, save_every: int = 2,
+              step_ms: float = 40.0, root=None) -> dict:
+    """Run the full sweep; returns the JSON-ready result dict."""
+    own_root = root is None
+    root = root or tempfile.mkdtemp(prefix="bench_ckpt_")
+    results = []
+    try:
+        for n in scales:
+            per_mode = {}
+            for mode in ("none", "sync", "async"):
+                loop_s, drain_s, n_saves, superseded = _run_mode(
+                    mode, n, steps, save_every, step_ms, root)
+                per_mode[mode] = {"loop_s": loop_s, "drain_s": drain_s,
+                                  "n_saves": n_saves,
+                                  "superseded": superseded}
+            n_saves = per_mode["sync"]["n_saves"]
+            sync_ovh = max(
+                0.0, per_mode["sync"]["loop_s"] - per_mode["none"]["loop_s"])
+            async_ovh = max(
+                0.0, per_mode["async"]["loop_s"] - per_mode["none"]["loop_s"])
+            hidden = (1.0 - async_ovh / sync_ovh) if sync_ovh > 0 else 1.0
+            results.append({
+                "n_params": n,
+                "mib": round(n * 4 / (1 << 20), 2),
+                "baseline_loop_s": round(per_mode["none"]["loop_s"], 4),
+                "sync_overhead_ms_per_save":
+                    round(sync_ovh / n_saves * 1e3, 3),
+                "async_overhead_ms_per_save":
+                    round(async_ovh / n_saves * 1e3, 3),
+                "async_drain_ms":
+                    round(per_mode["async"]["drain_s"] * 1e3, 3),
+                "superseded": per_mode["async"]["superseded"],
+                "hidden_fraction": round(hidden, 4),
+                "_sync_overhead_s": sync_ovh,
+                "_async_overhead_s": async_ovh,
+            })
+    finally:
+        if own_root:
+            shutil.rmtree(root, ignore_errors=True)
+    total_sync = sum(r["_sync_overhead_s"] for r in results)
+    total_async = sum(r["_async_overhead_s"] for r in results)
+    overall = (1.0 - total_async / total_sync) if total_sync > 0 else 1.0
+    for r in results:
+        r.pop("_sync_overhead_s")
+        r.pop("_async_overhead_s")
+    return {
+        "bench": "ckpt",
+        "steps": steps,
+        "save_every": save_every,
+        "step_ms": step_ms,
+        "scales": results,
+        "hidden_fraction_overall": round(overall, 4),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scales", default=",".join(
+        str(s) for s in DEFAULT_SCALES),
+        help="comma-separated param counts (default %(default)s)")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--step-ms", type=float, default=40.0,
+                    help="simulated device time per step (GIL-released)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless the async path hides >= --threshold "
+                         "of the sync checkpoint overhead")
+    ap.add_argument("--threshold", type=float, default=0.8)
+    args = ap.parse_args(argv)
+    scales = tuple(int(s) for s in args.scales.split(",") if s)
+
+    out = run_bench(scales, args.steps, args.save_every, args.step_ms)
+    print(json.dumps(out, indent=2))
+    if args.check:
+        got = out["hidden_fraction_overall"]
+        if got < args.threshold:
+            print(f"FAIL: async hides {got:.1%} of sync checkpoint "
+                  f"overhead, need >= {args.threshold:.0%}",
+                  file=sys.stderr)
+            return 1
+        print(f"OK: async hides {got:.1%} (>= {args.threshold:.0%})",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,10 @@ def main():
                     help="opt-in gate: run tools/bench_router.py "
                          "--check-recompiles and fail if any replica "
                          "engine recompiled after warmup")
+    ap.add_argument("--bench-ckpt", action="store_true",
+                    help="opt-in gate: run tools/bench_ckpt.py --check and "
+                         "fail unless the async checkpointer hides >=80%% "
+                         "of the sync checkpoint step-time overhead")
     args = ap.parse_args()
 
     if not args.no_analyze:
@@ -111,6 +115,19 @@ def main():
              "--requests", "192", "--check-recompiles"],
             cwd=REPO, env=env)
         print(f"bench router: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.bench_ckpt:
+        # Opt-in: sync-vs-async checkpoint overhead sweep on the CPU
+        # backend, gated on the >=80%-hidden acceptance bar (absolute I/O
+        # times are machine-dependent; the *ratio* is the invariant).
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_ckpt", "--check"],
+            cwd=REPO, env=env)
+        print(f"bench ckpt: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
