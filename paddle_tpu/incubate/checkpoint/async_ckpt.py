@@ -17,13 +17,34 @@ step path:
    wait; when full, the *oldest unwritten* snapshot is superseded by the
    newer one (its ticket reports ``superseded``) instead of ever
    blocking the trainer.
-3. **Two-phase atomic commit** — shards, the sha256 manifest (with the
-   health stamp folded in — no stamp-after-rename window) and sidecars
-   land in a ``<path>.tmp`` staging dir, every file and the dir are
-   fsynced, then one ``os.replace`` publishes the checkpoint. Readers
-   (``load_sharded``, newest-healthy walks, elastic resume, replica
-   resurrection) can never observe a torn checkpoint: it either does
-   not exist yet or is complete.
+3. **Two-phase atomic commit** (single-process jobs) — shards, the
+   sha256 manifest (with the health stamp folded in — no
+   stamp-after-rename window) and sidecars land in a ``<path>.tmp``
+   staging dir, every file and the dir are fsynced, then one
+   ``os.replace`` publishes the checkpoint. Re-saves over an existing
+   path first *park* the previous commit as ``<path>.old`` (another
+   ``os.replace``), publish, then remove the parked dir — at every
+   instant at least one complete checkpoint exists, and a crash inside
+   the swap is recovered at startup (``cleanup_stale_staging`` renames
+   the parked dir back). Readers (``load_sharded``, newest-healthy
+   walks, elastic resume, replica resurrection) can never observe a
+   torn checkpoint: it either does not exist yet or is complete.
+
+**Multi-host jobs** (``jax.process_count() > 1``) cannot use the
+dir-level swap: on a shared filesystem each host owns only its
+``shards_<proc>.npz``/``metadata_<proc>.json`` pair, and any host
+renaming or deleting the shared directory would destroy its peers'
+files. There the commit degrades to the *cooperative* protocol
+``save_sharded`` already uses — per-host file-level tmp+``os.replace``
+into the final dir, manifest (with inline health doc) strictly after
+its shard archive — so a torn write is *detectable* (checksums +
+missing-file checks in ``verify_checkpoint``, run by every restore
+walk) rather than invisible. Same "no torn read ever surfaces"
+contract, host-local decisions only: the async writer thread never
+crosses a host barrier (coalescing is timing-dependent per host, so a
+barrier could pair different snapshots across hosts and deadlock);
+the synchronous ``commit_checkpoint``, which *is* called collectively,
+does barrier so its return means the checkpoint is complete.
 
 I/O failures retry on the writer thread with the existing backoff
 substrate (:func:`~paddle_tpu.utils.resilience.retry_call`) and then
@@ -32,8 +53,9 @@ of killing the step loop — a full disk makes you lose a snapshot, not
 the job.
 
 Fault sites (chaos campaign, docs/fault_tolerance.md): ``ckpt_fetch``,
-``ckpt_shard_write``, ``ckpt_pre_rename``, ``ckpt_post_rename`` fire at
-the matching pipeline stage; actions ``kill_during_commit`` (hard exit),
+``ckpt_shard_write``, ``ckpt_pre_rename``, ``ckpt_swap_window`` (previous
+checkpoint parked, new one not yet published), ``ckpt_post_rename`` fire
+at the matching pipeline stage; actions ``kill_during_commit`` (hard exit),
 ``torn_write`` (truncate the staged archive after checksumming),
 ``disk_full`` (raise ENOSPC), ``slow_io`` (stall the writer) are
 interpreted here.
@@ -59,8 +81,8 @@ from ...core import monitor as _monitor
 from ...observability import flight as _flight
 from ...observability import tracer as _otrace
 from ...utils.resilience import RetryError, fault_injector, retry_call
-from .sharded import (HEALTH_STAMP_FILE, STAGING_SUFFIX, _flatten,
-                      _sha256_of, _slices_of, _spec_of)
+from .sharded import (HEALTH_STAMP_FILE, OLD_SUFFIX, STAGING_SUFFIX,
+                      _flatten, _sha256_of, _slices_of, _spec_of)
 
 #: injected ``slow_io`` stall per fire (seconds); env-tunable so chaos
 #: tests can widen the commit window enough to land a real SIGKILL in it.
@@ -179,9 +201,12 @@ def _write_staged(staging: str, meta, blobs, scalars, health,
            "checksums": {shards_name: digest},
            "health": dict(health),
            "entries": meta}
-    for name, payload in ((f"metadata_{proc}.json", doc),
-                          (HEALTH_STAMP_FILE, dict(health)),
-                          ("scalars.json", scalars)):
+    sidecars = [(f"metadata_{proc}.json", doc)]
+    if proc == 0:
+        # shared (not per-host) files: one writer, matching save_sharded
+        sidecars += [(HEALTH_STAMP_FILE, dict(health)),
+                     ("scalars.json", scalars)]
+    for name, payload in sidecars:
         p = os.path.join(staging, name)
         with open(p, "w") as f:  # noqa: PTA002 -- manifest/sidecar write, writer thread only
             json.dump(payload, f)
@@ -192,19 +217,108 @@ def _write_staged(staging: str, meta, blobs, scalars, health,
 
 
 def _publish(staging: str, final: str):
-    """Phase 2: the single atomic publish. A crash strictly before the
-    ``os.replace`` leaves only a ``*.tmp`` dir every reader skips; a
-    crash strictly after leaves a complete committed checkpoint."""
+    """Phase 2: the atomic publish. A crash strictly before the final
+    ``os.replace`` leaves only ``*.tmp``/``*.old`` dirs every reader
+    skips (and the startup sweep recovers); a crash strictly after
+    leaves a complete committed checkpoint.
+
+    Re-saves over an existing path must never enter a state with zero
+    restorable checkpoints (FaultToleranceCallback re-saves "latest" in
+    place, so there may be no older sibling to fall back to): the
+    previous commit is *parked* atomically as ``final + ".old"`` —
+    ``os.replace`` cannot swap non-empty dirs in one shot — then the new
+    dir is renamed in, then the parked dir is removed. A crash inside
+    that window leaves the parked dir, which ``cleanup_stale_staging``
+    renames back into place on restart."""
     _fire("ckpt_pre_rename")
+    old = final + OLD_SUFFIX
+    if os.path.isdir(old):
+        # debris from a previous crashed swap whose final was republished
+        shutil.rmtree(old)  # noqa: PTA002 -- stale parked-dir removal, writer thread only
     if os.path.isdir(final):
-        # re-saving over an existing checkpoint: drop the stale one first
-        # (os.replace cannot atomically swap non-empty dirs). The window
-        # where neither exists degrades readers to an OLDER committed
-        # checkpoint — safe, never torn.
-        shutil.rmtree(final)  # noqa: PTA002 -- stale-target removal, writer thread only
+        os.replace(final, old)  # noqa: PTA002 -- atomic old-checkpoint parking, writer thread only
+        _fire("ckpt_swap_window")
     os.replace(staging, final)  # noqa: PTA002 -- the atomic publish, writer thread only
     _fsync_dir(os.path.dirname(os.path.abspath(final)))
+    shutil.rmtree(old, ignore_errors=True)  # noqa: PTA002 -- parked-dir removal post-publish, writer thread only
     _fire("ckpt_post_rename")
+
+
+def _write_cooperative(final: str, meta, blobs, scalars, health,
+                       fsync: bool = True):
+    """Multi-host commit: per-host *file-level* atomicity into the shared
+    ``final`` dir, never touching peers' files.
+
+    Directory-level swap atomicity is impossible here without cross-host
+    coordination — any host rmtree'ing or renaming the shared dir would
+    destroy its peers' in-progress or already-published shards (each
+    host owns only ``shards_<proc>.npz`` + ``metadata_<proc>.json``).
+    So this keeps ``save_sharded``'s cooperative protocol: shard archive
+    first, manifest (checksums + inline health doc) strictly after via
+    tmp+``os.replace``, shared sidecars from process 0 only. A crash
+    leaves either no manifest (``verify_checkpoint``: torn save) or a
+    manifest whose checksums expose any half-written archive — readers
+    verify before trusting, so a torn state is detected and skipped
+    rather than invisible."""
+    import jax
+    proc = jax.process_index()
+    os.makedirs(final, exist_ok=True)  # noqa: PTA002 -- cooperative commit, writer thread only
+    shards_name = f"shards_{proc}.npz"
+    shards_path = os.path.join(final, shards_name)
+    tmp = os.path.join(final, f".tmp_{shards_name}")
+    with open(tmp, "wb") as f:  # noqa: PTA002 -- shard archive write, writer thread only
+        np.savez(f, **blobs)  # noqa: PTA002 -- shard archive write, writer thread only
+    if fsync:
+        _fsync_file(tmp)
+    os.replace(tmp, shards_path)  # noqa: PTA002 -- per-file atomic publish, writer thread only
+    digest = _sha256_of(shards_path)
+    _fire("ckpt_shard_write", shards_path)
+    doc = {"format": 3,
+           "checksums": {shards_name: digest},
+           "health": dict(health),
+           "entries": meta}
+    extras = []
+    if proc == 0:
+        extras += [(HEALTH_STAMP_FILE, dict(health)),
+                   ("scalars.json", scalars)]
+    # the manifest lands LAST: its presence is this host's commit marker
+    extras.append((f"metadata_{proc}.json", doc))
+    for name, payload in extras:
+        if name == f"metadata_{proc}.json":
+            _fire("ckpt_pre_rename")
+        p = os.path.join(final, name)
+        tmp = os.path.join(final, ".tmp_" + name)
+        with open(tmp, "w") as f:  # noqa: PTA002 -- manifest/sidecar write, writer thread only
+            json.dump(payload, f)
+        if fsync:
+            _fsync_file(tmp)
+        os.replace(tmp, p)  # noqa: PTA002 -- per-file atomic publish, writer thread only
+    if fsync:
+        _fsync_dir(final)
+    _fire("ckpt_post_rename")
+
+
+def _barrier():
+    """All hosts reached this point (no-op in a single-process job). Only
+    the *collectively called* sync commit path may use this — the async
+    writer thread must stay barrier-free (host-local coalescing makes
+    its schedule nondeterministic across hosts)."""
+    from ...distributed.collective import barrier
+    barrier()
+
+
+def _commit_files(path: str, meta, blobs, scalars, health,
+                  fsync: bool = True):
+    """Land one materialized snapshot at ``path``: atomic dir swap when
+    this process owns the whole checkpoint, cooperative per-host files
+    when peers share the directory."""
+    import jax
+    if jax.process_count() > 1:
+        _write_cooperative(path, meta, blobs, scalars, health, fsync=fsync)
+    else:
+        staging = path + STAGING_SUFFIX
+        _write_staged(staging, meta, blobs, scalars, health, fsync=fsync)
+        _publish(staging, path)
 
 
 def commit_checkpoint(state, path: str, *, healthy: bool = True,
@@ -214,16 +328,26 @@ def commit_checkpoint(state, path: str, *, healthy: bool = True,
     """Synchronous crash-consistent checkpoint commit.
 
     Same layout as :func:`~paddle_tpu.incubate.checkpoint.save_sharded`
-    but published atomically: stage → fsync → one ``os.replace``. The
-    health stamp rides inside the same commit (manifest ``health`` key +
-    the ``health.json`` sidecar staged pre-rename), closing the
-    stamp-after-rename window the sidecar-only protocol had. Partial
-    writes are invisible by construction.
+    but published atomically in single-process jobs: stage → fsync → one
+    ``os.replace`` (a re-save parks the previous commit as ``*.old``
+    first, so there is never a zero-checkpoint instant). The health
+    stamp rides inside the same commit (manifest ``health`` key + the
+    ``health.json`` sidecar staged pre-rename), closing the
+    stamp-after-rename window the sidecar-only protocol had.
+
+    Multi-host jobs keep ``save_sharded``'s cooperative per-host-file
+    protocol (see :func:`_write_cooperative` — a dir swap would destroy
+    peer hosts' shards), with the health doc still inside the manifest;
+    like ``save_sharded`` this is safe to call from every process, and a
+    trailing barrier makes the return mean "checkpoint complete on all
+    hosts".
 
     This is the cold-path entry (sentinel rollback snapshots, tests);
     the train loop uses :class:`AsyncCheckpointer`, whose writer thread
-    lands in the same staging/publish code.
+    lands in the same commit code (minus the barrier — writer schedules
+    are host-local).
     """
+    import jax
     with _otrace.span("checkpoint/commit", {"path": path}):
         from ...core.tensor import Tensor
         flat = {k: (v._data if isinstance(v, Tensor) else v)
@@ -231,33 +355,54 @@ def commit_checkpoint(state, path: str, *, healthy: bool = True,
         _fire("ckpt_fetch")
         meta, blobs, scalars = _materialize(flat)
         health = _health_doc(healthy, step, reason)
-        staging = path + STAGING_SUFFIX
-        _write_staged(staging, meta, blobs, scalars, health, fsync=fsync)
-        _publish(staging, path)
+        _commit_files(path, meta, blobs, scalars, health, fsync=fsync)
+        if jax.process_count() > 1:
+            _barrier()
     return path
 
 
 def cleanup_stale_staging(root: str,
                           held: Optional[Set[str]] = None) -> List[str]:
-    """Remove orphaned ``*.tmp`` staging dirs under ``root`` — debris from
-    a writer killed mid-stage in a previous run. ``held`` protects paths a
+    """Sweep swap debris under ``root`` from a writer killed mid-commit in
+    a previous run: orphaned ``*.tmp`` staging dirs are removed (by
+    definition uncommitted), and a parked ``*.old`` dir is *recovered* —
+    renamed back into place when the crash landed inside the swap window
+    (final missing: the parked dir is the only complete checkpoint left),
+    removed when the final was republished. ``held`` protects paths a
     live writer still owns. Returns the removed paths. Startup-only by
     contract (checkpoint GC must never race an in-flight stage)."""
     removed: List[str] = []
+    recovered = 0
     try:
         names = os.listdir(root)
     except OSError:
         return removed
     for name in names:
         full = os.path.join(root, name)
-        if not name.endswith(STAGING_SUFFIX) or not os.path.isdir(full):
+        if not os.path.isdir(full):
             continue
-        if held and full in held:
-            continue
-        shutil.rmtree(full, ignore_errors=True)  # noqa: PTA002 -- startup-only orphan sweep, never on the step path
-        removed.append(full)
+        if name.endswith(STAGING_SUFFIX):
+            if held and full in held:
+                continue
+            shutil.rmtree(full, ignore_errors=True)  # noqa: PTA002 -- startup-only orphan sweep, never on the step path
+            removed.append(full)
+        elif name.endswith(OLD_SUFFIX):
+            final = full[:-len(OLD_SUFFIX)]
+            if held and (full in held or final in held):
+                continue
+            if os.path.isdir(final):
+                # the new checkpoint made it: the parked dir is just debris
+                shutil.rmtree(full, ignore_errors=True)  # noqa: PTA002 -- startup-only orphan sweep, never on the step path
+                removed.append(full)
+            else:
+                # crash between parking the old commit and publishing the
+                # new one — un-park it so the path stays restorable
+                os.replace(full, final)  # noqa: PTA002 -- startup-only swap recovery, never on the step path
+                recovered += 1
     if removed:
         _monitor.stat_add("ckpt.async.stale_staging_cleaned", len(removed))
+    if recovered:
+        _monitor.stat_add("ckpt.async.parked_old_recovered", recovered)
     return removed
 
 
@@ -288,6 +433,10 @@ class SaveTicket:
 
     def _finish(self, *, committed: bool = False, superseded: bool = False,
                 error: Optional[BaseException] = None):
+        if self._done.is_set():
+            # terminal states are write-once: a late failure (e.g. in an
+            # on_commit callback) must not un-commit a published ticket
+            return
         self.committed = committed
         self.superseded = superseded
         self.error = error
@@ -455,6 +604,7 @@ class AsyncCheckpointer:
         for it in items:
             out.add(it.path)
             out.add(it.path + STAGING_SUFFIX)
+            out.add(it.path + OLD_SUFFIX)  # transient during a re-save swap
         return out
 
     def __enter__(self):
@@ -523,8 +673,6 @@ class AsyncCheckpointer:
                 reg.observe("ckpt.async.write_ms", (t2 - t1) * 1e3)
             reg.add("ckpt.async.commits", 1)
             item.ticket._finish(committed=True)
-            if item.on_commit is not None:
-                item.on_commit()
         except RetryError as e:
             shutil.rmtree(staging, ignore_errors=True)  # noqa: PTA002 -- degraded-path cleanup, writer thread only
             if not self._config.degrade_on_failure:
@@ -540,6 +688,7 @@ class AsyncCheckpointer:
                 f"({e.__cause__!r}); training continues on the previous "
                 f"committed checkpoint")
             item.ticket._finish(error=e)
+            return
         except Exception as e:
             # non-I/O failure (a leaf that can't serialize, a bug): the
             # snapshot is lost but the writer and the train loop live on
@@ -549,11 +698,24 @@ class AsyncCheckpointer:
                                  {"path": item.path, "error": repr(e)})
             warnings.warn(f"async checkpoint to {item.path} failed: {e!r}")
             item.ticket._finish(error=e)
+            return
+        # the checkpoint is durably published at this point: a failing
+        # post-commit callback gets its own accounting and must neither
+        # look like a failed checkpoint nor disturb the committed ticket
+        if item.on_commit is not None:
+            try:
+                item.on_commit()
+            except Exception as e:
+                reg.add("ckpt.async.on_commit_errors", 1)
+                _flight.record_event("ckpt_on_commit_error",
+                                     {"path": item.path, "error": repr(e)})
+                warnings.warn(
+                    f"on_commit callback for committed checkpoint "
+                    f"{item.path} failed: {e!r}")
 
     def _stage_and_publish(self, item: _Pending, meta, blobs, scalars):
         t0 = time.perf_counter()
-        _write_staged(item.path + STAGING_SUFFIX, meta, blobs, scalars,
-                      item.health, fsync=self._config.fsync)
-        _publish(item.path + STAGING_SUFFIX, item.path)
+        _commit_files(item.path, meta, blobs, scalars, item.health,
+                      fsync=self._config.fsync)
         self._registry.observe("ckpt.async.commit_ms",
                                (time.perf_counter() - t0) * 1e3)

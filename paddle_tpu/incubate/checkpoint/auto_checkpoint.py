@@ -25,8 +25,9 @@ import json
 import os
 import shutil
 import sys
+import threading
 import warnings
-from typing import Optional
+from typing import Dict, Optional
 
 from .sharded import (save_sharded, load_sharded,
                       CheckpointIntegrityError, read_health_stamp,
@@ -85,6 +86,10 @@ class TrainEpochRange:
         # async_save routes through the crash-consistent AsyncCheckpointer
         # (async_ckpt.py): overlapped fetch+write, atomic os.replace commit
         self._saver = AsyncCheckpointer() if async_save else None
+        # mark_unhealthy verdicts for epochs whose async save is still
+        # queued/in-flight; applied by _commit once the snapshot publishes
+        self._unhealthy_lock = threading.Lock()
+        self._pending_unhealthy: Dict[int, Optional[str]] = {}
         from ...distributed.elastic import maybe_auto_guard
         self._guard = maybe_auto_guard(preemption_guard)
         self.restored_epoch = -1
@@ -178,12 +183,32 @@ class TrainEpochRange:
     def mark_unhealthy(self, epoch: int, reason: Optional[str] = None):
         """Health-stamp an already-saved epoch as numerically bad (the
         sentinel detected the divergence only after the save); a restore
-        will then skip it even though its checksums are intact."""
+        will then skip it even though its checksums are intact. With
+        ``async_save`` the epoch's snapshot may still be queued — the
+        verdict is recorded and applied when the snapshot publishes."""
         ckpt = self._epoch_dir(epoch)
+        if self._saver is not None:
+            with self._unhealthy_lock:
+                self._pending_unhealthy[epoch] = reason
         if os.path.isdir(ckpt):
             write_health_stamp(ckpt, False, step=epoch, reason=reason)
+            if (self._saver is not None
+                    and ckpt not in self._saver.held_paths()):
+                # applied to the committed dir with nothing in flight that
+                # could republish it — don't poison a future same-epoch save
+                with self._unhealthy_lock:
+                    self._pending_unhealthy.pop(epoch, None)
 
     def _commit(self, epoch: int):
+        # a mark_unhealthy verdict that raced this epoch's in-flight save:
+        # the snapshot just published with its save-time healthy stamp,
+        # which the sentinel has since overruled
+        with self._unhealthy_lock:
+            pending = epoch in self._pending_unhealthy
+            reason = self._pending_unhealthy.pop(epoch, None)
+        if pending:
+            write_health_stamp(self._epoch_dir(epoch), False, step=epoch,
+                               reason=reason)
         # status.json is written only after the shard files exist, so a
         # crash mid-save leaves the previous checkpoint referenced; the
         # write itself is tmp+replace so a crash mid-write can't leave

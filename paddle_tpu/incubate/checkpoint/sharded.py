@@ -48,6 +48,17 @@ from ...utils.resilience import fault_injector
 #: ``*.tmp`` paths as invisible: they are by definition uncommitted.
 STAGING_SUFFIX = ".tmp"
 
+#: Suffix of the directory the publish step *parks the previous committed
+#: checkpoint under* while swapping in a new one for the same path
+#: (``os.replace(final, final + ".old")`` → ``os.replace(staging, final)``
+#: → rmtree the parked dir). Readers must skip ``*.old`` dirs: during the
+#: swap they coexist with (or briefly replace) the final path, and a
+#: crash inside the swap window is recovered at startup by
+#: ``cleanup_stale_staging`` renaming the parked dir back into place —
+#: so a re-save over an existing checkpoint can never leave zero
+#: restorable checkpoints.
+OLD_SUFFIX = ".old"
+
 
 class CheckpointIntegrityError(RuntimeError):
     """A checkpoint directory is torn: checksum mismatch or a shard archive
@@ -276,8 +287,12 @@ def _manifest_health(path: str) -> Dict[str, Any]:
 
 def _is_checkpoint_dir(path: str) -> bool:
     # *.tmp is the async-commit staging dir: it holds metadata files but is
-    # by definition uncommitted — no restore walk may ever pick it up
-    if path.rstrip(os.sep).endswith(STAGING_SUFFIX):
+    # by definition uncommitted — no restore walk may ever pick it up.
+    # *.old is the previous checkpoint parked mid-swap by a re-save over
+    # the same path: complete but superseded (and recovered/removed by the
+    # startup sweep), so restore walks must not race the swap for it.
+    stripped = path.rstrip(os.sep)
+    if stripped.endswith(STAGING_SUFFIX) or stripped.endswith(OLD_SUFFIX):
         return False
     try:
         names = os.listdir(path)

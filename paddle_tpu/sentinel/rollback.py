@@ -15,8 +15,9 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 import warnings
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..core import monitor as _monitor
 from ..incubate.checkpoint.sharded import (
@@ -48,6 +49,12 @@ class CheckpointRollback:
         self._optimizer = optimizer
         self.keep_last = max(1, int(keep_last))
         self._ckpt = AsyncCheckpointer() if async_save else None
+        # unhealthy verdicts whose snapshot was still queued/in-flight when
+        # the sentinel spoke — applied when that snapshot publishes (the
+        # commit hook) or, for snapshots that never publish, consumed and
+        # re-checked by the restore walk after draining the writer
+        self._unhealthy_lock = threading.Lock()
+        self._pending_unhealthy: Dict[int, Optional[str]] = {}
         # orphaned *.tmp staging dirs from a previous crashed run; startup
         # only, so this can never race our own writer
         cleanup_stale_staging(self.path)
@@ -72,7 +79,8 @@ class CheckpointRollback:
         d = self._snap_dir(step)
         if self._ckpt is not None:
             self._ckpt.save(self._state(), d, step=step, healthy=healthy,
-                            reason=reason, on_commit=self._gc)
+                            reason=reason,
+                            on_commit=lambda: self._on_commit(step, d))
         else:
             commit_checkpoint(self._state(), d, healthy=healthy, step=step,
                               reason=reason)
@@ -91,12 +99,37 @@ class CheckpointRollback:
         return sorted(s for s in (_snap_no(n) for n in os.listdir(self.path))
                       if s is not None)
 
+    def _on_commit(self, step: int, d: str):
+        """Writer-thread hook, fired strictly after a snapshot's atomic
+        publish: apply any ``mark_unhealthy`` verdict that raced the
+        in-flight save (the snapshot published with its save-time healthy
+        stamp, which the sentinel has since overruled), then GC."""
+        with self._unhealthy_lock:
+            pending = step in self._pending_unhealthy
+            reason = self._pending_unhealthy.pop(step, None)
+        if pending:
+            write_health_stamp(d, False, step=step, reason=reason)
+        self._gc()
+
     def mark_unhealthy(self, step: int, reason: Optional[str] = None):
         """Retroactively stamp a snapshot bad (the sentinel discovered the
-        divergence only after this state was already saved)."""
+        divergence only after this state was already saved). With
+        ``async_save`` the snapshot may still be queued or in flight — the
+        verdict is recorded and applied the moment it publishes, so a
+        restore can never pick a snapshot the sentinel declared bad."""
         d = self._snap_dir(step)
+        if self._ckpt is not None:
+            with self._unhealthy_lock:
+                self._pending_unhealthy[step] = reason
         if os.path.isdir(d):
             write_health_stamp(d, False, step=step, reason=reason)
+            if self._ckpt is not None and d not in self._ckpt.held_paths():
+                # the verdict landed on the committed dir and no queued
+                # save can republish it — drop the pending entry so a
+                # future snapshot at the same step (post-rollback retrain
+                # revisits step numbers) is not wrongly poisoned
+                with self._unhealthy_lock:
+                    self._pending_unhealthy.pop(step, None)
 
     def _gc(self):
         held = self._ckpt.held_paths() if self._ckpt is not None else ()
@@ -116,6 +149,17 @@ class CheckpointRollback:
         intact. Returns the restored step, or None when nothing usable is
         left."""
         self.wait()  # a queued async snapshot may be the newest state
+        # verdicts whose snapshot never published (superseded or degraded-
+        # skipped saves never fire the commit hook): conservatively stamp
+        # any same-step dir that does exist — the sentinel said this step's
+        # state diverged, so restoring it is exactly what must not happen
+        with self._unhealthy_lock:
+            pending = dict(self._pending_unhealthy)
+            self._pending_unhealthy.clear()
+        for step, reason in pending.items():
+            d = self._snap_dir(step)
+            if os.path.isdir(d):
+                write_health_stamp(d, False, step=step, reason=reason)
         for step in reversed(self.steps()):
             d = self._snap_dir(step)
             stamp = read_health_stamp(d)

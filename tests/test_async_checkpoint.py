@@ -14,7 +14,7 @@ import pytest
 
 from paddle_tpu.core.monitor import StatRegistry
 from paddle_tpu.incubate.checkpoint import (
-    AsyncCheckpointConfig, AsyncCheckpointer, STAGING_SUFFIX,
+    AsyncCheckpointConfig, AsyncCheckpointer, OLD_SUFFIX, STAGING_SUFFIX,
     CheckpointIntegrityError, TrainEpochRange, cleanup_stale_staging,
     commit_checkpoint, load_sharded, newest_healthy_checkpoint,
     read_health_stamp, save_sharded, verify_checkpoint, write_health_stamp)
@@ -104,6 +104,62 @@ class TestCommitProtocol:
         removed = cleanup_stale_staging(str(tmp_path), held={held})
         assert removed == [stale]
         assert os.path.isdir(held) and os.path.isdir(keep)
+
+    def test_recommit_never_has_a_zero_checkpoint_instant(self, tmp_path,
+                                                          monkeypatch):
+        # regression: _publish used to rmtree(final) before os.replace, so
+        # a crash in between left NEITHER checkpoint. Now the old commit
+        # is parked as *.old — prove the swap window always holds at least
+        # one complete checkpoint by failing exactly inside it.
+        p = str(tmp_path / "latest")
+        commit_checkpoint(_state(1.0), p)
+        real_replace = os.replace
+
+        def exploding_replace(src, dst):
+            real_replace(src, dst)
+            if dst.endswith(OLD_SUFFIX):  # crash right after parking
+                raise RuntimeError("synthetic crash inside the swap window")
+
+        monkeypatch.setattr(ac.os, "replace", exploding_replace)
+        with pytest.raises(RuntimeError, match="swap window"):
+            commit_checkpoint(_state(2.0), p)
+        monkeypatch.undo()
+        # on disk: no final, but the parked old commit + the staged new one
+        assert not os.path.isdir(p)
+        assert os.path.isdir(p + OLD_SUFFIX)
+        # the startup sweep recovers the parked commit and drops staging
+        cleanup_stale_staging(str(tmp_path))
+        verify_checkpoint(p)
+        out = load_sharded(p, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0))  # commit #1
+        assert not os.path.isdir(p + OLD_SUFFIX)
+        assert not os.path.isdir(p + STAGING_SUFFIX)
+        # and a clean re-commit over the recovered path still works
+        commit_checkpoint(_state(3.0), p)
+        out = load_sharded(p, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0) * 3)
+
+    def test_cleanup_removes_stale_old_when_final_exists(self, tmp_path):
+        p = str(tmp_path / "snap_1")
+        commit_checkpoint(_state(2.0), p)
+        commit_checkpoint(_state(1.0), str(tmp_path / "scratch"))
+        os.rename(str(tmp_path / "scratch"), p + OLD_SUFFIX)
+        removed = cleanup_stale_staging(str(tmp_path))
+        assert removed == [p + OLD_SUFFIX]
+        out = load_sharded(p, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0) * 2)
+
+    def test_parked_old_dir_is_invisible_to_readers(self, tmp_path):
+        committed = str(tmp_path / "snap_1")
+        commit_checkpoint(_state(), committed)
+        # a parked previous commit with a NEWER numeric prefix must never
+        # win a restore walk over a committed sibling
+        commit_checkpoint(_state(2.0), str(tmp_path / "scratch"))
+        os.rename(str(tmp_path / "scratch"),
+                  str(tmp_path / ("snap_2" + OLD_SUFFIX)))
+        assert newest_healthy_checkpoint(str(tmp_path)) == committed
+        from paddle_tpu.incubate.checkpoint.sharded import _is_checkpoint_dir
+        assert not _is_checkpoint_dir(str(tmp_path / ("snap_2" + OLD_SUFFIX)))
 
 
 class _BlockingWriter:
@@ -219,6 +275,29 @@ class TestAsyncCheckpointer:
         assert not t1.committed  # the dying writer took t1 with it
         ck.close(timeout=30)
 
+    def test_on_commit_failure_keeps_ticket_committed(self, tmp_path):
+        # the checkpoint is durably published before on_commit runs: a
+        # failing callback must not flip the ticket or count as a failed
+        # checkpoint (it used to re-_finish(error=...) and bump errors)
+        reg = StatRegistry()
+        with AsyncCheckpointer(registry=reg) as ck:
+            with pytest.warns(UserWarning, match="on_commit"):
+                t = ck.save(_state(), str(tmp_path / "ck"),
+                            on_commit=lambda: 1 / 0)
+                assert t.wait(30)
+        assert t.committed and t.error is None
+        assert reg.get("ckpt.async.commits") == 1
+        assert reg.get("ckpt.async.errors") == 0
+        assert reg.get("ckpt.async.on_commit_errors") == 1
+        verify_checkpoint(str(tmp_path / "ck"))
+
+    def test_ticket_finish_is_write_once(self):
+        from paddle_tpu.incubate.checkpoint import SaveTicket
+        t = SaveTicket("p", 1)
+        t._finish(committed=True)
+        t._finish(error=RuntimeError("late failure"))
+        assert t.committed and t.error is None and t.done
+
     def test_observability_surface(self, tmp_path):
         reg = StatRegistry()
         with AsyncCheckpointer(registry=reg) as ck:
@@ -236,6 +315,74 @@ class TestAsyncCheckpointer:
         text = render_prometheus()
         assert "paddle_tpu_ckpt_async_commits_total" in text
         assert "paddle_tpu_ckpt_async_write_ms" in text
+
+
+class TestMultiHost:
+    def test_multihost_commit_is_cooperative(self, tmp_path, monkeypatch):
+        # regression: the atomic protocol staged every process into the
+        # SAME <path>.tmp and rmtree'd the final dir — on a shared
+        # filesystem one host destroyed its peers' shards. Multi-host must
+        # keep save_sharded's per-host-file protocol: simulate two hosts
+        # sequentially and prove neither touches the other's files.
+        import jax
+        barriers = []
+        monkeypatch.setattr(ac, "_barrier", lambda: barriers.append(1))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        p = str(tmp_path / "ck")
+
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        commit_checkpoint(_state(), p, step=1)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        commit_checkpoint(_state(), p, step=1)
+        names = set(os.listdir(p))
+        assert {"metadata_0.json", "metadata_1.json",
+                "shards_0.npz", "shards_1.npz"} <= names
+        # shared sidecars come from process 0 only (scalars written once)
+        assert "scalars.json" in names and "health.json" in names
+        # no dir-level staging was ever used
+        assert not os.path.exists(p + STAGING_SUFFIX)
+        assert not os.path.exists(p + OLD_SUFFIX)
+        assert len(barriers) == 2  # the sync commit is collective
+
+        # a re-save from one host must leave the peer's files intact
+        # (this is exactly what rmtree(final) used to destroy)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        commit_checkpoint(_state(), p, step=2)
+        names = set(os.listdir(p))
+        assert "metadata_1.json" in names and "shards_1.npz" in names
+        verify_checkpoint(p)
+        out = load_sharded(p, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0))
+
+    def test_multihost_health_rides_the_manifest(self, tmp_path,
+                                                 monkeypatch):
+        import jax
+        monkeypatch.setattr(ac, "_barrier", lambda: None)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(), p, healthy=False, step=7, reason="nan")
+        # proc 1 writes no sidecar, but its manifest carries the verdict
+        assert not os.path.exists(os.path.join(p, "health.json"))
+        stamp = read_health_stamp(p)
+        assert stamp["healthy"] is False and stamp["reason"] == "nan"
+
+    def test_multihost_torn_manifestless_write_is_detected(self, tmp_path,
+                                                           monkeypatch):
+        import jax
+        monkeypatch.setattr(ac, "_barrier", lambda: None)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(), p)
+        # simulate a peer that died after its shard archive but before its
+        # manifest: checksummed files all verify, and the torn peer state
+        # is detectable the moment its manifest appears truncated/absent —
+        # here the nastier variant: manifest present, archive truncated
+        with open(os.path.join(p, "shards_0.npz"), "r+b") as f:
+            f.truncate(os.path.getsize(os.path.join(p, "shards_0.npz")) // 2)
+        with pytest.raises(CheckpointIntegrityError):
+            verify_checkpoint(p)
 
 
 class TestFaultActions:
@@ -430,6 +577,57 @@ class TestIntegration:
         # restore waits for the queued async snapshots first
         assert rb.restore_newest_healthy() == 2
         np.testing.assert_allclose(np.asarray(st.w._data), np.full(4, 2.0))
+
+    def test_rollback_mark_unhealthy_applies_to_in_flight_snapshot(
+            self, tmp_path, monkeypatch):
+        # regression: mark_unhealthy only stamped an EXISTING dir, so a
+        # verdict against a still-queued async snapshot was silently
+        # dropped and restore_newest_healthy could restore it
+        from paddle_tpu.sentinel.rollback import CheckpointRollback
+
+        class Store:
+            def __init__(self):
+                self.w = jnp.arange(4.0)
+
+            def state_dict(self):
+                return {"w": self.w}
+
+            def set_state_dict(self, s):
+                self.w = s["w"]
+
+        blocker = _BlockingWriter(ac._write_staged)
+        monkeypatch.setattr(ac, "_write_staged", blocker)
+        st = Store()
+        rb = CheckpointRollback(str(tmp_path / "snaps"), model=st,
+                                keep_last=4, async_save=True)
+        d = rb.snapshot(1)
+        assert blocker.entered.wait(10)   # snapshot 1 is mid-write
+        rb.mark_unhealthy(1, reason="divergence caught mid-save")
+        assert not os.path.isdir(d)       # verdict raced the publish
+        blocker.release.set()
+        rb.wait(30)
+        # the commit hook applied the pending verdict post-publish
+        stamp = read_health_stamp(d)
+        assert stamp["healthy"] is False
+        assert stamp["reason"] == "divergence caught mid-save"
+        assert rb.restore_newest_healthy() is None
+        rb._ckpt.close(30)
+
+    def test_epoch_mark_unhealthy_applies_to_in_flight_save(
+            self, tmp_path, monkeypatch):
+        blocker = _BlockingWriter(ac._write_staged)
+        monkeypatch.setattr(ac, "_write_staged", blocker)
+        r = TrainEpochRange(3, "mu_job",
+                            checkpoint_path=str(tmp_path / "mu"),
+                            async_save=True)
+        r.save(0)
+        assert blocker.entered.wait(10)
+        r.mark_unhealthy(0, reason="nan epoch")
+        blocker.release.set()
+        r.wait()
+        stamp = read_health_stamp(r._epoch_dir(0))
+        assert stamp["healthy"] is False and stamp["reason"] == "nan epoch"
+        r._saver.close(30)
 
     def test_fault_tolerance_callback_async_save(self, tmp_path):
         import paddle_tpu as paddle

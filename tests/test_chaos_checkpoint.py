@@ -3,7 +3,9 @@
 
 A real training subprocess (TrainEpochRange with ``async_save=True``) is
 hard-killed at randomized points of the commit pipeline — snapshot fetch,
-shard write, just before and just after the atomic rename — via the
+shard write, just before and just after the atomic rename, and (for
+re-saves over the same path) inside the swap window where the previous
+commit is parked as ``*.old`` — via the
 ``kill_during_commit`` fault action (``os._exit``, no cleanup, same as a
 SIGKILL from the checkpoint's point of view), plus one case with an
 actual ``SIGKILL`` landed from outside while ``slow_io`` holds the commit
@@ -28,7 +30,7 @@ import time
 import numpy as np
 import pytest
 
-from paddle_tpu.incubate.checkpoint import (STAGING_SUFFIX,
+from paddle_tpu.incubate.checkpoint import (OLD_SUFFIX, STAGING_SUFFIX,
                                             verify_checkpoint)
 from paddle_tpu.utils.resilience import FAULT_CRASH_EXIT_CODE
 
@@ -81,6 +83,25 @@ def _write_script(tmp_path):
     p = tmp_path / "train.py"
     p.write_text(textwrap.dedent(TRAIN_SCRIPT))
     return str(p)
+
+
+# Re-saves over the SAME path (FaultToleranceCallback's "latest" pattern):
+# the swap parks commit #1 as *.old before publishing commit #2, so a kill
+# inside that window must leave the parked commit recoverable — never a
+# zero-checkpoint state.
+RESAVE_SCRIPT = """
+    import os, sys
+    os.environ.pop("JAX_PLATFORMS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, "/root/repo")
+    import numpy as np
+    from paddle_tpu.incubate.checkpoint import commit_checkpoint
+    path = sys.argv[1]
+    commit_checkpoint({"w": np.arange(4.0)}, path, step=1)
+    commit_checkpoint({"w": np.arange(4.0) * 2}, path, step=2)
+    print("RESAVE DONE", flush=True)
+"""
 
 
 def _run(script, ckpt_dir, out_npz, extra_env=None, timeout=240):
@@ -156,6 +177,43 @@ class TestChaosMatrix:
         if os.path.isdir(job_dir):
             assert not [n for n in os.listdir(job_dir)
                         if n.endswith(STAGING_SUFFIX)]
+
+    def test_kill_inside_swap_window_recovers_parked_commit(self, tmp_path):
+        """Kill between parking the old checkpoint and publishing the new
+        one, re-saving the SAME path — the window where the pre-fix
+        protocol (rmtree before replace) left ZERO restorable checkpoints.
+        The parked *.old commit must be recovered on restart."""
+        import numpy as np
+        from paddle_tpu.incubate.checkpoint import (cleanup_stale_staging,
+                                                    load_sharded)
+        script = str(tmp_path / "resave.py")
+        with open(script, "w") as f:
+            f.write(textwrap.dedent(RESAVE_SCRIPT))
+        path = str(tmp_path / "latest")
+
+        # occurrence 1: the first commit has nothing to park, so the site
+        # first fires during commit #2's swap
+        crashed = _run(script, path, "unused", extra_env={
+            "PADDLE_TPU_FAULT_SPEC": "ckpt_swap_window:1:kill_during_commit"})
+        assert crashed.returncode == FAULT_CRASH_EXIT_CODE, (
+            crashed.stdout, crashed.stderr)
+        assert not os.path.isdir(path)          # mid-swap: final not yet in
+        assert os.path.isdir(path + OLD_SUFFIX)  # ...but commit #1 is parked
+
+        # the startup sweep un-parks commit #1 and drops the staged debris
+        cleanup_stale_staging(str(tmp_path))
+        verify_checkpoint(path)
+        out = load_sharded(path, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(4.0))
+        assert not os.path.isdir(path + OLD_SUFFIX)
+        assert not os.path.isdir(path + STAGING_SUFFIX)
+
+        # a clean rerun republishes the newer state over the recovered one
+        ok = _run(script, path, "unused")
+        assert ok.returncode == 0, (ok.stdout, ok.stderr)
+        out = load_sharded(path, return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(4.0) * 2)
+        assert not os.path.isdir(path + OLD_SUFFIX)
 
     def test_external_sigkill_mid_commit_window(self, tmp_path, golden):
         """A real SIGKILL from outside, landed while slow_io holds the
