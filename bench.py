@@ -21,6 +21,21 @@ import time
 import numpy as np
 
 
+def _tuned_flash(seq, head_dim, dtype, causal=True):
+    """True when this model's step runs the Pallas flash kernel with an
+    autotuned block config (tuner winner resolved for its shape key) —
+    False for dense-attention or non-Pallas models, so the BENCH
+    trajectory shows which numbers are autotuned."""
+    try:
+        from paddle_tpu import tuner
+        if seq < 4096:          # transformer auto-impl crossover: dense
+            return False
+        return tuner.get_flash_blocks(seq, seq, head_dim, dtype,
+                                      causal) is not None
+    except Exception:
+        return False
+
+
 def _drive(model, opt, x_np, y_np, steps, use_amp, amp_dtype="bfloat16"):
     """Compile the fused train step once, then run `steps` pipelined steps.
     Returns seconds per step (excluding compile)."""
@@ -101,6 +116,8 @@ def bench_resnet50(on_tpu: bool):
         "batch": batch,
         "image_size": size,
         "train_tflops": imgs_per_sec * flops_per_img / 1e12,
+        "tuned": False,           # conv/matmul path: XLA-scheduled, no
+                                  # tunable Pallas kernel in the step
     }
 
 
@@ -160,6 +177,8 @@ def bench_bert(on_tpu: bool):
         "n_params": n_params,
         # 6ND approximation for transformer train FLOPs
         "train_tflops": tokens_per_sec * 6 * n_params / 1e12,
+        "tuned": _tuned_flash(seq, cfg.hidden_size // cfg.num_heads,
+                              "bfloat16" if on_tpu else "float32"),
     }
 
 
@@ -237,6 +256,8 @@ def bench_yolov3(on_tpu: bool):
         "batch": batch,
         "image_size": size,
         "train_tflops": imgs_per_sec * flops_per_img / 1e12,
+        "tuned": False,           # train path is conv-only; the tuned
+                                  # NMS kernel runs in eval/postprocess
     }
 
 
@@ -292,6 +313,8 @@ def bench_gpt_longseq(on_tpu: bool):
         # 6ND ignores attention FLOPs; at S=4096 add 12*L*h*S^2-ish? keep
         # the standard 6ND for comparability with the BERT entry
         "train_tflops": tokens_per_sec * 6 * n_params / 1e12,
+        "tuned": _tuned_flash(seq, cfg.hidden_size // cfg.num_heads,
+                              "bfloat16" if on_tpu else "float32"),
     }
 
 

@@ -58,6 +58,26 @@ if (int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
 # perf path and is unaffected by this setting.
 _jax.config.update("jax_default_matmul_precision", "highest")
 
+# Fleet-wide persistent compilation cache (serving/cache.py owns the full
+# story): when PADDLE_TPU_COMPILE_CACHE names a root, point JAX's own
+# persistent cache at <root>/xla HERE — before the first import-time jit —
+# so a warm process start performs zero XLA backend compiles at all, not
+# just zero for serving signatures. Inlined (not imported from
+# serving.cache, which would be circular this early); the values match
+# enable_persistent_compilation(), whose later idempotent update is a
+# no-op.
+_cc_root = _os.environ.get("PADDLE_TPU_COMPILE_CACHE", "").strip()
+if _cc_root:
+    try:
+        _cc_dir = _os.path.join(_os.path.expanduser(_cc_root), "xla")
+        _os.makedirs(_cc_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cc_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass                     # serving.cache warns with the details
+del _cc_root
+
 from .core import (  # noqa: F401
     Tensor, Parameter, no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
     grad as _functional_grad, seed, get_rng_state, set_rng_state,

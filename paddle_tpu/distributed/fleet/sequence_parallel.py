@@ -167,6 +167,26 @@ class RingAttention:
                      batch_axes=self._batch_axes)
 
 
+def _ring_blocks(Tl: int, D: int, dtype):
+    """Block edges for the ring-flash chunk kernel. This path calls the
+    kernel core without a padding wrapper, so blocks MUST divide Tl
+    exactly — a tuned winner that doesn't divide is discarded (the tuner
+    enumerates with ``require_divides=True``, so this only filters stale
+    or hand-edited cache entries)."""
+    default = Tl if Tl <= 128 else (128 if Tl % 128 == 0 else 16)
+    try:
+        from ...tuner import get_flash_blocks
+        tuned = get_flash_blocks(Tl, Tl, D, dtype, False, ring=True)
+    except Exception:
+        tuned = None
+    if tuned is not None:
+        bq, bk = int(tuned[0]), int(tuned[1])
+        if (bq > 0 and bk > 0 and Tl % bq == 0 and Tl % bk == 0
+                and bq % 16 == 0 and bk % 16 == 0):
+            return bq, bk
+    return default, default
+
+
 def _ring_flash_local(q, k, v, axis: str, causal: bool, scale,
                       interpret: bool):
     """Ring attention whose LOCAL chunk compute is the Pallas flash
@@ -193,8 +213,7 @@ def _ring_flash_local(q, k, v, axis: str, causal: bool, scale,
     if Tl % 16:
         raise ValueError(f"ring_flash_attention: per-shard sequence {Tl} "
                          f"must be a multiple of 16")
-    bq = Tl if Tl <= 128 else (128 if Tl % 128 == 0 else 16)
-    bk = bq
+    bq, bk = _ring_blocks(Tl, D, q.dtype)
     BH = B * H
     qb = q.reshape(BH, Tl, D)
 

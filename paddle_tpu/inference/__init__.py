@@ -113,7 +113,11 @@ class Predictor:
     def __init__(self, config: Config):
         prefix = config._prefix
         from jax import export as jax_export
-        from ..serving.cache import default_cache
+        from ..serving.cache import default_cache, persistent_root
+        # activate env-configured persistent compilation BEFORE the first
+        # compile this predictor triggers, so even parameter-upload utility
+        # programs land in (and later load from) the fleet-wide cache
+        persistent_root()
         with open(prefix + ".pdmodel", "rb") as f:
             self._exported = jax_export.deserialize(f.read())
         # compiled-callable cache keyed on (artifact, input shapes/dtypes):
@@ -212,22 +216,25 @@ class Predictor:
         return [np.asarray(o) for o in outs]
 
     def _call_cached(self, xs):
-        """Execute through the shape-keyed ExecutableCache: a jax.jit
-        wrapper per input signature means one XLA compile per signature
-        (shape-polymorphic artifacts re-lower per shape otherwise).
+        """Execute through the shape-keyed ExecutableCache: one AOT
+        XLA compile per input signature (shape-polymorphic artifacts
+        re-lower per shape otherwise), AOT so the executable is
+        serializable into the persistent tier.
 
         Sharded predictors commit each input onto its NamedSharding and
         append the sharding token to the cache key — replicas over
         different device subsets share the process-wide default cache, so
         the token (which includes device ids) is what keeps their
-        executables, and the unsharded 2-tuple keys, from colliding."""
+        executables, and the unsharded 2-tuple keys, from colliding.
+
+        The key is process-stable (artifact abspath + shape/dtype
+        signature + sharding token, no ids), so it doubles as the
+        persistent-store key: a restarted process loads the serialized
+        executable instead of compiling, and with a warm store a whole
+        fleet start performs zero XLA compiles for known signatures."""
         from ..serving.cache import signature_of
         sig = signature_of(xs)
         exported = self._exported
-
-        def _compile():
-            return jax.jit(lambda params, *xargs: exported.call(
-                params, *xargs))
 
         if self._sharding is None:
             key = (self._model_key, sig)
@@ -235,7 +242,14 @@ class Predictor:
             key = (self._model_key, sig, self._sharding.token)
             xs = [jax.device_put(x, s) for x, s in
                   zip(xs, self._sharding.input_shardings)]
-        fn = self._exec_cache.get_or_compile(key, _compile)
+        params = self._params
+
+        def _compile():
+            return jax.jit(lambda ps, *xargs: exported.call(
+                ps, *xargs)).lower(params, *xs).compile()
+
+        fn = self._exec_cache.get_or_compile(key, _compile,
+                                             persist_key=repr(key))
         outs = fn(self._params, *xs)
         return list(outs) if isinstance(outs, (list, tuple)) else [outs]
 

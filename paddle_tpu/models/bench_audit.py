@@ -1,0 +1,129 @@
+"""Bench-model audit entrypoints for the trace analyzer (PTA009/PTA010).
+
+bench.py's headline numbers come from the fused hapi train step over
+ResNet-50 and GPT; these factories register *miniature* builds of those
+exact step paths (same Model._build_train_step machinery, same loss and
+optimizer families, shrunk shapes) so the trace audit — and the
+``--bench-check`` gate over ``bench_audit_baseline.json`` — watches the
+programs the benchmark actually runs. A fusion break or host transfer
+introduced anywhere in the conv/BN or decoder-block step path shows up
+here long before a TPU run does.
+
+Shapes are deliberately tiny: the audit traces and XLA-compiles each
+program on CPU, and the gate runs in CI.
+"""
+from __future__ import annotations
+
+
+def _train_step_spec(build):
+    """Common AuditSpec assembly over a (net, opt, loss_layer, x, y)
+    bundle: mirrors hapi.model._audit_hapi_train_spec — build the fused
+    train step for the signature, snapshot init params/opt state on the
+    host once, and rebuild fresh donated argument arrays per call."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ..core import audit
+    from ..core.tensor import stable_uid
+    from ..hapi import Model
+
+    net, opt, loss_layer, x_np, y_np = build()
+    model = Model(net)
+    model.prepare(optimizer=opt, loss=loss_layer)
+    sig = (((tuple(x_np.shape), str(x_np.dtype)),
+            (tuple(y_np.shape), str(y_np.dtype))), False)
+    ts = model._get_train_step(sig)
+    for p in ts["trainable"]:
+        if stable_uid(p) not in opt._state:
+            opt._state[stable_uid(p)] = opt._init_state(p)
+    base_train = [np.asarray(p._data)  # noqa: PTA002 -- audit-factory setup: one-time host snapshot of the init params, not a step-path sync
+                  for p in ts["trainable"]]
+    base_fixed = [np.asarray(ts["state"][i]._data)  # noqa: PTA002 -- audit-factory setup: one-time host snapshot, not a step-path sync
+                  for i in ts["fixed_pos"]]
+    base_states = jax.tree_util.tree_map(
+        np.asarray, [opt._state[stable_uid(p)] for p in ts["trainable"]])
+
+    def make_args(variant):
+        # fresh arrays per call: donate_argnums=(0, 2) consumes them
+        rng = np.random.default_rng(11 + variant)
+        train_raws = [jnp.asarray(b) for b in base_train]
+        fixed_raws = [jnp.asarray(b) for b in base_fixed]
+        opt_states = jax.tree_util.tree_map(jnp.asarray, base_states)
+        if np.issubdtype(x_np.dtype, np.integer):
+            x = rng.integers(0, int(x_np.max()) + 1,
+                             x_np.shape).astype(x_np.dtype)
+        else:
+            x = rng.standard_normal(x_np.shape).astype(x_np.dtype)
+        if np.issubdtype(y_np.dtype, np.integer):
+            y = rng.integers(0, int(y_np.max()) + 1,
+                             y_np.shape).astype(y_np.dtype)
+        else:
+            y = rng.standard_normal(y_np.shape).astype(y_np.dtype)
+        key = jax.random.PRNGKey(variant)
+        lr = jnp.asarray(0.1, jnp.float32)
+        step_no = jnp.asarray(1.0, jnp.float32)
+        return (train_raws, fixed_raws, opt_states, [jnp.asarray(x)],
+                [jnp.asarray(y)], key, lr, step_no)
+
+    return audit.AuditSpec(fn=ts["raw_step"], make_args=make_args,
+                           jit_kwargs={"donate_argnums": (0, 2)})
+
+
+def _audit_resnet_train_spec():
+    """bench.py workload 1 (resnet50 + Momentum + CE), shrunk to
+    resnet18 @ 32x32 so CPU tracing stays cheap — identical step path:
+    conv/BN running stats through the effects carry, weight decay,
+    momentum update."""
+    import numpy as np
+
+    def build():
+        from .. import nn, optimizer as optim, seed
+        from ..vision import models as vmodels
+        seed(0)
+        net = vmodels.resnet18(num_classes=10)
+        opt = optim.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=net.parameters(),
+                             weight_decay=1e-4)
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 32, 32).astype(np.float32)
+        y = rng.randint(0, 10, (2,)).astype(np.int64)
+        return net, opt, nn.CrossEntropyLoss(), x, y
+
+    return _train_step_spec(build)
+
+
+def _audit_gpt_train_spec():
+    """bench.py workload 5 (GPT + AdamW + pretraining criterion), shrunk
+    to 2 layers / 32 hidden / seq 32 — the decoder-block step path the
+    S=4096 MFU number runs through (dense attention at this size; the
+    flash kernel itself is pinned numerically by tests/test_tuner.py)."""
+    import numpy as np
+
+    def build():
+        from .. import optimizer as optim, seed
+        from . import GPTConfig, GPTForCausalLM, GPTPretrainingCriterion
+        seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_dropout_prob=0.0)
+        net = GPTForCausalLM(cfg)
+        opt = optim.AdamW(learning_rate=1e-4, parameters=net.parameters(),
+                          weight_decay=0.01)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (2, 32)).astype(np.int32)
+        return net, opt, GPTPretrainingCriterion(), ids, ids.astype(
+            np.int64)
+
+    return _train_step_spec(build)
+
+
+def _register_audit_entrypoints():
+    from ..core import audit
+    audit.register_entrypoint("resnet_train_step", _audit_resnet_train_spec,
+                              tags=("train", "bench"))
+    audit.register_entrypoint("gpt_train_step", _audit_gpt_train_spec,
+                              tags=("train", "bench"))
+
+
+_register_audit_entrypoints()

@@ -47,6 +47,9 @@ class GPTConfig:
     # force either. Training with attention_dropout_prob > 0 stays dense
     # (the fused kernel never materialises the prob matrix to drop).
     attn_impl: str = "auto"
+    # explicit (block_q, block_k) for the flash kernel; None = ask the
+    # paddle_tpu.tuner winner cache for this (shape, dtype, platform)
+    attn_blocks: Optional[tuple] = None
 
     @property
     def ffn_size(self):
@@ -74,7 +77,8 @@ class GPTModel(Layer):
             c.hidden_size, c.num_heads, c.ffn_size,
             dropout=c.hidden_dropout_prob, activation="gelu",
             attn_dropout=c.attention_dropout_prob, normalize_before=True,
-            attn_impl=getattr(c, "attn_impl", "auto"))
+            attn_impl=getattr(c, "attn_impl", "auto"),
+            attn_blocks=getattr(c, "attn_blocks", None))
         self.decoder = TransformerEncoder(layer, c.num_layers,
                                           norm=LayerNorm(c.hidden_size))
 

@@ -64,7 +64,7 @@ class MultiHeadAttention(Layer):
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None,
-                 attn_impl="auto"):
+                 attn_impl="auto", attn_blocks=None):
         super().__init__()
         self.embed_dim = embed_dim
         self.kdim = kdim or embed_dim
@@ -78,6 +78,12 @@ class MultiHeadAttention(Layer):
             raise ValueError(f"attn_impl {attn_impl!r} not in "
                              "('auto', 'dense', 'flash')")
         self.attn_impl = attn_impl
+        # explicit (block_q, block_k) for the flash kernel; None defers to
+        # the paddle_tpu.tuner winner cache (falling back to the kernel's
+        # historical 128)
+        if attn_blocks is not None:
+            attn_blocks = (int(attn_blocks[0]), int(attn_blocks[1]))
+        self.attn_blocks = attn_blocks
         self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
         self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
         self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
@@ -128,8 +134,10 @@ class MultiHeadAttention(Layer):
             qf = manipulation.reshape(self.q_proj(query), shape)
             kf = manipulation.reshape(self.k_proj(key), shape)
             vf = manipulation.reshape(self.v_proj(value), shape)
+            blocks = self.attn_blocks or (None, None)
             out, _ = flash_attention(
-                qf, kf, vf, causal=isinstance(attn_mask, _CausalMask))
+                qf, kf, vf, causal=isinstance(attn_mask, _CausalMask),
+                block_q=blocks[0], block_k=blocks[1])
             out = manipulation.reshape(out, [b, lq, self.embed_dim])
             return self.out_proj(out)
         q = self._split_heads(self.q_proj(query))
@@ -180,7 +188,7 @@ class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
                  normalize_before=False, weight_attr=None, bias_attr=None,
-                 attn_impl="auto"):
+                 attn_impl="auto", attn_blocks=None):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
@@ -188,7 +196,8 @@ class TransformerEncoderLayer(Layer):
         self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
                                             weight_attr=weight_attr,
                                             bias_attr=bias_attr,
-                                            attn_impl=attn_impl)
+                                            attn_impl=attn_impl,
+                                            attn_blocks=attn_blocks)
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.dropout = Dropout(act_dropout, mode="upscale_in_train")
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)

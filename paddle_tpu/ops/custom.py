@@ -106,7 +106,7 @@ def register_cpp_op(name: str, source: str, fn_name: Optional[str] = None,
 # IoU matrix and the kept-mask resident in VMEM across the whole loop —
 # one kernel launch, zero HBM traffic in the loop body.
 
-def _nms_kernel(iou_ref, valid_ref, thr_ref, kept_ref):
+def _nms_kernel(iou_ref, valid_ref, thr_ref, kept_ref, *, unroll=1):
     # Mosaic-friendly formulation: everything 2-D, the kept-mask carried
     # through the fori_loop in vector registers (no per-element VMEM
     # stores), dynamic column selection via a masked reduction.
@@ -126,22 +126,44 @@ def _nms_kernel(iou_ref, valid_ref, thr_ref, kept_ref):
         return jnp.where(row_ids == i, keep_i.astype(jnp.int32), kept)
 
     kept_ref[:] = jax.lax.fori_loop(0, k, body,
-                                    jnp.zeros((k, 1), jnp.int32))
+                                    jnp.zeros((k, 1), jnp.int32),
+                                    unroll=unroll)
 
 
-def pallas_greedy_nms(iou, valid, thr, interpret=False):
+def _nms_unroll(k: int) -> int:
+    """Loop-unroll factor from the autotuner's winner cache (key
+    ``nms|{platform}|k{k}``); 1 — the historical behavior — when no
+    winner is known. The sequential scan's body is tiny, so unrolling
+    amortizes per-iteration scalar overhead."""
+    try:
+        from ..tuner import get_nms_config
+        cfg = get_nms_config(k)
+        u = int(cfg["unroll"]) if cfg else 1
+    except Exception:
+        return 1
+    # a bad factor would change trip arithmetic; only accept exact
+    # divisors of the candidate count
+    return u if u >= 1 and k % u == 0 else 1
+
+
+def pallas_greedy_nms(iou, valid, thr, interpret=False, unroll=None):
     """Greedy NMS over score-sorted candidates as ONE Pallas kernel.
 
     iou [k,k] f32 (symmetric, sorted by score desc), valid [k] int32,
     thr [1] f32 → kept mask [k] int32. Matches the lax.scan reference in
     detection._greedy_nms_mask (equivalence-tested); the IoU matrix and
     the mask stay VMEM/register resident across the whole loop.
+    ``unroll=None`` defers the loop-unroll factor to the tuner cache.
     """
+    import functools
+
     from jax.experimental import pallas as pl
 
     k = iou.shape[0]
+    if unroll is None:
+        unroll = _nms_unroll(k)
     out = pl.pallas_call(
-        _nms_kernel,
+        functools.partial(_nms_kernel, unroll=int(unroll)),
         out_shape=jax.ShapeDtypeStruct((k, 1), jnp.int32),
         interpret=interpret,
     )(iou.astype(jnp.float32), valid.reshape(k, 1).astype(jnp.int32),

@@ -31,6 +31,38 @@ __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
 
+#: historical hand-picked block edge — the fallback when the autotuner
+#: has no winner for a shape (paddle_tpu.tuner consults disk winners and
+#: the committed defaults table first)
+DEFAULT_BLOCK = 128
+
+
+def _ceil16(n: int) -> int:
+    return max(16, -(-int(n) // 16) * 16)
+
+
+def _sanitize_block(block: int, length: int) -> int:
+    """Clamp a requested block edge to a legal Mosaic tile: a multiple of
+    16 rows (the sublane tile for both f32 and bf16), at most the
+    16-rounded sequence length. Tuner- or user-supplied blocks that
+    violate the constraint are rounded up rather than rejected — the
+    caller's padding absorbs the difference."""
+    b = int(block)
+    if b <= 0:
+        b = DEFAULT_BLOCK
+    b = _ceil16(b)
+    return min(b, _ceil16(length))
+
+
+def _tuned_blocks(q_len, kv_len, head_dim, dtype, causal):
+    """(block_q, block_k) from the autotuner's winner cache, or None.
+    Never raises: an unavailable/broken tuner degrades to the default."""
+    try:
+        from ..tuner import get_flash_blocks
+        return get_flash_blocks(q_len, kv_len, head_dim, dtype, causal)
+    except Exception:
+        return None
+
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, causal,
                block_q, block_k, seq_len, kv_len):
@@ -85,8 +117,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, causal,
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    return_softmax=False, *, scale=None, block_q=128,
-                    block_k=128, name=None):
+                    return_softmax=False, *, scale=None, block_q=None,
+                    block_k=None, name=None):
     """Memory-efficient exact attention (paddle's flash_attention API:
     same positional order ``(q, k, v, dropout, causal, return_softmax)``
     and the same ``(out, softmax)`` tuple return, so positionally-ported
@@ -97,6 +129,10 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     probabilities are never materialized (that is the point of the
     kernel), so ``return_softmax=True`` raises, as does ``dropout > 0``
     (attention-prob dropout needs the dense path).
+
+    ``block_q``/``block_k`` default to the autotuner's winner for the
+    (shape, dtype, platform) key — falling back to the historical 128
+    when no winner is cached. Explicit values win over the tuner.
 
     The sequence is padded to the block size internally; padded keys are
     masked, padded query rows are sliced away.
@@ -113,12 +149,21 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         b, s, h, d = q.shape
         skv = kk.shape[1]
         sc = scale if scale is not None else 1.0 / np.sqrt(d)
+        bq_req, bk_req = block_q, block_k
+        if bq_req is None and bk_req is None:
+            tuned = _tuned_blocks(s, skv, d, q.dtype, causal)
+            if tuned is not None:
+                bq_req, bk_req = tuned
+        if bq_req is None:
+            bq_req = DEFAULT_BLOCK
+        if bk_req is None:
+            bk_req = DEFAULT_BLOCK
         # block shapes must stay multiples of the sublane tile (8 rows for
         # f32, 16 for bf16) or Mosaic may fail to compile (odd seq lengths
         # like 100); round to 16 so both dtypes are safe — the seq is
         # padded up to the rounded block below, padded keys masked
-        bq = min(block_q, max(16, -(-s // 16) * 16))
-        bk = min(block_k, max(16, -(-skv // 16) * 16))
+        bq = _sanitize_block(bq_req, s)
+        bk = _sanitize_block(bk_req, skv)
         s_pad = -(-s // bq) * bq
         kv_pad = -(-skv // bk) * bk
 
@@ -234,6 +279,14 @@ def _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
 
     bh, s_pad, d = qb.shape
     kv_pad = kb.shape[1]
+    # the grid floor-divides: a non-dividing block would silently drop the
+    # tail rows/keys for direct callers (flash_attention() pads before
+    # calling, but ring-flash and the tuner call this core directly)
+    if s_pad % bq or kv_pad % bk:
+        raise ValueError(
+            f"flash attention core: block_q={bq} / block_k={bk} must "
+            f"divide the (padded) sequence lengths ({s_pad}, {kv_pad}); "
+            "pad the operands or pick a dividing block")
     kernel = functools.partial(
         _fa_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk,
         seq_len=true_kv, kv_len=kv_pad)

@@ -1,18 +1,36 @@
 """ExecutableCache: compiled-callable cache keyed on (model, shapes, dtype).
 
-The compile-once-reuse layer under both the serving engine and the
-standalone :class:`~paddle_tpu.inference.Predictor`. An entry is whatever
-``compile_fn`` returns — in practice a ``jax.jit``-wrapped call of the
-deserialized StableHLO program, so each distinct input signature costs
-exactly one XLA compile and every later hit is a cheap executable launch.
-LRU-bounded with hit/miss/evict counters so recompile pressure is visible
-(``/statsz`` surfaces them; zero misses after warmup is the steady state).
+The compile-once-reuse layer under the serving engine, the standalone
+:class:`~paddle_tpu.inference.Predictor`, the LLM scheduler and the
+static decoder — ONE process-wide in-memory cache (``default_cache()``),
+so two components over the same program reuse each other's executables.
+An entry is whatever ``compile_fn`` returns — a ``jax.jit`` wrapper or an
+AOT ``Compiled`` — so each distinct input signature costs exactly one XLA
+compile and every later hit is a cheap executable launch. LRU-bounded
+with hit/miss/evict counters published to the default StatRegistry
+(``serving.executable_cache.*`` on ``/metricsz``; zero misses after
+warmup is the steady state).
+
+Persistence (fleet-wide, survives restarts) is two tiers under one root
+(``PADDLE_TPU_COMPILE_CACHE`` or :func:`enable_persistent_compilation`):
+
+* ``<root>/xla`` — JAX's own persistent compilation cache
+  (``jax_compilation_cache_dir``): every ``jit`` in the process, not
+  just serving, skips XLA backend compiles that any earlier process
+  already paid for.
+* ``<root>/executables`` — :class:`PersistentExecutableStore`: whole
+  serialized AOT executables keyed by the cache's own process-stable
+  signature tokens, loaded by ``get_or_compile(..., persist_key=...)``
+  without issuing a compile request at all.
 """
 from __future__ import annotations
 
+import hashlib
 import os
+import pickle
 import threading
 import time
+import warnings
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -23,12 +41,188 @@ from ..observability import tracer as _tracer
 SigT = Tuple[Tuple[Tuple[int, ...], str], ...]
 
 _DEFAULT_CAPACITY_ENV = "PADDLE_TPU_EXEC_CACHE_SIZE"
+_PERSIST_ENV = "PADDLE_TPU_COMPILE_CACHE"
+
+#: /metricsz namespace for the shared cache's counters
+_STAT_PREFIX = "serving.executable_cache."
+
+#: bump when the on-disk executable entry format changes
+_STORE_VERSION = 1
 
 
 def signature_of(arrays: Sequence[Any]) -> SigT:
     """Shape/dtype signature of a list of arrays (numpy or jax)."""
     return tuple((tuple(int(d) for d in a.shape), str(a.dtype))
                  for a in arrays)
+
+
+# -- persistent compilation (fleet-wide, survives restarts) -------------------
+
+_PERSIST_ROOT: Optional[str] = None
+_PERSIST_LOCK = threading.Lock()
+_PERSIST_RESOLVED = False
+
+
+def enable_persistent_compilation(path: Optional[str] = None) -> str:
+    """Turn on the on-disk compilation tiers and return the cache root.
+
+    Wires ``jax_compilation_cache_dir`` at ``<root>/xla`` (with the
+    min-compile-time/min-entry-size floors dropped so every executable
+    qualifies) and anchors the :class:`PersistentExecutableStore` at
+    ``<root>/executables``. Idempotent; the first caller's root wins.
+    Default root: ``$PADDLE_TPU_COMPILE_CACHE`` or
+    ``~/.cache/paddle_tpu/compile``.
+    """
+    global _PERSIST_ROOT, _PERSIST_RESOLVED
+    with _PERSIST_LOCK:
+        if _PERSIST_ROOT is not None:
+            return _PERSIST_ROOT
+        root = (path or os.environ.get(_PERSIST_ENV, "").strip()
+                or os.path.join(os.path.expanduser("~/.cache/paddle_tpu"),
+                                "compile"))
+        root = os.path.expanduser(root)
+        try:
+            import jax
+            os.makedirs(os.path.join(root, "xla"), exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(root, "xla"))
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            # jax latches "no cache" on the first compile; any import-time
+            # jit before this point would otherwise pin the cache off for
+            # the whole process
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            _cc.reset_cache()
+        except Exception as e:   # unwritable dir / exotic jax build
+            warnings.warn(f"persistent compilation cache disabled: {e}")
+        _PERSIST_ROOT = root
+        _PERSIST_RESOLVED = True
+        return root
+
+
+def persistent_root() -> Optional[str]:
+    """The active persistence root, auto-enabling from the environment on
+    first call; None when persistence is off (no env var, no explicit
+    :func:`enable_persistent_compilation`)."""
+    global _PERSIST_RESOLVED
+    with _PERSIST_LOCK:
+        if _PERSIST_ROOT is not None or _PERSIST_RESOLVED:
+            return _PERSIST_ROOT
+        _PERSIST_RESOLVED = True
+        if not os.environ.get(_PERSIST_ENV, "").strip():
+            return None
+    return enable_persistent_compilation()
+
+
+def _reset_persistence_for_tests():
+    global _PERSIST_ROOT, _PERSIST_RESOLVED, _STORE
+    with _PERSIST_LOCK:
+        _PERSIST_ROOT = None
+        _PERSIST_RESOLVED = False
+    with _STORE_LOCK:
+        _STORE = None
+
+
+class PersistentExecutableStore:
+    """Whole serialized executables on disk, keyed by process-stable
+    cache-key strings.
+
+    Entries are ``pickle((payload, in_tree, out_tree))`` from
+    ``jax.experimental.serialize_executable`` under a sha256 filename of
+    (key, jax version, backend platform, store version) — a jax upgrade
+    or platform change simply misses instead of loading an incompatible
+    executable. All failure modes (corrupt file, version skew, unpickla-
+    ble payload, unwritable dir) degrade to miss-with-warning: a bad
+    store can never take down serving, the entry is recompiled and
+    rewritten.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def _path(self, key: str) -> str:
+        import jax
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:
+            platform = "unknown"
+        tag = f"{_STORE_VERSION}|{jax.__version__}|{platform}|{key}"
+        h = hashlib.sha256(tag.encode()).hexdigest()
+        return os.path.join(self.directory, f"{h}.jaxexec")
+
+    def load(self, key: str):
+        """The deserialized executable for ``key``, or None."""
+        from jax.experimental import serialize_executable as _se
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.loads(f.read())
+            exe = _se.deserialize_and_load(payload, in_tree, out_tree)
+        except FileNotFoundError:
+            _mon.stat_add(_STAT_PREFIX + "disk_misses", 1)
+            return None
+        except Exception as e:
+            _mon.stat_add(_STAT_PREFIX + "disk_errors", 1)
+            warnings.warn(
+                f"persistent executable cache: dropping unreadable entry "
+                f"{os.path.basename(path)} ({type(e).__name__}: {e}); "
+                f"recompiling")
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        _mon.stat_add(_STAT_PREFIX + "disk_hits", 1)
+        return exe
+
+    def save(self, key: str, compiled: Any) -> bool:
+        """Serialize ``compiled`` if it supports AOT serialization
+        (``jax.stages.Compiled``); atomically write. False (with at most
+        a warning) on anything else — callers treat persistence as an
+        optimization, never state."""
+        from jax.experimental import serialize_executable as _se
+        try:
+            blob = pickle.dumps(_se.serialize(compiled))
+        except Exception:
+            return False            # lazy jit wrapper etc. — memory-only
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError as e:
+            warnings.warn(f"persistent executable cache: could not write "
+                          f"{path}: {e}")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        _mon.stat_add(_STAT_PREFIX + "disk_writes", 1)
+        return True
+
+
+_STORE: Optional[PersistentExecutableStore] = None
+_STORE_LOCK = threading.Lock()
+
+
+def persistent_store() -> Optional[PersistentExecutableStore]:
+    """Process-wide executable store under the persistence root, or None
+    when persistence is off."""
+    global _STORE
+    root = persistent_root()
+    if root is None:
+        return None
+    with _STORE_LOCK:
+        if _STORE is None or not _STORE.directory.startswith(root):
+            _STORE = PersistentExecutableStore(
+                os.path.join(root, "executables"))
+        return _STORE
 
 
 class ExecutableCache:
@@ -48,36 +242,55 @@ class ExecutableCache:
         with self._lock:
             return len(self._entries)
 
-    def get_or_compile(self, key: Any, compile_fn: Callable[[], Any]) -> Any:
+    def get_or_compile(self, key: Any, compile_fn: Callable[[], Any], *,
+                       persist_key: Optional[str] = None) -> Any:
         """Return the cached executable for ``key``, compiling on miss.
 
         ``compile_fn`` runs outside the lock (XLA compiles can take
         seconds); concurrent misses on the same key race benignly — the
         first finisher's entry wins and the duplicate is dropped.
+
+        ``persist_key`` opts this entry into the on-disk executable tier
+        (no-op when persistence is off). It MUST be process-stable —
+        derived from artifact paths/signatures, never from ``id()`` — or
+        a restarted process could load someone else's executable.
+        Entries whose compiled object is not AOT-serializable silently
+        stay memory-only.
         """
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
+                _mon.stat_add(_STAT_PREFIX + "hits", 1)
                 return entry
             self.misses += 1
-        # compile hook: stamp every miss with its build duration (for jit
-        # entries this is trace+lower; XLA compile itself may still be
-        # deferred to first execution) — recompile pressure shows up as a
-        # `jit.compile_ms` histogram and on the span timeline.
-        t0 = time.perf_counter()
-        with _tracer.span("jit/compile", {"cache_key": repr(key)[:200]}):
-            compiled = compile_fn()
-        _mon.stat_observe("jit.compile_ms",
-                          (time.perf_counter() - t0) * 1e3)
-        _mon.stat_add("jit.cache_misses", 1)
+        _mon.stat_add(_STAT_PREFIX + "misses", 1)
+        store = persistent_store() if persist_key else None
+        compiled = store.load(persist_key) if store is not None else None
+        from_disk = compiled is not None
+        if compiled is None:
+            # compile hook: stamp every miss with its build duration (for
+            # jit entries this is trace+lower; XLA compile itself may
+            # still be deferred to first execution) — recompile pressure
+            # shows up as `jit.compile_ms` and on the span timeline.
+            t0 = time.perf_counter()
+            with _tracer.span("jit/compile",
+                              {"cache_key": repr(key)[:200]}):
+                compiled = compile_fn()
+            _mon.stat_observe("jit.compile_ms",
+                              (time.perf_counter() - t0) * 1e3)
+            _mon.stat_add("jit.cache_misses", 1)
         with self._lock:
             winner = self._entries.setdefault(key, compiled)
             self._entries.move_to_end(key)
             while len(self._entries) > self._capacity:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+                _mon.stat_add(_STAT_PREFIX + "evictions", 1)
+            _mon.stat_set(_STAT_PREFIX + "size", len(self._entries))
+        if store is not None and not from_disk and winner is compiled:
+            store.save(persist_key, winner)
         return winner
 
     def contains(self, key: Any) -> bool:

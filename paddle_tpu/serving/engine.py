@@ -80,6 +80,11 @@ class DrainableEngineBase:
 
     def _init_serving_base(self, registry: Optional[_mon.StatRegistry],
                            stat_prefix: str):
+        # activate env-configured persistent compilation before this
+        # engine's first compile (no-op when PADDLE_TPU_COMPILE_CACHE is
+        # unset and enable_persistent_compilation() was never called)
+        from .cache import persistent_root
+        persistent_root()
         self._registry = registry or _mon.default_registry()
         self._prefix = stat_prefix
         self._draining = threading.Event()
@@ -193,12 +198,14 @@ class Engine(DrainableEngineBase):
             def _call(arrays: List[np.ndarray]) -> List[Any]:
                 out = fn(*arrays)
                 return list(out) if isinstance(out, (list, tuple)) else [out]
-            # plain callables get an engine-local cache; a miss marks the
-            # first time a padded signature is seen (== a jit compile when
-            # fn is jitted)
+            # plain callables share the process-wide cache; the key holds
+            # the fn OBJECT (not id(fn) — ids are reused after GC, and in
+            # a shared cache a recycled id would alias two models). A miss
+            # marks the first time a padded signature is seen (== a jit
+            # compile when fn is jitted).
             return _call, \
-                (cache if cache is not None else ExecutableCache()), \
-                ("callable", id(fn)), True
+                (cache if cache is not None else default_cache()), \
+                ("callable", fn), True
         raise TypeError(
             f"model must be a Predictor, artifact path prefix, or callable; "
             f"got {type(model).__name__}")

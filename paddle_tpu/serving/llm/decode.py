@@ -30,7 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..cache import ExecutableCache
+from ..cache import ExecutableCache, default_cache
 from .kvcache import StaticKVCache, append_token_kv, valid_mask, \
     write_prompt_kv
 
@@ -341,8 +341,11 @@ class GPTStaticDecoder:
         self.max_top_k = max(0, min(int(max_top_k), self.spec.vocab_size))
         # NOT `exec_cache or ...`: an empty ExecutableCache has len() == 0
         # and is falsy, which would silently orphan the engine's cache.
+        # Default is the ONE process-wide cache (serving/cache.py), shared
+        # with Predictors and batch engines; the spec-based key below
+        # keeps decoders from colliding in it.
         self.exec_cache = (exec_cache if exec_cache is not None
-                           else ExecutableCache())
+                           else default_cache())
         # GSPMD: with a mesh, params are replicated onto it and KV slots
         # shard over `slot_axis` (see StaticKVCache). The mesh token —
         # axis names + shape + device ids — joins the cache key so two

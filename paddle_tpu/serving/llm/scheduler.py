@@ -37,7 +37,7 @@ from ...core import monitor as _mon
 from ...observability import flight as _flight
 from ...observability import tracer as _otrace
 from ..buckets import pow2_buckets
-from ..cache import ExecutableCache
+from ..cache import ExecutableCache, default_cache
 from ..engine import DrainableEngineBase
 from ..queue import BatchQueue
 from ..request import (Deadline, DeadlineExceeded, EngineDraining,
@@ -425,7 +425,10 @@ class LLMEngine(DrainableEngineBase):
         self._init_serving_base(registry, self._config.stat_prefix)
         # `is not None`, not truthiness: an empty ExecutableCache has
         # len() == 0 and is falsy, so `cache or ...` would drop it.
-        self._cache = cache if cache is not None else ExecutableCache()
+        # Default: the ONE process-wide cache (serving/cache.py) — the
+        # LLM engine shares executables and counters with Predictors and
+        # batch engines instead of holding a private per-engine cache.
+        self._cache = cache if cache is not None else default_cache()
         self._decoder = GPTStaticDecoder(
             model, max_top_k=self._config.max_top_k, exec_cache=self._cache,
             mesh=mesh, slot_axis=slot_axis)

@@ -1,0 +1,192 @@
+"""paddle_tpu.tuner: empirical Pallas-kernel autotuner.
+
+TVM-style per-shape schedule search (PAPERS.md) scaled to this repo's
+kernel families: instead of hand-picked 128x128 blocks everywhere, the
+flash-attention kernels (ops/pallas_attention.py, the ring-flash chunk
+kernel in distributed/fleet/sequence_parallel.py) and the ops/custom.py
+Pallas kernels resolve their block/grid configuration per
+``(shape, dtype, platform)`` key through this package:
+
+1. **in-process memo** — after the first resolution a key costs one dict
+   lookup on the kernel-call path (zero measurable overhead),
+2. **on-disk winner cache** — ``PADDLE_TPU_TUNE_CACHE`` (default
+   ``~/.cache/paddle_tpu/tuning/``), versioned JSON written by
+   ``tools/autotune.py`` (or by tune-on-miss), shared by every process
+   that mounts it — replicas and restarts reuse each other's search,
+3. **committed defaults** — ``default_winners.json`` ships winners for
+   the bench-model shapes so CI and cold fleets never tune from scratch,
+4. **heuristic fallback** — the historical hardcoded config, so an empty
+   cache is never worse than the pre-tuner behavior.
+
+Active search happens only in ``tools/autotune.py`` or when
+``PADDLE_TPU_AUTOTUNE=1`` opts into tune-on-miss (a training step must
+never block on a surprise search by default).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from . import runner, space, store
+from .space import flash_candidates, nms_candidates
+from .store import CACHE_VERSION, WinnerStore, cache_dir, store_for
+
+__all__ = [
+    "CACHE_VERSION", "WinnerStore", "cache_dir", "store_for",
+    "flash_key", "nms_key", "get_flash_blocks", "get_nms_config",
+    "record_winner", "autotune_flash", "tune_on_miss_enabled",
+    "flash_candidates", "nms_candidates", "clear_memo",
+]
+
+_ENV_AUTOTUNE = "PADDLE_TPU_AUTOTUNE"
+
+#: resolved configs, keyed by canonical key string — the zero-overhead
+#: tier consulted at kernel-call time
+_MEMO: Dict[str, Optional[Dict[str, Any]]] = {}
+_MEMO_LOCK = threading.Lock()
+
+
+def clear_memo() -> None:
+    with _MEMO_LOCK:
+        _MEMO.clear()
+    store._reset_for_tests()
+
+
+def tune_on_miss_enabled() -> bool:
+    return os.environ.get(_ENV_AUTOTUNE, "").strip() in ("1", "true", "on")
+
+
+def _platform() -> str:
+    import jax
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def _ceil16(n: int) -> int:
+    return max(16, -(-int(n) // 16) * 16)
+
+
+# -- canonical keys -----------------------------------------------------------
+
+def flash_key(q_len: int, kv_len: int, head_dim: int, dtype: str,
+              causal: bool, platform: Optional[str] = None,
+              ring: bool = False) -> str:
+    """Key for the flash-attention family. Lengths are canonicalized to
+    the 16-row sublane grid (4095 and 4096 share a winner); ``ring``
+    marks the divisor-constrained ring-flash chunk variant."""
+    p = platform or _platform()
+    fam = "ring_flash" if ring else "flash_fwd"
+    try:                 # canonicalize: np.dtype / jnp scalar type / str
+        import numpy as _np
+        dtype = _np.dtype(dtype).name
+    except TypeError:
+        dtype = str(dtype)
+    return (f"{fam}|{p}|{dtype}|d{int(head_dim)}|q{_ceil16(q_len)}"
+            f"|k{_ceil16(kv_len)}|c{int(bool(causal))}")
+
+
+def nms_key(k: int, platform: Optional[str] = None) -> str:
+    return f"nms|{platform or _platform()}|k{int(k)}"
+
+
+# -- lookup (the kernel-call path) -------------------------------------------
+
+def _resolve(key: str) -> Optional[Dict[str, Any]]:
+    with _MEMO_LOCK:
+        if key in _MEMO:
+            return _MEMO[key]
+    cfg = store_for(key.split("|", 2)[1]).lookup(key)
+    with _MEMO_LOCK:
+        _MEMO[key] = cfg
+    return cfg
+
+
+def get_flash_blocks(q_len: int, kv_len: int, head_dim: int, dtype: str,
+                     causal: bool, ring: bool = False
+                     ) -> Optional[Tuple[int, int]]:
+    """The tuned (block_q, block_k) for a flash-attention shape, or None
+    when no winner is known (caller applies its heuristic default)."""
+    cfg = _resolve(flash_key(q_len, kv_len, head_dim, dtype, causal,
+                             ring=ring))
+    if not cfg:
+        return None
+    try:
+        return int(cfg["block_q"]), int(cfg["block_k"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def get_nms_config(k: int) -> Optional[Dict[str, Any]]:
+    return _resolve(nms_key(k))
+
+
+def record_winner(key: str, config: Dict[str, Any],
+                  us: Optional[float] = None) -> None:
+    """Write a winner to the disk cache and refresh the memo."""
+    store_for(key.split("|", 2)[1]).record(key, config, us=us)
+    with _MEMO_LOCK:
+        _MEMO[key] = dict(config)
+
+
+# -- active search ------------------------------------------------------------
+
+def autotune_flash(batch_heads: int, q_len: int, kv_len: int,
+                   head_dim: int, dtype: str = "float32",
+                   causal: bool = False, ring: bool = False,
+                   trials: int = 5, interpret: Optional[bool] = None,
+                   record: bool = True) -> Dict[str, Any]:
+    """Search (block_q, block_k) for one flash-attention shape by timing
+    the real kernel, and (by default) persist the winner.
+
+    Returns ``{"block_q", "block_k", "us", "results"}``. Runs the actual
+    ``_fa_fwd_with_lse`` program — candidate pruning is VMEM-based, the
+    scoring is wall clock with median-of-``trials``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..ops import pallas_attention as fa
+
+    if interpret is None:
+        interpret = _platform() != "tpu"
+    jdt = jnp.dtype(dtype)
+    q16, k16 = _ceil16(q_len), _ceil16(kv_len)
+    cands = flash_candidates(q_len, kv_len, head_dim,
+                             itemsize=jdt.itemsize, require_divides=ring)
+    kq = jax.random.PRNGKey(0)
+    qb = jax.random.normal(kq, (batch_heads, q16, head_dim), jdt)
+    kb = jax.random.normal(kq, (batch_heads, k16, head_dim), jdt)
+    vb = jax.random.normal(kq, (batch_heads, k16, head_dim), jdt)
+    scale = 1.0 / float(head_dim) ** 0.5
+
+    def make_runner(cand):
+        bq, bk = cand
+        if q16 % bq or k16 % bk:
+            # pad to the candidate's grid exactly like flash_attention()
+            qq = jnp.pad(qb, ((0, 0), (0, -(-q16 // bq) * bq - q16),
+                              (0, 0)))
+            kk = jnp.pad(kb, ((0, 0), (0, -(-k16 // bk) * bk - k16),
+                              (0, 0)))
+            vv = jnp.pad(vb, ((0, 0), (0, -(-k16 // bk) * bk - k16),
+                              (0, 0)))
+        else:
+            qq, kk, vv = qb, kb, vb
+        fn = jax.jit(lambda a, b, c: fa._fa_fwd_with_lse(
+            a, b, c, causal, scale, bq, bk, interpret, kv_len)[0])
+        return lambda: fn(qq, kk, vv)
+
+    best, best_t, results = runner.search(cands, make_runner,
+                                          trials=trials)
+    if best is None:
+        raise RuntimeError(
+            f"autotune_flash: no candidate built for shape "
+            f"(bh={batch_heads}, q={q_len}, kv={kv_len}, d={head_dim}, "
+            f"{dtype})")
+    cfg = {"block_q": int(best[0]), "block_k": int(best[1])}
+    us = best_t * 1e6
+    if record:
+        record_winner(flash_key(q_len, kv_len, head_dim, dtype, causal,
+                                ring=ring), cfg, us=us)
+    return dict(cfg, us=us, results=results)

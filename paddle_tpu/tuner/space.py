@@ -1,0 +1,88 @@
+"""Candidate spaces for the kernel autotuner, with VMEM-footprint pruning.
+
+TVM's schedule-search insight applies at Pallas granularity: the right
+block/grid shape is a function of (shape, dtype, platform), not a
+constant. The spaces here are deliberately small — tens of candidates —
+because each trial costs a Mosaic compile; VMEM pruning (the ~16 MiB/core
+budget, pallas_guide.md) cuts the obviously-unbuildable ones before any
+compile is attempted.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+#: per-core VMEM on current TPU generations (pallas_guide.md); trials
+#: budget 80% of it so the compiler keeps headroom for spills/semaphores
+VMEM_BYTES = 16 * 1024 * 1024
+VMEM_BUDGET = int(VMEM_BYTES * 0.8)
+
+#: sublane tile: block rows must stay multiples of 16 so both f32 (8) and
+#: bf16 (16) layouts are legal (ops/pallas_attention.py convention)
+SUBLANE = 16
+
+#: candidate block edges for the flash-attention family
+FLASH_BLOCKS = (16, 32, 64, 128, 256, 512)
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def flash_vmem_bytes(block_q: int, block_k: int, kv_len: int,
+                     head_dim: int, itemsize: int = 4) -> int:
+    """VMEM-resident bytes for one flash-attention program instance.
+
+    The forward kernel's BlockSpecs bring the q block, the FULL padded
+    K/V sequence, and the output block into VMEM; the score block,
+    accumulator and row stats live in registers/VMEM scratch. f32
+    accumulation dominates the scratch terms regardless of input dtype.
+    """
+    kv_pad = _ceil_to(kv_len, block_k)
+    q_blk = block_q * head_dim * itemsize
+    kv_res = 2 * kv_pad * head_dim * itemsize
+    scores = block_q * block_k * 4            # f32 score block
+    acc = block_q * head_dim * 4              # f32 accumulator
+    out = block_q * head_dim * itemsize
+    stats = 2 * block_q * 4                   # m / l rows
+    return q_blk + kv_res + scores + acc + out + stats
+
+
+def flash_candidates(q_len: int, kv_len: int, head_dim: int,
+                     itemsize: int = 4,
+                     require_divides: bool = False
+                     ) -> List[Tuple[int, int]]:
+    """(block_q, block_k) candidates for a flash-attention shape, VMEM
+    pruned. ``require_divides`` restricts to blocks that divide the
+    16-rounded lengths exactly — the ring-flash path calls the kernel
+    core without a padding wrapper, so only exact divisors are legal
+    there."""
+    q16 = max(SUBLANE, _ceil_to(q_len, SUBLANE))
+    k16 = max(SUBLANE, _ceil_to(kv_len, SUBLANE))
+    out: List[Tuple[int, int]] = []
+    for bq in FLASH_BLOCKS:
+        if bq > q16:
+            continue
+        if require_divides and q16 % bq:
+            continue
+        for bk in FLASH_BLOCKS:
+            if bk > k16:
+                continue
+            if require_divides and k16 % bk:
+                continue
+            if flash_vmem_bytes(bq, bk, kv_len, head_dim,
+                                itemsize) > VMEM_BUDGET:
+                continue
+            out.append((bq, bk))
+    if not out:
+        # tiniest legal block always fits; the caller's padding logic
+        # clamps further
+        out.append((SUBLANE, SUBLANE))
+    return out
+
+
+def nms_candidates(k: int) -> List[Dict[str, int]]:
+    """Unroll factors for the greedy-NMS fori_loop (ops/custom.py): the
+    loop body is tiny, so unrolling amortizes loop overhead until the
+    unrolled body overflows instruction budget. Only exact divisors of
+    the candidate count keep the trip arithmetic trivial."""
+    return [{"unroll": u} for u in (1, 2, 4, 8) if u <= max(1, k)]

@@ -1,0 +1,150 @@
+"""PTA009 bench-audit gate (tools/check_audit_regression.py).
+
+The gate traces the bench step paths (resnet_train_step /
+gpt_train_step, registered by paddle_tpu.models.bench_audit) and
+compares the MFU-moving counters against the committed
+bench_audit_baseline.json. These tests drive the gate through its
+--report seam with synthetic reports: a seeded fusion-break or
+host-transfer regression MUST exit 1; matching counts MUST pass.
+"""
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+from tools import check_audit_regression as gate  # noqa: E402
+
+
+def _clean_stats():
+    return {
+        "tags": ["train", "bench"], "path": "paddle_tpu/models/x.py",
+        "line": 1, "error": "", "trace_count": 1,
+        "fingerprints": ["aa", "aa"], "fingerprint_stable": True,
+        "transfers": [], "large_consts": [], "donation": None,
+        "hlo": {"instructions": 1000, "fusions": 50, "copies": 20,
+                "custom_calls": 0, "host_transfers": 0},
+    }
+
+
+def _clean_payload():
+    return {"version": 1, "platform": "cpu", "error": "",
+            "entrypoints": {n: _clean_stats()
+                            for n in gate.ENTRYPOINTS}}
+
+
+@pytest.fixture()
+def baseline_file(tmp_path):
+    base = gate.summarize(_clean_payload())
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 1, "entrypoints": base}))
+    return str(path)
+
+
+def _run(payload, baseline_file, tmp_path):
+    report = tmp_path / "report.json"
+    report.write_text(json.dumps(payload))
+    return gate.main(["--report", str(report),
+                      "--baseline", baseline_file])
+
+
+class TestSummarize:
+    def test_counts(self):
+        p = _clean_payload()
+        st = p["entrypoints"]["gpt_train_step"]
+        st["transfers"] = ["device_put", "device_put", "io_callback"]
+        st["large_consts"] = [{"elements": 99999}]
+        st["trace_count"] = 3
+        st["fingerprint_stable"] = False
+        st["donation"] = {"donatable_inputs": 4, "total_inputs": 8,
+                          "donatable_bytes": 1024}
+        s = gate.summarize(p)["gpt_train_step"]
+        assert s == {"host_transfers": 3, "large_consts": 1,
+                     "donatable_inputs": 4, "retraces": 2,
+                     "fingerprint_unstable": 1, "copy_fraction": 0.02}
+
+    def test_error_entrypoint_carried(self):
+        p = _clean_payload()
+        p["entrypoints"]["resnet_train_step"]["error"] = "boom"
+        assert "error" in gate.summarize(p)["resnet_train_step"]
+
+    def test_missing_entrypoint_is_error(self):
+        p = _clean_payload()
+        del p["entrypoints"]["gpt_train_step"]
+        assert "error" in gate.summarize(p)["gpt_train_step"]
+
+
+class TestGate:
+    def test_matching_counts_pass(self, baseline_file, tmp_path):
+        assert _run(_clean_payload(), baseline_file, tmp_path) == 0
+
+    def test_seeded_host_transfer_fails(self, baseline_file, tmp_path,
+                                        capsys):
+        p = _clean_payload()
+        p["entrypoints"]["gpt_train_step"]["transfers"] = ["device_put"]
+        assert _run(p, baseline_file, tmp_path) == 1
+        assert "host_transfers regressed 0 -> 1" in capsys.readouterr().out
+
+    def test_seeded_fusion_break_fails(self, baseline_file, tmp_path,
+                                       capsys):
+        # copy fraction jumping 2% -> 12% is a broken fusion, not noise
+        p = _clean_payload()
+        p["entrypoints"]["resnet_train_step"]["hlo"]["copies"] = 120
+        assert _run(p, baseline_file, tmp_path) == 1
+        assert "fusion broke" in capsys.readouterr().out
+
+    def test_copy_fraction_slack_tolerated(self, baseline_file, tmp_path):
+        # within the absolute slack (XLA version skew), not a failure
+        p = _clean_payload()
+        p["entrypoints"]["resnet_train_step"]["hlo"]["copies"] = 40
+        assert _run(p, baseline_file, tmp_path) == 0
+
+    def test_seeded_retrace_fails(self, baseline_file, tmp_path):
+        p = _clean_payload()
+        p["entrypoints"]["gpt_train_step"]["trace_count"] = 2
+        assert _run(p, baseline_file, tmp_path) == 1
+
+    def test_entrypoint_trace_failure_fails(self, baseline_file, tmp_path):
+        p = _clean_payload()
+        p["entrypoints"]["gpt_train_step"]["error"] = "Traceback: boom"
+        assert _run(p, baseline_file, tmp_path) == 1
+
+    def test_missing_baseline_fails(self, tmp_path):
+        assert _run(_clean_payload(), str(tmp_path / "nope.json"),
+                    tmp_path) == 1
+
+    def test_improvement_passes_and_never_ratchets_up(self, baseline_file,
+                                                      tmp_path):
+        p = _clean_payload()
+        p["entrypoints"]["gpt_train_step"]["hlo"]["copies"] = 0
+        assert _run(p, baseline_file, tmp_path) == 0
+
+
+class TestCommittedBaseline:
+    def test_baseline_is_committed_and_clean(self):
+        with open(os.path.join(REPO, "bench_audit_baseline.json")) as f:
+            base = json.load(f)["entrypoints"]
+        for name in gate.ENTRYPOINTS:
+            assert base[name]["host_transfers"] == 0
+            assert base[name]["retraces"] == 0
+            assert base[name]["donatable_inputs"] == 0
+            assert base[name]["fingerprint_unstable"] == 0
+
+    def test_bench_entrypoints_registered(self):
+        from paddle_tpu.core import audit
+        eps = audit.load_default_entrypoints()
+        for name in gate.ENTRYPOINTS:
+            assert name in eps
+            assert "bench" in eps[name].tags
+
+
+@pytest.mark.slow
+def test_live_audit_matches_committed_baseline():
+    """The real trace audit over the bench step paths must pass the gate
+    against the committed baseline — i.e. --bench-check is green at this
+    commit."""
+    assert gate.main([]) == 0

@@ -1,0 +1,110 @@
+"""Distribution hygiene: audit artifacts never ship; tuner defaults do.
+
+run_tests.py writes ``analysis.sarif`` / ``trace_audit.json`` at the
+repo root (gitignored working files). This builds a real sdist and wheel
+through ``setuptools.build_meta`` — with those artifacts present on
+disk, the worst case — and asserts the file lists exclude them, and that
+the committed ``paddle_tpu/tuner/default_winners.json`` IS packaged (the
+cold-fleet autotuner tier depends on it shipping).
+"""
+import os
+import subprocess
+import sys
+import tarfile
+import zipfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: gitignored audit/bench artifacts that must never reach a distribution
+FORBIDDEN = ("analysis.sarif", "trace_audit.json", "trace_audit_full.json",
+             ".pytest_shard_0.log")
+
+_BUILD = r"""
+import os, sys
+from setuptools import build_meta
+out = sys.argv[1]
+kind = sys.argv[2]
+if kind == "sdist":
+    print(build_meta.build_sdist(out))
+else:
+    print(build_meta.build_wheel(out))
+"""
+
+
+@pytest.fixture(scope="module")
+def dists(tmp_path_factory):
+    """Build sdist + wheel once, in a subprocess (build_meta assumes it
+    owns cwd/argv), with sentinel audit artifacts planted at the root."""
+    out = tmp_path_factory.mktemp("dist")
+    planted = []
+    for name in FORBIDDEN:
+        path = os.path.join(REPO, name)
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("{}")
+            planted.append(path)
+    # setuptools writes build/ + egg-info into the project root; remember
+    # which did not exist so only OUR side effects get cleaned up
+    side_effects = [p for p in
+                    (os.path.join(REPO, "build"),
+                     os.path.join(REPO, "paddle_tpu.egg-info"))
+                    if not os.path.exists(p)]
+    script = out / "build.py"
+    script.write_text(_BUILD)
+    try:
+        names = {}
+        for kind in ("sdist", "wheel"):
+            proc = subprocess.run(
+                [sys.executable, str(script), str(out), kind],
+                capture_output=True, text=True, cwd=REPO, timeout=300)
+            assert proc.returncode == 0, proc.stderr[-3000:]
+            names[kind] = os.path.join(
+                str(out), proc.stdout.strip().splitlines()[-1])
+    finally:
+        import shutil
+        for path in planted:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        for path in side_effects:
+            shutil.rmtree(path, ignore_errors=True)
+    sdist_names = tarfile.open(names["sdist"]).getnames()
+    wheel_names = zipfile.ZipFile(names["wheel"]).namelist()
+    return sdist_names, wheel_names
+
+
+@pytest.mark.slow
+class TestDistributionContents:
+    def test_no_audit_artifact_in_sdist(self, dists):
+        sdist_names, _ = dists
+        leaked = [n for n in sdist_names
+                  if os.path.basename(n) in FORBIDDEN]
+        assert leaked == [], f"audit artifacts in sdist: {leaked}"
+
+    def test_no_audit_artifact_in_wheel(self, dists):
+        _, wheel_names = dists
+        leaked = [n for n in wheel_names
+                  if os.path.basename(n) in FORBIDDEN]
+        assert leaked == [], f"audit artifacts in wheel: {leaked}"
+
+    def test_no_sarif_or_log_anywhere(self, dists):
+        sdist_names, wheel_names = dists
+        bad = [n for n in sdist_names + wheel_names
+               if n.endswith((".sarif", ".log"))]
+        assert bad == []
+
+    def test_tuner_defaults_ship_in_wheel(self, dists):
+        _, wheel_names = dists
+        assert any(n.endswith("paddle_tpu/tuner/default_winners.json")
+                   for n in wheel_names), \
+            "default_winners.json missing from wheel — cold installs " \
+            "would lose the committed autotuner tier"
+
+    def test_bench_audit_baseline_not_in_wheel(self, dists):
+        # repo-root CI fixture, not a runtime file
+        _, wheel_names = dists
+        assert not any(n.endswith("bench_audit_baseline.json")
+                       for n in wheel_names)

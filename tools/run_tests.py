@@ -53,8 +53,11 @@ def main():
     ap.add_argument("--bench-check", action="store_true",
                     help="opt-in gate: compare the two newest BENCH_*.json "
                          "via tools/check_bench_regression.py and fail on "
-                         "a >5%% throughput drop (same contract as the "
-                         "analyzer gate)")
+                         "a >5%% throughput drop, then run the PTA009 "
+                         "bench-audit gate (tools/check_audit_regression"
+                         ".py) against bench_audit_baseline.json — new "
+                         "host transfers / fusion breaks on the bench "
+                         "step paths fail without spending chip time")
     ap.add_argument("--bench-router", action="store_true",
                     help="opt-in gate: run tools/bench_router.py "
                          "--check-recompiles and fail if any replica "
@@ -101,6 +104,19 @@ def main():
                                           "check_bench_regression.py")],
             cwd=REPO)
         print(f"bench check: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+        # PTA009 audit gate: traces the bench step paths on CPU and fails
+        # on new host transfers / retraces / copy-fraction growth vs the
+        # committed baseline — catches the CAUSE of a throughput drop
+        # before a TPU round measures the effect.
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, os.path.join("tools",
+                                          "check_audit_regression.py")],
+            cwd=REPO, env=env)
+        print(f"bench audit gate: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
