@@ -97,5 +97,6 @@ def load_default_entrypoints() -> Dict[str, AuditEntrypoint]:
     from ..static import executor as _executor         # noqa: F401
     from ..serving import engine as _engine            # noqa: F401
     from ..serving.llm import decode as _decode        # noqa: F401
+    from ..serving.llm import spec as _spec            # noqa: F401
     from ..models import bench_audit as _bench_audit   # noqa: F401
     return entrypoints()

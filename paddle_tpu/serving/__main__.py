@@ -78,6 +78,32 @@ def main(argv=None) -> int:
                     help="default generation budget per request")
     lv.add_argument("--no-warmup", action="store_true",
                     help="skip the ahead-of-time decode/prefill compiles")
+    lv.add_argument("--prefix-cache", action="store_true",
+                    help="enable cross-request prefix KV reuse (repeated "
+                         "prompt prefixes skip their share of prefill)")
+    lv.add_argument("--prefix-capacity-mb", type=float, default=256.0,
+                    help="host-RAM byte budget for the prefix KV store")
+    lv.add_argument("--spec-k", type=int, default=0,
+                    help="draft tokens proposed per speculative decode "
+                         "tick (0 disables speculative decoding)")
+    lv.add_argument("--spec-draft-scale", type=int, default=4,
+                    help="draft model shrink factor vs the target "
+                         "(GPTConfig.draft); used when --spec-k > 0")
+    lv.add_argument("--draft-state-dict", default=None,
+                    help="framework_io.save'd state dict for the draft "
+                         "model (omit for random draft weights — "
+                         "acceptance will be ~0; smoke tests only)")
+    lv.add_argument("--roles", default="",
+                    help="comma-separated per-replica roles "
+                         "(prefill|decode|mixed), one per --replicas: "
+                         "disaggregated prefill/decode fleet with KV "
+                         "handoff through a shared prefix store")
+    lv.add_argument("--prefill-threshold", type=int, default=64,
+                    help="prompts with at least this many tokens are "
+                         "routed as prefill-phase")
+    lv.add_argument("--no-handoff", action="store_true",
+                    help="disable the prefill->decode KV handoff (role "
+                         "routing only)")
     args = ap.parse_args(argv)
 
     if args.cmd == "serve-llm":
@@ -133,11 +159,12 @@ def _serve_llm(args) -> int:
     from .http import serve_forever
     from .llm import LLMEngine, LLMEngineConfig
 
-    model = GPTForCausalLM(GPTConfig(
+    gcfg = GPTConfig(
         vocab_size=args.vocab_size, hidden_size=args.hidden_size,
         num_layers=args.num_layers, num_heads=args.num_heads,
         max_position_embeddings=args.max_positions,
-        hidden_dropout_prob=0.0, attention_dropout_prob=0.0))
+        hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    model = GPTForCausalLM(gcfg)
     model.eval()
     if args.state_dict:
         from .. import framework_io
@@ -146,12 +173,27 @@ def _serve_llm(args) -> int:
         print("paddle_tpu.serving: WARNING serving a randomly initialized "
               "model (--state-dict not given)", flush=True)
 
+    draft = None
+    if args.spec_k > 0:
+        draft = GPTForCausalLM(gcfg.draft(args.spec_draft_scale))
+        draft.eval()
+        if args.draft_state_dict:
+            from .. import framework_io
+            draft.set_state_dict(framework_io.load(args.draft_state_dict))
+        else:
+            print("paddle_tpu.serving: WARNING speculative draft model is "
+                  "randomly initialized (--draft-state-dict not given); "
+                  "acceptance will be ~0", flush=True)
+
     cfg = LLMEngineConfig(
         num_slots=args.num_slots, max_seq=args.max_seq,
         prefill_buckets=_parse_int_list(args.prefill_buckets) or None,
         max_queue=args.max_queue, default_deadline=args.deadline_s,
         default_max_new_tokens=args.max_new_tokens,
-        warmup=not args.no_warmup)
+        warmup=not args.no_warmup,
+        prefix_cache=args.prefix_cache,
+        prefix_capacity_mb=args.prefix_capacity_mb,
+        spec_k=args.spec_k)
 
     def _ready(httpd):
         host, port = httpd.server_address[:2]
@@ -161,14 +203,29 @@ def _serve_llm(args) -> int:
         # machine-readable line for --port 0 callers (supervisors, tests)
         print(f"PADDLE_TPU_SERVING_PORT={port}", flush=True)
 
-    if args.replicas > 1 or args.model_parallel > 1:
+    roles = [r.strip() for r in args.roles.split(",") if r.strip()] or None
+    if args.replicas > 1 or args.model_parallel > 1 or roles:
         from .router import Router, RouterConfig, llm_replica_factory
         axes = ({"model": args.model_parallel}
                 if args.model_parallel > 1 else None)
+        shared_store = None
+        if args.prefix_cache or roles:
+            # ONE store across replicas: prefix hits survive replica
+            # hops, and it is the prefill->decode KV handoff channel
+            from .llm import PrefixStore
+            shared_store = PrefixStore(
+                capacity_bytes=int(args.prefix_capacity_mb * (1 << 20)),
+                block_tokens=cfg.prefix_block)
         router = Router(
-            llm_replica_factory(lambda replica: model, cfg),
+            llm_replica_factory(
+                lambda replica: model, cfg, roles=roles,
+                prefix_store=shared_store,
+                draft_model_factory=(
+                    (lambda replica: draft) if draft is not None else None)),
             RouterConfig(num_replicas=args.replicas, model_axes=axes,
-                         kind="llm"))
+                         kind="llm", roles=roles,
+                         prefill_threshold=args.prefill_threshold,
+                         handoff=not args.no_handoff))
         router.install_drain_signal_handler()
         serve_forever(None, args.host, args.port, quiet=False,
                       ready_cb=_ready, router=router)
@@ -176,7 +233,7 @@ def _serve_llm(args) -> int:
         print("paddle_tpu.serving: drained, bye", flush=True)
         return 0
 
-    engine = LLMEngine(model, cfg)
+    engine = LLMEngine(model, cfg, draft_model=draft)
     engine.install_drain_signal_handler()
 
     serve_forever(None, args.host, args.port, quiet=False, ready_cb=_ready,

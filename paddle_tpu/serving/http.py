@@ -92,7 +92,12 @@ class _Handler(BaseHTTPRequestHandler):
             elif draining:
                 self._send_json(503, {"status": "draining"})
             else:
-                self._send_json(200, {"status": "ok"})
+                payload = {"status": "ok"}
+                if llm is not None:
+                    # disaggregated fleets route by this (prefill/decode/
+                    # mixed); load balancers can match phase to role
+                    payload["role"] = llm.role
+                self._send_json(200, payload)
         elif self.path == "/statsz":
             payload = engine.stats() if engine is not None else {}
             if llm is not None:

@@ -45,6 +45,8 @@ from .kvcache import StaticKVCache  # noqa: F401
 from .decode import (  # noqa: F401
     GPTDecodeSpec, GPTStaticDecoder, SamplingParams, extract_gpt_params,
     pack_sampling)
+from .prefix import PrefixEntry, PrefixStore, chain_hashes  # noqa: F401
+from .spec import GPTSpecDecoder  # noqa: F401
 from .scheduler import (  # noqa: F401
     ContinuousBatcher, GenerationRequest, LLMEngine, LLMEngineConfig)
 
@@ -52,4 +54,5 @@ __all__ = [
     "StaticKVCache", "GPTDecodeSpec", "GPTStaticDecoder", "SamplingParams",
     "extract_gpt_params", "pack_sampling", "ContinuousBatcher",
     "GenerationRequest", "LLMEngine", "LLMEngineConfig",
+    "PrefixStore", "PrefixEntry", "chain_hashes", "GPTSpecDecoder",
 ]

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 
 from ..cache import ExecutableCache, default_cache
 from .kvcache import StaticKVCache, append_token_kv, valid_mask, \
-    write_prompt_kv
+    write_prompt_kv, write_prompt_kv_at
 
 
 @dataclass(frozen=True)
@@ -312,6 +312,139 @@ def get_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
     return fn
 
 
+def build_tail_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
+    """The RAW (un-jitted) tail prefill — prefill a prompt *suffix* into a
+    slot whose first ``starts[i]`` rows were bulk-copied from the prefix
+    store. Queries attend over the slot's FULL cache row (cached prefix +
+    freshly written tail) under an offset-causal mask, so the produced
+    hidden states — and therefore the first sampled token — are bitwise
+    what a full prefill of the whole prompt would produce: masked
+    positions contribute exactly-0.0 softmax weight (same -1e9 additive
+    mask as the dense path), and row-wise dot products contract in the
+    same order regardless of the extra zero-weight columns.
+    """
+    scale = 1.0 / np.sqrt(spec.head_dim)
+    max_pos = spec.max_position_embeddings
+
+    def _tail(params, tokens, tail_lens, starts, kbuf, vbuf, lengths,
+              finished, slot_ids, temperature, top_k, do_sample, eos, key):
+        # tokens: [B, Lt] right-padded tails; tail_lens: [B] true tail
+        # counts; starts: [B] reuse offsets (block multiples).
+        b, lt = tokens.shape
+        max_seq = kbuf.shape[2]
+        pos = starts[:, None] + jnp.arange(lt, dtype=jnp.int32)[None]
+        posc = jnp.clip(pos, 0, max_pos - 1)
+        h = params["tok"][tokens] + params["pos"][posc]        # [B, Lt, E]
+        # offset-causal over the whole row: tail query i (absolute
+        # position starts+i) sees cache rows j <= starts+i — the reused
+        # prefix plus the tail K/V written below (its own row included)
+        j = jnp.arange(max_seq, dtype=jnp.int32)[None, None]
+        mask = jnp.where(j <= pos[:, :, None], 0.0,
+                         -1e9).astype(h.dtype)[:, None]        # [B,1,Lt,max]
+        kcs, vcs = [], []
+        for li, lp in enumerate(params["layers"]):
+            x = _layer_norm(h, lp["n1w"], lp["n1b"], spec.ln_epsilon)
+
+            def heads(t):
+                return t.reshape(b, lt, spec.num_heads, spec.head_dim)
+
+            q = heads(x @ lp["qw"] + lp["qb"])
+            kn = heads(x @ lp["kw"] + lp["kb"])
+            vn = heads(x @ lp["vw"] + lp["vb"])
+            # attention reads the gathered slot rows with the fresh tail
+            # K/V spliced in; the buffers themselves are written once,
+            # after the layer loop, via ONE update per request
+            row_k = kbuf[slot_ids, li]                         # [B,max,H,D]
+            row_v = vbuf[slot_ids, li]
+
+            def _splice(row, new, st):
+                return jax.lax.dynamic_update_slice(row, new, (st, 0, 0))
+
+            row_k = jax.vmap(_splice)(row_k, kn, starts)
+            row_v = jax.vmap(_splice)(row_v, vn, starts)
+            qh = jnp.transpose(q * scale, (0, 2, 1, 3))        # [B,H,Lt,D]
+            kt = jnp.transpose(row_k, (0, 2, 1, 3))            # [B,H,max,D]
+            vt = jnp.transpose(row_v, (0, 2, 1, 3))
+            prod = jnp.matmul(qh, jnp.swapaxes(kt, -1, -2))    # [B,H,Lt,max]
+            weights = jax.nn.softmax(prod + mask, axis=-1)
+            out = jnp.matmul(weights, vt)                      # [B,H,Lt,D]
+            out = jnp.transpose(out, (0, 2, 1, 3)).reshape(
+                b, lt, spec.hidden_size)
+            h = h + (out @ lp["ow"] + lp["ob"])
+            x = _layer_norm(h, lp["n2w"], lp["n2b"], spec.ln_epsilon)
+            ffn = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
+            h = h + (ffn @ lp["w2"] + lp["b2"])
+            kcs.append(kn)
+            vcs.append(vn)
+        kbuf, vbuf = write_prompt_kv_at(
+            kbuf, vbuf, jnp.stack(kcs, axis=1), jnp.stack(vcs, axis=1),
+            slot_ids, starts)
+        lengths = lengths.at[slot_ids].set(starts + tail_lens)
+        h = _layer_norm(h, params["fnw"], params["fnb"], spec.ln_epsilon)
+        last = jnp.take_along_axis(
+            h, (tail_lens - 1)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]                                      # [B, E]
+        lraw = (last @ params["tok"].T).astype(jnp.float32)
+        nxt = _sample(lraw, temperature, top_k, do_sample, key, max_top_k)
+        finished = finished.at[slot_ids].set((nxt == eos) & (eos >= 0))
+        return kbuf, vbuf, lengths, finished, nxt
+
+    return _tail
+
+
+@functools.lru_cache(maxsize=64)
+def get_tail_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
+    """Bucketed *tail* prefill for prefix-cache hits: same contract as
+    :func:`get_prefill_fn` plus a per-request ``starts`` offset vector.
+    One trace per (batch, tail_bucket) shape.
+
+    tail_prefill(params, tokens[B, Lt], tail_lens[B], starts[B], kbuf,
+                 vbuf, lengths, finished, slot_ids[B], temperature[B],
+                 top_k[B], do_sample[B], eos[B], key)
+      -> (kbuf, vbuf, lengths, finished, next_tokens[B])
+    """
+    counter = {"traces": 0}
+    raw = build_tail_prefill_fn(spec, max_top_k)
+
+    def _tail(*args):
+        counter["traces"] += 1
+        return raw(*args)
+
+    fn = jax.jit(_tail)
+    fn.trace_counter = counter
+    return fn
+
+
+def build_insert_prefix_fn():
+    """The RAW prefix bulk-copy: land a cached ``[L, n, H, D]`` prefix
+    into one slot's rows [0, n) — ONE batched ``dynamic_update_slice``
+    per buffer across all layers (the tentpole's no-per-layer-host-loop
+    invariant lives here)."""
+
+    def _insert(kbuf, vbuf, k_pre, v_pre, slot):
+        return write_prompt_kv_at(kbuf, vbuf, k_pre[None], v_pre[None],
+                                  jnp.asarray([slot], jnp.int32),
+                                  jnp.asarray([0], jnp.int32))
+
+    return _insert
+
+
+@functools.lru_cache(maxsize=8)
+def get_insert_prefix_fn():
+    """Jitted prefix bulk-copy; retraces only per distinct prefix-row
+    count (block multiples — a small closed set)."""
+    counter = {"traces": 0}
+    raw = build_insert_prefix_fn()
+
+    def _insert(*args):
+        counter["traces"] += 1
+        return raw(*args)
+
+    fn = jax.jit(_insert)
+    fn.trace_counter = counter
+    return fn
+
+
 def pack_sampling(params_list: Sequence[SamplingParams]):
     """Host-side SamplingParams -> the per-slot device vectors the compiled
     step consumes (eos -1 disables eos handling for that slot)."""
@@ -394,6 +527,25 @@ class GPTStaticDecoder:
             self._key + ("prefill", batch, prompt_len),
             lambda: get_prefill_fn(self.spec, self.max_top_k))
 
+    def tail_prefill_fn(self, batch: int, tail_len: int):
+        return self.exec_cache.get_or_compile(
+            self._key + ("tail_prefill", batch, tail_len),
+            lambda: get_tail_prefill_fn(self.spec, self.max_top_k))
+
+    def insert_prefix_fn(self, prefix_len: int):
+        return self.exec_cache.get_or_compile(
+            self._key + ("insert_prefix", prefix_len),
+            lambda: get_insert_prefix_fn())
+
+    def prefix_sig(self, kv: StaticKVCache):
+        """The shape signature a PrefixStore entry must match to be
+        copyable into this decoder's cache (max_seq deliberately NOT part
+        of it — a prefix exported from a larger-max_seq engine reuses
+        fine in a smaller slot as long as it fits, which the scheduler's
+        reuse cap guarantees)."""
+        return (self.spec.num_layers, self.spec.num_heads,
+                self.spec.head_dim, str(kv.dtype))
+
     # -- convenience wrappers ------------------------------------------------
     def prefill(self, kv: StaticKVCache, params, tokens, true_lens,
                 slot_ids, finished, samp_vecs, key):
@@ -406,6 +558,27 @@ class GPTStaticDecoder:
             slot_ids, *samp_vecs, key)
         kv.swap(k, v, lengths)
         return nxt, finished
+
+    def tail_prefill(self, kv: StaticKVCache, params, tokens, tail_lens,
+                     starts, slot_ids, finished, samp_vecs, key):
+        """Prefill prompt *tails* at per-request offsets (after an
+        :meth:`insert_prefix` landed the cached head); same return shape
+        as :meth:`prefill`."""
+        fn = self.tail_prefill_fn(tokens.shape[0], tokens.shape[1])
+        k, v, lengths, finished, nxt = fn(
+            params, tokens, tail_lens, starts, kv.k, kv.v, kv.lengths,
+            finished, slot_ids, *samp_vecs, key)
+        kv.swap(k, v, lengths)
+        return nxt, finished
+
+    def insert_prefix(self, kv: StaticKVCache, k_pre, v_pre, slot: int):
+        """Bulk-copy a cached host prefix ``[L, n, H, D]`` into ``slot``'s
+        rows [0, n) — one batched device update across all layers. The
+        slot's length is set by the tail prefill that follows."""
+        fn = self.insert_prefix_fn(int(k_pre.shape[1]))
+        k, v = fn(kv.k, kv.v, jnp.asarray(k_pre, dtype=kv.dtype),
+                  jnp.asarray(v_pre, dtype=kv.dtype), slot)
+        kv.swap(k, v, kv.lengths)
 
     def decode_step(self, kv: StaticKVCache, params, finished, last_tokens,
                     samp_vecs, key):
@@ -426,11 +599,11 @@ _AUDIT_SPEC = GPTDecodeSpec(vocab_size=32, hidden_size=8, num_layers=1,
 _AUDIT_TOP_K = 4
 
 
-def _audit_params(rng):
+def _audit_params(rng, spec: GPTDecodeSpec = _AUDIT_SPEC):
     """A synthetic tiny GPT parameter pytree matching extract_gpt_params'
     layout; values vary with the rng so PTA010's perturbed variants share
-    shapes but not data."""
-    spec = _AUDIT_SPEC
+    shapes but not data. ``spec`` must be single-layer (the audit
+    entrypoints all are); spec.py reuses this for its draft pytree."""
     e, v, p = spec.hidden_size, spec.vocab_size, spec.max_position_embeddings
 
     def arr(*shape):

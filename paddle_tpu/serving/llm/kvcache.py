@@ -149,6 +149,17 @@ class StaticKVCache:
         fetched)."""
         return np.asarray(jax.device_get(self.lengths))  # noqa: PTA002 -- deliberate observability fetch (tests, /statsz); the tick loop never calls this
 
+    def host_slot_kv(self, slot: int, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """One deliberate device->host copy of a slot's first ``n`` K/V
+        rows as ``[num_layers, n, heads, head_dim]`` host arrays — the
+        prefix-store export path. Called once per *admission* (after a
+        prefill populated the rows), never on the per-tick path."""
+        if not (0 <= slot < self.num_slots) or not (0 < n <= self.max_seq):
+            raise ValueError(f"bad prefix export slot={slot} n={n}")
+        k = np.asarray(jax.device_get(self.k[slot, :, :n]))  # noqa: PTA002 -- admission-time prefix-store export (one copy per admitted prompt); never on the per-tick path
+        v = np.asarray(jax.device_get(self.v[slot, :, :n]))  # noqa: PTA002 -- admission-time prefix-store export; paired with the K fetch above
+        return k, v
+
     def __repr__(self):
         return (f"StaticKVCache(slots={self.num_slots}, "
                 f"layers={self.num_layers}, max_seq={self.max_seq}, "
@@ -176,6 +187,40 @@ def append_token_kv(kb, vb, k_new, v_new, positions):
                 jax.lax.dynamic_update_slice(row_v, vn[None], start))
 
     return jax.vmap(_one)(kb, vb, k_new, v_new, positions)
+
+
+def append_tokens_kv(kb, vb, k_new, v_new, positions):
+    """Multi-token generalisation of :func:`append_token_kv`: write T new
+    tokens' K/V per slot starting at that slot's position (the speculative
+    verify step lands its k+1 candidate rows with this).
+
+    ``kb``/``vb``: ``[S, max_seq, H, D]``; ``k_new``/``v_new``:
+    ``[S, T, H, D]``; ``positions``: ``[S]`` int32. Same vmapped
+    ``lax.dynamic_update_slice`` shape as the single-token writer, so XLA
+    lowers it to one scatter per buffer.
+    """
+    def _one(row_k, row_v, kn, vn, pos):
+        # row_*: [max_seq, H, D]; kn/vn: [T, H, D]
+        start = (pos, 0, 0)
+        return (jax.lax.dynamic_update_slice(row_k, kn, start),
+                jax.lax.dynamic_update_slice(row_v, vn, start))
+
+    return jax.vmap(_one)(kb, vb, k_new, v_new, positions)
+
+
+def write_prompt_kv_at(k_buf, v_buf, k_new, v_new, slot_ids, starts):
+    """Write K/V rows into slots at per-request offsets — the
+    prefix-reuse writer. ``k_new``/``v_new``: ``[B, L_layers, L, H, D]``;
+    ``starts``: length-B offsets (0 == :func:`write_prompt_kv`). ONE
+    batched ``dynamic_update_slice`` per request covers all layers at
+    once — no per-layer host loop, the tentpole invariant for prefix
+    bulk-copy."""
+    b = k_new.shape[0]
+    for i in range(b):
+        start = (slot_ids[i], 0, starts[i], 0, 0)
+        k_buf = jax.lax.dynamic_update_slice(k_buf, k_new[i][None], start)
+        v_buf = jax.lax.dynamic_update_slice(v_buf, v_new[i][None], start)
+    return k_buf, v_buf
 
 
 def write_prompt_kv(k_buf, v_buf, k_prompt, v_prompt, slot_ids):

@@ -1,0 +1,252 @@
+"""PrefixStore: cross-request prefix KV reuse for the static-slot decoder.
+
+Chatbot traffic at scale shares system prompts: two requests whose first
+N tokens are identical compute *identical* K/V rows for those N
+positions (causal attention never looks right), so the second prefill is
+pure waste. This store keeps completed prompts' K/V on host, keyed by a
+**block chain hash** over the token prefix, and the scheduler bulk-copies
+the longest cached prefix into a fresh slot on admission — one batched
+``lax.dynamic_update_slice`` across all layers (see
+``kvcache.write_prompt_kv_at``) — then prefills only the uncached tail
+bucket. LazyTensor's async-dispatch discipline (arxiv 2102.13267) is the
+design anchor: the store lives entirely off the per-tick path; its only
+device traffic is one admission-time insert copy and one admission-time
+export copy.
+
+Layout and hash scheme
+----------------------
+
+Tokens are grouped into fixed ``block_tokens`` blocks. The chain hash of
+block *i* is ``H(chain[i-1] || tokens[i*B:(i+1)*B])`` — a hash over the
+*entire* prefix, so equal chain values identify equal token prefixes
+(verified byte-for-byte on lookup anyway; hashes only prune the search).
+An entry stores host numpy K/V ``[num_layers, n_tokens, heads,
+head_dim]`` for one block-aligned prefix and is indexed under *every*
+intermediate chain point, so a new prompt sharing only the first 2 of an
+entry's 4 blocks still hits (and reuses ``entry.k[:, :2 * B]``).
+
+Eviction is LRU by last hit under a byte capacity; entries pinned by an
+in-flight request (``refs > 0``) are never evicted — the router's
+prefill->decode KV handoff pins the entry on the prefill replica until
+the decode replica has consumed it.
+
+Thread safety: lookups/inserts run on engine worker threads and (for the
+handoff) the router's dispatch threads; every mutable structure is
+guarded by ``self._lock``.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...core import monitor as _mon
+
+#: shape signature an entry must match to be reusable by a decoder:
+#: (num_layers, num_heads, head_dim, dtype_str)
+ShapeSig = Tuple[int, int, int, str]
+
+
+def chain_hashes(tokens: np.ndarray, block: int) -> List[bytes]:
+    """Chain hash per complete block: ``out[i]`` identifies the token
+    prefix ``tokens[: (i + 1) * block]``."""
+    out: List[bytes] = []
+    prev = b""
+    n = (len(tokens) // block) * block
+    arr = np.asarray(tokens[:n], dtype=np.int32)  # noqa: PTA002 -- hashes the caller's host-side prompt tokens, no device value involved
+    for i in range(n // block):
+        blk = arr[i * block:(i + 1) * block].tobytes()
+        prev = hashlib.sha1(prev + blk).digest()
+        out.append(prev)
+    return out
+
+
+class PrefixEntry:
+    """One cached block-aligned prefix: immutable payload; the store owns
+    the mutable refcount / recency bookkeeping (under its lock)."""
+
+    __slots__ = ("key", "tokens", "k", "v", "n_tokens", "nbytes", "sig")
+
+    def __init__(self, key: bytes, tokens: np.ndarray, k: np.ndarray,
+                 v: np.ndarray, sig: ShapeSig):
+        self.key = key
+        self.tokens = tokens
+        self.k = k
+        self.v = v
+        self.n_tokens = int(tokens.size)
+        self.nbytes = int(k.nbytes + v.nbytes)
+        self.sig = sig
+
+    def __repr__(self):
+        return (f"PrefixEntry(n_tokens={self.n_tokens}, "
+                f"nbytes={self.nbytes})")
+
+
+class PrefixStore:
+    """Ref-counted, capacity-bounded host store of prompt-prefix K/V."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 block_tokens: int = 16,
+                 registry: Optional[_mon.StatRegistry] = None,
+                 stat_prefix: str = "serving.llm.prefix"):
+        if block_tokens < 1:
+            raise ValueError(f"block_tokens must be >= 1, got {block_tokens}")
+        self.capacity_bytes = int(capacity_bytes)
+        self.block_tokens = int(block_tokens)
+        self._registry = registry if registry is not None \
+            else _mon.default_registry()
+        self._prefix = stat_prefix
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, PrefixEntry] = {}   # full-chain key
+        self._index: Dict[bytes, bytes] = {}           # chain point -> key
+        self._refs: Dict[bytes, int] = {}
+        self._last_hit: Dict[bytes, int] = {}
+        self._tick = 0                                  # recency clock
+        self._bytes = 0
+        self._stat_set("bytes", 0)
+        self._stat_set("entries", 0)
+
+    # -- stats ---------------------------------------------------------------
+    def _stat_add(self, name, v):
+        self._registry.add(f"{self._prefix}.{name}", v)
+
+    def _stat_set(self, name, v):
+        self._registry.set(f"{self._prefix}.{name}", v)
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "block_tokens": self.block_tokens,
+                "pinned": sum(1 for n in self._refs.values() if n > 0),
+            }
+
+    # -- pin / unpin ---------------------------------------------------------
+    def unpin(self, entry: PrefixEntry):
+        """Release one in-flight reference (eviction becomes possible at
+        refs == 0). Safe after the entry was evicted is impossible —
+        pinned entries are never evicted — but tolerate a double unpin
+        going negative-proof."""
+        with self._lock:
+            if entry.key in self._refs:
+                self._refs[entry.key] = max(0, self._refs[entry.key] - 1)
+
+    # -- lookup / insert -----------------------------------------------------
+    def lookup(self, tokens, max_tokens: int,
+               sig: ShapeSig) -> Tuple[Optional[PrefixEntry], int]:
+        """Longest cached prefix of ``tokens`` reusable at most
+        ``max_tokens`` tokens with a matching shape signature. A hit is
+        returned *pinned* (the caller owns one reference and must
+        :meth:`unpin` when its request leaves the engine) with the number
+        of reusable tokens (a block multiple <= max_tokens)."""
+        toks = np.asarray(tokens, dtype=np.int32).reshape(-1)  # noqa: PTA002 -- admission-time view of the caller's host-side prompt
+        nb_max = min(int(max_tokens), toks.size) // self.block_tokens
+        if nb_max < 1:
+            self._stat_add("misses", 1)
+            return None, 0
+        hashes = chain_hashes(toks, self.block_tokens)[:nb_max]
+        with self._lock:
+            for i in range(len(hashes) - 1, -1, -1):
+                key = self._index.get(hashes[i])
+                if key is None:
+                    continue
+                entry = self._entries.get(key)
+                n = (i + 1) * self.block_tokens
+                if entry is None or entry.sig != sig \
+                        or entry.n_tokens < n \
+                        or not np.array_equal(entry.tokens[:n], toks[:n]):
+                    continue
+                self._tick += 1
+                self._last_hit[key] = self._tick
+                self._refs[key] = self._refs.get(key, 0) + 1
+                self._stat_add("hits", 1)
+                self._stat_add("hit_tokens", n)
+                return entry, n
+        self._stat_add("misses", 1)
+        return None, 0
+
+    def insert(self, tokens, k: np.ndarray, v: np.ndarray,
+               sig: ShapeSig) -> Optional[PrefixEntry]:
+        """Store the K/V of a block-aligned prompt prefix (``k``/``v``:
+        host ``[L, n, H, D]`` with n a block multiple == len(tokens)).
+        Returns the entry *pinned* (caller unpins when its request leaves
+        the engine); dedups against an existing entry covering the same
+        chain. May evict LRU unpinned entries to fit the byte budget;
+        pinned entries are never evicted, so the store can transiently
+        exceed capacity under pin churn."""
+        toks = np.asarray(tokens, dtype=np.int32).reshape(-1)  # noqa: PTA002 -- admission-time view of the caller's host-side prompt
+        n = (toks.size // self.block_tokens) * self.block_tokens
+        if n < self.block_tokens:
+            return None
+        toks = toks[:n]
+        if k.shape[1] != n or v.shape[1] != n:
+            raise ValueError(
+                f"prefix K/V rows {k.shape[1]}/{v.shape[1]} != {n} tokens")
+        hashes = chain_hashes(toks, self.block_tokens)
+        key = hashes[-1]
+        with self._lock:
+            existing_key = self._index.get(key)
+            if existing_key is not None:
+                existing = self._entries.get(existing_key)
+                if existing is not None and existing.sig == sig \
+                        and existing.n_tokens >= n \
+                        and np.array_equal(existing.tokens[:n], toks):
+                    self._tick += 1
+                    self._last_hit[existing.key] = self._tick
+                    self._refs[existing.key] = \
+                        self._refs.get(existing.key, 0) + 1
+                    return existing
+            entry = PrefixEntry(key, toks,
+                                np.ascontiguousarray(k),   # noqa: PTA002 -- k/v are host numpy arrays by contract (kvcache.host_slot_kv already fetched them)
+                                np.ascontiguousarray(v),   # noqa: PTA002 -- see above; layout-normalizing host copy, no device value
+                                sig)
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            self._tick += 1
+            self._last_hit[key] = self._tick
+            self._refs[key] = 1
+            for h in hashes:
+                self._index[h] = key
+            # LRU-by-last-hit eviction down to capacity; pinned
+            # (refs > 0) entries are skipped — an in-flight prefix is
+            # never evicted. Inline so the lock scope is self-evident.
+            if self._bytes > self.capacity_bytes:
+                recency = dict(self._last_hit)
+                victims = sorted(
+                    (vk for vk, e in self._entries.items()
+                     if self._refs.get(vk, 0) == 0),
+                    key=lambda vk: recency.get(vk, 0))
+                for vk in victims:
+                    if self._bytes <= self.capacity_bytes:
+                        break
+                    victim = self._entries.pop(vk)
+                    self._bytes -= victim.nbytes
+                    self._refs.pop(vk, None)
+                    self._last_hit.pop(vk, None)
+                    stale = [h for h, k2 in self._index.items() if k2 == vk]
+                    for h in stale:
+                        del self._index[h]
+                    self._stat_add("evictions", 1)
+            self._stat_add("inserts", 1)
+            self._stat_set("bytes", self._bytes)
+            self._stat_set("entries", len(self._entries))
+            return entry
+
+    def __repr__(self):
+        with self._lock:
+            return (f"PrefixStore(entries={len(self._entries)}, "
+                    f"bytes={self._bytes}/{self.capacity_bytes}, "
+                    f"block={self.block_tokens})")

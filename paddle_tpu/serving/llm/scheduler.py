@@ -44,6 +44,8 @@ from ..request import (Deadline, DeadlineExceeded, EngineDraining,
                        RequestTooLarge)
 from .decode import GPTStaticDecoder, SamplingParams, pack_sampling
 from .kvcache import StaticKVCache
+from .prefix import PrefixStore
+from .spec import GPTSpecDecoder
 
 _REQ_IDS = itertools.count(1)
 _STREAM_END = object()
@@ -62,7 +64,7 @@ class GenerationRequest:
 
     __slots__ = ("req_id", "prompt", "sampling", "deadline", "future",
                  "t_enqueue", "t_first_token", "tokens", "finish_reason",
-                 "_stream_q", "_clock")
+                 "_stream_q", "_clock", "_prefix_entry", "_t_last")
 
     def __init__(self, prompt, sampling: SamplingParams,
                  deadline: Optional[Deadline] = None, stream: bool = False,
@@ -82,6 +84,10 @@ class GenerationRequest:
         self.tokens: List[int] = []
         self.finish_reason: Optional[str] = None
         self._stream_q = _pyqueue.Queue() if stream else None
+        # prefix-store pin held while this request is in flight (the
+        # batcher unpins on release/evict/abort) + inter-token clock
+        self._prefix_entry = None
+        self._t_last: Optional[float] = None
 
     @property
     def prompt_len(self) -> int:
@@ -159,6 +165,11 @@ class LLMEngineConfig:
                  warmup: bool = True,
                  seed: int = 0,
                  measure_mfu: bool = False,
+                 prefix_cache: bool = False,
+                 prefix_block: int = 16,
+                 prefix_capacity_mb: float = 256.0,
+                 spec_k: int = 0,
+                 role: str = "mixed",
                  stat_prefix: str = "serving.llm"):
         self.num_slots = int(num_slots)
         self.max_seq = int(max_seq)
@@ -183,6 +194,17 @@ class LLMEngineConfig:
         # opt-in: publish `serving.llm.mfu` from XLA cost analysis of the
         # decode step (costs one extra compile at the first tick)
         self.measure_mfu = bool(measure_mfu)
+        # disaggregated-fleet knobs (docs/serving.md "Disaggregated fleet")
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix_block = int(prefix_block)
+        self.prefix_capacity_mb = float(prefix_capacity_mb)
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self.spec_k = int(spec_k)          # 0 disables speculative decode
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be prefill/decode/mixed, got {role!r}")
+        self.role = role
         self.stat_prefix = stat_prefix
 
     @property
@@ -212,7 +234,9 @@ class ContinuousBatcher:
     """
 
     def __init__(self, decoder: GPTStaticDecoder, config: LLMEngineConfig,
-                 registry: _mon.StatRegistry, clock=time.monotonic):
+                 registry: _mon.StatRegistry, clock=time.monotonic,
+                 prefix_store: Optional[PrefixStore] = None,
+                 spec_decoder: Optional[GPTSpecDecoder] = None):
         self.decoder = decoder
         self.config = config
         self._registry = registry
@@ -220,6 +244,18 @@ class ContinuousBatcher:
         self._clock = clock
         self.kv = decoder.new_kv(config.num_slots, config.max_seq)
         self._params = decoder.params()
+        self.prefix_store = prefix_store
+        self.spec = spec_decoder
+        self.kv_draft: Optional[StaticKVCache] = None
+        self._draft_params = None
+        if spec_decoder is not None:
+            # draft cache mirrors the target's slot/position geometry; one
+            # shared lengths vector advances both in lockstep
+            self.kv_draft = spec_decoder.new_draft_kv(config.num_slots,
+                                                      config.max_seq)
+            self._draft_params = spec_decoder.draft_params()
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._reqs: Dict[int, GenerationRequest] = {}
         self._slot_samp: List[SamplingParams] = [
             SamplingParams() for _ in range(config.num_slots)]
@@ -272,14 +308,68 @@ class ContinuousBatcher:
         self._reqs[slot] = req
         self._slot_samp[slot] = req.sampling
         self._samp_vecs = pack_sampling(self._slot_samp)
-        lp = self.config.bucket_for(req.prompt_len)
-        padded = np.zeros((1, lp), np.int32)
-        padded[0, :req.prompt_len] = req.prompt
-        nxt, self._finished = self.decoder.prefill(
-            self.kv, self._params, jnp.asarray(padded),
-            jnp.asarray([req.prompt_len], jnp.int32),
-            jnp.asarray([slot], jnp.int32), self._finished,
-            pack_sampling([req.sampling]), self._next_key())
+        samp1 = pack_sampling([req.sampling])
+        slot_arr = jnp.asarray([slot], jnp.int32)
+        entry, reuse_n = None, 0
+        if self.prefix_store is not None:
+            # cap: at least one prompt token must prefill (logits source)
+            entry, reuse_n = self.prefix_store.lookup(
+                req.prompt, req.prompt_len - 1,
+                self.decoder.prefix_sig(self.kv))
+            # the PADDED tail bucket must fit behind the reused head —
+            # dynamic_update_slice clamps out-of-range starts, which would
+            # silently corrupt the reused rows. Shrink reuse block-wise
+            # until offset + tail_bucket fits (rarely more than one step).
+            while reuse_n > 0 and reuse_n + self.config.bucket_for(
+                    req.prompt_len - reuse_n) > self.config.max_seq:
+                reuse_n -= self.prefix_store.block_tokens
+            if entry is not None and reuse_n <= 0:
+                self.prefix_store.unpin(entry)
+                entry, reuse_n = None, 0
+        if reuse_n > 0:
+            # hit: bulk-copy the cached head, prefill only the tail bucket
+            self.decoder.insert_prefix(
+                self.kv, entry.k[:, :reuse_n], entry.v[:, :reuse_n], slot)
+            req._prefix_entry = entry       # stays pinned until release
+            tail = req.prompt[reuse_n:]
+            lt = self.config.bucket_for(int(tail.size))
+            padded = np.zeros((1, lt), np.int32)
+            padded[0, :tail.size] = tail
+            nxt, self._finished = self.decoder.tail_prefill(
+                self.kv, self._params, jnp.asarray(padded),
+                jnp.asarray([int(tail.size)], jnp.int32),
+                jnp.asarray([reuse_n], jnp.int32), slot_arr,
+                self._finished, samp1, self._next_key())
+            self._stat_add("prefix.reused_tokens", reuse_n)
+        else:
+            lp = self.config.bucket_for(req.prompt_len)
+            padded = np.zeros((1, lp), np.int32)
+            padded[0, :req.prompt_len] = req.prompt
+            nxt, self._finished = self.decoder.prefill(
+                self.kv, self._params, jnp.asarray(padded),
+                jnp.asarray([req.prompt_len], jnp.int32), slot_arr,
+                self._finished, samp1, self._next_key())
+            if self.prefix_store is not None:
+                # miss: export the block-aligned head for future requests
+                blk = self.prefix_store.block_tokens
+                n = (req.prompt_len // blk) * blk
+                if n >= blk:
+                    k_h, v_h = self.kv.host_slot_kv(slot, n)
+                    ins = self.prefix_store.insert(
+                        req.prompt[:n], k_h, v_h,
+                        self.decoder.prefix_sig(self.kv))
+                    if ins is not None:
+                        req._prefix_entry = ins
+        if self.spec is not None:
+            # the draft cache never reuses prefixes (the draft is cheap and
+            # its K/V is not stored); full-prompt prefill, keep K/V only
+            lp = self.config.bucket_for(req.prompt_len)
+            dpad = np.zeros((1, lp), np.int32)
+            dpad[0, :req.prompt_len] = req.prompt
+            self.spec.draft_prefill(
+                self.kv_draft, self._draft_params, jnp.asarray(dpad),
+                jnp.asarray([req.prompt_len], jnp.int32), slot_arr,
+                self.kv.lengths, self._finished, samp1, self._next_key())
         self._last = self._last.at[jnp.asarray([slot])].set(nxt)
         # The admission-time fetch of the first generated token: streaming
         # TTFT requires it on host, and it doubles as the finish probe.
@@ -289,17 +379,100 @@ class ContinuousBatcher:
         self._stat_observe("ttft_ms", (now - req.t_enqueue) * 1000.0)
         self._stat_add("prefills", 1)
         req._emit(tok)
+        req._t_last = now
         self._stat_add("tokens_generated", 1)
         self._maybe_finish(slot, req, tok)
 
     def tick(self) -> int:
-        """One decode tick: advance every slot one token through THE
-        compiled step, deliver tokens, retire finished slots. Returns the
-        number of active sequences advanced."""
+        """One decode tick: advance every slot through THE compiled step
+        (1 token plain, 1..k+1 speculative), deliver tokens, retire
+        finished slots. Returns the number of active sequences advanced."""
         if not self._reqs:
             return 0
+        if self.spec is not None:
+            if self._spec_room_ok():
+                with _otrace.span("serving.llm/spec_tick"):
+                    return self._spec_tick()
+            # near the end of a slot row there is no room for k+1
+            # candidate writes — run the plain one-token step instead
+            self._stat_add("spec.fallback_ticks", 1)
         with _otrace.span("serving.llm/decode_tick"):
             return self._tick_inner()
+
+    def _spec_room_ok(self) -> bool:
+        """True when every ACTIVE slot can absorb k+1 candidate K/V rows:
+        the next write position is ``prompt_len + len(tokens) - 1`` (the
+        last emitted token is not yet in cache) and the verify step lands
+        rows up to position + k."""
+        k = self.spec.k
+        for req in self._reqs.values():
+            pos = req.prompt_len + len(req.tokens) - 1
+            if pos + k + 1 > self.config.max_seq:
+                return False
+        return True
+
+    def _spec_tick(self) -> int:
+        t0 = self._clock()
+        self._finished, self._last, out_dev = self.spec.step(
+            self.kv, self.kv_draft, self._params, self._draft_params,
+            self._finished, self._last, self._samp_vecs, self._next_key())
+        # THE one host fetch of the tick: the packed [S, k+2]
+        # (count | tokens...) matrix — same budget as the plain tick's
+        # next-token vector, just wider.
+        out = np.asarray(jax.device_get(out_dev))  # noqa: PTA002 -- the single per-tick packed emit fetch; token streaming requires host delivery
+        n = len(self._reqs)
+        dt = max(self._clock() - t0, 1e-9)
+        total = 0
+        for slot, req in list(self._reqs.items()):
+            if req.expired:
+                self._evict(slot, req)
+                continue
+            n_emit = int(out[slot, 0])
+            toks = out[slot, 1:1 + n_emit]
+            if not req.sampling.do_sample:
+                # acceptance accounting is a greedy-lane concept; sampling
+                # slots take one verified token per tick by construction
+                self._spec_proposed += self.spec.k
+                self._spec_accepted += n_emit - 1
+                self._stat_add("spec.proposed", self.spec.k)
+                self._stat_add("spec.accepted", n_emit - 1)
+            total += self._emit_many(slot, req, toks)
+        self._stat_observe("decode_tick_ms", dt * 1000.0)
+        # per-token time: the tick advanced each slot by total/n tokens on
+        # average, so normalize to stay comparable with the plain tick
+        self._stat_observe("tpot_ms", dt * 1000.0 * n / max(1, total))
+        self._stat_add("tokens_generated", total)
+        self._stat_set("tokens_per_sec", total / dt)
+        self._stat_add("spec.ticks", 1)
+        if self._spec_proposed:
+            self._stat_set("spec.acceptance_rate",
+                           self._spec_accepted / self._spec_proposed)
+        return n
+
+    def _emit_many(self, slot: int, req: GenerationRequest, toks) -> int:
+        """Deliver a spec tick's emitted tokens in order, stopping at the
+        first finish condition (same eos-before-budget order as
+        :meth:`_maybe_finish`; surplus device-side tokens are discarded —
+        the slot is released, so the cache divergence is unobservable)."""
+        emitted = 0
+        now = self._clock()
+        if req._t_last is not None:
+            self._stat_observe("intertoken_ms",
+                               (now - req._t_last) * 1000.0)
+        req._t_last = now
+        s = req.sampling
+        for tok in toks:
+            tok = int(tok)
+            req._emit(tok)
+            emitted += 1
+            if s.eos_token_id is not None and tok == int(s.eos_token_id):
+                self._release(slot, req, "stop")
+                break
+            if len(req.tokens) >= s.max_new_tokens \
+                    or req.prompt_len + len(req.tokens) >= self.config.max_seq:
+                self._release(slot, req, "length")
+                break
+        return emitted
 
     def _tick_inner(self) -> int:
         if self.config.measure_mfu and self._decode_flops is None:
@@ -323,12 +496,17 @@ class ContinuousBatcher:
             # tick wall time includes the sanctioned token fetch, so this
             # is delivered MFU, not device-only MFU
             self._stat_set("mfu", self._decode_flops / dt / self._peak_flops)
+        now = self._clock()
         for slot, req in list(self._reqs.items()):
             if req.expired:
                 self._evict(slot, req)
                 continue
             tok = int(toks[slot])
             req._emit(tok)
+            if req._t_last is not None:
+                self._stat_observe("intertoken_ms",
+                                   (now - req._t_last) * 1000.0)
+            req._t_last = now
             self._maybe_finish(slot, req, tok)
         return n
 
@@ -358,9 +536,19 @@ class ContinuousBatcher:
         elif req.prompt_len + len(req.tokens) >= self.config.max_seq:
             self._release(slot, req, "length")
 
+    def _unpin_prefix(self, req: GenerationRequest):
+        """Drop the request's prefix-store pin (if any) the moment the
+        request leaves the engine — eviction of its entry becomes legal
+        again. Every exit path (release/evict/abort) funnels through
+        this."""
+        if req._prefix_entry is not None and self.prefix_store is not None:
+            self.prefix_store.unpin(req._prefix_entry)
+            req._prefix_entry = None
+
     def _release(self, slot: int, req: GenerationRequest, reason: str):
         del self._reqs[slot]
         self.kv.free(slot)
+        self._unpin_prefix(req)
         req._finish(reason)
         self._stat_add("completed", 1)
         self._stat_observe("request_latency_ms",
@@ -371,6 +559,7 @@ class ContinuousBatcher:
         future fails — a stalled consumer cannot pin a slot forever."""
         del self._reqs[slot]
         self.kv.free(slot)
+        self._unpin_prefix(req)
         req.fail(DeadlineExceeded(
             f"generation request {req.req_id} exceeded its "
             f"{req.deadline.seconds}s deadline after "
@@ -382,6 +571,7 @@ class ContinuousBatcher:
         for slot, req in list(self._reqs.items()):
             del self._reqs[slot]
             self.kv.free(slot)
+            self._unpin_prefix(req)
             req.fail(exc_factory(req))
 
     # -- warmup --------------------------------------------------------------
@@ -392,16 +582,45 @@ class ContinuousBatcher:
         lengths."""
         t0 = self._clock()
         samp = pack_sampling([SamplingParams()])
+        slot0 = jnp.asarray([0], jnp.int32)
         for lp in self.config.prefill_buckets:
             self.decoder.prefill(
                 self.kv, self._params, jnp.zeros((1, lp), jnp.int32),
-                jnp.asarray([lp], jnp.int32), jnp.asarray([0], jnp.int32),
+                jnp.asarray([lp], jnp.int32), slot0,
                 self._finished, samp, self._next_key())
+            if self.prefix_store is not None:
+                # one trace per tail bucket covers every reuse offset —
+                # `starts` is a traced device argument, not a shape
+                self.decoder.tail_prefill(
+                    self.kv, self._params, jnp.zeros((1, lp), jnp.int32),
+                    jnp.asarray([lp], jnp.int32),
+                    jnp.zeros((1,), jnp.int32), slot0,
+                    self._finished, samp, self._next_key())
+            if self.spec is not None:
+                self.spec.draft_prefill(
+                    self.kv_draft, self._draft_params,
+                    jnp.zeros((1, lp), jnp.int32),
+                    jnp.asarray([lp], jnp.int32), slot0, self.kv.lengths,
+                    self._finished, samp, self._next_key())
         nxt, _ = self.decoder.decode_step(
             self.kv, self._params, self._finished, self._last,
             self._samp_vecs, self._next_key())
+        if self.spec is not None:
+            # the spec step needs headroom for k+1 candidate rows; warmup
+            # state after the bucket loop has lengths == largest bucket,
+            # so reset first and trace against zeroed lengths
+            self.kv.reset()
+            self.kv_draft.reset()
+            _, _, out = self.spec.step(
+                self.kv, self.kv_draft, self._params, self._draft_params,
+                jnp.zeros((self.config.num_slots,), jnp.bool_),
+                jnp.zeros((self.config.num_slots,), jnp.int32),
+                self._samp_vecs, self._next_key())
+            out.block_until_ready()  # noqa: PTA002 -- warmup barrier: ensure compiles finish before serving starts
         nxt.block_until_ready()  # noqa: PTA002 -- warmup barrier: ensure compiles finish before serving starts
         self.kv.reset()
+        if self.kv_draft is not None:
+            self.kv_draft.reset()
         self._finished = jnp.zeros((self.config.num_slots,), jnp.bool_)
         self._last = jnp.zeros((self.config.num_slots,), jnp.int32)
         self._stat_set("warmup_ms", (self._clock() - t0) * 1000.0)
@@ -420,7 +639,9 @@ class LLMEngine(DrainableEngineBase):
     def __init__(self, model, config: Optional[LLMEngineConfig] = None,
                  registry: Optional[_mon.StatRegistry] = None,
                  cache: Optional[ExecutableCache] = None,
-                 mesh=None, slot_axis: str = "model"):
+                 mesh=None, slot_axis: str = "model",
+                 draft_model=None,
+                 prefix_store: Optional[PrefixStore] = None):
         self._config = config or LLMEngineConfig()
         self._init_serving_base(registry, self._config.stat_prefix)
         # `is not None`, not truthiness: an empty ExecutableCache has
@@ -432,8 +653,29 @@ class LLMEngine(DrainableEngineBase):
         self._decoder = GPTStaticDecoder(
             model, max_top_k=self._config.max_top_k, exec_cache=self._cache,
             mesh=mesh, slot_axis=slot_axis)
+        # prefix reuse: an explicit store (the disaggregated fleet shares
+        # ONE across replicas for the prefill->decode KV handoff) enables
+        # it even when the config flag is off
+        self._prefix_store = prefix_store
+        if self._prefix_store is None and self._config.prefix_cache:
+            self._prefix_store = PrefixStore(
+                capacity_bytes=int(
+                    self._config.prefix_capacity_mb * (1 << 20)),
+                block_tokens=self._config.prefix_block,
+                registry=self._registry,
+                stat_prefix=f"{self._config.stat_prefix}.prefix")
+        spec_decoder = None
+        if self._config.spec_k > 0:
+            if draft_model is None:
+                raise ValueError(
+                    "spec_k > 0 requires a draft_model (the small GPT "
+                    "that proposes candidate tokens)")
+            spec_decoder = GPTSpecDecoder(
+                self._decoder, draft_model, k=self._config.spec_k,
+                exec_cache=self._cache)
         self._batcher = ContinuousBatcher(
-            self._decoder, self._config, self._registry)
+            self._decoder, self._config, self._registry,
+            prefix_store=self._prefix_store, spec_decoder=spec_decoder)
         self._queue = BatchQueue(max_size=self._config.max_queue)
         if self._config.warmup:
             self._batcher.warmup()
@@ -454,6 +696,14 @@ class LLMEngine(DrainableEngineBase):
     @property
     def decoder(self) -> GPTStaticDecoder:
         return self._decoder
+
+    @property
+    def prefix_store(self) -> Optional[PrefixStore]:
+        return self._prefix_store
+
+    @property
+    def role(self) -> str:
+        return self._config.role
 
     def submit(self, prompt, *, max_new_tokens: Optional[int] = None,
                do_sample: bool = False, temperature: float = 1.0,
@@ -542,6 +792,10 @@ class LLMEngine(DrainableEngineBase):
             "slots": {"total": self._config.num_slots,
                       "in_use": self._batcher.active,
                       "free": self._batcher.free_slots},
+            "role": self._config.role,
+            "spec_k": self._config.spec_k,
+            "prefix_store": (self._prefix_store.stats()
+                             if self._prefix_store is not None else None),
         }
 
     # -- worker --------------------------------------------------------------

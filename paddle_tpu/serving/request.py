@@ -19,6 +19,15 @@ from ..utils.resilience import Deadline, DeadlineExceeded  # noqa: F401
 
 _REQ_IDS = itertools.count(1)
 
+#: Request-phase / replica-role taxonomy for the disaggregated LLM fleet
+#: (docs/serving.md "Disaggregated fleet"). A request is *prefill-phase*
+#: when its dominant cost is the prompt prefill (long prompt), otherwise
+#: *decode-phase*; a replica's role says which phases it serves ("mixed"
+#: serves both — the non-disaggregated default).
+PHASE_PREFILL = "prefill"
+PHASE_DECODE = "decode"
+REPLICA_ROLES = (PHASE_PREFILL, PHASE_DECODE, "mixed")
+
 
 class ServingError(RuntimeError):
     """Base class for serving-side rejections."""

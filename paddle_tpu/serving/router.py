@@ -25,6 +25,22 @@ Device math: with ``model_axes={"model": 4}`` and 8 visible devices,
 ``num_replicas=2`` gives each replica a 4-device sub-mesh — the 2×4
 replica-by-model layout. Without ``model_axes`` the pool is split evenly
 and replicas run single-device (mesh None).
+
+**Disaggregated prefill/decode fleet** (``kind="llm"`` with ``roles``):
+prompt prefill is a throughput-bound batch matmul while decode is a
+latency-bound single-token step; co-locating them makes every long-prompt
+admission stall the decode ticks of every other sequence on that replica.
+With ``roles=("prefill", "decode", ...)`` the router classifies each
+request by phase (prompt length >= ``prefill_threshold`` → prefill-phase)
+and dispatches it only to replicas whose role serves that phase ("mixed"
+serves both). When ``handoff`` is on and the replicas share ONE
+:class:`~paddle_tpu.serving.llm.PrefixStore` (see
+:func:`llm_replica_factory`'s ``prefix_store``), a prefill-phase request
+is first run as a 1-token warmup on a prefill-role replica — its
+admission exports the prompt's block-aligned K/V into the shared store —
+and the real request is then dispatched decode-phase: the decode
+replica's admission finds the prefix cached and prefills only the short
+tail, so its resident decode batch barely notices the long prompt.
 """
 from __future__ import annotations
 
@@ -37,7 +53,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ..core import monitor as _mon
 from ..distributed.elastic import ChainedSignalHandler, RestartBudget
 from .replica import DEAD, DRAINING, HEALTHY, Replica
-from .request import EngineDraining, ServingError
+from .request import (
+    PHASE_DECODE, PHASE_PREFILL, REPLICA_ROLES, EngineDraining, ServingError)
 
 
 class NoHealthyReplicas(ServingError):
@@ -59,12 +76,40 @@ class RouterConfig:
                  restart_backoff_cap: float = 30.0,
                  auto_resurrect: bool = True,
                  checkpoint_root: Optional[str] = None,
-                 stat_prefix: str = "serving.router"):
+                 stat_prefix: str = "serving.router",
+                 roles: Optional[Sequence[str]] = None,
+                 prefill_threshold: int = 64,
+                 handoff: bool = True,
+                 handoff_timeout: float = 30.0):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
         if kind not in ("classifier", "llm"):
             raise ValueError(
                 f"kind must be 'classifier' or 'llm', got {kind!r}")
+        if roles is not None:
+            roles = tuple(str(r) for r in roles)
+            if kind != "llm":
+                raise ValueError("roles= is only meaningful for kind='llm'")
+            if len(roles) != num_replicas:
+                raise ValueError(
+                    f"roles must name one role per replica: got "
+                    f"{len(roles)} roles for {num_replicas} replicas")
+            bad = [r for r in roles if r not in REPLICA_ROLES]
+            if bad:
+                raise ValueError(
+                    f"invalid roles {bad}; each must be one of "
+                    f"{REPLICA_ROLES}")
+            # a fleet that cannot serve one of the phases would reject
+            # every request of that phase at dispatch — fail at config time
+            for phase in (PHASE_PREFILL, PHASE_DECODE):
+                if not any(r in (phase, "mixed") for r in roles):
+                    raise ValueError(
+                        f"roles {roles} leave no replica serving the "
+                        f"{phase} phase (need at least one {phase!r} or "
+                        f"'mixed')")
+        if prefill_threshold < 1:
+            raise ValueError(
+                f"prefill_threshold must be >= 1, got {prefill_threshold}")
         self.num_replicas = int(num_replicas)
         self.model_axes = dict(model_axes) if model_axes else None
         self.kind = kind
@@ -76,6 +121,10 @@ class RouterConfig:
         self.auto_resurrect = bool(auto_resurrect)
         self.checkpoint_root = checkpoint_root
         self.stat_prefix = stat_prefix
+        self.roles = roles
+        self.prefill_threshold = int(prefill_threshold)
+        self.handoff = bool(handoff)
+        self.handoff_timeout = float(handoff_timeout)
 
 
 class Router:
@@ -166,9 +215,30 @@ class Router:
     def draining(self) -> bool:
         return self._draining.is_set()
 
-    def _pick(self, tried) -> Optional[Replica]:
+    def _role_of(self, replica_id: int) -> str:
+        roles = self._config.roles
+        return roles[replica_id] if roles is not None else "mixed"
+
+    def _phase_of(self, args, kwargs) -> Optional[str]:
+        """Classify an LLM request by phase: a prompt of
+        ``prefill_threshold`` or more tokens is prefill-dominated. Returns
+        None (no phase routing) for classifier routers, role-less configs,
+        or calls whose prompt cannot be measured."""
+        if self._config.kind != "llm" or self._config.roles is None:
+            return None
+        prompt = args[0] if args else kwargs.get("prompt")
+        try:
+            n = len(prompt)
+        except TypeError:
+            return None
+        return (PHASE_PREFILL if n >= self._config.prefill_threshold
+                else PHASE_DECODE)
+
+    def _pick(self, tried, phase: Optional[str] = None) -> Optional[Replica]:
         cands = [r for r in self.replicas
-                 if r.replica_id not in tried and r.admissible]
+                 if r.replica_id not in tried and r.admissible
+                 and (phase is None
+                      or self._role_of(r.replica_id) in (phase, "mixed"))]
         if not cands:
             return None
         low = min(r.outstanding for r in cands)
@@ -176,18 +246,38 @@ class Router:
         return mins[next(self._rr) % len(mins)]
 
     def submit(self, *args, **kwargs):
-        """Place one request on the least-loaded admissible replica.
-        Returns whatever that replica's engine returns (a Future for
-        classifier engines, a GenerationRequest for LLM engines). Retries
-        on a replica that starts draining between pick and submit; raises
-        :class:`NoHealthyReplicas` when no replica can take it."""
+        """Place one request on the least-loaded admissible replica whose
+        role serves the request's phase (every replica, for phase-less
+        routers). Returns whatever that replica's engine returns (a Future
+        for classifier engines, a GenerationRequest for LLM engines).
+        Retries on a replica that starts draining between pick and submit;
+        raises :class:`NoHealthyReplicas` when no replica can take it.
+
+        Prefill-phase requests go through the KV handoff when it is
+        enabled and the fleet shares a prefix store (see the module
+        docstring); otherwise they dispatch directly to a
+        prefill-serving replica."""
         if self._draining.is_set():
             self._registry.add(f"{self._prefix}.rejected_draining", 1)
             raise EngineDraining("router is draining; submit rejected")
+        phase = self._phase_of(args, kwargs)
+        if phase == PHASE_PREFILL and self._config.handoff \
+                and self._handoff_ready():
+            return self._handoff_submit(args, kwargs)
+        return self._dispatch(phase, args, kwargs)
+
+    def _dispatch(self, phase, args, kwargs):
         tried: set = set()
+        relaxed = phase is None
         while True:
-            r = self._pick(tried)
+            r = self._pick(tried, None if relaxed else phase)
             if r is None:
+                if not relaxed:
+                    # every phase-matched replica is out — availability
+                    # beats placement: serve from any admissible replica
+                    relaxed = True
+                    self._registry.add(f"{self._prefix}.phase_fallback", 1)
+                    continue
                 self._registry.add(f"{self._prefix}.rejected_no_replica", 1)
                 raise NoHealthyReplicas(
                     f"no admissible replica among {len(self.replicas)} "
@@ -199,7 +289,60 @@ class Router:
                 tried.add(r.replica_id)
                 continue
             self._registry.add(f"{self._prefix}.dispatched", 1)
+            if self._config.roles is not None:
+                self._registry.add(
+                    f"{self._prefix}.dispatched_role_"
+                    f"{self._role_of(r.replica_id)}", 1)
+                if phase is not None:
+                    self._registry.add(
+                        f"{self._prefix}.dispatched_phase_{phase}", 1)
             return out
+
+    # -- prefill/decode KV handoff -------------------------------------------
+    def _handoff_ready(self) -> bool:
+        """The handoff pays off only when a dedicated prefill replica and
+        a decode-serving replica share ONE PrefixStore object — otherwise
+        the prefilled K/V is invisible to the decode replica and the
+        warmup is pure waste."""
+        roles = self._config.roles
+        if roles is None or PHASE_PREFILL not in roles:
+            return False
+        stores = {}
+        for r in self.replicas:
+            store = getattr(r.engine, "prefix_store", None)
+            if store is not None:
+                stores[r.replica_id] = store
+        for rid, store in stores.items():
+            if roles[rid] != PHASE_PREFILL:
+                continue
+            for rid2, store2 in stores.items():
+                if rid2 != rid and roles[rid2] in (PHASE_DECODE, "mixed") \
+                        and store2 is store:
+                    return True
+        return False
+
+    def _handoff_submit(self, args, kwargs):
+        """KV handoff for a prefill-phase request: run a 1-token warmup
+        generation on a prefill-role replica — its admission exports the
+        prompt's block-aligned K/V into the SHARED prefix store — then
+        dispatch the real request decode-phase, where admission finds the
+        prefix cached and prefills only the tail. A failed or timed-out
+        warmup degrades gracefully: the decode replica prefills the whole
+        prompt itself (slower, never wrong)."""
+        prompt = args[0] if args else kwargs.get("prompt")
+        pre_kwargs = dict(kwargs)
+        pre_kwargs.pop("prompt", None)
+        pre_kwargs.update(max_new_tokens=1, stream=False, do_sample=False)
+        try:
+            pre = self._dispatch(PHASE_PREFILL, (prompt,), pre_kwargs)
+            pre.result(timeout=self._config.handoff_timeout)
+            self._registry.add(f"{self._prefix}.handoff_prefills", 1)
+        except Exception as e:
+            self._registry.add(f"{self._prefix}.handoff_failed", 1)
+            warnings.warn(
+                f"router: prefill handoff failed ({type(e).__name__}: "
+                f"{e}); the decode replica will prefill locally")
+        return self._dispatch(PHASE_DECODE, args, kwargs)
 
     # -- health loop ---------------------------------------------------------
     def _health_loop(self):
@@ -235,6 +378,12 @@ class Router:
                 h["queue_depth"])
             self._registry.set_labeled(
                 f"{self._prefix}.replica_restarts", labels, h["restarts"])
+            if self._config.roles is not None:
+                # assignment gauge: constant 1 per (replica, role) pair so
+                # dashboards can join per-replica series onto roles
+                self._registry.set_labeled(
+                    f"{self._prefix}.replica_role",
+                    {"replica": str(rid), "role": self._role_of(rid)}, 1)
             state = h["state"]
             if state == HEALTHY and not h["healthy"]:
                 warnings.warn(
@@ -321,6 +470,9 @@ class Router:
         """Aggregate health: ``ok`` (all healthy) / ``degraded`` (some) /
         ``unhealthy`` (none admissible) / ``draining``."""
         reps = [r.healthz() for r in self.replicas]
+        if self._config.roles is not None:
+            for rid, h in enumerate(reps):
+                h["role"] = self._role_of(rid)
         if self._draining.is_set():
             status = "draining"
         elif all(h["healthy"] for h in reps):
@@ -343,6 +495,8 @@ class Router:
             "stats": self._registry.stats_with_prefix(self._prefix + "."),
             "replicas": per,
             "num_replicas": len(self.replicas),
+            "roles": (list(self._config.roles)
+                      if self._config.roles is not None else None),
             "draining": self.draining,
             "total_dispatched": sum(dispatched),
             "balance_factor": balance,
@@ -390,21 +544,39 @@ def predictor_replica_factory(model_prefix: str,
 
 
 def llm_replica_factory(model_factory: Callable[[Replica], object],
-                        config=None) -> Callable[[Replica], object]:
+                        config=None, *,
+                        roles: Optional[Sequence[str]] = None,
+                        prefix_store=None,
+                        draft_model_factory: Optional[
+                            Callable[[Replica], object]] = None
+                        ) -> Callable[[Replica], object]:
     """Factory for LLM replicas: ``model_factory(replica)`` builds (or
     restores — ``replica.boot_checkpoint`` names the newest health-stamped
     checkpoint) the GPT model; each replica gets an
     :class:`~paddle_tpu.serving.llm.LLMEngine` over its sub-mesh with a
     per-replica stat prefix (the trailing-dot namespace fix in
     ``LLMEngine.stats`` is what keeps two of these from sharing
-    counters)."""
+    counters).
+
+    Disaggregation hooks: ``roles`` stamps ``config.role`` per replica
+    (pass the same sequence to :class:`RouterConfig` so routing and
+    engine stats agree); ``prefix_store`` is the ONE shared
+    :class:`~paddle_tpu.serving.llm.PrefixStore` every replica mounts —
+    the prefill→decode KV handoff channel; ``draft_model_factory`` builds
+    the speculative-decoding draft model for configs with ``spec_k > 0``.
+    """
     import copy
 
     def factory(replica: Replica):
         from .llm import LLMEngine, LLMEngineConfig
         cfg = copy.copy(config) if config is not None else LLMEngineConfig()
         cfg.stat_prefix = f"{cfg.stat_prefix}.replica{replica.replica_id}"
+        if roles is not None:
+            cfg.role = roles[replica.replica_id]
         model = model_factory(replica)
+        draft = (draft_model_factory(replica)
+                 if draft_model_factory is not None else None)
         return LLMEngine(model, cfg, registry=replica.registry,
-                         mesh=replica.mesh)
+                         mesh=replica.mesh, draft_model=draft,
+                         prefix_store=prefix_store)
     return factory

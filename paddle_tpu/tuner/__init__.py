@@ -119,6 +119,17 @@ def get_flash_blocks(q_len: int, kv_len: int, head_dim: int, dtype: str,
         return None
 
 
+def get_spec_verify_blocks(k: int, kv_len: int, head_dim: int,
+                           dtype: str = "float32"
+                           ) -> Optional[Tuple[int, int]]:
+    """Tuned (block_q, block_k) for a speculative *verify* step: k+1
+    candidate queries attending causally over a full kv row. The shape is
+    just a causal flash instance (q = k+1, canonicalised to the same
+    16-multiple families `flash_key` uses), so verify reuses the flash
+    winner memo instead of growing a new family."""
+    return get_flash_blocks(k + 1, kv_len, head_dim, dtype, causal=True)
+
+
 def get_nms_config(k: int) -> Optional[Dict[str, Any]]:
     return _resolve(nms_key(k))
 

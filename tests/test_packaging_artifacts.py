@@ -17,9 +17,10 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: gitignored audit/bench artifacts that must never reach a distribution
+#: gitignored audit/bench artifacts — plus review-process residue
+#: (REVIEW.md/VERDICT.md) — that must never reach a distribution
 FORBIDDEN = ("analysis.sarif", "trace_audit.json", "trace_audit_full.json",
-             ".pytest_shard_0.log")
+             ".pytest_shard_0.log", "REVIEW.md", "VERDICT.md")
 
 _BUILD = r"""
 import os, sys

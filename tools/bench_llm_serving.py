@@ -8,6 +8,19 @@ document (same shape as tools/bench_serving.py).
     python -m tools.bench_llm_serving --no-baseline      # skip the
                                                          # static-vs-concat
                                                          # comparison
+    python -m tools.bench_llm_serving --prefix-trace     # shared-prefix
+                                                         # reuse-on-vs-off
+                                                         # A/B (--check
+                                                         # gates it)
+
+The ``--prefix-trace`` mode replays ONE trace of prompts where
+``--shared-frac`` of the requests open with the same ``--shared-len``-token
+prefix (the few-shot/system-prompt pattern) through two fresh engines —
+prefix KV reuse on and off — and reports the store hit rate plus TTFT
+percentiles for both. Requests run one at a time so TTFT measures prefill
+cost, not queue depth. ``--check`` gates ``hit_rate >= 0.5`` and
+reuse-on TTFT p50 strictly below reuse-off (the tools/run_tests.py
+``--bench-llm`` stage).
 
 Each sweep drives ``--requests`` mixed-length prompts at the offered rate
 (requests/s; 0 = as fast as submission allows) through a fresh
@@ -99,6 +112,92 @@ def run_sweep(engine, requests, offered_qps, prompt_lens, max_new, vocab,
     }
 
 
+def _prefix_trace_prompts(requests, shared_frac, shared_len, tail_len,
+                          vocab, seed=0):
+    """One fixed trace: ``shared_frac`` of prompts = common prefix +
+    unique tail, the rest fully unique (same total length)."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    shared = rng.randint(0, vocab, size=shared_len).astype(np.int32)
+    prompts = []
+    for _ in range(requests):
+        if rng.rand() < shared_frac:
+            tail = rng.randint(0, vocab, size=tail_len).astype(np.int32)
+            prompts.append(np.concatenate([shared, tail]))
+        else:
+            prompts.append(rng.randint(
+                0, vocab, size=shared_len + tail_len).astype(np.int32))
+    return prompts
+
+
+def run_prefix_trace(model, prompts, max_new, num_slots, max_seq,
+                     reuse: bool):
+    """Replay ``prompts`` sequentially (submit → wait → next, so TTFT is
+    pure prefill + first-tick cost) through a fresh engine with prefix KV
+    reuse on or off; returns TTFT/wall numbers plus the store counters."""
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    reg = StatRegistry()
+    engine = LLMEngine(model, LLMEngineConfig(
+        num_slots=num_slots, max_seq=max_seq,
+        max_queue=max(1024, len(prompts)),
+        default_max_new_tokens=max_new,
+        prefix_cache=reuse), registry=reg)
+    t0 = time.monotonic()
+    for p in prompts:
+        engine.generate(p, max_new_tokens=max_new)
+    wall = time.monotonic() - t0
+    pre = engine.config.stat_prefix
+    hits = reg.get(f"{pre}.prefix.hits")
+    misses = reg.get(f"{pre}.prefix.misses")
+    out = {
+        "reuse": reuse,
+        "requests": len(prompts),
+        "wall_s": round(wall, 4),
+        "ttft_p50_ms": round(reg.quantile(f"{pre}.ttft_ms", 0.50), 3),
+        "ttft_p95_ms": round(reg.quantile(f"{pre}.ttft_ms", 0.95), 3),
+        "tokens_generated": reg.get(f"{pre}.tokens_generated"),
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "hit_rate": round(hits / max(1, hits + misses), 4),
+        "reused_tokens": reg.get(f"{pre}.prefix.reused_tokens"),
+    }
+    engine.drain()
+    return out
+
+
+def run_prefix_ab(model, args):
+    """The reuse-on vs reuse-off A/B over one shared-prefix trace."""
+    prompts = _prefix_trace_prompts(
+        args.requests, args.shared_frac, args.shared_len, args.tail_len,
+        args.vocab)
+    on = run_prefix_trace(model, prompts, args.max_new, args.num_slots,
+                          args.max_seq, reuse=True)
+    off = run_prefix_trace(model, prompts, args.max_new, args.num_slots,
+                           args.max_seq, reuse=False)
+    doc = {
+        "bench": "llm-prefix-trace",
+        "shared_frac": args.shared_frac,
+        "shared_len": args.shared_len,
+        "tail_len": args.tail_len,
+        "vocab": args.vocab, "hidden": args.hidden,
+        "layers": args.layers, "heads": args.heads,
+        "num_slots": args.num_slots, "max_seq": args.max_seq,
+        "max_new": args.max_new,
+        "reuse_on": on,
+        "reuse_off": off,
+        "ttft_p50_speedup": round(
+            off["ttft_p50_ms"] / max(1e-9, on["ttft_p50_ms"]), 3),
+        "check": {
+            "hit_rate_ge_0.5": on["hit_rate"] >= 0.5,
+            "ttft_p50_improved":
+                on["ttft_p50_ms"] < off["ttft_p50_ms"],
+        },
+    }
+    return doc
+
+
 def run_baseline(model, batch, prompt_len, new_tokens, vocab, seed=0):
     """Static-slot vs concat-grown decode through the SAME
     ``model.generate`` entry point: cold (includes tracing) and warm
@@ -154,7 +253,31 @@ def main(argv=None) -> int:
                     help="skip the static-vs-concat model.generate timing")
     ap.add_argument("--baseline-batch", type=int, default=8)
     ap.add_argument("--baseline-new", type=int, default=64)
+    ap.add_argument("--prefix-trace", action="store_true",
+                    help="run the shared-prefix reuse-on-vs-off A/B "
+                         "instead of the load sweep")
+    ap.add_argument("--shared-frac", type=float, default=0.8,
+                    help="fraction of trace prompts opening with the "
+                         "common prefix")
+    ap.add_argument("--shared-len", type=int, default=248,
+                    help="common-prefix length in tokens")
+    ap.add_argument("--tail-len", type=int, default=8,
+                    help="unique tail length behind the shared prefix")
+    ap.add_argument("--check", action="store_true",
+                    help="with --prefix-trace: exit 1 unless hit_rate >= "
+                         "0.5 and reuse-on TTFT p50 beats reuse-off")
     args = ap.parse_args(argv)
+
+    if args.prefix_trace:
+        # the A/B needs prefill FLOPs to dominate jit dispatch overhead
+        # and the whole-KV-buffer functional-update copies both paths
+        # pay, or the measurement is noise: upgrade any knob the caller
+        # left at its load-sweep default to the flop-dominant config
+        for k, v in {"hidden": 512, "heads": 8, "layers": 6,
+                     "max_seq": 512, "num_slots": 4, "requests": 32,
+                     "max_new": 8}.items():
+            if getattr(args, k) == ap.get_default(k):
+                setattr(args, k, v)
 
     from paddle_tpu.core.monitor import StatRegistry
     from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
@@ -162,6 +285,15 @@ def main(argv=None) -> int:
     model = _synthetic_gpt(args.vocab, args.hidden, args.layers, args.heads,
                            max_pos=max(args.max_seq,
                                        args.baseline_new + 32))
+
+    if args.prefix_trace:
+        doc = run_prefix_ab(model, args)
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        if args.check and not all(doc["check"].values()):
+            print(f"FAIL: {doc['check']}", file=sys.stderr)
+            return 1
+        return 0
     prompt_lens = [int(s) for s in args.prompt_lens.split(",") if s.strip()]
     loads = [float(x) for x in args.loads.split(",") if x.strip()]
 

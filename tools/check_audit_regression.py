@@ -3,7 +3,10 @@
 fusion breaks or host transfers.
 
 Runs the trace audit over the bench entrypoints (``resnet_train_step``,
-``gpt_train_step`` from :mod:`paddle_tpu.models.bench_audit`) and
+``gpt_train_step`` from :mod:`paddle_tpu.models.bench_audit`, plus the
+serving-side ``llm_spec_decode_step`` from
+:mod:`paddle_tpu.serving.llm.spec` — its one-fetch-per-tick contract is
+exactly a host-transfer count) and
 compares the per-entrypoint counts that move MFU — host transfers inside
 the compiled region, large closed-over control-flow constants, missed
 donation, retraces, and the HLO copy fraction — against the committed
@@ -33,7 +36,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE = os.path.join(REPO, "bench_audit_baseline.json")
 
 #: the bench step paths under the gate
-ENTRYPOINTS = ("resnet_train_step", "gpt_train_step")
+ENTRYPOINTS = ("resnet_train_step", "gpt_train_step",
+               "llm_spec_decode_step")
 
 #: copy_fraction may drift this much absolutely before failing (XLA
 #: version skew moves copy counts a little; a real fusion break moves a

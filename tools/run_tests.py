@@ -66,6 +66,12 @@ def main():
                     help="opt-in gate: run tools/bench_ckpt.py --check and "
                          "fail unless the async checkpointer hides >=80%% "
                          "of the sync checkpoint step-time overhead")
+    ap.add_argument("--bench-llm", action="store_true",
+                    help="opt-in gate: run tools/bench_llm_serving.py "
+                         "--prefix-trace --check (80%% shared-prefix "
+                         "trace) and fail unless the prefix KV store hit "
+                         "rate is >=0.5 and reuse-on TTFT p50 beats "
+                         "reuse-off")
     args = ap.parse_args()
 
     if not args.no_analyze:
@@ -144,6 +150,20 @@ def main():
             [sys.executable, "-m", "tools.bench_ckpt", "--check"],
             cwd=REPO, env=env)
         print(f"bench ckpt: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.bench_llm:
+        # Opt-in: the shared-prefix A/B on the CPU backend, gated on the
+        # hit-rate and TTFT invariants (absolute times are machine-
+        # dependent; the reuse-on-vs-off *ordering* is the invariant).
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_llm_serving",
+             "--prefix-trace", "--check"],
+            cwd=REPO, env=env)
+        print(f"bench llm: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
