@@ -19,6 +19,22 @@ _warnings.filterwarnings(
 
 import jax as _jax
 
+# jax < 0.6 exposes shard_map only under jax.experimental (and spells
+# check_vma as check_rep); the codebase is written against the stable
+# ``jax.shard_map`` surface, so alias it here — before any subpackage
+# that shard_maps is imported.
+if not hasattr(_jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map_compat(f=None, /, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        if f is None:  # decorator form: jax.shard_map(mesh=..., ...)
+            return lambda g: _exp_shard_map(g, **kw)
+        return _exp_shard_map(f, **kw)
+
+    _jax.shard_map = _shard_map_compat
+
 # Under a launcher/spawn (PADDLE_TRAINERS_NUM > 1) the distributed runtime
 # must come up before the first XLA-backend touch below. Inline (not via
 # paddle_tpu.distributed) because that package import already pulls in

@@ -18,6 +18,8 @@ from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, destroy_process_group,
     all_reduce, reduce, broadcast, all_gather, all_gather_object, scatter,
     reduce_scatter, alltoall, send, recv, p2p_exchange, barrier, wait,
+    compressed_all_reduce, compressed_grad_sync,
+    compressed_allreduce_wire_bytes, dense_allreduce_wire_bytes,
 )
 from .parallel import (  # noqa: F401
     DataParallel, sync_params_buffers, shard_batch, build_global_batch,

@@ -170,7 +170,7 @@ def _member_mask(group: Group, axes: Tuple[str, ...]):
     if group.ranks is None:
         return None
     idx = _linear_index(axes)
-    return jnp.isin(idx, jnp.asarray(np.array(group.ranks, np.int32)))
+    return jnp.isin(idx, jnp.asarray(np.array(group.ranks, np.int32)))  # noqa: PTA002 -- group.ranks is a host-side python list (trace-time constant), no device value involved
 
 
 # -- raw implementations (jax arrays; usable inside shard_map directly) -------
@@ -234,7 +234,7 @@ def _raw_allgather(x, group: Group, axes: Tuple[str, ...]):
             full = lax.all_gather(full, a)
         full = full.reshape((-1,) + x.shape)
     if group.ranks is not None:
-        full = full[jnp.asarray(np.array(group.ranks, np.int32))]
+        full = full[jnp.asarray(np.array(group.ranks, np.int32))]  # noqa: PTA002 -- group.ranks is a host-side python list (trace-time constant), no device value involved
     return full
 
 
@@ -267,6 +267,134 @@ def _raw_p2p(x, src, dst, axes: Tuple[str, ...]):
     moved = lax.ppermute(x, axes[0], perm=[(src, dst)])
     idx = lax.axis_index(axes[0])
     return jnp.where(idx == dst, moved, x)
+
+
+# -- compressed (quantized) allreduce ----------------------------------------
+# EQuARX-style (PAPERS.md): express the allreduce as reduce-scatter +
+# all-gather and quantize both wire phases to int8 (block-scaled) or bf16,
+# keeping quantize/exchange/dequantize one fused XLA program — no host
+# transfers (the PTA009 entrypoint below audits exactly that). int8 with
+# the default 256-element blocks cuts bytes-on-wire ~3.9x vs f32; the
+# two quantization passes bound the elementwise error by
+# (n+1) * absmax / 127 (each contribution loses <= its block absmax/254
+# per pass), which is noise against SGD gradient variance — the
+# convergence test in tests/test_compressed_allreduce.py holds the
+# training loss to the dense path's budget.
+
+DEFAULT_COMPRESS_BLOCK = 256
+_WIRE_DTYPES = ("int8", "bf16")
+
+
+def _compress_block_for(nelems: int, wire_dtype: str) -> int:
+    """Block size for the quantize stage: tuner winner if one is known
+    (tools/autotune.py --compress), else the 256 default."""
+    try:
+        from ..tuner import get_compress_block
+    except ImportError:      # tuner unavailable mid-bootstrap
+        return DEFAULT_COMPRESS_BLOCK
+    blk = get_compress_block(nelems, wire_dtype)
+    return int(blk) if blk else DEFAULT_COMPRESS_BLOCK
+
+
+def _block_quantize_int8(blocks):
+    """``[..., block]`` f32 -> (int8 codes, f32 per-block absmax scales)."""
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    scale = jnp.where(absmax > 0, absmax, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / scale[..., None] * 127.0),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def _block_dequantize_int8(q, scale):
+    return q.astype(jnp.float32) * (scale[..., None] / 127.0)
+
+
+def _raw_compressed_allreduce(x, axes: Tuple[str, ...], wire_dtype="int8",
+                              block: Optional[int] = None, mean=False):
+    """The in-trace compressed allreduce (shard_map body).
+
+    Phase 1 (reduce-scatter): block-quantize the local value, all_to_all
+    the codes+scales so rank j holds every rank's j-th shard, dequantize
+    and sum locally. Phase 2 (all-gather): re-quantize the reduced shard,
+    all_gather, dequantize. Every rank dequantizes identical codes, so the
+    replicas stay bitwise identical — the same guarantee the dense psum
+    gives, which is what keeps replicated parameters in lockstep.
+    """
+    if wire_dtype not in _WIRE_DTYPES:
+        raise ValueError(
+            f"compressed allreduce wire dtype must be one of "
+            f"{_WIRE_DTYPES}, got {wire_dtype!r}")
+    if len(axes) != 1:
+        raise NotImplementedError(
+            "compressed allreduce needs a single mesh-axis group (dp)")
+    axis = axes[0]
+    n = _mesh.mesh_axis_size(axes)
+    orig_dtype = x.dtype
+    if n == 1:
+        return x
+    blk = int(block or _compress_block_for(x.size, wire_dtype))  # noqa: PTA001 -- x.size and the tuner block are trace-time python ints, not traced values
+    flat = x.astype(jnp.float32).reshape(-1)
+    per = -(-flat.size // (n * blk)) * blk      # shard length, blk-multiple
+    flat = jnp.pad(flat, (0, n * per - flat.size))
+    shards = flat.reshape(n, per)
+    if wire_dtype == "bf16":
+        got = lax.all_to_all(shards.astype(jnp.bfloat16), axis, 0, 0)
+        local = jnp.sum(got.astype(jnp.float32), axis=0)         # [per]
+        full = lax.all_gather(local.astype(jnp.bfloat16), axis)  # [n, per]
+        out = full.astype(jnp.float32).reshape(-1)
+    else:
+        q, s = _block_quantize_int8(shards.reshape(n, per // blk, blk))
+        gq = lax.all_to_all(q, axis, 0, 0)      # [n, per//blk, blk]
+        gs = lax.all_to_all(s, axis, 0, 0)      # [n, per//blk]
+        local = jnp.sum(_block_dequantize_int8(gq, gs), axis=0)
+        q2, s2 = _block_quantize_int8(local)    # reduced shard, requantized
+        fq = lax.all_gather(q2, axis)           # [n, per//blk, blk]
+        fs = lax.all_gather(s2, axis)           # [n, per//blk]
+        out = _block_dequantize_int8(fq, fs).reshape(-1)
+    out = out[: x.size].reshape(x.shape)
+    if mean:
+        out = out / n
+    return out.astype(orig_dtype)
+
+
+def compressed_allreduce_wire_bytes(nelems: int, world: int,
+                                    wire_dtype="int8",
+                                    block: Optional[int] = None) -> int:
+    """Analytic per-device bytes-on-wire of the two-phase compressed
+    exchange: (world-1) quantized shards sent in each phase. The scale
+    sidecar (4 bytes per block) is charged to the int8 wire."""
+    if world <= 1:
+        return 0
+    blk = int(block or DEFAULT_COMPRESS_BLOCK)
+    per = -(-int(nelems) // (world * blk)) * blk
+    if wire_dtype == "bf16":
+        payload = per * 2
+    elif wire_dtype == "int8":
+        payload = per + (per // blk) * 4
+    else:
+        raise ValueError(f"unknown wire dtype {wire_dtype!r}")
+    return 2 * (world - 1) * payload
+
+
+def dense_allreduce_wire_bytes(nelems: int, world: int,
+                               itemsize: int = 4) -> int:
+    """Per-device bytes of the dense ring/two-phase allreduce — the
+    baseline the >=3x acceptance bar is measured against."""
+    if world <= 1:
+        return 0
+    per = -(-int(nelems) // world)
+    return 2 * (world - 1) * per * itemsize
+
+
+def compressed_grad_sync(grads, axis: str = "dp", wire_dtype: str = "int8",
+                         block: Optional[int] = None, mean: bool = True):
+    """Compressed gradient mean over a mesh axis, for hand-written
+    shard_map train steps (the DataParallel SPMD path inserts the dense
+    psum implicitly via sharding; an explicit step opts into compression
+    by calling this on its gradient pytree instead of ``lax.pmean``)."""
+    return jax.tree_util.tree_map(
+        lambda g: _raw_compressed_allreduce(g, (axis,), wire_dtype,
+                                            block, mean), grads)
 
 
 # -- public functional API ----------------------------------------------------
@@ -315,6 +443,74 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         out = _eager_multiprocess_reduce(raw, op)
         if isinstance(tensor, Tensor):
             # see broadcast: untaped host-level mutation -> version bump
+            tensor._swap_payload(Tensor(jnp.asarray(out)))
+            tensor._inplace_version += 1
+            return tensor
+        return out
+    return tensor  # world of one
+
+
+def _eager_compressed_reduce(arr, op, wire_dtype, block):
+    """Host-level compressed reduce (one process per host): quantize the
+    local value, process_allgather the int8 codes + scales (the actual
+    DCN payload), dequantize and sum. Every process dequantizes identical
+    gathered rows, so replicas stay bitwise identical."""
+    from jax.experimental import multihost_utils
+    x = jnp.asarray(arr)
+    blk = int(block or _compress_block_for(x.size, wire_dtype))
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = -(-flat.size // blk) * blk - flat.size
+    blocks = jnp.pad(flat, (0, pad)).reshape(-1, blk)
+    if wire_dtype == "bf16":
+        rows = multihost_utils.process_allgather(
+            blocks.astype(jnp.bfloat16))
+        total = jnp.asarray(rows).astype(jnp.float32).sum(axis=0)
+    else:
+        q, s = _block_quantize_int8(blocks)
+        gq = multihost_utils.process_allgather(q)
+        gs = multihost_utils.process_allgather(s)
+        total = _block_dequantize_int8(jnp.asarray(gq),
+                                       jnp.asarray(gs)).sum(axis=0)
+    out = total.reshape(-1)[: flat.size].reshape(x.shape)
+    if op == ReduceOp.AVG:
+        out = out / jax.process_count()
+    return out.astype(x.dtype)
+
+
+def compressed_all_reduce(tensor, op=ReduceOp.SUM, group=None,
+                          wire_dtype: str = "int8",
+                          block: Optional[int] = None):
+    """Quantized allreduce (EQuARX, PAPERS.md): same contract as
+    :func:`all_reduce` but the wire payload is block-scaled int8 (or
+    bf16) instead of the input dtype. SUM/AVG only — quantization
+    commutes with addition up to the bounded rounding error, not with
+    max/min/prod. Enabled fleet-wide via
+    ``DistributedStrategy.compressed_allreduce`` (docs/quantization.md).
+    """
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise NotImplementedError(
+            "compressed_all_reduce supports SUM/AVG only")
+    if wire_dtype not in _WIRE_DTYPES:
+        raise ValueError(
+            f"compressed allreduce wire dtype must be one of "
+            f"{_WIRE_DTYPES}, got {wire_dtype!r}")
+    g = _get_group(group)
+    if g.ranks is not None:
+        raise NotImplementedError(
+            "compressed_all_reduce over an arbitrary rank group; use a "
+            "mesh-axis group (dp)")
+    axes = _resolve_axes(g)
+    if axes:
+        return _run("c_compressed_allreduce", tensor,
+                    lambda x: _raw_compressed_allreduce(
+                        x, axes, wire_dtype, block,
+                        mean=(op == ReduceOp.AVG)))
+    if jax.process_count() > 1:
+        # host-level path (see all_reduce): multihost_utils stays outside
+        # the op funnel's jit
+        raw = tensor._data if isinstance(tensor, Tensor) else tensor
+        out = _eager_compressed_reduce(raw, op, wire_dtype, block)
+        if isinstance(tensor, Tensor):
             tensor._swap_payload(Tensor(jnp.asarray(out)))
             tensor._inplace_version += 1
             return tensor
@@ -400,7 +596,7 @@ def all_gather_object(object_list, obj, group=None):
         buf[:8] = np.frombuffer(np.int64(data.size).tobytes(), np.uint8)
         buf[8:8 + data.size] = data
         rows = multihost_utils.process_allgather(jnp.asarray(buf))
-        for row in np.asarray(rows):
+        for row in np.asarray(rows):  # noqa: PTA002 -- object gather is a host-side pickle exchange by contract; the fetch IS the operation
             size = int(np.frombuffer(row[:8].tobytes(), np.int64)[0])
             object_list.append(pickle.loads(row[8:8 + size].tobytes()))
     else:
@@ -436,7 +632,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         full = _raw_broadcast(stack, src_in_group, g, axes)
         if g.ranks is not None:
             # each member picks its slot by *group* rank; non-members keep x
-            ranks = jnp.asarray(np.array(g.ranks, np.int32))
+            ranks = jnp.asarray(np.array(g.ranks, np.int32))  # noqa: PTA002 -- g.ranks is a host-side python list (trace-time constant), no device value involved
             matches = ranks == idx
             my = jnp.take(full, jnp.argmax(matches), axis=0)
             return jnp.where(matches.any(), my, x)
@@ -555,7 +751,7 @@ def wait(tensor, group=None, use_calc_stream=True):
     """reference: collective.py:276. XLA owns stream ordering; block the host
     until the value is ready (the closest observable semantics)."""
     if isinstance(tensor, Tensor):
-        tensor.block_until_ready()
+        tensor.block_until_ready()  # noqa: PTA002 -- wait()'s documented contract IS the host-side sync (reference collective.py:276)
     return tensor
 
 
@@ -574,3 +770,69 @@ def get_world_size(group=None):
         from .env import get_world_size as _w
         return _w()
     return g.nranks
+
+
+# -- trace-audit entrypoint ---------------------------------------------------
+
+def build_compressed_train_step(mesh, axis: str = "dp",
+                                wire_dtype: str = "int8",
+                                block: Optional[int] = None,
+                                lr: float = 0.1):
+    """A dp train step whose gradient sync is the compressed allreduce:
+    linear regression, per-shard grads, :func:`compressed_grad_sync`
+    instead of ``lax.pmean``, SGD update. Small on purpose — the PTA009
+    audit checks the *collective*: quantize → all_to_all/all_gather →
+    dequantize must stay one fused device program with zero host
+    transfers, and the replicated parameters must come back bit-identical
+    across ranks (out_specs=P() asserts replication)."""
+    from jax.sharding import PartitionSpec as P
+
+    def _shard_fn(w, b, x, y):
+        err = x @ w + b - y
+        n_local = x.shape[0]
+        gw = x.T @ err * (2.0 / n_local)
+        gb = jnp.mean(err, axis=0) * 2.0
+        gw, gb = compressed_grad_sync((gw, gb), axis=axis,
+                                      wire_dtype=wire_dtype, block=block)
+        loss = lax.pmean(jnp.mean(err * err), axis)
+        return w - lr * gw, b - lr * gb, loss
+
+    # check_vma=False: the all_gather phase replicates the result by
+    # construction, but the checker cannot infer that statically
+    return jax.shard_map(_shard_fn, mesh=mesh,
+                         in_specs=(P(), P(), P(axis), P(axis)),
+                         out_specs=(P(), P(), P()),
+                         check_vma=False)
+
+
+def _audit_compressed_allreduce_spec():
+    from ..core import audit
+    devices = np.array(jax.devices())  # noqa: PTA002 -- host-side device-list layout at audit registration, not a step path
+    mesh = jax.sharding.Mesh(devices, ("dp",))
+    n, feat, out, per_rank = devices.size, 32, 4, 4
+
+    def make_args(variant):
+        rng = np.random.default_rng(77 + variant)
+        w = jnp.asarray(rng.standard_normal((feat, out)) * 0.1, jnp.float32)
+        b = jnp.zeros((out,), jnp.float32)
+        x = jnp.asarray(rng.standard_normal((n * per_rank, feat)),
+                        jnp.float32)
+        y = jnp.asarray(rng.standard_normal((n * per_rank, out)),
+                        jnp.float32)
+        return (w, b, x, y)
+
+    # fresh w/b per call (make_args), so the updated params can consume
+    # their input buffers — same donation contract as the bench steps
+    return audit.AuditSpec(fn=build_compressed_train_step(mesh, block=64),
+                           make_args=make_args,
+                           jit_kwargs={"donate_argnums": (0, 1)})
+
+
+def _register_audit_entrypoints():
+    from ..core import audit
+    audit.register_entrypoint("compressed_allreduce_train_step",
+                              _audit_compressed_allreduce_spec,
+                              tags=("train", "collective", "bench"))
+
+
+_register_audit_entrypoints()

@@ -58,8 +58,20 @@ def _validate_strategy(st: DistributedStrategy):
             "(docs/adr/0002-dgc.md): on TPU the dense-gradient allreduce "
             "rides ICI and overlaps with compute, and a sparse top-k "
             "exchange compiles to gather/scatter traffic that is slower "
-            "than the dense collective it replaces. Use localsgd or "
-            "gradient_merge to cut cross-host communication instead.")
+            "than the dense collective it replaces. Set "
+            "strategy.compressed_allreduce = True for the shipped "
+            "dense-but-quantized exchange (docs/quantization.md), or use "
+            "localsgd / gradient_merge to cut cross-host communication.")
+    if st.compressed_allreduce_dtype not in ("int8", "bf16"):
+        raise ValueError(
+            "compressed_allreduce_dtype must be 'int8' or 'bf16', got "
+            f"{st.compressed_allreduce_dtype!r}")
+    if st.compressed_allreduce and st.fp16_allreduce:
+        warnings.warn(
+            "both compressed_allreduce and fp16_allreduce are set; "
+            "compressed_allreduce wins (fp16_allreduce is its bf16 "
+            "special case without block scales)", UserWarning,
+            stacklevel=2)
     if st.pipeline and int(hc.get("pp_degree", 1)) <= 1:
         raise ValueError(
             "strategy.pipeline=True requires hybrid_configs['pp_degree']>1 "
@@ -167,10 +179,12 @@ def distributed_model(model):
     if st.recompute:
         _apply_recompute(model, st.recompute_configs.get("checkpoints", []))
     mode = hcg.get_parallel_mode()
-    if st.fp16_allreduce and mode != "data":
+    if (st.fp16_allreduce or st.compressed_allreduce) and mode != "data":
         import warnings
+        which = ("compressed_allreduce" if st.compressed_allreduce
+                 else "fp16_allreduce")
         warnings.warn(
-            f"fp16_allreduce applies to the DataParallel cross-process "
+            f"{which} applies to the DataParallel cross-process "
             f"gradient exchange only; it has no effect in {mode!r} mode",
             UserWarning, stacklevel=2)
     if mode == "pipeline":
@@ -178,7 +192,10 @@ def distributed_model(model):
         return PipelineParallel(model, hcg, _F.strategy)
     if mode == "data":
         from ..parallel import DataParallel
-        return DataParallel(model, bf16_allreduce=bool(st.fp16_allreduce))
+        return DataParallel(
+            model, bf16_allreduce=bool(st.fp16_allreduce),
+            compressed_allreduce=bool(st.compressed_allreduce),
+            compressed_allreduce_dtype=str(st.compressed_allreduce_dtype))
     # model/tensor parallel: layers are already mesh-annotated; replicate the
     # rest (reference broadcasts non-mp params across the mp ring)
     for _, p in model.named_parameters():

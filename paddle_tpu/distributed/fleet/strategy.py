@@ -38,6 +38,12 @@ _DEFAULTS: Dict[str, Any] = {
     "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
                     "sparsity": [0.999]},
     "fp16_allreduce": False,
+    # quantized gradient allreduce (EQuARX-style block-scaled wire format;
+    # docs/quantization.md) — the shipped alternative to the out-of-scope
+    # DGC slot above. dtype: "int8" (block-scaled, ~3.9x fewer wire bytes
+    # than f32) or "bf16" (2x, exact-sum-in-f32).
+    "compressed_allreduce": False,
+    "compressed_allreduce_dtype": "int8",
     "pipeline": False,
     "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1},
     "tensor_parallel": False,

@@ -68,8 +68,8 @@ def mesh_axis_size(axis, mesh: Optional[Mesh] = None) -> int:
     if m is None:
         return 1
     if isinstance(axis, (tuple, list)):
-        return int(np.prod([m.shape[a] for a in axis]))
-    return int(m.shape[axis])
+        return int(np.prod([m.shape[a] for a in axis]))  # noqa: PTA001 -- mesh axis sizes are host python ints (trace-time constants)
+    return int(m.shape[axis])  # noqa: PTA001 -- mesh axis sizes are host python ints (trace-time constants)
 
 
 def sharding_for(spec: PartitionSpec, mesh: Optional[Mesh] = None):

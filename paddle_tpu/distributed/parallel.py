@@ -69,7 +69,9 @@ class DataParallel(Layer):
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None, bf16_allreduce=False):
+                 group=None, bf16_allreduce=False,
+                 compressed_allreduce=False,
+                 compressed_allreduce_dtype="int8"):
         super().__init__()
         self._layers = layers
         self._group = group
@@ -77,6 +79,14 @@ class DataParallel(Layer):
         # optimizer.py:20 — halve cross-process gradient bytes; bf16 is
         # the TPU-native half-width format)
         self._bf16_allreduce = bool(bf16_allreduce)
+        # strategy.compressed_allreduce: block-scaled quantized gradient
+        # exchange (collective.compressed_all_reduce, docs/quantization.md)
+        if compressed_allreduce_dtype not in ("int8", "bf16"):
+            raise ValueError(
+                "compressed_allreduce_dtype must be 'int8' or 'bf16', "
+                f"got {compressed_allreduce_dtype!r}")
+        self._compressed_allreduce = bool(compressed_allreduce)
+        self._compressed_dtype = str(compressed_allreduce_dtype)
         self._mesh = _mesh.ensure_mesh()
         self.find_unused_parameters = find_unused_parameters
         # replicate parameters/buffers across the mesh (BCastParamsToDevices,
@@ -116,7 +126,14 @@ class DataParallel(Layer):
             if p._grad is None:
                 continue
             raw = p._grad
-            if self._bf16_allreduce and raw.dtype == jnp.float32:
+            if (self._compressed_allreduce
+                    and jnp.issubdtype(raw.dtype, jnp.floating)):
+                g = Tensor(raw)
+                C.compressed_all_reduce(g, op=C.ReduceOp.AVG,
+                                        group=self._group,
+                                        wire_dtype=self._compressed_dtype)
+                p._grad = g._data
+            elif self._bf16_allreduce and raw.dtype == jnp.float32:
                 g = Tensor(raw.astype(jnp.bfloat16))
                 C.all_reduce(g, op=C.ReduceOp.AVG, group=self._group)
                 p._grad = g._data.astype(jnp.float32)

@@ -7,16 +7,29 @@ calibration, and the fake_quantize kernels
 (operators/fake_quantize_op.cc: abs_max, channel_wise_abs_max,
 moving_average_abs_max).
 
-TPU design: fake-quant is expressed functionally with the straight-through
-estimator — ``x + stop_gradient(quant(x) - x)`` — so autograd gives STE
-for free and XLA fuses the whole simulate-quantize chain; no graph pass
-is needed (layers are wrapped, the reference's dygraph path).
+TPU design, two tiers:
+
+- **Simulation (QAT)**: fake-quant is expressed functionally with the
+  straight-through estimator — ``x + stop_gradient(quant(x) - x)`` — so
+  autograd gives STE for free and XLA fuses the whole simulate-quantize
+  chain; no graph pass is needed (layers are wrapped, the reference's
+  dygraph path).
+- **Execution (PTQ convert / save_quantized_model)**: ``convert`` swaps
+  the wrappers for :class:`Int8Linear`/:class:`Int8Conv2D`, whose weights
+  are REAL ``jnp.int8`` buffers with per-out-channel f32 scales — weight
+  memory halves at rest and, when a calibrated activation scale exists,
+  the Linear matmul runs as an int8×int8 ``dot_general`` with int32
+  accumulation (the serving executable the reference's quantize-for-
+  inference pass produces). The int8 buffers flow through ``jit.save`` →
+  ``Predictor`` unchanged (StableHLO and the pickled .pdiparams both
+  carry s8). See docs/quantization.md.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -37,7 +50,6 @@ def fake_quantize_abs_max(x, bit_length=8):
         # straight-through: value of q, gradient of a
         out = a + jax.lax.stop_gradient(q - a)
         return out, scale
-    import jax
     return apply("fake_quantize_abs_max", impl, x)
 
 
@@ -46,7 +58,6 @@ def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
     qmax = float(2 ** (bit_length - 1) - 1)
 
     def impl(a):
-        import jax
         axes = tuple(i for i in range(a.ndim) if i != quant_axis)
         scale = jnp.max(jnp.abs(a), axis=axes, keepdims=True)
         s = jnp.where(scale > 0, scale, 1.0)
@@ -58,20 +69,78 @@ def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
 
 class MovingAverageAbsMaxObserver:
     """reference: fake_quantize_op.cc FakeQuantizeMovingAverageAbsMax
-    state (accum/state/scale buffers)."""
+    state (accum/state/scale buffers).
+
+    ``scale`` follows the repo's established EMA semantics (first batch
+    initializes, then ``rate*scale + (1-rate)*cur`` — pinned by
+    tests/test_op_tail_r5b.py); the reference's ``accum``/``state``
+    buffers (``accum = rate*accum + cur``, ``state = rate*state + 1``)
+    are maintained alongside purely so reference checkpoints round-trip.
+
+    ``state_dict``/``set_state_dict`` round-trip the triple under BOTH the
+    repo-style keys (``scale``/``accum``/``state``) and the reference's
+    persistable-variable names (``OutScale``/``InAccum``/``InState``), the
+    same dual-key convention the PR-5 GradScaler fix established for
+    ``good_steps``/``incr_count`` — a checkpoint written by either side
+    loads on the other.
+    """
+
+    #: (repo key, reference key) pairs, in emit order
+    _KEYS = (("scale", "OutScale"), ("accum", "InAccum"),
+             ("state", "InState"))
 
     def __init__(self, moving_rate=0.9):
         self._rate = moving_rate
         self.scale: Optional[float] = None
+        self._accum = 0.0
+        self._state = 0.0
 
     def observe(self, x):
         raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        # calibration is a cold path by contract: one concrete absmax per
+        # calibration batch is the semantics (the reference kernel reads
+        # cur_scale the same way)
         cur = float(jnp.max(jnp.abs(raw)))
+        self._accum = self._rate * self._accum + cur
+        self._state = self._rate * self._state + 1.0
         if self.scale is None:
             self.scale = cur
         else:
             self.scale = self._rate * self.scale + (1 - self._rate) * cur
         return self.scale
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        vals = {"scale": 0.0 if self.scale is None else float(self.scale),
+                "accum": float(self._accum), "state": float(self._state)}
+        out = {}
+        for repo_key, ref_key in self._KEYS:
+            arr = np.asarray(vals[repo_key], np.float32)  # noqa: PTA002 -- state_dict serializes host python floats for checkpointing, not a per-step path
+            out[repo_key] = arr
+            out[ref_key] = arr
+        return out
+
+    def set_state_dict(self, state_dict):
+        def pick(repo_key, ref_key):
+            for k in (repo_key, ref_key):
+                if k in state_dict:
+                    v = state_dict[k]
+                    return float(v.numpy() if isinstance(v, Tensor)  # noqa: PTA002 -- checkpoint load path: observer state must land as host floats once, not per step
+                                 else np.asarray(v))  # noqa: PTA002 -- checkpoint load path, see above
+            return None
+        accum = pick("accum", "InAccum")
+        state = pick("state", "InState")
+        scale = pick("scale", "OutScale")
+        if accum is not None:
+            self._accum = accum
+        if state is not None:
+            self._state = state
+        if scale is not None:
+            self.scale = scale if state is None or state > 0 else None
+        elif self._state > 0:
+            self.scale = self._accum / self._state
+        return self
+
+    load_state_dict = set_state_dict
 
 
 def quant_dequant_with_scale(x, scale, bit_length=8):
@@ -79,14 +148,167 @@ def quant_dequant_with_scale(x, scale, bit_length=8):
     qmax = float(2 ** (bit_length - 1) - 1)
 
     def impl(a):
-        import jax
         s = max(float(scale), 1e-8)
         q = jnp.clip(jnp.round(a / s * qmax), -qmax - 1, qmax) / qmax * s
         return a + jax.lax.stop_gradient(q - a)
     return apply("quant_dequant", impl, x)
 
 
-class QuantedLinear(Layer):
+# -- real-int8 execution primitives ------------------------------------------
+
+def quantize_weight_int8(w, quant_axis=1):
+    """Per-channel symmetric int8 weight quantization (the EXECUTABLE form,
+    not a simulation): returns ``(q, scale)`` with ``q`` int8 and ``scale``
+    f32 of shape ``[channels]`` such that ``w ≈ q * scale`` broadcast over
+    ``quant_axis``. ``scale = absmax / 127`` per channel."""
+    raw = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    axes = tuple(i for i in range(raw.ndim) if i != quant_axis)
+    absmax = jnp.max(jnp.abs(raw), axis=axes, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax, 1.0).astype(jnp.float32) / 127.0
+    q = jnp.clip(jnp.round(raw / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale.reshape(-1)
+
+
+def _expand_scale(scale, ndim, quant_axis):
+    shape = [1] * ndim
+    shape[quant_axis] = -1
+    return scale.reshape(shape)
+
+
+class Int8Linear(Layer):
+    """Linear whose weight is a real ``jnp.int8`` buffer with per-out-
+    channel f32 scales (reference capability: the quantize-for-inference
+    pass's dequantize-fused INT8 matmul).
+
+    Two execution forms, picked by whether a calibrated activation scale
+    exists:
+
+    - ``act_scale`` set (PTQ convert): the input is quantized to int8 with
+      the frozen scale and the matmul runs int8×int8 with int32
+      accumulation (``lax.dot_general(..., preferred_element_type=int32)``)
+      — the true serving kernel; XLA fuses quantize → dot → rescale.
+    - ``act_scale`` None: weight-only int8 — the weight dequant fuses into
+      the f32 matmul; activations stay f32.
+
+    ``weight`` is exposed as a dequantized read-only view for API parity
+    with ``nn.Linear`` (eval/export code that inspects ``.weight`` keeps
+    working); the storage is int8.
+    """
+
+    def __init__(self, weight_q, w_scale, bias=None,
+                 act_scale: Optional[float] = None):
+        super().__init__()
+        self.register_buffer("weight_q", weight_q if isinstance(
+            weight_q, Tensor) else Tensor(jnp.asarray(weight_q, jnp.int8)))
+        self.register_buffer("w_scale", w_scale if isinstance(
+            w_scale, Tensor) else Tensor(jnp.asarray(w_scale, jnp.float32)))
+        self.bias = bias
+        self._act_scale = None if act_scale is None else float(act_scale)
+
+    @classmethod
+    def from_float(cls, weight, bias=None, act_scale=None):
+        q, s = quantize_weight_int8(weight, quant_axis=1)
+        return cls(Tensor(q), Tensor(s), bias=bias, act_scale=act_scale)
+
+    @property
+    def weight(self):
+        """Dequantized f32 view (API parity; storage stays int8)."""
+        return Tensor(self.weight_q._data.astype(jnp.float32)
+                      * self.w_scale._data[None, :])
+
+    def forward(self, x):
+        act_scale = self._act_scale
+
+        def impl(a, q, s, *rest):
+            if act_scale is not None:
+                sa = max(act_scale, 1e-8) / 127.0
+                aq = jnp.clip(jnp.round(a / sa), -127.0, 127.0
+                              ).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    aq, q, (((a.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(jnp.float32) * (sa * s)
+            else:
+                out = (a @ q.astype(jnp.float32)) * s
+            if rest:
+                out = out + rest[0]
+            return out
+        args = (x, self.weight_q, self.w_scale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply("int8_linear", impl, *args)
+
+
+class Int8Conv2D(Layer):
+    """Conv2D with weight-only int8 storage: the weight lives as an int8
+    buffer + per-out-channel scales and dequantizes into the f32
+    convolution (XLA fuses the convert+scale into the conv). Activation
+    int8 convolution is out of scope — conv serving traffic here is
+    memory-bound on weights, which is what halving storage addresses."""
+
+    def __init__(self, weight_q, w_scale, bias=None, stride=1, padding=0,
+                 dilation=1, groups=1):
+        super().__init__()
+        self.register_buffer("weight_q", weight_q if isinstance(
+            weight_q, Tensor) else Tensor(jnp.asarray(weight_q, jnp.int8)))
+        self.register_buffer("w_scale", w_scale if isinstance(
+            w_scale, Tensor) else Tensor(jnp.asarray(w_scale, jnp.float32)))
+        self.bias = bias
+        self._cfg = {"_stride": stride, "_padding": padding,
+                     "_dilation": dilation, "_groups": groups}
+
+    @classmethod
+    def from_float(cls, weight, bias=None, **cfg):
+        q, s = quantize_weight_int8(weight, quant_axis=0)
+        return cls(Tensor(q), Tensor(s), bias=bias,
+                   stride=cfg.get("_stride", 1),
+                   padding=cfg.get("_padding", 0),
+                   dilation=cfg.get("_dilation", 1),
+                   groups=cfg.get("_groups", 1))
+
+    @property
+    def weight(self):
+        return Tensor(self.weight_q._data.astype(jnp.float32)
+                      * _expand_scale(self.w_scale._data,
+                                      self.weight_q._data.ndim, 0))
+
+    def forward(self, x):
+        nd = self.weight_q._data.ndim
+
+        def deq(q, s):
+            return q.astype(jnp.float32) * _expand_scale(s, nd, 0)
+        w = apply_raw("int8_dequant_weight", deq,
+                      self.weight_q, self.w_scale)
+        return F.conv2d(x, w, self.bias,
+                        stride=self._cfg.get("_stride", 1),
+                        padding=self._cfg.get("_padding", 0),
+                        dilation=self._cfg.get("_dilation", 1),
+                        groups=self._cfg.get("_groups", 1))
+
+
+class _ObserverStateMixin:
+    """Observer state joins the wrapper layer's state (the accum/state/
+    scale triple is what makes a calibrated checkpoint reloadable — scale
+    alone loses the running average)."""
+
+    def state_dict(self, *args, **kwargs):
+        dest = super().state_dict(*args, **kwargs)
+        prefix = kwargs.get("structured_name_prefix", "")
+        for k, v in self._observer.state_dict().items():
+            dest[f"{prefix}_observer.{k}"] = v
+        return dest
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        obs = {k.split("_observer.", 1)[1]: v
+               for k, v in state_dict.items() if "_observer." in k}
+        if obs:
+            self._observer.set_state_dict(obs)
+        rest = {k: v for k, v in state_dict.items()
+                if "_observer." not in k}
+        return super().set_state_dict(rest, *args, **kwargs)
+
+
+class QuantedLinear(_ObserverStateMixin, Layer):
     """Linear with fake-quantized weight + input (reference:
     slim/quantization/imperative/qat.py QuantizedLinear)."""
 
@@ -108,7 +330,7 @@ class QuantedLinear(Layer):
         return F.linear(xq, wq, self.bias)
 
 
-class QuantedConv2D(Layer):
+class QuantedConv2D(_ObserverStateMixin, Layer):
     """reference: imperative/qat.py QuantizedConv2D."""
 
     def __init__(self, layer, weight_bits=8, activation_bits=8,
@@ -167,14 +389,20 @@ class ImperativeQuantAware:
         return model
 
     def save_quantized_model(self, model, path, input_spec=None):
+        """reference: imperative_qat.py save_quantized_model — the export
+        carries REAL int8 weights: the trained wrappers are converted to
+        Int8Linear/Int8Conv2D (observer scales become frozen activation
+        scales) and the resulting program — s8 buffers, dequant fused into
+        the matmuls — is what jit.save exports and Predictor executes."""
         from .. import jit
+        ImperativePTQ().convert(model)
         jit.save(model, path, input_spec=input_spec)
 
 
 class ImperativePTQ:
     """Post-training quantization (reference: slim/quantization/imperative/
-    ptq.py): wrap, run calibration batches, then ``convert`` freezes the
-    observed activation scales."""
+    ptq.py): wrap, run calibration batches, then ``convert`` swaps the
+    wrappers for real-int8 execution layers."""
 
     def __init__(self, quant_config=None):
         self._cfg = quant_config or {}
@@ -183,29 +411,20 @@ class ImperativePTQ:
         return ImperativeQuantAware().quantize(model)
 
     def convert(self, model: Layer):
-        """Freeze observers: replace moving-average observation with the
-        calibrated fixed scale."""
-        for sub in model._sub_layers.values():
-            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
-                scale = sub._observer.scale or 1.0
-
-                def freeze(layer=sub, s=scale):
-                    def fwd(x):
-                        xq = quant_dequant_with_scale(x, s, layer._abits)
-                        wq, _ = fake_channel_wise_quantize_abs_max(
-                            layer.weight, layer._wbits,
-                            quant_axis=1 if isinstance(layer, QuantedLinear)
-                            else 0)
-                        if isinstance(layer, QuantedLinear):
-                            return F.linear(xq, wq, layer.bias)
-                        return F.conv2d(
-                            xq, wq, layer.bias,
-                            stride=layer._cfg.get("_stride", 1),
-                            padding=layer._cfg.get("_padding", 0),
-                            dilation=layer._cfg.get("_dilation", 1),
-                            groups=layer._cfg.get("_groups", 1))
-                    return fwd
-                sub.forward = freeze()
+        """Freeze calibration into EXECUTABLE int8 layers: each
+        QuantedLinear becomes an :class:`Int8Linear` (int8 weight buffer +
+        per-channel scales + the observer's activation scale driving an
+        int8×int8 matmul); each QuantedConv2D becomes an
+        :class:`Int8Conv2D` (weight-only int8). Not a simulation — the
+        f32 master weights are dropped and weight memory halves."""
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, QuantedLinear):
+                setattr(model, name, Int8Linear.from_float(
+                    sub.weight, bias=sub.bias,
+                    act_scale=sub._observer.scale))
+            elif isinstance(sub, QuantedConv2D):
+                setattr(model, name, Int8Conv2D.from_float(
+                    sub.weight, bias=sub.bias, **sub._cfg))
             else:
                 self.convert(sub)
         return model

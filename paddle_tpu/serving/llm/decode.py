@@ -31,8 +31,9 @@ import jax
 import jax.numpy as jnp
 
 from ..cache import ExecutableCache, default_cache
-from .kvcache import StaticKVCache, append_token_kv, valid_mask, \
-    write_prompt_kv, write_prompt_kv_at
+from .kvcache import StaticKVCache, append_token_kv, dequantize_kv, \
+    is_quantized_kv, kv_layer_view, kv_max_seq, kv_stack_layers, \
+    valid_mask, write_prompt_kv, write_prompt_kv_at
 
 
 @dataclass(frozen=True)
@@ -103,6 +104,43 @@ def extract_gpt_params(model) -> Dict[str, Any]:
     }
 
 
+#: per-layer weight matrices that quantize to int8 (biases/norms stay f32
+#: — they are O(E) bytes and scale-sensitive)
+_QUANT_WEIGHT_KEYS = ("qw", "kw", "vw", "ow", "w1", "w2")
+
+
+def quantize_gpt_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-out-channel int8 quantization of the GPT weight pytree: each
+    matmul weight becomes ``{"q": int8 [in, out], "s": f32 [out]}`` with
+    ``w ≈ q * s`` (scale = absmax/127 per column). The embedding tables
+    stay f32: ``tok`` doubles as the logit head, where a per-row scale
+    would perturb the argmax ordering the accuracy budget is measured on.
+    Layout matches :func:`extract_gpt_params`, so the same step builders
+    serve both — ``_mm`` dispatches on the leaf type."""
+    from ...quantization import quantize_weight_int8
+
+    def _q(w):
+        q, s = quantize_weight_int8(w, quant_axis=1)
+        return {"q": q, "s": s}
+
+    layers = tuple(
+        {k: (_q(v) if k in _QUANT_WEIGHT_KEYS else v)
+         for k, v in lp.items()}
+        for lp in params["layers"])
+    return dict(params, layers=layers)
+
+
+def _mm(x, w):
+    """``x @ w`` for a dense f32 weight or an int8 ``{"q", "s"}`` leaf.
+    The int8 path multiplies against the raw codes and applies the
+    per-out-channel scale to the product — exactly equal to dequantizing
+    first (scales distribute over the contraction), but the weight reads
+    stay int8, which is the memory-bandwidth win."""
+    if isinstance(w, dict):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
 # -- building blocks (must mirror the framework eval ops exactly) -----------
 
 def _layer_norm(x, w, b, eps):
@@ -142,21 +180,28 @@ def _block_decode(spec, lp, h, kb, vb, positions, mask, scale):
     """
     s = h.shape[0]
     x = _layer_norm(h, lp["n1w"], lp["n1b"], spec.ln_epsilon)
-    q = (x @ lp["qw"] + lp["qb"]).reshape(s, spec.num_heads, spec.head_dim)
-    kn = (x @ lp["kw"] + lp["kb"]).reshape(s, spec.num_heads, spec.head_dim)
-    vn = (x @ lp["vw"] + lp["vb"]).reshape(s, spec.num_heads, spec.head_dim)
+    q = (_mm(x, lp["qw"]) + lp["qb"]).reshape(s, spec.num_heads,
+                                              spec.head_dim)
+    kn = (_mm(x, lp["kw"]) + lp["kb"]).reshape(s, spec.num_heads,
+                                               spec.head_dim)
+    vn = (_mm(x, lp["vw"]) + lp["vb"]).reshape(s, spec.num_heads,
+                                               spec.head_dim)
     kb, vb = append_token_kv(kb, vb, kn, vn, positions)
+    # int8 cache: dequantize in-register for the attention reads; the
+    # buffers themselves stay quantized
+    kd = dequantize_kv(kb, h.dtype)
+    vd = dequantize_kv(vb, h.dtype)
     qh = (q * scale)[:, :, None, :]                       # [S, H, 1, D]
-    kt = jnp.transpose(kb, (0, 2, 1, 3))                  # [S, H, max, D]
-    vt = jnp.transpose(vb, (0, 2, 1, 3))
+    kt = jnp.transpose(kd, (0, 2, 1, 3))                  # [S, H, max, D]
+    vt = jnp.transpose(vd, (0, 2, 1, 3))
     prod = jnp.matmul(qh, jnp.swapaxes(kt, -1, -2))       # [S, H, 1, max]
     weights = jax.nn.softmax(prod + mask, axis=-1)
     out = jnp.matmul(weights, vt)                         # [S, H, 1, D]
     out = jnp.transpose(out, (0, 2, 1, 3)).reshape(s, spec.hidden_size)
-    h = h + (out @ lp["ow"] + lp["ob"])
+    h = h + (_mm(out, lp["ow"]) + lp["ob"])
     x = _layer_norm(h, lp["n2w"], lp["n2b"], spec.ln_epsilon)
-    ffn = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
-    return h + (ffn @ lp["w2"] + lp["b2"]), kb, vb
+    ffn = jax.nn.gelu(_mm(x, lp["w1"]) + lp["b1"], approximate=False)
+    return h + (_mm(ffn, lp["w2"]) + lp["b2"]), kb, vb
 
 
 def _block_prefill(spec, lp, h, mask, scale):
@@ -168,9 +213,9 @@ def _block_prefill(spec, lp, h, mask, scale):
     def heads(t):                                         # [B, L, H, D]
         return t.reshape(b, l, spec.num_heads, spec.head_dim)
 
-    q = heads(x @ lp["qw"] + lp["qb"])
-    k = heads(x @ lp["kw"] + lp["kb"])
-    v = heads(x @ lp["vw"] + lp["vb"])
+    q = heads(_mm(x, lp["qw"]) + lp["qb"])
+    k = heads(_mm(x, lp["kw"]) + lp["kb"])
+    v = heads(_mm(x, lp["vw"]) + lp["vb"])
     qh = jnp.transpose(q * scale, (0, 2, 1, 3))           # [B, H, L, D]
     kh = jnp.transpose(k, (0, 2, 1, 3))
     vh = jnp.transpose(v, (0, 2, 1, 3))
@@ -178,10 +223,10 @@ def _block_prefill(spec, lp, h, mask, scale):
     weights = jax.nn.softmax(prod + mask, axis=-1)
     out = jnp.matmul(weights, vh)                         # [B, H, L, D]
     out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, l, spec.hidden_size)
-    h = h + (out @ lp["ow"] + lp["ob"])
+    h = h + (_mm(out, lp["ow"]) + lp["ob"])
     x = _layer_norm(h, lp["n2w"], lp["n2b"], spec.ln_epsilon)
-    ffn = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
-    return h + (ffn @ lp["w2"] + lp["b2"]), k, v
+    ffn = jax.nn.gelu(_mm(x, lp["w1"]) + lp["b1"], approximate=False)
+    return h + (_mm(ffn, lp["w2"]) + lp["b2"]), k, v
 
 
 # -- the compiled programs ---------------------------------------------------
@@ -198,19 +243,20 @@ def build_decode_step(spec: GPTDecodeSpec, max_top_k: int):
 
     def _step(params, kbuf, vbuf, lengths, finished, last_tokens,
               temperature, top_k, do_sample, eos, key):
-        max_seq = kbuf.shape[2]
+        max_seq = kv_max_seq(kbuf)
         positions = lengths                       # write position per slot
         posc = jnp.clip(positions, 0, max_pos - 1)
         h = params["tok"][last_tokens] + params["pos"][posc]      # [S, E]
         mask = valid_mask(positions, max_seq, h.dtype)
         new_k, new_v = [], []
         for li, lp in enumerate(params["layers"]):
-            h, kb, vb = _block_decode(spec, lp, h, kbuf[:, li], vbuf[:, li],
+            h, kb, vb = _block_decode(spec, lp, h, kv_layer_view(kbuf, li),
+                                      kv_layer_view(vbuf, li),
                                       positions, mask, scale)
             new_k.append(kb)
             new_v.append(vb)
-        kbuf = jnp.stack(new_k, axis=1)
-        vbuf = jnp.stack(new_v, axis=1)
+        kbuf = kv_stack_layers(new_k)
+        vbuf = kv_stack_layers(new_v)
         h = _layer_norm(h, params["fnw"], params["fnb"], spec.ln_epsilon)
         lraw = (h @ params["tok"].T).astype(jnp.float32)          # [S, V]
         nxt = _sample(lraw, temperature, top_k, do_sample, key, max_top_k)
@@ -330,6 +376,11 @@ def build_tail_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
               finished, slot_ids, temperature, top_k, do_sample, eos, key):
         # tokens: [B, Lt] right-padded tails; tail_lens: [B] true tail
         # counts; starts: [B] reuse offsets (block multiples).
+        if is_quantized_kv(kbuf):
+            raise NotImplementedError(
+                "tail prefill (prefix reuse) over an int8 KV cache is "
+                "unsupported; LLMEngineConfig gates prefix_cache off for "
+                "kv_dtype='int8'")
         b, lt = tokens.shape
         max_seq = kbuf.shape[2]
         pos = starts[:, None] + jnp.arange(lt, dtype=jnp.int32)[None]
@@ -348,9 +399,9 @@ def build_tail_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
             def heads(t):
                 return t.reshape(b, lt, spec.num_heads, spec.head_dim)
 
-            q = heads(x @ lp["qw"] + lp["qb"])
-            kn = heads(x @ lp["kw"] + lp["kb"])
-            vn = heads(x @ lp["vw"] + lp["vb"])
+            q = heads(_mm(x, lp["qw"]) + lp["qb"])
+            kn = heads(_mm(x, lp["kw"]) + lp["kb"])
+            vn = heads(_mm(x, lp["vw"]) + lp["vb"])
             # attention reads the gathered slot rows with the fresh tail
             # K/V spliced in; the buffers themselves are written once,
             # after the layer loop, via ONE update per request
@@ -370,10 +421,11 @@ def build_tail_prefill_fn(spec: GPTDecodeSpec, max_top_k: int):
             out = jnp.matmul(weights, vt)                      # [B,H,Lt,D]
             out = jnp.transpose(out, (0, 2, 1, 3)).reshape(
                 b, lt, spec.hidden_size)
-            h = h + (out @ lp["ow"] + lp["ob"])
+            h = h + (_mm(out, lp["ow"]) + lp["ob"])
             x = _layer_norm(h, lp["n2w"], lp["n2b"], spec.ln_epsilon)
-            ffn = jax.nn.gelu(x @ lp["w1"] + lp["b1"], approximate=False)
-            h = h + (ffn @ lp["w2"] + lp["b2"])
+            ffn = jax.nn.gelu(_mm(x, lp["w1"]) + lp["b1"],
+                              approximate=False)
+            h = h + (_mm(ffn, lp["w2"]) + lp["b2"])
             kcs.append(kn)
             vcs.append(vn)
         kbuf, vbuf = write_prompt_kv_at(
@@ -468,10 +520,21 @@ class GPTStaticDecoder:
 
     def __init__(self, model, max_top_k: int = 64,
                  exec_cache: Optional[ExecutableCache] = None,
-                 mesh=None, slot_axis: str = "model"):
+                 mesh=None, slot_axis: str = "model",
+                 weight_dtype: str = "float32",
+                 kv_dtype: str = "float32"):
         self.spec = GPTDecodeSpec.from_model(model)
         self._model = model
         self.max_top_k = max(0, min(int(max_top_k), self.spec.vocab_size))
+        if weight_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'float32' or 'int8', got "
+                f"{weight_dtype!r}")
+        if kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r}")
+        self.weight_dtype = weight_dtype
+        self.kv_dtype = kv_dtype
         # NOT `exec_cache or ...`: an empty ExecutableCache has len() == 0
         # and is falsy, which would silently orphan the engine's cache.
         # Default is the ONE process-wide cache (serving/cache.py), shared
@@ -487,7 +550,8 @@ class GPTStaticDecoder:
         # unsharded key).
         self.mesh = mesh
         self.slot_axis = slot_axis
-        self._key = ("gpt-static", self.spec, self.max_top_k)
+        self._key = ("gpt-static", self.spec, self.max_top_k,
+                     self.weight_dtype, self.kv_dtype)
         self._param_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -497,6 +561,8 @@ class GPTStaticDecoder:
 
     def params(self):
         p = extract_gpt_params(self._model)
+        if self.weight_dtype == "int8":
+            p = quantize_gpt_params(p)
         if self._param_sharding is not None:
             sh = self._param_sharding
             p = jax.tree_util.tree_map(
@@ -512,7 +578,9 @@ class GPTStaticDecoder:
         return StaticKVCache(num_slots, self.spec.num_layers, max_seq,
                              self.spec.num_heads, self.spec.head_dim,
                              dtype=dtype, mesh=self.mesh,
-                             slot_axis=self.slot_axis)
+                             slot_axis=self.slot_axis,
+                             kv_dtype=("int8" if self.kv_dtype == "int8"
+                                       else None))
 
     # -- compiled-program access --------------------------------------------
     def decode_fn(self, num_slots: int, max_seq: int):
@@ -564,6 +632,11 @@ class GPTStaticDecoder:
         """Prefill prompt *tails* at per-request offsets (after an
         :meth:`insert_prefix` landed the cached head); same return shape
         as :meth:`prefill`."""
+        if kv.quantized:
+            raise NotImplementedError(
+                "tail_prefill over an int8 KV cache is unsupported; "
+                "LLMEngineConfig gates prefix_cache off for "
+                "kv_dtype='int8'")
         fn = self.tail_prefill_fn(tokens.shape[0], tokens.shape[1])
         k, v, lengths, finished, nxt = fn(
             params, tokens, tail_lens, starts, kv.k, kv.v, kv.lengths,
@@ -575,6 +648,11 @@ class GPTStaticDecoder:
         """Bulk-copy a cached host prefix ``[L, n, H, D]`` into ``slot``'s
         rows [0, n) — one batched device update across all layers. The
         slot's length is set by the tail prefill that follows."""
+        if kv.quantized:
+            raise NotImplementedError(
+                "insert_prefix into an int8 KV cache is unsupported; "
+                "LLMEngineConfig gates prefix_cache off for "
+                "kv_dtype='int8'")
         fn = self.insert_prefix_fn(int(k_pre.shape[1]))
         k, v = fn(kv.k, kv.v, jnp.asarray(k_pre, dtype=kv.dtype),
                   jnp.asarray(v_pre, dtype=kv.dtype), slot)
@@ -645,6 +723,39 @@ def _audit_decode_spec():
                            make_args=make_args)
 
 
+def _audit_int8_decode_spec():
+    """Same decode step, int8 weights + int8 KV: the serving-memory
+    tentpole's executable. Proves the quantized hot path keeps PTA009's
+    zero-host-transfer invariant (dequantization is fused in-graph)."""
+    from ...core import audit
+    spec = _AUDIT_SPEC
+    slots, max_seq, layers = 2, 16, spec.num_layers
+    hd = spec.head_dim
+
+    def make_args(variant):
+        rng = np.random.default_rng(5678 + variant)
+        q_shape = (slots, layers, max_seq, spec.num_heads, hd)
+        s_shape = (slots, layers, max_seq)
+
+        def qbuf():
+            return {"q": jnp.zeros(q_shape, jnp.int8),
+                    "s": jnp.zeros(s_shape, jnp.float32)}
+
+        return (quantize_gpt_params(_audit_params(rng)),
+                qbuf(), qbuf(),
+                jnp.asarray([3, 1], jnp.int32),           # lengths
+                jnp.zeros((slots,), bool),                # finished
+                jnp.asarray(rng.integers(0, spec.vocab_size, slots),
+                            jnp.int32),                   # last_tokens
+                jnp.ones((slots,), jnp.float32),          # temperature
+                jnp.zeros((slots,), jnp.int32),           # top_k
+                jnp.zeros((slots,), bool),                # do_sample
+                jnp.full((slots,), -1, jnp.int32),        # eos
+                jax.random.PRNGKey(variant))
+    return audit.AuditSpec(fn=build_decode_step(spec, _AUDIT_TOP_K),
+                           make_args=make_args)
+
+
 def _audit_prefill_spec():
     from ...core import audit
     spec = _AUDIT_SPEC
@@ -676,6 +787,10 @@ def _register_audit_entrypoints():
     from ...core import audit
     audit.register_entrypoint("llm_decode_step", _audit_decode_spec,
                               tags=("serving", "decode"))
+    audit.register_entrypoint("llm_int8_decode_step",
+                              _audit_int8_decode_spec,
+                              tags=("serving", "decode", "quantized",
+                                    "bench"))
     audit.register_entrypoint("llm_prefill", _audit_prefill_spec,
                               tags=("serving", "prefill"))
 

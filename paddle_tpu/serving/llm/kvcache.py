@@ -36,6 +36,65 @@ class SlotsExhausted(RuntimeError):
     :attr:`StaticKVCache.free_slots` instead of catching this)."""
 
 
+# -- int8 KV representation ---------------------------------------------------
+# A quantized buffer is a dict pytree {"q": int8 [..., H, D] codes,
+# "s": f32 [...] per-row absmax scales} — one scale per (slot, layer,
+# position) row, so a loud token cannot flatten its neighbours'
+# resolution. Dequant is q/127*s, computed INSIDE the fused decode step
+# (the codes never round-trip through the host). Dicts are pytrees, so
+# the quantized buffers flow through jax.jit/device_put exactly like the
+# dense arrays they replace.
+
+def quantize_kv_rows(x):
+    """``[..., H, D]`` float rows -> ({int8 codes, f32 scales}) with one
+    absmax scale per row (all leading axes)."""
+    absmax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    s = jnp.where(absmax > 0, absmax, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x / s[..., None, None] * 127.0),
+                 -127.0, 127.0).astype(jnp.int8)
+    return {"q": q, "s": s}
+
+
+def dequantize_kv(buf, dtype=jnp.float32):
+    """Dense view of a quantized buffer (or identity on a dense one)."""
+    if not isinstance(buf, dict):
+        return buf
+    return (buf["q"].astype(dtype)
+            * (buf["s"][..., None, None] / 127.0).astype(dtype))
+
+
+def is_quantized_kv(buf) -> bool:
+    return isinstance(buf, dict)
+
+
+def kv_layer_view(buf, li: int):
+    """Layer ``li``'s slice of a whole-cache buffer: dense
+    ``[S, L, max, H, D] -> [S, max, H, D]``, quantized dict likewise on
+    both leaves."""
+    if isinstance(buf, dict):
+        return {"q": buf["q"][:, li], "s": buf["s"][:, li]}
+    return buf[:, li]
+
+
+def kv_stack_layers(bufs):
+    """Inverse of :func:`kv_layer_view` over all layers: re-stack the
+    per-layer buffers on axis 1."""
+    if bufs and isinstance(bufs[0], dict):
+        return {"q": jnp.stack([b["q"] for b in bufs], axis=1),
+                "s": jnp.stack([b["s"] for b in bufs], axis=1)}
+    return jnp.stack(bufs, axis=1)
+
+
+def kv_max_seq(buf) -> int:
+    return (buf["q"] if isinstance(buf, dict) else buf).shape[2]
+
+
+def kv_nbytes(buf) -> int:
+    """Device bytes of a (possibly quantized) KV buffer."""
+    return sum(int(leaf.nbytes)
+               for leaf in jax.tree_util.tree_leaves(buf))
+
+
 class StaticKVCache:
     """Preallocated per-slot KV storage + per-slot length/position state.
 
@@ -49,21 +108,38 @@ class StaticKVCache:
 
     def __init__(self, num_slots: int, num_layers: int, max_seq: int,
                  num_heads: int, head_dim: int, dtype="float32",
-                 mesh=None, slot_axis: str = "model"):
+                 mesh=None, slot_axis: str = "model",
+                 kv_dtype: Optional[str] = None):
         if num_slots < 1 or max_seq < 2:
             raise ValueError(
                 f"need num_slots >= 1 and max_seq >= 2, got "
                 f"{num_slots}/{max_seq}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (dense) or 'int8', got "
+                f"{kv_dtype!r}")
         self.num_slots = int(num_slots)
         self.num_layers = int(num_layers)
         self.max_seq = int(max_seq)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
         self.dtype = jnp.dtype(dtype)
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
         self.mesh = mesh
         self.slot_axis = slot_axis
         shape = (self.num_slots, self.num_layers, self.max_seq,
                  self.num_heads, self.head_dim)
+        if self.quantized:
+            # {"q": int8 codes, "s": f32 per-(slot,layer,row) scales} —
+            # halves KV memory (+1 scale per H*D row); the decode step
+            # dequantizes in-register, so the codes never leave device
+            def _zero_buf():
+                return {"q": jnp.zeros(shape, jnp.int8),
+                        "s": jnp.zeros(shape[:3], jnp.float32)}
+        else:
+            def _zero_buf():
+                return jnp.zeros(shape, self.dtype)
         if mesh is not None:
             # GSPMD: shard the slot axis over the model axis of the mesh.
             # Slot rows are independent (attention never crosses slots),
@@ -79,18 +155,19 @@ class StaticKVCache:
                                               PartitionSpec(slot_axis))
             self._len_sharding = NamedSharding(mesh,
                                                PartitionSpec(slot_axis))
-            self.k = jax.device_put(jnp.zeros(shape, self.dtype),
-                                    self._kv_sharding)
-            self.v = jax.device_put(jnp.zeros(shape, self.dtype),
-                                    self._kv_sharding)
+            sh = self._kv_sharding
+            self.k = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), _zero_buf())
+            self.v = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), _zero_buf())
             self.lengths = jax.device_put(
                 jnp.zeros((self.num_slots,), jnp.int32),
                 self._len_sharding)
         else:
             self._kv_sharding = None
             self._len_sharding = None
-            self.k = jnp.zeros(shape, self.dtype)
-            self.v = jnp.zeros(shape, self.dtype)
+            self.k = _zero_buf()
+            self.v = _zero_buf()
             self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
         self._free: List[int] = list(range(self.num_slots))
         self._active: set = set()
@@ -138,9 +215,16 @@ class StaticKVCache:
     def swap(self, k, v, lengths):
         """Install the arrays returned by a jitted prefill/decode call.
         Shape-checked: a shape change would mean a recompile upstream."""
-        assert k.shape == self.k.shape and v.shape == self.v.shape, \
-            (k.shape, self.k.shape)
+        def _shapes(buf):
+            return [leaf.shape for leaf in jax.tree_util.tree_leaves(buf)]
+        assert _shapes(k) == _shapes(self.k) \
+            and _shapes(v) == _shapes(self.v), (_shapes(k), _shapes(self.k))
         self.k, self.v, self.lengths = k, v, lengths
+
+    def kv_bytes(self) -> int:
+        """Device bytes held by the K+V buffers (the slots-per-chip
+        denominator the int8 acceptance bar is measured with)."""
+        return kv_nbytes(self.k) + kv_nbytes(self.v)
 
     def host_lengths(self) -> np.ndarray:
         """One deliberate device->host fetch of the per-slot lengths (used
@@ -154,6 +238,11 @@ class StaticKVCache:
         rows as ``[num_layers, n, heads, head_dim]`` host arrays — the
         prefix-store export path. Called once per *admission* (after a
         prefill populated the rows), never on the per-tick path."""
+        if self.quantized:
+            raise NotImplementedError(
+                "prefix export from an int8 KV cache is unsupported "
+                "(prefix reuse is gated off at config time for "
+                "kv_dtype='int8'; see LLMEngineConfig)")
         if not (0 <= slot < self.num_slots) or not (0 < n <= self.max_seq):
             raise ValueError(f"bad prefix export slot={slot} n={n}")
         k = np.asarray(jax.device_get(self.k[slot, :, :n]))  # noqa: PTA002 -- admission-time prefix-store export (one copy per admitted prompt); never on the per-tick path
@@ -180,6 +269,10 @@ def append_token_kv(kb, vb, k_new, v_new, positions):
     axis — per-slot starts are traced values, so XLA lowers this to one
     scatter, keeping the decode step a single fused program.
     """
+    if is_quantized_kv(kb):
+        return (_append_token_kv_q(kb, k_new, positions),
+                _append_token_kv_q(vb, v_new, positions))
+
     def _one(row_k, row_v, kn, vn, pos):
         # row_*: [max_seq, H, D]; kn/vn: [H, D]
         start = (pos, 0, 0)
@@ -187,6 +280,21 @@ def append_token_kv(kb, vb, k_new, v_new, positions):
                 jax.lax.dynamic_update_slice(row_v, vn[None], start))
 
     return jax.vmap(_one)(kb, vb, k_new, v_new, positions)
+
+
+def _append_token_kv_q(buf, new, positions):
+    """int8 variant of the single-token writer: quantize the new rows
+    (one scale per slot) and land code + scale with the same vmapped
+    ``dynamic_update_slice`` shape — still one scatter per leaf."""
+    qs = quantize_kv_rows(new)                 # q [S, H, D], s [S]
+
+    def _one(row_q, row_s, qn, sn, pos):
+        # row_q: [max_seq, H, D] int8; row_s: [max_seq] f32
+        return (jax.lax.dynamic_update_slice(row_q, qn[None], (pos, 0, 0)),
+                jax.lax.dynamic_update_slice(row_s, sn[None], (pos,)))
+
+    q, s = jax.vmap(_one)(buf["q"], buf["s"], qs["q"], qs["s"], positions)
+    return {"q": q, "s": s}
 
 
 def append_tokens_kv(kb, vb, k_new, v_new, positions):
@@ -215,12 +323,31 @@ def write_prompt_kv_at(k_buf, v_buf, k_new, v_new, slot_ids, starts):
     batched ``dynamic_update_slice`` per request covers all layers at
     once — no per-layer host loop, the tentpole invariant for prefix
     bulk-copy."""
+    if is_quantized_kv(k_buf):
+        return (_write_prompt_kv_q(k_buf, k_new, slot_ids, starts),
+                _write_prompt_kv_q(v_buf, v_new, slot_ids, starts))
     b = k_new.shape[0]
     for i in range(b):
         start = (slot_ids[i], 0, starts[i], 0, 0)
         k_buf = jax.lax.dynamic_update_slice(k_buf, k_new[i][None], start)
         v_buf = jax.lax.dynamic_update_slice(v_buf, v_new[i][None], start)
     return k_buf, v_buf
+
+
+def _write_prompt_kv_q(buf, new, slot_ids, starts=None):
+    """int8 variant of the prompt writers: quantize the ``[B, L, Lp, H,
+    D]`` rows (one scale per row) and land codes + scales per request —
+    still one ``dynamic_update_slice`` pair per request for all layers."""
+    qs = quantize_kv_rows(new)           # q like new, s [B, L, Lp]
+    q, s = buf["q"], buf["s"]
+    b = new.shape[0]
+    for i in range(b):
+        st = 0 if starts is None else starts[i]
+        q = jax.lax.dynamic_update_slice(
+            q, qs["q"][i][None], (slot_ids[i], 0, st, 0, 0))
+        s = jax.lax.dynamic_update_slice(
+            s, qs["s"][i][None], (slot_ids[i], 0, st))
+    return {"q": q, "s": s}
 
 
 def write_prompt_kv(k_buf, v_buf, k_prompt, v_prompt, slot_ids):
@@ -232,6 +359,9 @@ def write_prompt_kv(k_buf, v_buf, k_prompt, v_prompt, slot_ids):
     ``dynamic_update_slice`` ops — prefill batches are small (usually 1
     per admission) and each op writes one contiguous slot row.
     """
+    if is_quantized_kv(k_buf):
+        return (_write_prompt_kv_q(k_buf, k_prompt, slot_ids),
+                _write_prompt_kv_q(v_buf, v_prompt, slot_ids))
     b = k_prompt.shape[0]
     for i in range(b):
         start = (slot_ids[i], 0, 0, 0, 0)

@@ -170,6 +170,8 @@ class LLMEngineConfig:
                  prefix_capacity_mb: float = 256.0,
                  spec_k: int = 0,
                  role: str = "mixed",
+                 weight_dtype: str = "float32",
+                 kv_dtype: str = "float32",
                  stat_prefix: str = "serving.llm"):
         self.num_slots = int(num_slots)
         self.max_seq = int(max_seq)
@@ -205,6 +207,28 @@ class LLMEngineConfig:
             raise ValueError(
                 f"role must be prefill/decode/mixed, got {role!r}")
         self.role = role
+        # quantized serving (docs/quantization.md): int8 weights halve
+        # parameter bytes; int8 KV halves cache bytes so slots-per-chip
+        # doubles. Both dequantize inside the fused decode step.
+        if weight_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"weight_dtype must be 'float32' or 'int8', got "
+                f"{weight_dtype!r}")
+        if kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'float32' or 'int8', got {kv_dtype!r}")
+        if kv_dtype == "int8" and self.prefix_cache:
+            raise ValueError(
+                "prefix_cache requires a dense KV cache: the prefix "
+                "export/insert path moves raw f32 rows between engines. "
+                "Set kv_dtype='float32' or prefix_cache=False.")
+        if kv_dtype == "int8" and self.spec_k > 0:
+            raise ValueError(
+                "speculative decoding (spec_k > 0) requires a dense KV "
+                "cache: the verify/rollback path rewrites accepted rows "
+                "in place. Set kv_dtype='float32' or spec_k=0.")
+        self.weight_dtype = weight_dtype
+        self.kv_dtype = kv_dtype
         self.stat_prefix = stat_prefix
 
     @property
@@ -652,11 +676,18 @@ class LLMEngine(DrainableEngineBase):
         self._cache = cache if cache is not None else default_cache()
         self._decoder = GPTStaticDecoder(
             model, max_top_k=self._config.max_top_k, exec_cache=self._cache,
-            mesh=mesh, slot_axis=slot_axis)
+            mesh=mesh, slot_axis=slot_axis,
+            weight_dtype=self._config.weight_dtype,
+            kv_dtype=self._config.kv_dtype)
         # prefix reuse: an explicit store (the disaggregated fleet shares
         # ONE across replicas for the prefill->decode KV handoff) enables
         # it even when the config flag is off
         self._prefix_store = prefix_store
+        if prefix_store is not None and self._config.kv_dtype == "int8":
+            raise ValueError(
+                "a shared PrefixStore requires a dense KV cache "
+                "(kv_dtype='float32'): prefix export/insert moves raw "
+                "f32 rows between engines")
         if self._prefix_store is None and self._config.prefix_cache:
             self._prefix_store = PrefixStore(
                 capacity_bytes=int(

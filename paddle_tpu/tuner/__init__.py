@@ -29,14 +29,17 @@ import threading
 from typing import Any, Dict, Optional, Tuple
 
 from . import runner, space, store
-from .space import flash_candidates, nms_candidates
+from .space import compress_block_candidates, flash_candidates, nms_candidates
 from .store import CACHE_VERSION, WinnerStore, cache_dir, store_for
 
 __all__ = [
     "CACHE_VERSION", "WinnerStore", "cache_dir", "store_for",
-    "flash_key", "nms_key", "get_flash_blocks", "get_nms_config",
-    "record_winner", "autotune_flash", "tune_on_miss_enabled",
-    "flash_candidates", "nms_candidates", "clear_memo",
+    "flash_key", "nms_key", "compress_key",
+    "get_flash_blocks", "get_nms_config", "get_compress_block",
+    "record_winner", "autotune_flash", "autotune_compress",
+    "tune_on_miss_enabled",
+    "flash_candidates", "nms_candidates", "compress_block_candidates",
+    "clear_memo",
 ]
 
 _ENV_AUTOTUNE = "PADDLE_TPU_AUTOTUNE"
@@ -92,6 +95,16 @@ def nms_key(k: int, platform: Optional[str] = None) -> str:
     return f"nms|{platform or _platform()}|k{int(k)}"
 
 
+def compress_key(nelems: int, wire_dtype: str = "int8",
+                 platform: Optional[str] = None) -> str:
+    """Key for the compressed-allreduce quantize-block family. Gradient
+    sizes are bucketed to the next power of two (a 900k and a 1M gradient
+    share a winner) with a 64-element floor."""
+    n = max(64, int(nelems))  # noqa: PTA001 -- nelems is x.size, a host python int at trace time
+    bucket = 1 << (n - 1).bit_length()
+    return f"compress|{platform or _platform()}|{wire_dtype}|n{bucket}"
+
+
 # -- lookup (the kernel-call path) -------------------------------------------
 
 def _resolve(key: str) -> Optional[Dict[str, Any]]:
@@ -132,6 +145,19 @@ def get_spec_verify_blocks(k: int, kv_len: int, head_dim: int,
 
 def get_nms_config(k: int) -> Optional[Dict[str, Any]]:
     return _resolve(nms_key(k))
+
+
+def get_compress_block(nelems: int, wire_dtype: str = "int8"
+                       ) -> Optional[int]:
+    """The tuned quantize block for a gradient-size family, or None when
+    no winner is known (collective.py applies its 256 default)."""
+    cfg = _resolve(compress_key(nelems, wire_dtype))
+    if not cfg:
+        return None
+    try:
+        return int(cfg["block"])
+    except (KeyError, TypeError, ValueError):
+        return None
 
 
 def record_winner(key: str, config: Dict[str, Any],
@@ -200,4 +226,46 @@ def autotune_flash(batch_heads: int, q_len: int, kv_len: int,
     if record:
         record_winner(flash_key(q_len, kv_len, head_dim, dtype, causal,
                                 ring=ring), cfg, us=us)
+    return dict(cfg, us=us, results=results)
+
+
+def autotune_compress(nelems: int, wire_dtype: str = "int8",
+                      trials: int = 5, record: bool = True
+                      ) -> Dict[str, Any]:
+    """Search the quantize block size for one gradient-size family by
+    timing the jitted quantize→dequantize roundtrip (the stage whose cost
+    the block size controls; the wire bytes per candidate are analytic
+    and nearly flat past 64). Persists the winner under
+    :func:`compress_key` so ``distributed.collective`` picks it up."""
+    import jax
+    import jax.numpy as jnp
+    from ..distributed.collective import (_block_dequantize_int8,
+                                          _block_quantize_int8)
+
+    n = max(64, int(nelems))
+    x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+    cands = [c["block"] for c in compress_block_candidates(n)]
+
+    def make_runner(blk):
+        pad = -(-n // blk) * blk - n
+
+        def roundtrip(v):
+            blocks = jnp.pad(v, (0, pad)).reshape(-1, blk)
+            if wire_dtype == "bf16":
+                return blocks.astype(jnp.bfloat16).astype(
+                    jnp.float32).reshape(-1)[:n]
+            q, s = _block_quantize_int8(blocks)
+            return _block_dequantize_int8(q, s).reshape(-1)[:n]
+        fn = jax.jit(roundtrip)
+        return lambda: fn(x)
+
+    best, best_t, results = runner.search(cands, make_runner,
+                                          trials=trials)
+    if best is None:
+        raise RuntimeError(
+            f"autotune_compress: no candidate ran for nelems={nelems}")
+    cfg = {"block": int(best)}
+    us = best_t * 1e6
+    if record:
+        record_winner(compress_key(nelems, wire_dtype), cfg, us=us)
     return dict(cfg, us=us, results=results)

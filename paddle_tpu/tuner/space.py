@@ -80,6 +80,20 @@ def flash_candidates(q_len: int, kv_len: int, head_dim: int,
     return out
 
 
+#: candidate block sizes for the compressed-allreduce quantize stage.
+#: Smaller blocks track outliers better (tighter scales) but pay more
+#: scale-sidecar bytes; larger blocks amortize the sidecar but let one
+#: outlier flatten a whole block's resolution.
+COMPRESS_BLOCKS = (64, 128, 256, 512, 1024)
+
+
+def compress_block_candidates(nelems: int) -> List[Dict[str, int]]:
+    """Block-size candidates for one gradient-size family: a block larger
+    than the payload only pads, so prune those."""
+    out = [{"block": b} for b in COMPRESS_BLOCKS if b <= max(64, nelems)]
+    return out or [{"block": COMPRESS_BLOCKS[0]}]
+
+
 def nms_candidates(k: int) -> List[Dict[str, int]]:
     """Unroll factors for the greedy-NMS fori_loop (ops/custom.py): the
     loop body is tiny, so unrolling amortizes loop overhead until the

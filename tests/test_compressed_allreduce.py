@@ -1,0 +1,218 @@
+"""Compressed gradient allreduce (EQuARX-style block-scaled int8/bf16
+wire format, PAPERS.md) on the 8-virtual-device CPU mesh: error bounds
+vs the dense exchange, replica bitwise identity, convergence parity,
+the >=3x wire-bytes bar, and the fleet/DataParallel plumbing."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.collective import (
+    DEFAULT_COMPRESS_BLOCK, _block_dequantize_int8, _block_quantize_int8,
+    build_compressed_train_step)
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    dist.set_mesh(dist.build_mesh({"dp": 8}))
+    yield
+    dist.set_mesh(None)
+
+
+def spmd(fn, in_specs, out_specs, check=True):
+    # check=False: the compressed allreduce's all_gather phase replicates
+    # the result by construction, but the checker can't infer that
+    return jax.shard_map(fn, mesh=dist.get_mesh(),
+                         in_specs=in_specs, out_specs=out_specs,
+                         check_vma=check)
+
+
+class TestBlockQuantize:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        blocks = jnp.asarray(rng.standard_normal((16, 64)), jnp.float32)
+        q, s = _block_quantize_int8(blocks)
+        assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+        deq = _block_dequantize_int8(q, s)
+        # symmetric round-to-nearest: error <= absmax/(2*127) per block
+        bound = np.asarray(s)[:, None] / (2 * 127) + 1e-7
+        assert np.all(np.abs(np.asarray(deq - blocks)) <= bound)
+
+    def test_zero_block_is_exact(self):
+        q, s = _block_quantize_int8(jnp.zeros((2, 8)))
+        np.testing.assert_array_equal(np.asarray(q), 0)
+        deq = _block_dequantize_int8(q, s)
+        np.testing.assert_array_equal(np.asarray(deq), 0.0)
+
+
+class TestCompressedGradSync:
+    def _sync(self, x, **kw):
+        return spmd(lambda v: dist.compressed_grad_sync(v, **kw),
+                    P("dp"), P(), check=False)(x)
+
+    def test_int8_matches_dense_mean(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((8, 1000)), jnp.float32)
+        out = self._sync(x, wire_dtype="int8", block=128)
+        ref = np.asarray(x).mean(axis=0)
+        # two quantize stages, each bounded by absmax/127 per block
+        absmax = np.abs(np.asarray(x)).max()
+        bound = 2.5 * absmax / 127
+        assert np.abs(np.asarray(out) - ref).max() < bound
+
+    def test_bf16_wire_is_tighter(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((8, 513)), jnp.float32)
+        ref = np.asarray(x).mean(axis=0)
+        e_bf16 = np.abs(np.asarray(
+            self._sync(x, wire_dtype="bf16")) - ref).max()
+        assert e_bf16 < 0.05
+
+    def test_replicas_bitwise_identical(self):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((8, 300)), jnp.float32)
+        full = spmd(lambda v: dist.compressed_grad_sync(v),
+                    P("dp"), P("dp"))(x)   # keep per-rank copies
+        rows = np.asarray(full).reshape(8, -1)
+        for r in range(1, 8):
+            np.testing.assert_array_equal(rows[0], rows[r])
+
+    def test_pytree_and_odd_sizes(self):
+        rng = np.random.default_rng(4)
+        tree = {"w": jnp.asarray(rng.standard_normal((8, 37)), jnp.float32),
+                "b": jnp.asarray(rng.standard_normal((8, 3)), jnp.float32)}
+        out = spmd(lambda t: dist.compressed_grad_sync(t), P("dp"), P(),
+                   check=False)(tree)
+        for k in tree:
+            ref = np.asarray(tree[k]).mean(axis=0)
+            assert np.abs(np.asarray(out[k]) - ref).max() < 0.1
+
+    def test_bad_wire_dtype_raises(self):
+        with pytest.raises(ValueError):
+            spmd(lambda v: dist.compressed_grad_sync(v, wire_dtype="fp4"),
+                 P("dp"), P())(jnp.zeros((8, 8)))
+
+
+class TestWireBytes:
+    def test_int8_beats_dense_3x(self):
+        for n in (1 << 20, 1 << 24):
+            comp = dist.compressed_allreduce_wire_bytes(n, 8, "int8")
+            dense = dist.dense_allreduce_wire_bytes(n, 8)
+            assert dense / comp >= 3.0, (n, dense / comp)
+
+    def test_bf16_is_half(self):
+        n = 1 << 20
+        comp = dist.compressed_allreduce_wire_bytes(n, 8, "bf16")
+        dense = dist.dense_allreduce_wire_bytes(n, 8)
+        assert abs(dense / comp - 2.0) < 0.01
+
+    def test_world_of_one_is_free(self):
+        assert dist.compressed_allreduce_wire_bytes(1024, 1) == 0
+        assert dist.dense_allreduce_wire_bytes(1024, 1) == 0
+
+    def test_scale_sidecar_charged(self):
+        n = 1 << 16
+        small = dist.compressed_allreduce_wire_bytes(n, 8, "int8", block=64)
+        large = dist.compressed_allreduce_wire_bytes(n, 8, "int8", block=512)
+        assert small > large  # more blocks -> more scale bytes
+
+
+class TestConvergence:
+    def test_compressed_step_tracks_dense(self):
+        """Linear regression: the compressed-sync step must reach the
+        same loss neighborhood as the dense-sync step."""
+        mesh = dist.get_mesh()
+        rng = np.random.default_rng(7)
+        feat, out, per = 16, 4, 8
+        w_true = rng.standard_normal((feat, out)).astype(np.float32)
+        x = rng.standard_normal((8 * per, feat)).astype(np.float32)
+        y = (x @ w_true).astype(np.float32)
+
+        def run(step_fn):
+            w = jnp.zeros((feat, out), jnp.float32)
+            b = jnp.zeros((out,), jnp.float32)
+            losses = []
+            for _ in range(25):
+                w, b, loss = step_fn(w, b, jnp.asarray(x), jnp.asarray(y))
+                losses.append(float(loss))
+            return losses
+
+        comp = run(jax.jit(build_compressed_train_step(mesh, lr=0.05)))
+        assert comp[-1] < 0.05 * comp[0]          # converges
+        dense = run(jax.jit(build_compressed_train_step(
+            mesh, wire_dtype="bf16", lr=0.05)))
+        assert abs(comp[-1] - dense[-1]) < 0.1    # same neighborhood
+
+
+class TestPublicAPI:
+    def test_world_of_one_identity(self):
+        t = paddle.to_tensor(np.arange(6.0, dtype=np.float32))
+        dist.compressed_all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), np.arange(6.0))
+
+    def test_unsupported_op_raises(self):
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        with pytest.raises(NotImplementedError):
+            dist.compressed_all_reduce(t, op=dist.ReduceOp.MAX)
+
+    def test_bad_dtype_raises(self):
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        with pytest.raises(ValueError):
+            dist.compressed_all_reduce(t, wire_dtype="int4")
+
+    def test_mapped_context(self):
+        x = np.arange(8.0, dtype=np.float32)
+        out = spmd(lambda v: dist.compressed_all_reduce(v),
+                   P("dp"), P("dp"))(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, x.sum()),
+                                   atol=x.max() / 20)
+
+
+class TestFleetWiring:
+    def test_strategy_flags_reach_data_parallel(self):
+        st = fleet.DistributedStrategy()
+        st.compressed_allreduce = True
+        st.compressed_allreduce_dtype = "bf16"
+        fleet.init(is_collective=True, strategy=st)
+        import paddle_tpu.nn as nn
+        model = fleet.distributed_model(nn.Linear(4, 2))
+        assert model._compressed_allreduce is True
+        assert model._compressed_dtype == "bf16"
+
+    def test_bad_strategy_dtype_rejected(self):
+        st = fleet.DistributedStrategy()
+        st.compressed_allreduce = True
+        st.compressed_allreduce_dtype = "int4"
+        with pytest.raises(ValueError, match="int8"):
+            fleet.init(is_collective=True, strategy=st)
+
+    def test_dgc_error_names_replacement(self):
+        st = fleet.DistributedStrategy()
+        st.dgc = True
+        with pytest.raises(NotImplementedError, match="compressed_allreduce"):
+            fleet.init(is_collective=True, strategy=st)
+
+    def test_data_parallel_rejects_bad_dtype(self):
+        import paddle_tpu.nn as nn
+        with pytest.raises(ValueError):
+            dist.DataParallel(nn.Linear(2, 2), compressed_allreduce=True,
+                              compressed_allreduce_dtype="fp8")
+
+
+class TestTunerLane:
+    def test_block_candidates_and_key(self):
+        from paddle_tpu import tuner
+        cands = tuner.compress_block_candidates(1 << 20)
+        assert {c["block"] for c in cands} >= {64, 128, 256, 512}
+        k1 = tuner.compress_key(900_000, "int8", platform="cpu")
+        k2 = tuner.compress_key(1_000_000, "int8", platform="cpu")
+        assert k1 == k2  # pow2 bucketing shares a winner
+
+    def test_default_block_without_winner(self):
+        from paddle_tpu.distributed.collective import _compress_block_for
+        assert _compress_block_for(12345, "int8") in (
+            64, 128, 256, 512, 1024, DEFAULT_COMPRESS_BLOCK)

@@ -50,6 +50,14 @@ HOT_PREFIXES = (
     # Besides the device-fetch checks, this file gets the blocking-I/O
     # sub-check below; writer-thread internals carry noqa justifications.
     "paddle_tpu/incubate/checkpoint/async_ckpt.py",
+    # quantized hot paths (docs/quantization.md): Int8Linear.forward runs
+    # per serving request and PTQ observers run per training batch — a
+    # host sync in either multiplies by step rate
+    "paddle_tpu/quantization/",
+    # compressed gradient allreduce runs once per optimizer step over
+    # every gradient byte; eager group bookkeeping carries noqa
+    # justifications
+    "paddle_tpu/distributed/collective.py",
 )
 
 SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}

@@ -47,12 +47,19 @@ BENCH_FLASH_SHAPES = [
 ]
 BENCH_NMS_KS = [128]
 
+#: (nelems, wire_dtype) — gradient-size families for the compressed
+#: allreduce quantize stage (pow2-bucketed by compress_key, so one entry
+#: covers the whole bucket)
+BENCH_COMPRESS_SIZES = [(1 << 20, "int8"), (1 << 24, "int8"),
+                        (1 << 20, "bf16")]
+
 #: small enough for interpret-mode Pallas (CPU/CI): seconds, not hours
 QUICK_FLASH_SHAPES = [
     (128, 128, 32, "float32", True, False),
     (64, 64, 32, "float32", False, True),
 ]
 QUICK_NMS_KS = [64]
+QUICK_COMPRESS_SIZES = [(1 << 16, "int8")]
 
 
 def tune_flash_lane(shapes, trials, batch_heads):
@@ -111,6 +118,19 @@ def tune_nms_lane(ks, trials, interpret):
     return results
 
 
+def tune_compress_lane(sizes, trials):
+    from paddle_tpu import tuner
+
+    results = {}
+    for nelems, wire_dtype in sizes:
+        key = tuner.compress_key(nelems, wire_dtype)
+        win = tuner.autotune_compress(nelems, wire_dtype, trials=trials)
+        print(f"compress {key}: block={win['block']} "
+              f"({win['us']:.0f}us, {len(win['results'])} candidates)")
+        results[key] = {"block": win["block"]}
+    return results
+
+
 def emit_defaults(tuned, path):
     """Merge this run's winners into the committed defaults table,
     preserving curated entries and notes for keys not retuned."""
@@ -149,7 +169,7 @@ def main(argv=None):
     ap.add_argument("--batch-heads", type=int, default=8,
                     help="leading batch*heads dim for flash search "
                          "arrays (default %(default)s)")
-    ap.add_argument("--only", choices=["flash", "nms"],
+    ap.add_argument("--only", choices=["flash", "nms", "compress"],
                     help="restrict to one kernel family")
     ap.add_argument("--emit-defaults", nargs="?", metavar="PATH",
                     const=os.path.join(REPO, "paddle_tpu", "tuner",
@@ -166,6 +186,8 @@ def main(argv=None):
     interpret = not on_tpu
     flash_shapes = QUICK_FLASH_SHAPES if quick else BENCH_FLASH_SHAPES
     nms_ks = QUICK_NMS_KS if quick else BENCH_NMS_KS
+    compress_sizes = (QUICK_COMPRESS_SIZES if quick
+                      else BENCH_COMPRESS_SIZES)
 
     from paddle_tpu.tuner import cache_dir
     print(f"autotune: platform={platform} "
@@ -178,6 +200,8 @@ def main(argv=None):
                                      args.batch_heads))
     if args.only in (None, "nms"):
         tuned.update(tune_nms_lane(nms_ks, args.trials, interpret))
+    if args.only in (None, "compress"):
+        tuned.update(tune_compress_lane(compress_sizes, args.trials))
 
     if args.emit_defaults:
         emit_defaults(tuned, args.emit_defaults)

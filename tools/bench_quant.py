@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""Quantized hot-path sweep, printed as one JSON doc.
+
+    python -m tools.bench_quant                  # full sweep
+    python -m tools.bench_quant --check          # CI gate
+    python -m tools.bench_quant --write-baseline # refresh committed baseline
+
+Two lanes, mirroring the two quantized executables
+(docs/quantization.md):
+
+- **allreduce**: dense vs int8 vs bf16 compressed gradient exchange on
+  the 8-virtual-device CPU mesh — analytic per-device wire bytes (the
+  TPU-invariant quantity; CPU step times are reported but not gated) and
+  the measured mean-gradient error of each wire format.
+- **serving**: a tiny seeded GPT decoded f32 vs int8 (weights + KV) —
+  KV-cache and weight bytes, slots-at-equal-memory ratio, decode logits
+  error, and the warm-path retrace count.
+
+``--check`` enforces the acceptance bars as exit codes for
+``tools/run_tests.py --bench-quant``:
+
+- int8 wire bytes >= 3x smaller than dense at every swept size;
+- int8 KV fits >= 1.8x the slots of f32 in the same byte budget;
+- int8 decode KV-row error <= 2% of the f32 row range (accuracy budget);
+- warm decode retraces == 0 (one trace per shape, then pure execution).
+
+The committed ``bench_quant_baseline.json`` pins the analytic ratios;
+``--check`` also fails if a ratio regresses below its baseline (a wire-
+format or cache-layout change that silently costs bytes)."""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# 8 virtual devices BEFORE jax import (same trick as tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+BASELINE = os.path.join(REPO, "bench_quant_baseline.json")
+
+ALLREDUCE_SIZES = (1 << 20, 1 << 22)
+WIRE_BAR = 3.0
+SLOTS_BAR = 1.8
+KV_ERR_BAR = 0.02
+
+
+def bench_allreduce():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu.distributed as dist
+
+    dist.set_mesh(dist.build_mesh({"dp": 8}))
+    rows = []
+    for n in ALLREDUCE_SIZES:
+        rng = np.random.default_rng(n & 0xFFFF)
+        x = jnp.asarray(rng.standard_normal((8, n // 8)), jnp.float32)
+        ref = np.asarray(x).mean(axis=0)
+        row = {"nelems": n,
+               "dense_wire_bytes": dist.dense_allreduce_wire_bytes(n, 8)}
+        for wd in ("int8", "bf16"):
+            fn = jax.jit(jax.shard_map(  # noqa: PTA008 -- one jit per benchmarked (size, wire) config by design; each runs once, there is no reused hot loop
+                lambda v, wd=wd: dist.compressed_grad_sync(v, wire_dtype=wd),
+                mesh=dist.get_mesh(), in_specs=P("dp"), out_specs=P(),
+                check_vma=False))
+            out = np.asarray(fn(x))  # compile + correctness
+            t0 = time.perf_counter()
+            for _ in range(3):
+                fn(x).block_until_ready()
+            row[f"{wd}_step_ms"] = (time.perf_counter() - t0) / 3 * 1e3
+            row[f"{wd}_wire_bytes"] = dist.compressed_allreduce_wire_bytes(
+                n, 8, wd)
+            row[f"{wd}_ratio"] = (row["dense_wire_bytes"]
+                                  / row[f"{wd}_wire_bytes"])
+            row[f"{wd}_max_err"] = float(np.abs(out - ref).max())
+        rows.append(row)
+    dist.set_mesh(None)
+    return rows
+
+
+def bench_serving():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.cache import ExecutableCache
+    from paddle_tpu.serving.llm.decode import (
+        GPTStaticDecoder, SamplingParams, _QUANT_WEIGHT_KEYS, pack_sampling)
+    from paddle_tpu.serving.llm.kvcache import dequantize_kv
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+
+    def leaf_bytes(t):
+        return sum(x.nbytes for x in jax.tree_util.tree_leaves(t))
+
+    out = {}
+    decoded = {}
+    for mode in ("float32", "int8"):
+        cache = ExecutableCache()
+        dec = GPTStaticDecoder(net, max_top_k=8, exec_cache=cache,
+                               weight_dtype=mode, kv_dtype=mode)
+        params = dec.params()
+        kv = dec.new_kv(num_slots=2, max_seq=64)
+        kv.alloc(), kv.alloc()
+        samp = pack_sampling([SamplingParams()] * 2)
+        finished = jnp.zeros((2,), bool)
+        toks = jnp.asarray([[5, 9, 2, 11], [3, 1, 4, 1]], jnp.int32)
+        nxt, finished = dec.prefill(
+            kv, params, toks, jnp.asarray([4, 4], jnp.int32),
+            jnp.asarray([0, 1], jnp.int32), finished, samp,
+            jax.random.PRNGKey(0))
+        # first step compiles (or re-traces the shared lru-cached fn for
+        # this mode's arg structure); every later step must be pure
+        # execution — that delta is the warm-retrace gate
+        nxt, finished = dec.decode_step(kv, params, finished, nxt, samp,
+                                        jax.random.PRNGKey(1))
+        warm_start = dec.decode_fn(2, 64).trace_counter["traces"]
+        steps, t0 = 32, time.perf_counter()
+        for i in range(steps):
+            nxt, finished = dec.decode_step(kv, params, finished, nxt,
+                                            samp, jax.random.PRNGKey(i + 2))
+        nxt.block_until_ready()
+        dt = time.perf_counter() - t0
+        w_bytes = sum(leaf_bytes(params["layers"][li][k])
+                      for li in range(cfg.num_layers)
+                      for k in _QUANT_WEIGHT_KEYS)
+        decoded[mode] = {"k": np.asarray(dequantize_kv(kv.k)),
+                         "retraces": dec.decode_fn(2, 64)
+                         .trace_counter["traces"] - warm_start}
+        out[mode] = {"kv_bytes": kv.kv_bytes(), "weight_bytes": w_bytes,
+                     "tokens_per_s": 2 * steps / dt,
+                     "warm_retraces": decoded[mode]["retraces"]}
+
+    kf, kq = decoded["float32"]["k"], decoded["int8"]["k"]
+    import numpy as np
+    out["kv_row_rel_err"] = float(
+        np.abs(kf - kq).max() / (np.abs(kf).max() + 1e-6))
+    out["slots_ratio"] = (out["float32"]["kv_bytes"]
+                          / out["int8"]["kv_bytes"])
+    out["weight_ratio"] = (out["float32"]["weight_bytes"]
+                           / out["int8"]["weight_bytes"])
+    return out
+
+
+def run_sweep():
+    return {"version": 1,
+            "allreduce": bench_allreduce(),
+            "serving": bench_serving()}
+
+
+def check(doc, baseline=None):
+    problems = []
+    for row in doc["allreduce"]:
+        if row["int8_ratio"] < WIRE_BAR:
+            problems.append(
+                f"allreduce n={row['nelems']}: int8 wire ratio "
+                f"{row['int8_ratio']:.2f} < {WIRE_BAR}")
+    srv = doc["serving"]
+    if srv["slots_ratio"] < SLOTS_BAR:
+        problems.append(f"serving: slots ratio {srv['slots_ratio']:.2f} "
+                        f"< {SLOTS_BAR}")
+    if srv["kv_row_rel_err"] > KV_ERR_BAR:
+        problems.append(f"serving: int8 KV row error "
+                        f"{srv['kv_row_rel_err']:.4f} > {KV_ERR_BAR}")
+    for mode in ("float32", "int8"):
+        if srv[mode]["warm_retraces"]:
+            problems.append(f"serving[{mode}]: "
+                            f"{srv[mode]['warm_retraces']} warm retraces "
+                            f"(must be 0)")
+    if baseline:
+        for row, base in zip(doc["allreduce"],
+                             baseline.get("allreduce", [])):
+            for k in ("int8_ratio", "bf16_ratio"):
+                if row[k] < base[k] - 1e-6:
+                    problems.append(
+                        f"allreduce n={row['nelems']}: {k} regressed "
+                        f"{base[k]:.3f} -> {row[k]:.3f}")
+        bs = baseline.get("serving", {})
+        for k in ("slots_ratio", "weight_ratio"):
+            if k in bs and srv[k] < bs[k] - 1e-6:
+                problems.append(f"serving: {k} regressed "
+                                f"{bs[k]:.3f} -> {srv[k]:.3f}")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="gate the acceptance bars + baseline ratios")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="refresh the committed baseline (analytic "
+                         "ratios only — timings are machine-local)")
+    ap.add_argument("--baseline", default=BASELINE)
+    args = ap.parse_args(argv)
+
+    doc = run_sweep()
+    print(json.dumps(doc, indent=1, sort_keys=True))
+
+    if args.write_baseline:
+        stable = {
+            "version": 1,
+            "allreduce": [
+                {k: row[k] for k in ("nelems", "dense_wire_bytes",
+                                     "int8_wire_bytes", "bf16_wire_bytes",
+                                     "int8_ratio", "bf16_ratio")}
+                for row in doc["allreduce"]],
+            "serving": {k: doc["serving"][k]
+                        for k in ("slots_ratio", "weight_ratio")},
+        }
+        with open(args.baseline, "w") as f:
+            json.dump(stable, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"bench quant: baseline written to {args.baseline}",
+              file=sys.stderr)
+        return 0
+
+    if args.check:
+        baseline = None
+        try:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+        except FileNotFoundError:
+            print(f"bench quant: no baseline at {args.baseline} "
+                  f"(absolute bars only)", file=sys.stderr)
+        problems = check(doc, baseline)
+        if problems:
+            print("FAIL:", file=sys.stderr)
+            for p in problems:
+                print(f"  - {p}", file=sys.stderr)
+            return 1
+        print("bench quant: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,10 +3,12 @@
 fusion breaks or host transfers.
 
 Runs the trace audit over the bench entrypoints (``resnet_train_step``,
-``gpt_train_step`` from :mod:`paddle_tpu.models.bench_audit`, plus the
+``gpt_train_step`` from :mod:`paddle_tpu.models.bench_audit`; the
 serving-side ``llm_spec_decode_step`` from
 :mod:`paddle_tpu.serving.llm.spec` — its one-fetch-per-tick contract is
-exactly a host-transfer count) and
+exactly a host-transfer count; and the quantized hot paths
+``compressed_allreduce_train_step`` / ``llm_int8_decode_step``, whose
+quantize/dequantize stages must fuse in-graph) and
 compares the per-entrypoint counts that move MFU — host transfers inside
 the compiled region, large closed-over control-flow constants, missed
 donation, retraces, and the HLO copy fraction — against the committed
@@ -37,7 +39,11 @@ BASELINE = os.path.join(REPO, "bench_audit_baseline.json")
 
 #: the bench step paths under the gate
 ENTRYPOINTS = ("resnet_train_step", "gpt_train_step",
-               "llm_spec_decode_step")
+               "llm_spec_decode_step",
+               # quantized hot paths (docs/quantization.md): the
+               # compressed-gradient dp train step and the int8 serving
+               # decode step — both must keep zero host transfers
+               "compressed_allreduce_train_step", "llm_int8_decode_step")
 
 #: copy_fraction may drift this much absolutely before failing (XLA
 #: version skew moves copy counts a little; a real fusion break moves a

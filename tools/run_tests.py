@@ -72,6 +72,12 @@ def main():
                          "trace) and fail unless the prefix KV store hit "
                          "rate is >=0.5 and reuse-on TTFT p50 beats "
                          "reuse-off")
+    ap.add_argument("--bench-quant", action="store_true",
+                    help="opt-in gate: run tools/bench_quant.py --check "
+                         "and fail unless int8 allreduce wire bytes are "
+                         ">=3x smaller than dense, int8 KV fits >=1.8x "
+                         "the slots, decode accuracy holds, and warm "
+                         "retraces == 0 (bench_quant_baseline.json)")
     args = ap.parse_args()
 
     if not args.no_analyze:
@@ -164,6 +170,20 @@ def main():
              "--prefix-trace", "--check"],
             cwd=REPO, env=env)
         print(f"bench llm: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.bench_quant:
+        # Opt-in: the quantized hot-path sweep on the CPU backend, gated
+        # on the wire-bytes / slots-per-chip / accuracy / retrace bars
+        # (absolute times are machine-dependent; the byte ratios and the
+        # retrace count are the invariants).
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_quant", "--check"],
+            cwd=REPO, env=env)
+        print(f"bench quant: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
