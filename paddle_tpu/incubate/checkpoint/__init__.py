@@ -22,7 +22,7 @@ from .sharded import (save_sharded, load_sharded, AsyncSaver,  # noqa: F401
                       CheckpointIntegrityError, verify_checkpoint,
                       HEALTH_STAMP_FILE, OLD_SUFFIX, STAGING_SUFFIX,
                       write_health_stamp, read_health_stamp,
-                      newest_healthy_checkpoint)
+                      newest_healthy_checkpoint, swap_eligible)
 from .async_ckpt import (AsyncCheckpointer, AsyncCheckpointConfig,  # noqa: F401
                          CommitError, SaveTicket, commit_checkpoint,
                          cleanup_stale_staging)

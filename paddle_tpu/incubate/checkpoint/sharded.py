@@ -302,6 +302,33 @@ def _is_checkpoint_dir(path: str) -> bool:
                for n in names)
 
 
+def swap_eligible(path: str, verify: bool = True):
+    """Gate for the serving fleet's live weight hot-swap: may ``path`` be
+    rolled onto live replicas? Returns ``(ok, reason)`` — never raises.
+
+    Eligible means the two-phase commit finished (a committed checkpoint
+    dir, not ``*.tmp`` staging or a ``*.old`` parked-previous), the health
+    stamp vouches for it (the sentinel did not flag divergence), and, with
+    ``verify``, the checksum sweep passes. The same three gates the
+    resurrection boot path applies, exposed as a predicate so the swap
+    controller can refuse a roll BEFORE draining any replica."""
+    if not _is_checkpoint_dir(path):
+        return False, (
+            f"{path} is not a committed checkpoint directory (staging/"
+            f"parked dirs and metadata-less paths are never eligible)")
+    stamp = read_health_stamp(path)
+    if not stamp.get("healthy", True):
+        return False, (
+            f"{path} is stamped unhealthy"
+            + (f" ({stamp['reason']})" if stamp.get("reason") else ""))
+    if verify:
+        try:
+            verify_checkpoint(path)
+        except CheckpointIntegrityError as e:
+            return False, f"{path} failed checksum verification: {e}"
+    return True, "eligible"
+
+
 def newest_healthy_checkpoint(root: str,
                               verify: bool = True) -> Optional[str]:
     """Walk ``root`` for the newest checkpoint that is health-stamped sane

@@ -30,8 +30,8 @@ from .queue import BatchQueue  # noqa: F401
 from .batcher import Batch, DynamicBatcher  # noqa: F401
 from .engine import Engine, EngineConfig  # noqa: F401
 from .request import (  # noqa: F401
-    Deadline, DeadlineExceeded, EngineDraining, InferenceRequest,
-    QueueFull, RequestTooLarge, ServingError)
+    Deadline, DeadlineExceeded, EngineDraining, EngineKilled,
+    InferenceRequest, QueueFull, RequestTooLarge, ServingError)
 from .sharding import ShardingSpec, ResolvedSharding  # noqa: F401
 from .replica import Replica  # noqa: F401
 from .router import (  # noqa: F401
@@ -42,20 +42,22 @@ __all__ = [
     "Engine", "EngineConfig", "BucketSpec", "pow2_buckets",
     "ExecutableCache", "default_cache", "signature_of", "BatchQueue",
     "DynamicBatcher", "Batch", "InferenceRequest", "Deadline",
-    "DeadlineExceeded", "EngineDraining", "QueueFull", "RequestTooLarge",
-    "ServingError", "ShardingSpec", "ResolvedSharding", "Replica",
-    "Router", "RouterConfig", "NoHealthyReplicas",
-    "llm_replica_factory", "predictor_replica_factory", "llm",
+    "DeadlineExceeded", "EngineDraining", "EngineKilled", "QueueFull",
+    "RequestTooLarge", "ServingError", "ShardingSpec", "ResolvedSharding",
+    "Replica", "Router", "RouterConfig", "NoHealthyReplicas",
+    "llm_replica_factory", "predictor_replica_factory", "llm", "fleet",
 ]
 
 
 def __getattr__(name):
     # `serving.llm` pulls in jax at import time (compiled decode programs);
     # keep classifier serving importable without that cost by loading the
-    # LLM submodule on first access.
-    if name == "llm":
+    # LLM submodule on first access. `serving.fleet` (autoscaler/swap/
+    # replay control plane) stays lazy for the same reason — its swap path
+    # imports the checkpoint stack.
+    if name in ("llm", "fleet"):
         import importlib
-        mod = importlib.import_module(".llm", __name__)
-        globals()["llm"] = mod
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

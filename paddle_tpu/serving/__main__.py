@@ -104,6 +104,18 @@ def main(argv=None) -> int:
     lv.add_argument("--no-handoff", action="store_true",
                     help="disable the prefill->decode KV handoff (role "
                          "routing only)")
+    lv.add_argument("--autoscale", action="store_true",
+                    help="run the SLO-aware autoscaler over the replica "
+                         "set (requires --replicas > 1): replicas park "
+                         "when calm and unpark on SLO breach")
+    lv.add_argument("--slo-p95-ms", type=float, default=500.0,
+                    help="autoscaler SLO: p95 request latency bound")
+    lv.add_argument("--slo-max-queue", type=int, default=32,
+                    help="autoscaler SLO: total queued-request bound")
+    lv.add_argument("--min-replicas", type=int, default=1,
+                    help="autoscaler floor; --replicas is the ceiling")
+    lv.add_argument("--autoscale-interval-s", type=float, default=0.5,
+                    help="autoscaler controller tick period")
     args = ap.parse_args(argv)
 
     if args.cmd == "serve-llm":
@@ -227,8 +239,24 @@ def _serve_llm(args) -> int:
                          prefill_threshold=args.prefill_threshold,
                          handoff=not args.no_handoff))
         router.install_drain_signal_handler()
+        scaler = None
+        if args.autoscale:
+            from .fleet import SLO, Autoscaler, AutoscalerConfig
+            scaler = Autoscaler(
+                router,
+                SLO(p95_ms=args.slo_p95_ms, max_queue=args.slo_max_queue,
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.replicas),
+                AutoscalerConfig(interval_s=args.autoscale_interval_s))
+            scaler.start()
+            print(f"paddle_tpu.serving: autoscaler on "
+                  f"({args.min_replicas}..{args.replicas} replicas, "
+                  f"p95<={args.slo_p95_ms}ms, "
+                  f"queue<={args.slo_max_queue})", flush=True)
         serve_forever(None, args.host, args.port, quiet=False,
                       ready_cb=_ready, router=router)
+        if scaler is not None:
+            scaler.stop()
         router.drain()
         print("paddle_tpu.serving: drained, bye", flush=True)
         return 0

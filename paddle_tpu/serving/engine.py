@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
+from concurrent.futures import InvalidStateError
 from typing import Any, Callable, List, Optional, Sequence, Union
 
 import numpy as np
@@ -31,8 +32,8 @@ from .batcher import Batch, DynamicBatcher
 from .buckets import BucketSpec, pad_rows, pad_seq, unpad_rows
 from .cache import ExecutableCache, default_cache, signature_of
 from .queue import BatchQueue
-from .request import (Deadline, EngineDraining, InferenceRequest,
-                      RequestTooLarge)
+from .request import (Deadline, EngineDraining, EngineKilled,
+                      InferenceRequest, RequestTooLarge)
 
 ModelT = Union[str, Callable[..., Any], "object"]
 
@@ -92,6 +93,9 @@ class DrainableEngineBase:
         self._guard: Optional[PreemptionGuard] = None
         self._signal_chain: Optional[ChainedSignalHandler] = None
         self._drain_signaled = False  # set (only) from _on_drain_signal
+        self._admission_paused = threading.Event()
+        self._killed = threading.Event()
+        self._kill_reason = ""
 
     @property
     def registry(self) -> _mon.StatRegistry:
@@ -135,6 +139,39 @@ class DrainableEngineBase:
         its lock — signal handlers must go through ``_on_drain_signal``."""
         self._draining.set()
         self._queue.close()
+
+    # -- fleet control plane (pause / hard-kill) ----------------------------
+    @property
+    def admission_paused(self) -> bool:
+        return self._admission_paused.is_set()
+
+    def pause_admission(self):
+        """Stop admitting new requests WITHOUT draining: queued and
+        in-flight work completes, the worker stays alive, and
+        :meth:`resume_admission` reopens the front door. The weight
+        hot-swap path uses this to quiesce a replica."""
+        self._admission_paused.set()
+
+    def resume_admission(self):
+        self._admission_paused.clear()
+
+    @property
+    def was_killed(self) -> bool:
+        return self._killed.is_set()
+
+    def kill(self, reason: str = "killed") -> int:
+        """Hard-kill (in-process SIGKILL analog): fail every queued request
+        with :class:`EngineKilled` immediately — unlike drain, nothing is
+        flushed — and flag the worker to abort in-flight work at its next
+        poll point. Returns the number of queued requests failed. Safe to
+        call from any thread; idempotent."""
+        self._kill_reason = str(reason)
+        self._killed.set()
+        self._draining.set()
+        return self._queue.fail_all(
+            lambda: EngineKilled(
+                f"engine hard-killed ({self._kill_reason}); "
+                f"request aborted before execution"))
 
     def _stat_add(self, name: str, v):
         self._registry.add(f"{self._prefix}.{name}", v)
@@ -224,9 +261,19 @@ class Engine(DrainableEngineBase):
         """Enqueue one request; returns a ``concurrent.futures.Future``
         whose result is the list of output arrays (rows matching the
         request's rows)."""
+        if self._killed.is_set():
+            self._stat_add("rejected_killed", 1)
+            raise EngineKilled(
+                f"engine was hard-killed ({self._kill_reason}); "
+                f"submit rejected")
         if self._draining.is_set():
             self._stat_add("rejected_draining", 1)
             raise EngineDraining("engine is draining; submit rejected")
+        if self._admission_paused.is_set():
+            self._stat_add("rejected_paused", 1)
+            raise EngineDraining(
+                "engine admission is paused (fleet control); "
+                "submit rejected")
         if deadline is None and self._config.default_deadline is not None:
             deadline = self._config.default_deadline
         if deadline is not None and not isinstance(deadline, Deadline):
@@ -298,6 +345,8 @@ class Engine(DrainableEngineBase):
         poll = max(0.01, self._config.max_batch_delay)
         try:
             while True:
+                if self._killed.is_set():
+                    break
                 if self._guard is not None and self._guard.preempted \
                         and not self._draining.is_set():
                     self._stat_add("preemption_drains", 1)
@@ -317,6 +366,24 @@ class Engine(DrainableEngineBase):
                 self._execute(batch)
                 self._publish_cache_stats()
         finally:
+            if self._killed.is_set():
+                # hard-kill: fail whatever was admitted but not yet
+                # resolved (queued requests were failed by kill() itself;
+                # this catches the batch the worker never finished)
+                with self._inflight_lock:
+                    victims = list(self._inflight)
+                exc = EngineKilled(
+                    f"engine hard-killed ({self._kill_reason}); "
+                    f"in-flight request aborted")
+                for fut in victims:
+                    try:
+                        fut.set_exception(exc)
+                    except InvalidStateError:
+                        pass  # resolved by a racing complete; verdict stands
+                _flight.record_event(
+                    "engine_killed",
+                    {"engine": self._prefix, "reason": self._kill_reason,
+                     "aborted": len(victims)})
             if self._drain_signaled:
                 # SIGTERM-initiated drain: leave the post-mortem timeline
                 # (worker thread — never in signal context)

@@ -1,0 +1,26 @@
+"""Self-driving serving fleet: autoscaler + weight hot-swap + replay.
+
+The control plane that closes the loop between the telemetry the stack
+already publishes (StatRegistry gauges, health sweeps, flight events) and
+the levers it already has (Router park/unpark, RestartBudget-counted
+resurrection, engine admission pause, checkpoint health stamps):
+
+* :class:`SLO` / :class:`Autoscaler` — a controller thread polling
+  :meth:`Router.fleet_snapshot` against a declared SLO, scaling the
+  replica set with hysteresis + cooldown (docs/serving.md, "Fleet
+  operations");
+* :class:`WeightSwapper` — rolls a committed, health-stamped checkpoint
+  across replicas one at a time with quiesce → swap → probe → readmit,
+  and automatic rollback on a failed probe;
+* :mod:`replay` — record/synthesize request traces and replay them with
+  arrival-time fidelity (the chaos-harness substrate of
+  ``tools/bench_fleet.py``).
+
+Everything here is host-side control plane: polling snapshots, flipping
+admission flags, loading checkpoints. None of it runs on the request hot
+path (PTA002 lints this package with hot-path strictness to keep it so).
+"""
+from .autoscaler import SLO, Autoscaler, AutoscalerConfig  # noqa: F401
+from .replay import (TraceRecorder, TraceReplayer,  # noqa: F401
+                     load_trace, save_trace, synthesize_trace)
+from .swap import SwapError, WeightSwapper  # noqa: F401

@@ -559,6 +559,12 @@ class GPTStaticDecoder:
             self._key = self._key + (mesh_token(mesh),)
             self._param_sharding = NamedSharding(mesh, PartitionSpec())
 
+    @property
+    def model(self):
+        """The live model object (weight hot-swap mutates it in place via
+        ``set_state_dict``, then re-extracts params)."""
+        return self._model
+
     def params(self):
         p = extract_gpt_params(self._model)
         if self.weight_dtype == "int8":

@@ -41,7 +41,7 @@ from ..cache import ExecutableCache, default_cache
 from ..engine import DrainableEngineBase
 from ..queue import BatchQueue
 from ..request import (Deadline, DeadlineExceeded, EngineDraining,
-                       RequestTooLarge)
+                       EngineKilled, RequestTooLarge)
 from .decode import GPTStaticDecoder, SamplingParams, pack_sampling
 from .kvcache import StaticKVCache
 from .prefix import PrefixStore
@@ -64,7 +64,8 @@ class GenerationRequest:
 
     __slots__ = ("req_id", "prompt", "sampling", "deadline", "future",
                  "t_enqueue", "t_first_token", "tokens", "finish_reason",
-                 "_stream_q", "_clock", "_prefix_entry", "_t_last")
+                 "_stream_q", "_clock", "_prefix_entry", "_t_last",
+                 "weights_version")
 
     def __init__(self, prompt, sampling: SamplingParams,
                  deadline: Optional[Deadline] = None, stream: bool = False,
@@ -88,6 +89,10 @@ class GenerationRequest:
         # batcher unpins on release/evict/abort) + inter-token clock
         self._prefix_entry = None
         self._t_last: Optional[float] = None
+        # stamped at admission from the batcher's weight generation; the
+        # whole generation runs on that one generation (hot-swap waits
+        # for slots to quiesce), so the result is bitwise old-or-new
+        self.weights_version: Optional[int] = None
 
     @property
     def prompt_len(self) -> int:
@@ -127,7 +132,8 @@ class GenerationRequest:
         if not self.future.done():
             self.future.set_result(
                 {"tokens": list(self.tokens), "finish_reason": reason,
-                 "req_id": self.req_id})
+                 "req_id": self.req_id,
+                 "weights_version": self.weights_version})
         if self._stream_q is not None:
             self._stream_q.put(_STREAM_END)
 
@@ -280,6 +286,9 @@ class ContinuousBatcher:
             self._draft_params = spec_decoder.draft_params()
         self._spec_proposed = 0
         self._spec_accepted = 0
+        #: monotonically increasing weight generation; bumped by the
+        #: engine's swap_weights AFTER slots quiesce, read at admission
+        self.weights_version = 0
         self._reqs: Dict[int, GenerationRequest] = {}
         self._slot_samp: List[SamplingParams] = [
             SamplingParams() for _ in range(config.num_slots)]
@@ -329,6 +338,7 @@ class ContinuousBatcher:
     def _admit_inner(self, req: GenerationRequest):
         t0 = self._clock()
         slot = self.kv.alloc()
+        req.weights_version = self.weights_version
         self._reqs[slot] = req
         self._slot_samp[slot] = req.sampling
         self._samp_vecs = pack_sampling(self._slot_samp)
@@ -744,9 +754,19 @@ class LLMEngine(DrainableEngineBase):
         """Enqueue one prompt; returns the :class:`GenerationRequest`
         (``.future`` for the full result, ``.iter_tokens()`` when
         ``stream=True``)."""
+        if self._killed.is_set():
+            self._stat_add("rejected_killed", 1)
+            raise EngineKilled(
+                f"engine was hard-killed ({self._kill_reason}); "
+                f"submit rejected")
         if self._draining.is_set():
             self._stat_add("rejected_draining", 1)
             raise EngineDraining("engine is draining; submit rejected")
+        if self._admission_paused.is_set():
+            self._stat_add("rejected_paused", 1)
+            raise EngineDraining(
+                "engine admission is paused (fleet control); "
+                "submit rejected")
         arr = np.asarray(prompt, dtype=np.int32).reshape(-1)  # noqa: PTA002 -- admission-time conversion of the caller's host-side prompt, not a device value
         if arr.size > self._config.max_prompt_len:
             self._stat_add("rejected_oversize", 1)
@@ -783,6 +803,57 @@ class LLMEngine(DrainableEngineBase):
     def generate(self, prompt, **kw) -> dict:
         """Synchronous convenience: submit + wait."""
         return self.submit(prompt, **kw).result()
+
+    @property
+    def weights_version(self) -> int:
+        return self._batcher.weights_version
+
+    def swap_weights(self, state_dict: dict, *, timeout: float = 30.0,
+                     poll: float = 0.005) -> int:
+        """Live weight hot-swap: install ``state_dict`` into the model and
+        re-extract params, WITHOUT tearing down the engine or recompiling
+        (the decode/prefill executables are keyed by spec + dtypes, not by
+        parameter values, so the persistent cache serves them unchanged).
+
+        The caller must :meth:`pause_admission` first; this method then
+        waits until every in-flight slot retires and the queue is empty —
+        the swap happens only on a quiesced engine, which is what makes it
+        bitwise-safe: a generation is computed entirely by the old weights
+        or entirely by the new ones, never a mix. Returns the new weights
+        version (stamped into every subsequent request's result).
+        """
+        if not (self._admission_paused.is_set() or self._draining.is_set()):
+            raise RuntimeError(
+                "swap_weights requires pause_admission() first: in-flight "
+                "sequences must quiesce before params change under them")
+        deadline = time.monotonic() + timeout
+        while self._batcher.active > 0 or len(self._queue) > 0:
+            if self._killed.is_set():
+                raise EngineKilled(
+                    f"engine hard-killed ({self._kill_reason}) while "
+                    f"quiescing for a weight swap")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"engine did not quiesce within {timeout}s "
+                    f"(active={self._batcher.active}, "
+                    f"queued={len(self._queue)}); weight swap aborted")
+            time.sleep(poll)
+        # engine is quiesced AND admission is closed: the worker cannot
+        # touch params (admit/tick need a request) until we finish, so
+        # mutating the model + re-extracting here is single-writer
+        with _otrace.span("serving.llm/weight_swap"):
+            misses_before = self._cache.stats()["misses"]
+            self._decoder.model.set_state_dict(state_dict)
+            self._batcher.refresh_params()
+            self._batcher.weights_version += 1
+        self._stat_add("weight_swaps", 1)
+        self._stat_set("weights_version", self._batcher.weights_version)
+        _flight.record_event(
+            "weight_swap",
+            {"engine": self._prefix,
+             "version": self._batcher.weights_version,
+             "cache_misses_before": misses_before})
+        return self._batcher.weights_version
 
     def drain(self, timeout: Optional[float] = None) -> List:
         """Graceful drain: stop admission, finish every in-flight and
@@ -834,6 +905,22 @@ class LLMEngine(DrainableEngineBase):
         cfg = self._config
         try:
             while True:
+                if self._killed.is_set():
+                    # hard-kill: abort in-flight sequences (queued requests
+                    # were failed by kill() itself) and exit quietly — this
+                    # is a commanded death, not a worker crash, so no
+                    # re-raise / no noisy daemon-thread traceback
+                    n = self._batcher.active
+                    self._batcher.abort_all(
+                        lambda req: EngineKilled(
+                            f"engine hard-killed ({self._kill_reason}) "
+                            f"with request {req.req_id} in flight after "
+                            f"{len(req.tokens)} tokens"))
+                    _flight.record_event(
+                        "engine_killed",
+                        {"engine": self._prefix,
+                         "reason": self._kill_reason, "aborted": n})
+                    return
                 if self._guard is not None and self._guard.preempted \
                         and not self._draining.is_set():
                     self._stat_add("preemption_drains", 1)

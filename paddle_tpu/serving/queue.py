@@ -59,6 +59,23 @@ class BatchQueue:
             self._not_empty.notify_all()
             self._not_full.notify_all()
 
+    def fail_all(self, exc_factory: Callable[[], BaseException]) -> int:
+        """Hard-kill path: close admission and fail every queued request
+        with ``exc_factory()`` (drain lets takers consume the backlog;
+        a kill must not — the worker is already gone). Returns the number
+        of requests failed."""
+        with self._lock:
+            self._closed = True
+            victims = list(self._dq)
+            self._dq.clear()
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+        failed = 0
+        for req in victims:
+            if req.fail(exc_factory()):
+                failed += 1
+        return failed
+
     # -- producer side ------------------------------------------------------
     def put(self, req: InferenceRequest, block: bool = True,
             timeout: Optional[float] = None):

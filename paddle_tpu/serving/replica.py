@@ -32,12 +32,15 @@ locks) happen outside it.
 """
 from __future__ import annotations
 
+import errno
+import os
 import threading
 import time
 import warnings
 from concurrent.futures import Future
 from typing import Callable, Optional
 
+from ..utils.resilience import fault_injector
 from .request import EngineDraining
 
 #: lifecycle states (plain strings so /healthz payloads serialize as-is)
@@ -80,6 +83,7 @@ class Replica:
         self._restarts = 0
         self._unhealthy_reason: Optional[str] = None
         self._boot_checkpoint: Optional[str] = None
+        self._paused = False
         self._boot()
 
     # -- boot / resurrect ----------------------------------------------------
@@ -87,6 +91,20 @@ class Replica:
         """Pick the boot checkpoint, build the engine, go HEALTHY. Raises
         whatever the factory raises (first construction fails fast;
         :meth:`resurrect` catches)."""
+        # chaos hook: `replica_boot` fires once per engine construction —
+        # initial boot, resurrection, and autoscale-up all pass through
+        # here, so one occurrence spec covers them all
+        action = fault_injector().fire("replica_boot")
+        if action == "fail":
+            raise RuntimeError(
+                f"fault injection: replica {self.replica_id} boot failed")
+        if action == "disk_full":
+            raise OSError(errno.ENOSPC,
+                          f"fault injection: replica {self.replica_id} "
+                          f"boot hit ENOSPC")
+        if action == "slow_io":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_SLOW_IO_S", "0.2")))
         ckpt = None
         if self.checkpoint_root is not None:
             from ..incubate.checkpoint.async_ckpt import cleanup_stale_staging
@@ -152,10 +170,43 @@ class Replica:
     def admissible(self) -> bool:
         """May the router hand this replica a request right now?"""
         with self._lock:
-            if self._state != HEALTHY or self._unhealthy_reason is not None:
+            if self._state != HEALTHY or self._unhealthy_reason is not None \
+                    or self._paused:
                 return False
             engine = self._engine
         return engine is not None and not engine.draining
+
+    @property
+    def paused(self) -> bool:
+        with self._lock:
+            return self._paused
+
+    def pause(self):
+        """Fleet control: stop router dispatch to this replica WITHOUT
+        marking it unhealthy (the health sweep must not drain it) and
+        WITHOUT touching the engine — the weight-swap probe talks to the
+        engine directly while the replica is paused."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self):
+        with self._lock:
+            self._paused = False
+
+    def kill(self, reason: str = "killed") -> bool:
+        """Hard-kill (the in-process SIGKILL analog): the replica goes
+        DEAD immediately and the engine aborts queued + in-flight work
+        with :class:`~paddle_tpu.serving.request.EngineKilled`. The
+        router's health sweep sees DEAD and schedules a budgeted
+        resurrection, exactly as for a drained-out replica."""
+        with self._lock:
+            if self._state == DEAD:
+                return False
+            self._state = DEAD
+            engine = self._engine
+        if engine is not None:
+            engine.kill(f"replica {self.replica_id}: {reason}")
+        return True
 
     @property
     def boot_checkpoint(self) -> Optional[str]:
@@ -170,9 +221,12 @@ class Replica:
         accounting. Returns whatever the engine returns (a Future for the
         classifier engine, a GenerationRequest for the LLM engine)."""
         with self._lock:
-            if self._state != HEALTHY or self._unhealthy_reason is not None:
+            if self._state != HEALTHY or self._unhealthy_reason is not None \
+                    or self._paused:
                 raise EngineDraining(
-                    f"replica {self.replica_id} is {self._state}"
+                    f"replica {self.replica_id} is "
+                    + ("paused" if self._paused and self._state == HEALTHY
+                       else self._state)
                     + (f" ({self._unhealthy_reason})"
                        if self._unhealthy_reason else ""))
             engine = self._engine
@@ -215,6 +269,7 @@ class Replica:
             outstanding = self._outstanding
             restarts = self._restarts
             boot = self._boot_checkpoint
+            paused = self._paused
         reasons = []
         if state != HEALTHY:
             reasons.append(f"state={state}")
@@ -233,10 +288,14 @@ class Replica:
                     reasons.append("health_source")
             except Exception as e:
                 reasons.append(f"health_source_error: {e!r}")
+        # NB: paused is deliberately NOT a reason — the health sweep drains
+        # replicas whose healthz goes unhealthy, and a paused replica
+        # (autoscale park / mid-swap) must stay bootable, not get drained
         return {
             "replica": self.replica_id,
             "state": state,
             "healthy": not reasons,
+            "paused": paused,
             "reasons": reasons,
             "queue_depth": depth,
             "outstanding": outstanding,
@@ -283,6 +342,7 @@ class Replica:
         with self._lock:
             out = {
                 "state": self._state,
+                "paused": self._paused,
                 "outstanding": self._outstanding,
                 "dispatched": self._dispatched,
                 "completed": self._completed,

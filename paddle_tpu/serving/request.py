@@ -48,6 +48,12 @@ class RequestTooLarge(ServingError):
     configured to reject (rather than split) oversized requests."""
 
 
+class EngineKilled(ServingError):
+    """The engine was hard-killed (the in-process analog of a replica
+    SIGKILL): queued and in-flight requests fail with this error instead
+    of draining. Retryable — the request never produced partial output."""
+
+
 class InferenceRequest:
     """One queued inference call: inputs + deadline + result future."""
 

@@ -52,6 +52,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..core import monitor as _mon
 from ..distributed.elastic import ChainedSignalHandler, RestartBudget
+from ..observability import flight as _flight
 from .replica import DEAD, DRAINING, HEALTHY, Replica
 from .request import (
     PHASE_DECODE, PHASE_PREFILL, REPLICA_ROLES, EngineDraining, ServingError)
@@ -154,6 +155,10 @@ class Router:
         self._rr = itertools.count()   # rotating tie-break for dispatch
         self._resume_at: Dict[int, float] = {}  # health-thread-only
         self._fanned_out = False                # health-thread-only
+        self._degraded_last = 0                 # health-thread-only
+        self._parked: set = set()          # autoscaler-parked replica ids
+        self._parked_lock = threading.Lock()
+        self._trace_recorder = None        # replay.TraceRecorder hook
         self.replicas: List[Replica] = []
         for rid, sub in enumerate(self._split_devices(devices)):
             mesh = None
@@ -263,8 +268,27 @@ class Router:
         phase = self._phase_of(args, kwargs)
         if phase == PHASE_PREFILL and self._config.handoff \
                 and self._handoff_ready():
-            return self._handoff_submit(args, kwargs)
-        return self._dispatch(phase, args, kwargs)
+            out = self._handoff_submit(args, kwargs)
+        else:
+            out = self._dispatch(phase, args, kwargs)
+        rec = self._trace_recorder
+        if rec is not None:
+            # record only ACCEPTED requests (rejections raised above) —
+            # replay fidelity is about the traffic the fleet admitted
+            try:
+                rec.on_request(args, kwargs, phase)
+            except Exception:
+                # a broken recorder must never fail live traffic: count
+                # it where /metricsz shows it and keep dispatching
+                self._registry.add(
+                    f"{self._prefix}.trace_recorder_errors", 1)
+        return out
+
+    def set_trace_recorder(self, recorder) -> None:
+        """Install a :class:`~paddle_tpu.serving.fleet.replay
+        .TraceRecorder` observing every accepted request (None removes
+        it). The hook runs on the submitter's thread after dispatch."""
+        self._trace_recorder = recorder
 
     def _dispatch(self, phase, args, kwargs):
         tried: set = set()
@@ -297,6 +321,128 @@ class Router:
                     self._registry.add(
                         f"{self._prefix}.dispatched_phase_{phase}", 1)
             return out
+
+    # -- fleet control plane (autoscaler) ------------------------------------
+    def parked_ids(self) -> List[int]:
+        """Replica ids intentionally out of service (autoscale-down)."""
+        with self._parked_lock:
+            return sorted(self._parked)
+
+    def park(self, replica_id: int) -> bool:
+        """Scale-down: drain ``replica_id`` out of service and exclude it
+        from health-loop resurrection until :meth:`unpark`. False when it
+        is already parked. Parking is intentional capacity removal — it
+        does not count as degradation and costs no restart budget."""
+        r = self.replicas[replica_id]
+        with self._parked_lock:
+            if replica_id in self._parked:
+                return False
+            self._parked.add(replica_id)
+        r.begin_drain()
+        self._registry.add(f"{self._prefix}.park_downs", 1)
+        _flight.record_event("replica_park", {"replica": replica_id})
+        return True
+
+    def unpark(self, replica_id: int, *, boot_timeout: float = 5.0) -> bool:
+        """Scale-up: return a parked replica to service through the
+        budgeted boot path — one restart is claimed from the shared
+        :class:`RestartBudget`, so a scale-up is a counted resurrection.
+        Returns True when the replica booted here; False when it was not
+        parked, its park-drain outlasted ``boot_timeout`` (the health loop
+        finishes the boot at a later sweep), or the boot failed/budget is
+        spent. Parked replicas are idle, so the drain wait normally
+        resolves in one worker poll interval; callers run on the
+        controller thread, never the dispatch path."""
+        r = self.replicas[replica_id]
+        with self._parked_lock:
+            if replica_id not in self._parked:
+                return False
+            self._parked.discard(replica_id)
+        self._registry.add(f"{self._prefix}.unpark_ups", 1)
+        booted = False
+        if r.drain(boot_timeout):   # flips DRAINING -> DEAD; idle => fast
+            booted = r.resurrect(consume_budget=True)
+            if booted:
+                self._registry.add(f"{self._prefix}.resurrections", 1)
+        _flight.record_event("replica_unpark",
+                             {"replica": replica_id, "booted": booted})
+        return booted
+
+    def fleet_snapshot(self) -> dict:
+        """The autoscaler's one-call control-plane view: per-replica state
+        + load + latency, fleet aggregates, and restart-budget headroom.
+        Pure host-side registry/accounting reads — never touches device
+        values, so polling it adds zero host syncs to the hot path."""
+        parked = set(self.parked_ids())
+        reps = []
+        for r in self.replicas:
+            admissible = r.admissible
+            reps.append({
+                "replica": r.replica_id,
+                "state": r.state,
+                "parked": r.replica_id in parked,
+                "paused": r.paused,
+                "admissible": admissible,
+                "outstanding": r.outstanding,
+                "queue_depth": r.queue_depth(),
+                "p95_ms": self._replica_p95(r),
+                "completed": self._replica_completed(r),
+                "slots_in_use": self._replica_slots_in_use(r),
+            })
+        active = [x for x in reps if x["admissible"]]
+        stats = self._registry.stats_with_prefix(self._prefix + ".")
+        return {
+            "replicas": reps,
+            "active_replicas": len(active),
+            "parked": sorted(parked),
+            "queue_depth": sum(x["queue_depth"] for x in reps),
+            "outstanding": sum(x["outstanding"] for x in reps),
+            # the fleet p95 is the WORST active replica: SLO breaches are
+            # per-request, and requests land on one replica
+            "p95_ms": max((x["p95_ms"] for x in active), default=0.0),
+            # all-time completion count: the autoscaler diffs this per
+            # tick so a stale latency reservoir (no traffic since the
+            # spike) cannot hold a breach open forever
+            "completed": sum(x["completed"] for x in reps),
+            "rejected_no_replica": stats.get(
+                f"{self._prefix}.rejected_no_replica", 0),
+            "degraded": stats.get(f"{self._prefix}.degraded", 0),
+            "budget_remaining": self.budget.remaining,
+            "draining": self.draining,
+        }
+
+    def _replica_p95(self, r: Replica) -> float:
+        """p95 request latency of one replica's engine from its histogram
+        (0.0 before any traffic)."""
+        engine = r.engine
+        if engine is None:
+            return 0.0
+        ep = getattr(engine, "_prefix", None)
+        reg = getattr(engine, "registry", None)
+        if not ep or reg is None:
+            return 0.0
+        name = (f"{ep}.request_latency_ms" if self._config.kind == "llm"
+                else f"{ep}.latency_ms")
+        return reg.quantile(name, 0.95)
+
+    def _replica_completed(self, r: Replica) -> int:
+        """All-time completed-request count of one replica's engine (the
+        latency histogram's observation count)."""
+        engine = r.engine
+        if engine is None:
+            return 0
+        ep = getattr(engine, "_prefix", None)
+        reg = getattr(engine, "registry", None)
+        if not ep or reg is None:
+            return 0
+        name = (f"{ep}.request_latency_ms" if self._config.kind == "llm"
+                else f"{ep}.latency_ms")
+        return int(reg.histogram(name).get("count", 0))
+
+    def _replica_slots_in_use(self, r: Replica) -> int:
+        batcher = getattr(r.engine, "_batcher", None)
+        active = getattr(batcher, "active", 0)
+        return int(active) if isinstance(active, int) else 0
 
     # -- prefill/decode KV handoff -------------------------------------------
     def _handoff_ready(self) -> bool:
@@ -363,6 +509,7 @@ class Router:
 
     def _sweep(self):
         now = time.monotonic()
+        parked = set(self.parked_ids())
         for r in self.replicas:
             h = r.healthz()
             rid = r.replica_id
@@ -378,6 +525,15 @@ class Router:
                 h["queue_depth"])
             self._registry.set_labeled(
                 f"{self._prefix}.replica_restarts", labels, h["restarts"])
+            self._registry.set_labeled(
+                f"{self._prefix}.replica_parked", labels,
+                1 if rid in parked else 0)
+            self._registry.set_labeled(
+                f"{self._prefix}.replica_p95_ms", labels,
+                self._replica_p95(r))
+            self._registry.set_labeled(
+                f"{self._prefix}.replica_slots_in_use", labels,
+                self._replica_slots_in_use(r))
             if self._config.roles is not None:
                 # assignment gauge: constant 1 per (replica, role) pair so
                 # dashboards can join per-replica series onto roles
@@ -395,7 +551,31 @@ class Router:
             elif state == DRAINING:
                 r.poll_drained()
             elif state == DEAD and self._config.auto_resurrect:
-                self._maybe_resurrect(r, now)
+                if rid in parked:
+                    # parked is intentional: no resurrection, and any
+                    # pending backoff schedule is void (unpark reboots)
+                    self._resume_at.pop(rid, None)
+                else:
+                    self._maybe_resurrect(r, now)
+        # degraded = replicas lost for good (budget exhausted, not parked):
+        # the fleet is serving below its declared capacity
+        degraded = sum(
+            1 for x in self.replicas
+            if x.replica_id not in parked
+            and self._resume_at.get(x.replica_id) == float("inf"))
+        self._registry.set(f"{self._prefix}.degraded", degraded)
+        if degraded != self._degraded_last:
+            _flight.record_event(
+                "router_degraded_change",
+                {"degraded": degraded, "was": self._degraded_last,
+                 "budget_remaining": self.budget.remaining})
+            self._degraded_last = degraded
+        self._registry.set(
+            f"{self._prefix}.active_replicas",
+            sum(1 for x in self.replicas if x.admissible))
+        self._registry.set(
+            f"{self._prefix}.agg.queue_depth",
+            sum(x.queue_depth() for x in self.replicas))
 
     def _maybe_resurrect(self, r: Replica, now: float):
         """Budgeted, backed-off resurrection (health-thread-only state).
@@ -467,21 +647,34 @@ class Router:
 
     # -- observability -------------------------------------------------------
     def healthz(self) -> dict:
-        """Aggregate health: ``ok`` (all healthy) / ``degraded`` (some) /
-        ``unhealthy`` (none admissible) / ``draining``."""
+        """Aggregate health: ``ok`` (all in-service replicas healthy) /
+        ``degraded`` (capacity lost: an unhealthy replica, or one parked
+        forever by an exhausted restart budget) / ``unhealthy`` (none
+        admissible) / ``draining``. Parked (autoscaled-down) replicas are
+        intentional capacity and do not count against the verdict."""
         reps = [r.healthz() for r in self.replicas]
         if self._config.roles is not None:
             for rid, h in enumerate(reps):
                 h["role"] = self._role_of(rid)
+        parked = set(self.parked_ids())
+        for h in reps:
+            h["parked"] = h["replica"] in parked
+        in_service = [h for h in reps if not h["parked"]]
+        stats = self._registry.stats_with_prefix(self._prefix + ".")
+        budget_lost = stats.get(f"{self._prefix}.degraded", 0)
         if self._draining.is_set():
             status = "draining"
-        elif all(h["healthy"] for h in reps):
+        elif in_service and all(h["healthy"] for h in in_service) \
+                and not budget_lost:
             status = "ok"
         elif any(r.admissible for r in self.replicas):
             status = "degraded"
         else:
             status = "unhealthy"
-        return {"status": status, "kind": self.kind, "replicas": reps}
+        return {"status": status, "kind": self.kind, "replicas": reps,
+                "parked": sorted(parked),
+                "degraded_replicas": budget_lost,
+                "budget_remaining": self.budget.remaining}
 
     def stats(self) -> dict:
         """Router counters + per-replica accounting + the balance factor

@@ -158,6 +158,17 @@ class FaultInjector:
     alias of ``crash`` (hard ``os._exit``), named so chaos specs read as
     intent.
 
+    Serving-fleet sites (``serving.replica`` / ``serving.fleet.swap``):
+    ``replica_boot`` fires once per replica engine construction — initial
+    Router boot AND every resurrection/scale-up reboot count, so with 3
+    replicas ``replica_boot:4:disk_full`` hits the first scale-up boot
+    with ``ENOSPC`` (actions: ``fail`` raises RuntimeError, ``disk_full``
+    raises ENOSPC, ``slow_io`` stalls the boot). ``weight_swap`` fires
+    once per replica inside a hot-swap roll: ``fail``/``disk_full`` force
+    the swap's rollback path, ``slow_io`` stretches the swap window while
+    traffic is paused. See docs/fault_tolerance.md for the full site
+    catalog.
+
     Counters are per-process: a restarted trainer starts counting from zero
     again, which is exactly what makes "crash once, then succeed" scenarios
     expressible with a single rule. Duplicate ``site:occurrence`` pairs are

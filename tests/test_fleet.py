@@ -1,0 +1,572 @@
+"""Self-driving serving fleet (docs/serving.md "Fleet operations").
+
+Five invariant families:
+
+* **Park/unpark** — parking is intentional capacity removal (healthz
+  stays ``ok``, no budget spent); unparking boots through the budgeted
+  resurrection path (a scale-up is a counted restart).
+* **Autoscaler** — the hysteresis/cooldown state machine, driven
+  tick-by-tick against a fake router so every decision is deterministic:
+  breach and calm runs, the cooldown window, the min/max clamps, and the
+  stale-latency guard (a p95 reservoir with no fresh traffic is not a
+  breach).
+* **Hot swap** — version-tagged bitwise output (old weights OR new
+  weights, never mixed), zero recompiles across a roll, eligibility
+  gates (health stamp), fault-injected rollback to the prior weights.
+* **Kill** — the in-process SIGKILL analog fails queued AND in-flight
+  requests with ``EngineKilled`` (retryable) instead of hanging them.
+* **Degraded router** — an exhausted restart budget degrades service
+  gracefully: the ``degraded`` gauge rises, ``/healthz`` reports
+  ``degraded``, and the surviving replicas keep serving.
+
+Plus the replay harness (trace determinism, recorder hook, zero-drop
+replay) and the RestartBudget curve-reuse pin: the elastic supervisor
+and the Router share ONE backoff implementation with independent state.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.distributed.elastic import RestartBudget
+from paddle_tpu.incubate.checkpoint import commit_checkpoint, swap_eligible
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability.metrics import render_prometheus
+from paddle_tpu.serving.fleet import (SLO, Autoscaler, AutoscalerConfig,
+                                      SwapError, TraceRecorder,
+                                      TraceReplayer, WeightSwapper,
+                                      load_trace, save_trace,
+                                      synthesize_trace)
+from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+from paddle_tpu.serving.request import EngineKilled
+from paddle_tpu.serving.router import (NoHealthyReplicas, Router,
+                                       RouterConfig, llm_replica_factory)
+from paddle_tpu.utils import resilience
+
+VOCAB = 64
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _llm_cfg(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("max_queue", 64)
+    kw.setdefault("warmup", False)
+    kw.setdefault("default_max_new_tokens", 4)
+    return LLMEngineConfig(**kw)
+
+
+def _mk_router(n=2, seed=0, **rcfg):
+    rcfg.setdefault("health_interval", 0.05)
+    reg = StatRegistry()
+    return Router(
+        llm_replica_factory(lambda r: _tiny_model(seed), _llm_cfg()),
+        RouterConfig(num_replicas=n, kind="llm", **rcfg),
+        registry=reg)
+
+
+def _wait_for(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture
+def fault_spec(monkeypatch):
+    """Arm PADDLE_TPU_FAULT_SPEC for this test; disarm afterwards."""
+    def arm(spec):
+        monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", spec)
+        resilience._reset_fault_injector_for_tests()
+    yield arm
+    monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC", raising=False)
+    resilience._reset_fault_injector_for_tests()
+
+
+# -- park / unpark ------------------------------------------------------------
+
+class TestParkUnpark:
+    def test_park_unpark_roundtrip_costs_one_restart(self):
+        router = _mk_router(2)
+        try:
+            assert router.submit(PROMPT).result(timeout=120)["tokens"]
+            assert router.park(1) is True
+            assert router.park(1) is False          # already parked
+            snap = router.fleet_snapshot()
+            assert snap["parked"] == [1]
+            assert _wait_for(
+                lambda: router.fleet_snapshot()["active_replicas"] == 1)
+            # parking is NOT degradation: healthz stays ok, service runs
+            hz = router.healthz()
+            assert hz["status"] == "ok"
+            assert hz["parked"] == [1]
+            assert hz["degraded_replicas"] == 0
+            assert router.submit(PROMPT).result(timeout=120)["tokens"]
+            assert router.budget.used == 0          # park is free
+            # unpark boots through the budgeted path: one counted restart
+            assert router.unpark(1) is True
+            assert router.unpark(1) is False        # not parked anymore
+            assert router.budget.used == 1
+            assert router.replicas[1].state == "HEALTHY"
+            assert router.fleet_snapshot()["active_replicas"] == 2
+        finally:
+            router.drain(timeout=60)
+
+    def test_parked_replica_not_resurrected_by_sweep(self):
+        router = _mk_router(2)
+        try:
+            router.park(1)
+            # the sweep must treat a parked DEAD shell as intentional:
+            # no budget burn, no resurrection, no degraded accounting
+            assert _wait_for(lambda: router.replicas[1].state == "DEAD")
+            time.sleep(0.3)                          # several sweep ticks
+            assert router.replicas[1].state == "DEAD"
+            assert router.budget.used == 0
+            stats = router.registry.stats()
+            assert stats.get("serving.router.degraded", 0) == 0
+        finally:
+            router.drain(timeout=60)
+
+
+# -- autoscaler state machine (fake router: deterministic ticks) --------------
+
+class _FakeRouter:
+    """Just enough Router surface for the controller: a snapshot the test
+    mutates, park/unpark recording, and a registry."""
+
+    def __init__(self, n=3, parked=()):
+        self.replicas = list(range(n))
+        self.registry = StatRegistry()
+        self._parked = set(parked)
+        self.p95_ms = 0.0
+        self.queue_depth = 0
+        self.completed = 0
+        self.rejected = 0.0
+        self.lost = 0          # shells dead with no budget (not parked)
+        self.park_calls, self.unpark_calls = [], []
+
+    def parked_ids(self):
+        return sorted(self._parked)
+
+    def park(self, rid):
+        self._parked.add(rid)
+        self.park_calls.append(rid)
+        return True
+
+    def unpark(self, rid):
+        self._parked.discard(rid)
+        self.unpark_calls.append(rid)
+        return True
+
+    def fleet_snapshot(self):
+        reps = [{"replica": i, "parked": i in self._parked,
+                 "admissible": i not in self._parked,
+                 "outstanding": i, "queue_depth": 0}
+                for i in self.replicas]
+        return {
+            "replicas": reps,
+            "active_replicas": (len(self.replicas) - len(self._parked)
+                                - self.lost),
+            "parked": self.parked_ids(),
+            "queue_depth": self.queue_depth,
+            "outstanding": 0,
+            "p95_ms": self.p95_ms,
+            "completed": self.completed,
+            "rejected_no_replica": self.rejected,
+            "degraded": 0,
+            "budget_remaining": 3,
+            "draining": False,
+        }
+
+
+class TestAutoscaler:
+    def _scaler(self, fake, clock, **cfg):
+        cfg.setdefault("breach_ticks", 2)
+        cfg.setdefault("calm_ticks", 3)
+        cfg.setdefault("cooldown_s", 10.0)
+        return Autoscaler(fake, SLO(p95_ms=100.0, max_queue=8,
+                                    min_replicas=1),
+                          AutoscalerConfig(**cfg),
+                          registry=fake.registry, clock=lambda: clock[0])
+
+    def test_breach_hysteresis_then_scale_up(self):
+        fake = _FakeRouter(3, parked=(1, 2))
+        clock = [0.0]
+        sc = self._scaler(fake, clock)
+        fake.p95_ms, fake.completed = 500.0, 10
+        assert sc.tick()["action"] == "hold"        # breach run 1 of 2
+        fake.completed = 20
+        assert sc.tick()["action"] == "up"
+        assert fake.unpark_calls == [1]             # lowest parked id first
+        # cooldown: still breaching, but no second action inside window
+        fake.completed = 30
+        assert sc.tick()["action"] == "hold"
+        fake.completed = 40
+        assert sc.tick()["action"] == "hold"
+        clock[0] = 11.0                             # past cooldown
+        fake.completed = 50
+        assert sc.tick()["action"] == "up"
+        assert fake.unpark_calls == [1, 2]
+
+    def test_stale_p95_without_traffic_is_not_a_breach(self):
+        fake = _FakeRouter(3, parked=(1, 2))
+        clock = [0.0]
+        sc = self._scaler(fake, clock)
+        fake.p95_ms, fake.completed = 500.0, 10
+        sc.tick()
+        # the latency reservoir still reads 500ms but nothing completed
+        # since the last tick: the breach run must RESET, not advance
+        assert sc.tick()["breach"] is False
+        assert sc.tick()["breach"] is False
+        assert fake.unpark_calls == []
+
+    def test_queue_and_reject_axes_breach(self):
+        fake = _FakeRouter(3, parked=(1, 2))
+        sc = self._scaler(fake, [0.0], breach_ticks=1, cooldown_s=0.0)
+        fake.queue_depth = 9
+        d = sc.tick()
+        assert d["action"] == "up" and "queue" in d["reasons"][0]
+        fake.queue_depth = 0
+        fake.rejected = 2.0
+        d = sc.tick()
+        assert d["action"] == "up" and "unplaceable" in d["reasons"][0]
+
+    def test_calm_run_scales_down_to_min(self):
+        fake = _FakeRouter(3)
+        clock = [0.0]
+        sc = self._scaler(fake, clock, calm_ticks=2, cooldown_s=0.0)
+        sc.tick()
+        d = sc.tick()
+        assert d["action"] == "down"
+        assert fake.park_calls == [0]     # least outstanding wins
+        sc.tick()
+        assert sc.tick()["action"] == "down"
+        # at min_replicas=1 the fleet never parks its last replica
+        for _ in range(5):
+            assert sc.tick()["action"] == "hold"
+        assert len(fake.park_calls) == 2
+
+    def test_up_blocked_when_capacity_lost_not_parked(self):
+        fake = _FakeRouter(2, parked=())
+        sc = self._scaler(fake, [0.0], breach_ticks=1, cooldown_s=0.0)
+        fake.lost = 1                      # one shell gone for good
+        fake.p95_ms, fake.completed = 500.0, 5
+        d = sc.tick()
+        assert d["action"] == "up_blocked"
+        assert fake.registry.stats()["fleet.autoscale.up_blocked"] == 1
+
+    def test_slo_validation(self):
+        with pytest.raises(ValueError):
+            SLO(min_replicas=0)
+        with pytest.raises(ValueError):
+            SLO(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            Autoscaler(_FakeRouter(2), SLO(max_replicas=5))
+
+
+# -- restart-budget curve reuse (elastic supervisor <-> router) ---------------
+
+class TestRestartBudgetCurveReuse:
+    def test_router_shares_the_supervisor_budget_class(self):
+        router = _mk_router(1)
+        try:
+            assert isinstance(router.budget, RestartBudget)
+        finally:
+            router.drain(timeout=60)
+
+    def test_same_curve_independent_state(self):
+        """The supervisor's budget and the router's budget are the SAME
+        exponential curve (pin the formula) but separate accounting —
+        consuming one never moves the other."""
+        import random
+        sup = RestartBudget(6, backoff=1.0, cap=30.0,
+                            rng=random.Random(7))
+        rtr = RestartBudget(6, backoff=1.0, cap=30.0,
+                            rng=random.Random(7))
+        sup_curve, rtr_curve = [], []
+        for _ in range(6):
+            assert sup.try_consume() and rtr.try_consume()
+            sup_curve.append(sup.pause())
+            rtr_curve.append(rtr.pause())
+        assert sup_curve == rtr_curve              # identical curve
+        assert sup.used == rtr.used == 6
+
+        class _Mid:                                 # jitter factor == 1.0
+            def random(self):
+                return 0.5
+
+        pinned = RestartBudget(8, backoff=0.5, cap=4.0, rng=_Mid())
+        seen = []
+        for _ in range(5):
+            pinned.try_consume()
+            seen.append(round(pinned.pause(), 6))
+        # backoff * 2**(used-1), capped: 0.5, 1, 2, 4, 4
+        assert seen == [0.5, 1.0, 2.0, 4.0, 4.0]
+
+        solo = RestartBudget(3)
+        other = RestartBudget(3)
+        assert solo.try_consume()
+        assert solo.used == 1 and other.used == 0  # independent state
+
+
+# -- hard kill ----------------------------------------------------------------
+
+class TestKill:
+    def test_kill_fails_queued_and_inflight_with_engine_killed(self):
+        engine = LLMEngine(_tiny_model(), _llm_cfg(num_slots=1))
+        try:
+            futs = [engine.submit(PROMPT, max_new_tokens=8)
+                    for _ in range(3)]
+            engine.kill("test chaos")
+            assert engine.was_killed
+            for f in futs:
+                with pytest.raises(EngineKilled):
+                    f.result(timeout=30)
+            with pytest.raises(EngineKilled):       # admission slams shut
+                engine.submit(PROMPT)
+        finally:
+            engine.drain(timeout=30)
+
+    def test_router_resurrects_killed_replica(self):
+        router = _mk_router(2)
+        try:
+            assert router.submit(PROMPT).result(timeout=120)["tokens"]
+            assert router.replicas[0].kill("test chaos") is True
+            assert _wait_for(
+                lambda: router.replicas[0].state == "HEALTHY", timeout=30)
+            assert router.budget.used >= 1          # counted resurrection
+            assert router.submit(PROMPT).result(timeout=120)["tokens"]
+        finally:
+            router.drain(timeout=60)
+
+
+# -- live weight hot-swap -----------------------------------------------------
+
+class TestHotSwap:
+    def test_swap_requires_paused_admission(self):
+        engine = LLMEngine(_tiny_model(), _llm_cfg())
+        try:
+            with pytest.raises(RuntimeError, match="pause_admission"):
+                engine.swap_weights({})
+        finally:
+            engine.drain(timeout=30)
+
+    def test_classifier_router_refused(self):
+        class _Classifier:
+            kind = "classifier"
+        with pytest.raises(ValueError, match="LLMEngine"):
+            WeightSwapper(_Classifier())
+
+    def test_eligibility_gates(self, tmp_path):
+        ok, why = swap_eligible(str(tmp_path / "nope"))
+        assert not ok
+        sick = str(tmp_path / "sick")
+        commit_checkpoint({"model": _tiny_model(1).state_dict()}, sick,
+                          healthy=False, reason="probe failed")
+        ok, why = swap_eligible(sick)
+        assert not ok and "health" in why.lower()
+        router = _mk_router(1)
+        try:
+            with pytest.raises(SwapError, match="refusing"):
+                WeightSwapper(router).roll(sick)
+            assert router.registry.stats()["fleet.swap.refused"] == 1
+        finally:
+            router.drain(timeout=60)
+
+    def test_roll_is_version_tagged_bitwise_and_recompile_free(
+            self, tmp_path):
+        # reference output of the NEW weights, from a standalone engine
+        ref = LLMEngine(_tiny_model(seed=1), _llm_cfg())
+        try:
+            want = ref.submit(PROMPT, max_new_tokens=6) \
+                      .result(timeout=120)["tokens"]
+        finally:
+            ref.drain(timeout=30)
+
+        router = _mk_router(1, seed=0)
+        try:
+            before = router.submit(PROMPT, max_new_tokens=6) \
+                           .result(timeout=120)
+            assert before["weights_version"] == 0
+            assert before["tokens"] != want         # old weights differ
+
+            ckpt = str(tmp_path / "ckpt-new")
+            commit_checkpoint({"model": _tiny_model(seed=1).state_dict()},
+                              ckpt, healthy=True, step=1)
+            engine = router.replicas[0].engine
+            misses0 = engine.cache.stats()["misses"]
+            report = WeightSwapper(router).roll(ckpt)
+            assert report["swapped"] == [0]
+            assert report["aborted"] is False
+            assert report["versions"] == {0: 1}
+            # the whole point of spec-keyed executables: a weight swap
+            # costs ZERO recompiles
+            assert engine.cache.stats()["misses"] == misses0
+
+            after = router.submit(PROMPT, max_new_tokens=6) \
+                          .result(timeout=120)
+            assert after["weights_version"] == 1    # tagged at admission
+            assert after["tokens"] == want          # bitwise the new model
+            assert router.registry.stats()["fleet.swap.replicas_swapped"] \
+                == 1
+            assert router.registry.quantile("fleet.swap.downtime_ms",
+                                            0.95) > 0.0
+        finally:
+            router.drain(timeout=60)
+
+    def test_failed_swap_rolls_back_to_prior_weights(self, tmp_path,
+                                                     fault_spec):
+        router = _mk_router(1, seed=0)
+        try:
+            before = router.submit(PROMPT, max_new_tokens=6) \
+                           .result(timeout=120)["tokens"]
+            ckpt = str(tmp_path / "ckpt-new")
+            commit_checkpoint({"model": _tiny_model(seed=1).state_dict()},
+                              ckpt, healthy=True, step=1)
+            fault_spec("weight_swap:1:fail")
+            report = WeightSwapper(router).roll(ckpt)
+            assert report["aborted"] is True
+            assert report["rolled_back"] == 0
+            assert report["swapped"] == []
+            assert router.registry.stats()["fleet.swap.rollbacks"] == 1
+            # the replica serves the OLD weights again — bitwise
+            after = router.submit(PROMPT, max_new_tokens=6) \
+                          .result(timeout=120)
+            assert after["tokens"] == before
+            assert router.replicas[0].state == "HEALTHY"
+        finally:
+            router.drain(timeout=60)
+
+
+# -- degraded router (exhausted budget) ---------------------------------------
+
+class TestDegradedRouter:
+    def test_budget_exhaustion_degrades_gracefully(self):
+        router = _mk_router(2, max_restarts=0)
+        try:
+            assert router.submit(PROMPT).result(timeout=120)["tokens"]
+            router.replicas[0].kill("chaos: unrecoverable")
+            # no budget: the sweep gives up on replica 0 and says so
+            assert _wait_for(lambda: router.registry.stats().get(
+                "serving.router.degraded", 0) == 1, timeout=30)
+            hz = router.healthz()
+            assert hz["status"] == "degraded"
+            assert hz["degraded_replicas"] == 1
+            assert hz["budget_remaining"] == 0
+            # ...but the surviving replica still serves traffic
+            assert router.submit(PROMPT).result(timeout=120)["tokens"]
+            assert router.replicas[0].state == "DEAD"
+        finally:
+            router.drain(timeout=60)
+
+    def test_degraded_gauge_in_prometheus_exposition(self):
+        router = _mk_router(2, max_restarts=0)
+        try:
+            router.submit(PROMPT).result(timeout=120)
+            router.replicas[0].kill("chaos")
+            assert _wait_for(lambda: router.registry.stats().get(
+                "serving.router.degraded", 0) == 1, timeout=30)
+            text = render_prometheus(router.registry)
+            assert "paddle_tpu_serving_router_degraded 1" in text
+            # per-replica series carry the replica label (satellite of
+            # the aggregate /metricsz endpoint)
+            assert 'replica="0"' in text and 'replica="1"' in text
+            assert "paddle_tpu_serving_router_replica_p95_ms" in text
+            assert "paddle_tpu_serving_router_replica_parked" in text
+        finally:
+            router.drain(timeout=60)
+
+
+# -- traffic replay -----------------------------------------------------------
+
+class TestReplay:
+    def test_synthesize_is_deterministic_and_ordered(self):
+        a = synthesize_trace(50, 20.0, seed=3)
+        b = synthesize_trace(50, 20.0, seed=3)
+        assert a == b
+        assert a != synthesize_trace(50, 20.0, seed=4)
+        ts = [r["t"] for r in a]
+        assert ts == sorted(ts) and ts[0] > 0.0
+
+    def test_trace_roundtrip(self, tmp_path):
+        trace = synthesize_trace(10, 50.0, seed=1)
+        p = str(tmp_path / "storm.jsonl")
+        save_trace(trace, p)
+        assert load_trace(p) == trace
+        with open(p) as f:                          # one JSON per line
+            assert all(json.loads(ln) for ln in f if ln.strip())
+
+    def test_recorder_captures_accepted_requests_only(self):
+        router = _mk_router(1)
+        try:
+            rec = TraceRecorder()
+            router.set_trace_recorder(rec)
+            router.submit(PROMPT, max_new_tokens=2).result(timeout=120)
+            router.submit(PROMPT[:3], max_new_tokens=2).result(timeout=120)
+            assert len(rec) == 2
+            router.park(0)
+            with pytest.raises(NoHealthyReplicas):
+                router.submit(PROMPT)
+            assert len(rec) == 2                    # rejects not recorded
+            trace = rec.trace()
+            assert trace[0]["t"] == 0.0
+            assert trace[0]["prompt_len"] == len(PROMPT)
+            assert trace[1]["prompt_len"] == 3
+        finally:
+            router.drain(timeout=60)
+
+    def test_replay_completes_with_zero_drops(self):
+        router = _mk_router(1)
+        try:
+            trace = synthesize_trace(6, 30.0, seed=2, max_new_tokens=2,
+                                     prompt_len_range=(2, 6))
+            rep = TraceReplayer(router, trace, vocab=VOCAB,
+                                workers=4).run()
+            assert rep["offered"] == 6
+            assert rep["completed"] == 6
+            assert rep["dropped"] == 0
+            assert rep["weights_versions"] == {0: 6}
+            assert rep["latency_p95_ms"] > 0.0
+        finally:
+            router.drain(timeout=60)
+
+
+# -- the chaos storm end-to-end (the --bench-fleet gate, scaled down) ---------
+
+@pytest.mark.slow
+class TestChaosStorm:
+    def test_storm_with_kill_swap_and_enospc_recovers(self, tmp_path):
+        from tools import bench_fleet
+        spec_before = {k: os.environ.get(k) for k in
+                       ("PADDLE_TPU_FAULT_SPEC",
+                        "PADDLE_TPU_FAULT_SLOW_IO_S")}
+        try:
+            rc = bench_fleet.main([
+                "--requests", "60", "--rate", "10", "--tick-s", "0.2",
+                "--check", "--baseline",
+                str(tmp_path / "missing.json")])    # structural gates only
+            assert rc == 0
+        finally:
+            # the bench arms the process-wide injector; disarm it so
+            # later tests in this process see a clean environment
+            for k, v in spec_before.items():
+                os.environ.pop(k, None)
+                if v is not None:
+                    os.environ[k] = v
+            resilience._reset_fault_injector_for_tests()

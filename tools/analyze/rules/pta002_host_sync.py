@@ -58,6 +58,11 @@ HOT_PREFIXES = (
     # every gradient byte; eager group bookkeeping carries noqa
     # justifications
     "paddle_tpu/distributed/collective.py",
+    # fleet control plane (autoscaler / hot-swap / replay): by contract it
+    # adds ZERO host syncs to serving hot paths — all reads are registry
+    # snapshots. The one sanctioned copy (the swap rollback snapshot)
+    # carries a noqa justification.
+    "paddle_tpu/serving/fleet/",
 )
 
 SYNC_METHODS = {"numpy", "item", "tolist", "block_until_ready"}

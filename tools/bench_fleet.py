@@ -1,0 +1,400 @@
+#!/usr/bin/env python
+"""Traffic-replay chaos harness for the serving fleet — the self-driving
+proof, printed as one JSON document.
+
+    python -m tools.bench_fleet                   # run the chaos storm
+    python -m tools.bench_fleet --check           # CI gate (run_tests.py
+                                                  #   --bench-fleet)
+    python -m tools.bench_fleet --write-baseline  # refresh the committed
+                                                  #   bench_fleet_baseline.json
+    python -m tools.bench_fleet --trace my.jsonl  # replay a recorded trace
+
+One storm, three injected disasters, one verdict. A seeded Poisson trace
+(or ``--trace``, recorded from a live router by
+:class:`~paddle_tpu.serving.fleet.TraceRecorder`) is replayed with
+arrival-time fidelity against a 3-shell LLM router parked down to one
+serving replica, while:
+
+1. the SLO-aware autoscaler runs its controller loop — the cold-start
+   latency spike breaches the SLO and the fleet scales up through the
+   budgeted unpark path, with ``replica_boot:4:disk_full`` armed so the
+   FIRST scale-up boot dies on ``ENOSPC`` (the health sweep finishes
+   that boot on the backoff schedule: a failed scale-up is just a
+   counted resurrection);
+2. a live weight hot-swap rolls a committed checkpoint across the
+   serving replicas mid-storm, with ``weight_swap:2:slow_io`` stretching
+   one swap window — the cache-miss delta across the roll must be ZERO
+   (executables are keyed by spec/dtype, so new weights reuse them);
+3. a replica is hard-killed mid-storm (the in-process SIGKILL analog:
+   queued + in-flight requests die with ``EngineKilled`` and the clients
+   retry, exactly like production 503 handling).
+
+The verdict: every offered request completes (**drops == 0** — retries
+are allowed, losses are not), the fleet scales up at least once, the
+roll finishes un-aborted with zero recompiles, and the controller
+converges back inside the SLO within the committed tick budget after
+the storm ends. Absolute latencies are machine-dependent and not gated;
+the *structural* counters (drops, scale-ups, rollbacks, recompiles) and
+the *relative* recovery budget are the invariants
+(``bench_fleet_baseline.json``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "bench_fleet_baseline.json")
+
+#: the storm's armed disasters (see docs/fault_tolerance.md): the 4th
+#: replica_boot is the first scale-up boot (3 shells boot at router
+#: construction), and the 2nd weight_swap is mid-roll.
+FAULT_SPEC = "replica_boot:4:disk_full,weight_swap:2:slow_io"
+
+
+def _tiny_model():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_position_embeddings=128, hidden_dropout_prob=0.0,
+        attention_dropout_prob=0.0))
+    m.eval()
+    return m
+
+
+def _total_misses(router):
+    return sum(r.engine.cache.stats()["misses"]
+               for r in router.replicas if r.engine is not None)
+
+
+def run_chaos(args) -> dict:
+    # Arm the injector BEFORE any engine exists; the singleton parses the
+    # environment once per process.
+    from paddle_tpu.utils import resilience
+    if not args.no_faults:
+        os.environ["PADDLE_TPU_FAULT_SPEC"] = FAULT_SPEC
+        os.environ.setdefault("PADDLE_TPU_FAULT_SLOW_IO_S", "0.3")
+        resilience._reset_fault_injector_for_tests()
+
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.incubate.checkpoint import commit_checkpoint
+    from paddle_tpu.serving.llm import LLMEngineConfig
+    from paddle_tpu.serving.router import (Router, RouterConfig,
+                                           llm_replica_factory)
+    from paddle_tpu.serving.fleet import (SLO, Autoscaler, AutoscalerConfig,
+                                          TraceReplayer, WeightSwapper,
+                                          load_trace, synthesize_trace)
+
+    cfg = LLMEngineConfig(
+        num_slots=args.slots, max_seq=64, max_queue=256, warmup=False,
+        default_max_new_tokens=args.max_new_tokens)
+    reg = StatRegistry()
+    router = Router(
+        llm_replica_factory(lambda r: _tiny_model(), cfg),
+        RouterConfig(num_replicas=args.replicas, kind="llm",
+                     health_interval=0.1, max_restarts=8,
+                     restart_backoff=0.2, restart_backoff_cap=1.0),
+        registry=reg)
+
+    slo = SLO(p95_ms=args.slo_p95_ms, max_queue=args.slo_max_queue,
+              min_replicas=1, max_replicas=args.replicas)
+    scaler = Autoscaler(
+        router, slo,
+        AutoscalerConfig(interval_s=args.tick_s, breach_ticks=2,
+                         calm_ticks=3, cooldown_s=3 * args.tick_s,
+                         start_at_min=False),
+        registry=reg)
+    # Park down to min by hand (start_at_min does the same; doing it here
+    # keeps the controller loop below fully owned by the bench so every
+    # decision is timestamped and countable).
+    scaler._park_to_min()
+
+    decisions = []
+    stop = threading.Event()
+
+    def controller():
+        while not stop.is_set():
+            try:
+                d = scaler.tick()
+            except Exception as e:  # a mid-death snapshot race must not
+                d = {"action": "error", "breach": True, "error": repr(e)}
+            d["t"] = time.monotonic()
+            decisions.append(d)
+            stop.wait(args.tick_s)
+
+    if args.trace:
+        trace = load_trace(args.trace)
+    else:
+        trace = synthesize_trace(args.requests, args.rate,
+                                 seed=args.seed,
+                                 prompt_len_range=(4, 16),
+                                 max_new_tokens=args.max_new_tokens)
+    storm_len = trace[-1]["t"] if trace else 0.0
+
+    # the mid-storm roll target: a fresh set of weights, committed +
+    # health-stamped the same way the async checkpointer publishes them
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    ckpt = os.path.join(tmp, "ckpt-step1")
+    commit_checkpoint({"model": _tiny_model().state_dict()}, ckpt,
+                      healthy=True, step=1)
+    swapper = WeightSwapper(router, reg, quiesce_timeout=60.0,
+                            probe_timeout=60.0)
+
+    roll_report = {}
+    roll_recompiles = [0]
+    roll_done = threading.Event()
+    kill_done = []
+
+    def _serving_healthy():
+        parked = set(router.parked_ids())
+        return [r for r in router.replicas
+                if r.state == "HEALTHY" and r.replica_id not in parked]
+
+    def roller():
+        # disaster 2: roll new weights while the storm is still falling.
+        # Wait for the autoscaler to have scaled up (>= 2 serving
+        # replicas) so the roll exercises the multi-replica sequence and
+        # the armed weight_swap:2:slow_io actually fires mid-roll.
+        t_deadline = time.monotonic() + max(2.0, storm_len * 0.7)
+        time.sleep(max(1.0, storm_len * args.roll_at))
+        while len(_serving_healthy()) < min(2, args.replicas) \
+                and time.monotonic() < t_deadline:
+            time.sleep(0.1)
+        before = _total_misses(router)
+        try:
+            roll_report.update(swapper.roll(ckpt))
+        except Exception as e:
+            roll_report.update({"error": repr(e), "aborted": True})
+        roll_recompiles[0] = _total_misses(router) - before
+        roll_done.set()
+
+    def saboteur():
+        # disaster 3: hard-kill the busiest replica mid-storm — AFTER the
+        # roll finishes, so the kill proves EngineKilled retry recovery
+        # rather than corrupting a swap probe in flight (a kill during a
+        # swap is a legitimate production hazard, but it makes the gate's
+        # rollback-free invariant nondeterministic)
+        time.sleep(max(0.5, storm_len * args.kill_at))
+        roll_done.wait(timeout=max(5.0, storm_len))
+        victims = [r for r in _serving_healthy() if not r.paused]
+        if victims:
+            v = max(victims, key=lambda r: r.outstanding)
+            v.kill("bench-fleet chaos storm")
+            kill_done.append(v.replica_id)
+
+    ctrl = threading.Thread(target=controller, daemon=True,
+                            name="bench-fleet-controller")
+    sab = threading.Thread(target=saboteur, daemon=True)
+    rol = threading.Thread(target=roller, daemon=True)
+
+    replayer = TraceReplayer(router, trace, vocab=64,
+                             max_retries=args.max_retries,
+                             retry_delay=0.05,
+                             request_timeout=args.request_timeout,
+                             workers=args.workers)
+    t0 = time.monotonic()
+    ctrl.start()
+    sab.start()
+    rol.start()
+    replay = replayer.run()
+    storm_end = time.monotonic()
+    sab.join(timeout=30)
+    rol.join(timeout=120)
+
+    # convergence: keep ticking until the controller reports calm_ticks
+    # consecutive in-SLO decisions (or the patience budget runs out)
+    deadline = storm_end + args.converge_timeout
+    while time.monotonic() < deadline:
+        tail = [d for d in decisions if d["t"] > storm_end]
+        calm = 0
+        for d in tail:
+            calm = calm + 1 if not d.get("breach") else 0
+        if calm >= scaler.config.calm_ticks:
+            break
+        time.sleep(args.tick_s)
+    stop.set()
+    ctrl.join(timeout=10)
+
+    post = [d for d in decisions if d["t"] > storm_end]
+    recovery_ticks = 0
+    for d in post:  # ticks until the FIRST in-SLO decision after the storm
+        if not d.get("breach"):
+            break
+        recovery_ticks += 1
+    converged = any(not d.get("breach") for d in post)
+
+    healthz = router.healthz()
+    snap = router.fleet_snapshot()
+    doc = {
+        "bench": "fleet",
+        "replicas": args.replicas,
+        "fault_spec": "" if args.no_faults else FAULT_SPEC,
+        "storm": {
+            "requests": len(trace),
+            "rate_rps": args.rate if not args.trace else None,
+            "storm_len_s": round(storm_len, 2),
+            "wall_s": round(storm_end - t0, 2),
+        },
+        "replay": replay,
+        "autoscaler": {
+            "ticks": len(decisions),
+            "scale_ups": int(reg.stats().get(
+                "fleet.autoscale.scale_ups", 0)),
+            "scale_downs": int(reg.stats().get(
+                "fleet.autoscale.scale_downs", 0)),
+            "recovery_ticks": recovery_ticks,
+            "converged": converged,
+        },
+        "kill": {"count": len(kill_done), "replicas": kill_done},
+        "swap": {
+            "swapped": roll_report.get("swapped", []),
+            "skipped": roll_report.get("skipped", []),
+            "rolled_back": roll_report.get("rolled_back"),
+            "aborted": roll_report.get("aborted", True),
+            "error": roll_report.get("error"),
+            "downtime_p95_ms": round(
+                reg.quantile("fleet.swap.downtime_ms", 0.95), 3),
+            "recompiles": roll_recompiles[0],
+        },
+        "end_state": {
+            "healthz": healthz["status"],
+            "active_replicas": snap["active_replicas"],
+            "degraded": snap["degraded"],
+            "budget_remaining": snap["budget_remaining"],
+        },
+    }
+    router.drain(timeout=60)
+    return doc
+
+
+def check(doc, baseline=None):
+    """The acceptance bars. Structural invariants are absolute; the
+    recovery budget is relative to the committed baseline with generous
+    slack (CI boxes are slower than the baseline machine, and the tick
+    count depends on compile times)."""
+    problems = []
+    rep, auto, swap = doc["replay"], doc["autoscaler"], doc["swap"]
+    if rep["dropped"] != 0:
+        problems.append(f"dropped {rep['dropped']} accepted requests "
+                        f"(the fleet promises zero drops; retries are "
+                        f"allowed, losses are not)")
+    if rep["completed"] != rep["offered"]:
+        problems.append(f"completed {rep['completed']} != offered "
+                        f"{rep['offered']}")
+    if auto["scale_ups"] < 1:
+        problems.append("the storm never scaled the fleet up "
+                        "(scale_ups == 0)")
+    if not auto["converged"]:
+        problems.append("the controller never converged back inside the "
+                        "SLO after the storm")
+    if doc["kill"]["count"] < 1 and doc["fault_spec"]:
+        problems.append("the chaos kill never fired")
+    if swap["aborted"]:
+        problems.append(f"the weight roll aborted: {swap['error']}")
+    if swap["rolled_back"] is not None:
+        problems.append(f"replica {swap['rolled_back']} rolled back "
+                        f"during the storm roll (probe failed)")
+    if not swap["swapped"]:
+        problems.append("the weight roll swapped zero replicas")
+    if swap["recompiles"] != 0:
+        problems.append(f"{swap['recompiles']} recompile(s) across the "
+                        f"weight roll — swaps must reuse the spec-keyed "
+                        f"executables")
+    if doc["end_state"]["healthz"] not in ("ok", "degraded"):
+        problems.append(f"end-state healthz is "
+                        f"{doc['end_state']['healthz']!r}")
+    if baseline:
+        b = baseline.get("autoscaler", {})
+        budget = max(2 * b.get("recovery_ticks", 0) + 4,
+                     b.get("recovery_ticks", 0) + 10)
+        if auto["recovery_ticks"] > budget:
+            problems.append(
+                f"recovery took {auto['recovery_ticks']} ticks "
+                f"(baseline {b.get('recovery_ticks')}, budget {budget})")
+        bswap = baseline.get("swap", {})
+        base_dt = bswap.get("downtime_p95_ms", 0.0)
+        if base_dt and swap["downtime_p95_ms"] > 10 * base_dt:
+            problems.append(
+                f"swap downtime p95 {swap['downtime_p95_ms']:.1f}ms "
+                f"> 10x baseline {base_dt:.1f}ms")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=120)
+    ap.add_argument("--rate", type=float, default=12.0,
+                    help="synthetic storm offered load, req/s")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--trace", default=None,
+                    help="replay this recorded JSONL trace instead of "
+                         "synthesizing a storm")
+    ap.add_argument("--max-new-tokens", type=int, default=4)
+    ap.add_argument("--slo-p95-ms", type=float, default=750.0)
+    ap.add_argument("--slo-max-queue", type=int, default=24)
+    ap.add_argument("--tick-s", type=float, default=0.25,
+                    help="autoscaler controller tick period")
+    ap.add_argument("--kill-at", type=float, default=0.45,
+                    help="kill a replica at this fraction of the storm")
+    ap.add_argument("--roll-at", type=float, default=0.25,
+                    help="start the weight roll at this storm fraction")
+    ap.add_argument("--max-retries", type=int, default=40)
+    ap.add_argument("--request-timeout", type=float, default=120.0)
+    ap.add_argument("--workers", type=int, default=48)
+    ap.add_argument("--converge-timeout", type=float, default=60.0)
+    ap.add_argument("--no-faults", action="store_true",
+                    help="storm without the injected disasters (latency "
+                         "baseline of the harness itself)")
+    ap.add_argument("--check", action="store_true",
+                    help="gate the acceptance bars + baseline budgets")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="refresh the committed baseline")
+    ap.add_argument("--baseline", default=BASELINE)
+    args = ap.parse_args(argv)
+
+    doc = run_chaos(args)
+    json.dump(doc, sys.stdout, indent=2)
+    print()
+
+    if args.write_baseline:
+        base = {
+            "version": 1,
+            "autoscaler": {
+                "recovery_ticks": doc["autoscaler"]["recovery_ticks"]},
+            "swap": {
+                "downtime_p95_ms": doc["swap"]["downtime_p95_ms"]},
+            "replay": {"dropped": 0},
+        }
+        with open(args.baseline, "w") as f:
+            json.dump(base, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"bench fleet: baseline written to {args.baseline}",
+              file=sys.stderr)
+
+    if args.check:
+        baseline = None
+        try:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError):
+            print(f"bench fleet: no baseline at {args.baseline} "
+                  f"(absolute budgets skipped)", file=sys.stderr)
+        problems = check(doc, baseline)
+        if problems:
+            for p in problems:
+                print(f"FAIL: {p}", file=sys.stderr)
+            return 1
+        print("OK: zero drops, fleet scaled, roll clean, SLO recovered",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

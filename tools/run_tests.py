@@ -72,6 +72,13 @@ def main():
                          "trace) and fail unless the prefix KV store hit "
                          "rate is >=0.5 and reuse-on TTFT p50 beats "
                          "reuse-off")
+    ap.add_argument("--bench-fleet", action="store_true",
+                    help="opt-in gate: run tools/bench_fleet.py --check "
+                         "(traffic-replay chaos storm: kill + ENOSPC "
+                         "scale-up + mid-storm weight roll) and fail "
+                         "unless drops == 0, the fleet scaled up, the "
+                         "roll was recompile-free, and SLO recovery "
+                         "fits the bench_fleet_baseline.json budget")
     ap.add_argument("--bench-quant", action="store_true",
                     help="opt-in gate: run tools/bench_quant.py --check "
                          "and fail unless int8 allreduce wire bytes are "
@@ -170,6 +177,20 @@ def main():
              "--prefix-trace", "--check"],
             cwd=REPO, env=env)
         print(f"bench llm: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.bench_fleet:
+        # Opt-in: the self-driving-fleet chaos storm on the CPU backend,
+        # gated on the structural invariants (zero drops, scale-up
+        # happened, roll clean) and the relative recovery-tick budget
+        # (absolute latencies are machine-dependent).
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_fleet", "--check"],
+            cwd=REPO, env=env)
+        print(f"bench fleet: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
