@@ -29,11 +29,36 @@ if not hasattr(_jax, "shard_map"):
     def _shard_map_compat(f=None, /, **kw):
         if "check_vma" in kw:
             kw["check_rep"] = kw.pop("check_vma")
+        # the old replication checker predates the vma type system this
+        # codebase is written against and rejects valid programs (e.g.
+        # cond branches with different inferred replication — the error
+        # itself recommends check_rep=False). It is a static lint with no
+        # numeric effect, so default it off under old jax.
+        kw.setdefault("check_rep", False)
         if f is None:  # decorator form: jax.shard_map(mesh=..., ...)
             return lambda g: _exp_shard_map(g, **kw)
         return _exp_shard_map(f, **kw)
 
     _jax.shard_map = _shard_map_compat
+
+# jax < 0.5 has no lax.axis_size; psum of the python literal 1 over the
+# named axis is the classic spelling and is evaluated statically (returns
+# a python int), so `range(axis_size)` keeps working.
+from jax import lax as _lax
+if not hasattr(_lax, "axis_size"):
+    _lax.axis_size = lambda axis_name: _lax.psum(1, axis_name)
+
+# jax < 0.6 has no jax.typeof; get_aval is the same lookup (callers here
+# only probe optional attrs like .vma on the result, with defaults)
+if not hasattr(_jax, "typeof"):
+    from jax.core import get_aval as _get_aval
+    _jax.typeof = _get_aval
+
+# jax < 0.6 has no lax.pcast / vma type system; marking a value
+# device-varying is meaningless there (the old check_rep machinery infers
+# replication itself), so the compat spelling is identity
+if not hasattr(_lax, "pcast"):
+    _lax.pcast = lambda x, axes, to=None: x
 
 # Under a launcher/spawn (PADDLE_TRAINERS_NUM > 1) the distributed runtime
 # must come up before the first XLA-backend touch below. Inline (not via

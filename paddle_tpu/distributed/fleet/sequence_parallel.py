@@ -13,11 +13,32 @@ accumulation + ppermute of the K/V block to the next rank) — so ICI
 carries exactly one K/V block per step, overlapped by XLA with the
 block's matmuls. Numerics are exact (same streaming-max/denominator
 algebra as flash attention), verified against dense attention in tests.
-Differentiable end-to-end: AD through scan+ppermute yields the reverse
-ring schedule automatically.
+
+Two chunk-compute variants share the ring schedule:
+
+- :func:`ring_attention` — dense [Tl, Tl] score blocks per step.
+  Differentiable end-to-end: AD through scan+ppermute yields the reverse
+  ring schedule automatically.
+- :func:`ring_flash_attention` — the Pallas flash kernel per step, with
+  a hand-written :func:`jax.custom_vjp` backward (the kernel has no AD
+  rule). Forward saves per-rank (o, lse); backward walks the K/V ring a
+  second time running the FlashAttention recomputation schedule per
+  chunk (``ops/pallas_attention._fa_bwd_with_lse``): dQ accumulates
+  locally while each K/V block's dK/dV accumulator travels the ring
+  *with* its block, so after exactly S ppermute steps every accumulator
+  has collected all ranks' contributions and is back home. No [Tl, Tl]
+  score tensor ever materializes in either direction — see
+  docs/performance.md "Long-context training".
+
+The shard-mapped callables for both variants are cached per
+(mesh, axis, causal, scale, batch_axes[, interpret]) signature so warm
+eager calls reuse jit traces instead of rebuilding a fresh
+``jax.shard_map`` over a new lambda each call.
 """
 from __future__ import annotations
 
+import collections
+import functools
 from typing import Optional
 
 import numpy as np
@@ -30,10 +51,21 @@ from .. import mesh as _mesh
 
 _NEG = -1e30  # -inf stand-in: keeps the streaming-softmax algebra nan-free
 
+#: python-side trace counter, bumped once per (re)trace of each ring
+#: local function — the compile-counter regression tests assert warm
+#: calls leave these untouched
+_TRACE_COUNTS = collections.Counter()
+
+#: shard-mapped ring callables keyed by signature (see _ring_callable);
+#: bounded in practice by the handful of (mesh, flags) combinations a
+#: process uses, so no eviction policy
+_RING_CACHE = {}
+
 
 def _ring_attention_local(q, k, v, axis: str, causal: bool, scale):
     """Runs INSIDE shard_map. q/k/v: local [B, H, Tl, D] blocks (sequence
     dim sharded over ``axis``). Returns local attention output."""
+    _TRACE_COUNTS["ring_dense"] += 1
     S = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     B, H, Tl, D = q.shape
@@ -78,6 +110,38 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool, scale):
     return out.astype(q.dtype)
 
 
+def _canon_batch_axes(batch_axes):
+    return tuple(batch_axes) if isinstance(batch_axes, (list, tuple)) \
+        else batch_axes
+
+
+def _ring_callable(kind, mesh, axis, causal, scale, batch_axes,
+                   interpret=None):
+    """The shard-mapped ring callable for one signature, built once and
+    cached. A fresh ``jax.shard_map`` over a new lambda per call would
+    defeat jit's trace cache (the callable's identity IS the cache key),
+    so every eager warm call would retrace the whole ring program."""
+    key = (kind, mesh, axis, bool(causal), float(scale),  # noqa: PTA001 -- causal/scale are trace-time python config (never traced values); the cache key must be hashable
+           _canon_batch_axes(batch_axes), interpret)
+    fn = _RING_CACHE.get(key)
+    if fn is None:
+        spec = P(batch_axes, None, axis, None)
+        if kind == "dense":
+            # jit-wrapped: a bare shard_map call re-traces the local fn on
+            # every eager invocation; pjit's trace cache (keyed on the
+            # stable callable identity we cache here + avals) makes warm
+            # calls zero-trace
+            fn = jax.jit(jax.shard_map(
+                functools.partial(_ring_attention_local, axis=axis,
+                                  causal=causal, scale=scale),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        else:
+            fn = _build_ring_flash(mesh, spec, axis, causal, scale,
+                                   batch_axes, interpret)
+        _RING_CACHE[key] = fn
+    return fn
+
+
 def ring_attention(q, k, v, mesh=None, axis: str = "sp",
                    causal: bool = False, scale: Optional[float] = None,
                    batch_axes=None):
@@ -92,13 +156,9 @@ def ring_attention(q, k, v, mesh=None, axis: str = "sp",
     """
     m = mesh or _mesh.ensure_mesh()
     if scale is None:
-        scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    spec = P(batch_axes, None, axis, None)
-    fn = jax.shard_map(
-        lambda qq, kk, vv: _ring_attention_local(qq, kk, vv, axis, causal,
-                                                 scale),
-        mesh=m, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))  # noqa: PTA001 -- head dim is a static shape, a trace-time python int
+    return _ring_callable("dense", m, axis, causal, scale, batch_axes)(
+        q, k, v)
 
 
 def split_sequence(x, mesh=None, axis: str = "sp", seq_dim: int = 2):
@@ -129,10 +189,8 @@ def _ring_impl(qq, kk, vv, axis="sp", causal=False, batch_axes=None):
     # module-level (no closure) so the eager op cache can key it: a
     # per-call lambda over a Mesh is _UNCACHEABLE and re-traces the whole
     # ring program each call (dispatch.py cache rules)
-    ba = tuple(batch_axes) if isinstance(batch_axes, (list, tuple)) \
-        else batch_axes
     return ring_attention(qq, kk, vv, mesh=None, axis=axis, causal=causal,
-                          batch_axes=ba)
+                          batch_axes=_canon_batch_axes(batch_axes))
 
 
 class RingAttention:
@@ -149,9 +207,10 @@ class RingAttention:
         self._axis = axis
         self._causal = causal
         self._batch_axes = batch_axes
-        # use_flash: run the Pallas kernel per chunk (forward-only today
-        # — the lse-merge custom_vjp is future work; training paths keep
-        # the dense-chunk ring whose AD is exact)
+        # use_flash: run the Pallas kernel per chunk. Fully trainable —
+        # ring_flash_attention carries a custom_vjp whose backward runs
+        # the flash recomputation schedule around the ring, so this is
+        # the long-context TRAINING fast path, not just inference
         self._use_flash = use_flash
 
     def __call__(self, q, k, v):
@@ -167,28 +226,43 @@ class RingAttention:
                      batch_axes=self._batch_axes)
 
 
-def _ring_blocks(Tl: int, D: int, dtype):
-    """Block edges for the ring-flash chunk kernel. This path calls the
-    kernel core without a padding wrapper, so blocks MUST divide Tl
-    exactly — a tuned winner that doesn't divide is discarded (the tuner
-    enumerates with ``require_divides=True``, so this only filters stale
-    or hand-edited cache entries)."""
+def _sanitize_ring_blocks(tuned, Tl: int):
+    """Shared divisibility sanitizer for tuned ring block pairs: the ring
+    path calls the kernel core without a padding wrapper, so blocks MUST
+    divide Tl exactly and stay 16-row sublane multiples. Returns the
+    (bq, bk) pair or None when the entry is unusable."""
+    if tuned is None:
+        return None
+    bq, bk = int(tuned[0]), int(tuned[1])
+    if (bq > 0 and bk > 0 and Tl % bq == 0 and Tl % bk == 0
+            and bq % 16 == 0 and bk % 16 == 0):
+        return bq, bk
+    return None
+
+
+def _ring_blocks(Tl: int, D: int, dtype, bwd: bool = False):
+    """Block edges for the ring-flash chunk kernel (``bwd`` selects the
+    backward-kernel family). Tuned winners that don't divide Tl are
+    discarded by :func:`_sanitize_ring_blocks` (the tuner enumerates with
+    ``require_divides=True``, so this only filters stale or hand-edited
+    cache entries); a missing backward winner falls back to the forward
+    family's before the heuristic default."""
     default = Tl if Tl <= 128 else (128 if Tl % 128 == 0 else 16)
     try:
         from ...tuner import get_flash_blocks
-        tuned = get_flash_blocks(Tl, Tl, D, dtype, False, ring=True)
+        got = _sanitize_ring_blocks(
+            get_flash_blocks(Tl, Tl, D, dtype, False, ring=True, bwd=bwd),
+            Tl)
+        if got is None and bwd:
+            got = _sanitize_ring_blocks(
+                get_flash_blocks(Tl, Tl, D, dtype, False, ring=True), Tl)
     except Exception:
-        tuned = None
-    if tuned is not None:
-        bq, bk = int(tuned[0]), int(tuned[1])
-        if (bq > 0 and bk > 0 and Tl % bq == 0 and Tl % bk == 0
-                and bq % 16 == 0 and bk % 16 == 0):
-            return bq, bk
-    return default, default
+        got = None
+    return got if got is not None else (default, default)
 
 
-def _ring_flash_local(q, k, v, axis: str, causal: bool, scale,
-                      interpret: bool):
+def _ring_flash_fwd_local(q, k, v, axis: str, causal: bool, scale,
+                          interpret: bool):
     """Ring attention whose LOCAL chunk compute is the Pallas flash
     kernel (ops/pallas_attention.py) instead of a dense [Tl, Tl] block
     product — the full composition of the two long-context mechanisms:
@@ -204,9 +278,15 @@ def _ring_flash_local(q, k, v, axis: str, causal: bool, scale,
     the kernel's causal path, past chunks run non-causal. Runs INSIDE
     shard_map; q/k/v are local [B, H, Tl, D] blocks with Tl a multiple
     of 16 (the kernel's sublane tile).
+
+    Returns ``(o [B,H,Tl,D], lse [B,H,Tl] f32)`` — the merged logsumexp
+    rows are the backward residual (with them, per-chunk
+    ``p = exp(s·scale − lse)`` IS the global softmax weight, so the
+    backward never re-merges).
     """
     from ...ops.pallas_attention import _fa_fwd_with_lse
 
+    _TRACE_COUNTS["ring_flash_fwd"] += 1
     S = lax.axis_size(axis)
     rank = lax.axis_index(axis)
     B, H, Tl, D = q.shape
@@ -260,55 +340,148 @@ def _ring_flash_local(q, k, v, axis: str, causal: bool, scale,
     lse0 = jnp.full((BH, Tl), _NEG, jnp.float32)
     (o, lse, _, _), _ = lax.scan(
         step, (o0, lse0, k, v), jnp.arange(S))
-    return o.reshape(B, H, Tl, D).astype(q.dtype)
+    return (o.reshape(B, H, Tl, D).astype(q.dtype),
+            lse.reshape(B, H, Tl))
 
 
-def _grad_guard(fn):
-    """Forward-only marker: differentiation raises a clear error instead
-    of the un-vjp'd pallas_call's bare AssertionError."""
-    guarded = jax.custom_vjp(fn)
+def _ring_flash_bwd_local(q, k, v, o, lse, do, axis: str, causal: bool,
+                          scale, interpret: bool):
+    """Backward ring schedule (runs INSIDE shard_map). Residual layout:
+    per-rank local ``q/k/v/o [B,H,Tl,D]`` plus the merged ``lse
+    [B,H,Tl]`` f32 rows from the forward. Because lse is the GLOBAL
+    logsumexp, each chunk's ``p = exp(s·scale − lse)`` recomputed by
+    ``_fa_bwd_with_lse`` is already the globally-normalized softmax
+    weight — the forward's lse-merge weights are folded into the
+    gradient scaling for free, and ``delta = rowsum(dO∘O)`` is computed
+    ONCE per rank (it is chunk-independent).
 
-    def fwd(*args):
-        raise NotImplementedError(
-            "ring_flash_attention is forward-only (the lse-merge "
-            "custom_vjp is not implemented); use the dense-chunk "
-            "ring_attention / RingAttention(use_flash=False) for "
-            "training")
+    Schedule: walk the K/V ring again (same forward perm). dQ accumulates
+    locally in f32; each K/V block travels with its own f32 dK/dV
+    accumulator — block b sits on rank b+s at step s, so after S
+    ppermute steps every accumulator has collected all ranks'
+    contributions and is back on its home rank. The causal 3-way switch
+    skips kernel launches for future chunks exactly as the forward does
+    (the ppermutes stay outside the switch: every rank must participate
+    in every collective).
+    """
+    from ...ops.pallas_attention import _fa_bwd_with_lse
 
-    def bwd(res, g):   # pragma: no cover — fwd always raises first
-        raise NotImplementedError
-    guarded.defvjp(fwd, bwd)
-    return guarded
+    _TRACE_COUNTS["ring_flash_bwd"] += 1
+    S = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    B, H, Tl, D = q.shape
+    bq, bk = _ring_blocks(Tl, D, q.dtype, bwd=True)
+    BH = B * H
+    f32 = jnp.float32
+    qb = q.reshape(BH, Tl, D)
+    dob = do.reshape(BH, Tl, D)
+    lse_b = lse.reshape(BH, 1, Tl).astype(f32)
+    delta = jnp.sum(dob.astype(f32) * o.reshape(BH, Tl, D).astype(f32),
+                    axis=-1)[:, None, :]                    # [BH, 1, Tl]
+
+    def chunk_grads(kc, vc, causal_flag):
+        return _fa_bwd_with_lse(
+            qb, kc.reshape(BH, Tl, D), vc.reshape(BH, Tl, D), dob, None,
+            lse_b, causal_flag, scale, bq, bk, interpret, Tl, delta=delta,
+            grad_dtypes=(f32, f32, f32))
+
+    def step(carry, s):
+        dq, dka, dva, kc, vc = carry
+        src = jnp.mod(rank - s, S)
+        if causal:
+            idx = jnp.where(src > rank, 2,
+                            jnp.where(src == rank, 1, 0))
+            zero = lambda: (jnp.zeros((BH, Tl, D), f32),
+                            jnp.zeros((BH, Tl, D), f32),
+                            jnp.zeros((BH, Tl, D), f32))
+            dqc, dkc, dvc = lax.switch(
+                idx,
+                [lambda: chunk_grads(kc, vc, False),
+                 lambda: chunk_grads(kc, vc, True),
+                 zero])
+        else:
+            dqc, dkc, dvc = chunk_grads(kc, vc, False)
+        dq = dq + dqc
+        dka = dka + dkc
+        dva = dva + dvc
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        return (dq,
+                lax.ppermute(dka, axis, perm=perm),
+                lax.ppermute(dva, axis, perm=perm),
+                lax.ppermute(kc, axis, perm=perm),
+                lax.ppermute(vc, axis, perm=perm)), None
+
+    z = jnp.zeros((BH, Tl, D), f32)
+    (dq, dka, dva, _, _), _ = lax.scan(step, (z, z, z, k, v),
+                                       jnp.arange(S))
+    shape = (B, H, Tl, D)
+    return (dq.reshape(shape).astype(q.dtype),
+            dka.reshape(shape).astype(k.dtype),
+            dva.reshape(shape).astype(v.dtype))
+
+
+def _build_ring_flash(mesh, spec, axis, causal, scale, batch_axes,
+                      interpret):
+    """Assemble the custom_vjp ring-flash callable for one signature.
+    The custom_vjp sits OUTSIDE the shard_maps: forward shard_map returns
+    (o, lse), backward shard_map consumes the saved (q, k, v, o, lse)
+    residuals plus the cotangent. check_vma=False on both: pallas_call's
+    out ShapeDtypeStructs carry no varying-mesh-axes annotation, which
+    strict shard_map rejects; the sharding contract is fully pinned by
+    in_specs/out_specs here."""
+    sspec = P(batch_axes, None, axis)              # [B, H, Tl] rows
+    # jit-wrapped for the same warm-call zero-trace reason as the dense
+    # ring: both the eager forward and each jax.grad-driven backward hit
+    # the pjit trace cache instead of re-tracing the ring program
+    fwd_sm = jax.jit(jax.shard_map(
+        functools.partial(_ring_flash_fwd_local, axis=axis, causal=causal,
+                          scale=scale, interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, sspec), check_vma=False))
+    bwd_sm = jax.jit(jax.shard_map(
+        functools.partial(_ring_flash_bwd_local, axis=axis, causal=causal,
+                          scale=scale, interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, spec, spec, sspec, spec),
+        out_specs=(spec, spec, spec), check_vma=False))
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        return fwd_sm(q, k, v)[0]
+
+    def fwd(q, k, v):
+        o, lse = fwd_sm(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, do):
+        q, k, v, o, lse = res
+        return tuple(bwd_sm(q, k, v, o, lse, do))
+
+    ring.defvjp(fwd, bwd)
+    return ring
 
 
 def ring_flash_attention(q, k, v, mesh=None, axis: str = "sp",
                          causal: bool = False, scale: Optional[float] = None,
                          batch_axes=None, interpret: Optional[bool] = None):
     """Sequence-parallel attention with the Pallas flash kernel as the
-    per-chunk compute (see :func:`_ring_flash_local`). Same contract as
-    :func:`ring_attention`: GLOBAL [B, H, T, D] arrays, T divisible by
-    the axis size, returns the same sharding. ``interpret`` defaults to
-    True off-TPU so CPU-mesh tests run the kernel in interpret mode."""
+    per-chunk compute (see :func:`_ring_flash_fwd_local`). Same contract
+    as :func:`ring_attention`: GLOBAL [B, H, T, D] arrays, T divisible by
+    the axis size, returns the same sharding. Differentiable — the
+    attached custom_vjp runs the flash recomputation schedule around the
+    ring (:func:`_ring_flash_bwd_local`), so ``jax.grad`` through this is
+    the long-context training fast path. ``interpret`` defaults to True
+    off-TPU so CPU-mesh tests run the kernel in interpret mode."""
     m = mesh or _mesh.ensure_mesh()
     if scale is None:
-        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))  # noqa: PTA001 -- head dim is a static shape, a trace-time python int
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    spec = P(batch_axes, None, axis, None)
-    # check_vma=False: pallas_call's out ShapeDtypeStructs carry no
-    # varying-mesh-axes annotation, which strict shard_map rejects; the
-    # sharding contract is fully pinned by in_specs/out_specs here
-    fn = jax.shard_map(
-        lambda qq, kk, vv: _ring_flash_local(qq, kk, vv, axis, causal,
-                                             scale, interpret),
-        mesh=m, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return _grad_guard(fn)(q, k, v)
+    return _ring_callable("flash", m, axis, causal, scale, batch_axes,
+                          interpret=bool(interpret))(q, k, v)  # noqa: PTA001 -- interpret is a trace-time python flag (platform check above), never a traced value
 
 
 def _ring_flash_impl(qq, kk, vv, axis="sp", causal=False, batch_axes=None):
     # module-level for the op cache (see _ring_impl)
-    ba = tuple(batch_axes) if isinstance(batch_axes, (list, tuple)) \
-        else batch_axes
     return ring_flash_attention(qq, kk, vv, mesh=None, axis=axis,
-                                causal=causal, batch_axes=ba)
+                                causal=causal,
+                                batch_axes=_canon_batch_axes(batch_axes))

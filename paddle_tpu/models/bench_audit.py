@@ -118,12 +118,75 @@ def _audit_gpt_train_spec():
     return _train_step_spec(build)
 
 
+def _audit_gpt_ring_flash_spec():
+    """The long-context dp×sp train path: a GPT-style decoder block whose
+    attention is :func:`ring_flash_attention` with grads taken through
+    the ring-flash custom_vjp backward (sequence_parallel.py). The audit
+    pins the trace properties the S≥32k story depends on: both ring
+    walks (forward + backward recomputation) must stay fused device
+    programs with zero host transfers, zero retraces on warm steps, and
+    clean parameter donation. Shapes are tiny (Tl=16 per rank — the
+    kernel runs in interpret mode on CPU); the mesh adapts to the
+    process's device count (dp=2 × sp=n/2 at 8 devices, 1×1 fallback)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ..core import audit
+    from ..distributed.fleet import sequence_parallel as sp
+
+    devices = np.array(jax.devices())  # noqa: PTA002 -- host-side device-list layout at audit registration, not a step path
+    n = devices.size
+    dp = 2 if n >= 2 and n % 2 == 0 else 1
+    spn = n // dp
+    mesh = jax.sharding.Mesh(devices.reshape(dp, spn), ("dp", "sp"))
+    B, H, D = 2, 2, 16
+    T = 16 * spn                       # Tl = 16 rows per sp rank
+    E = H * D
+
+    def train_step(params, x, y):
+        def loss_fn(ps):
+            wq, wk, wv, wo, w1, w2 = ps
+
+            def heads(w):
+                return (x @ w).reshape(B, T, H, D).transpose(0, 2, 1, 3)
+
+            o = sp.ring_flash_attention(heads(wq), heads(wk), heads(wv),
+                                        mesh=mesh, axis="sp", causal=True,
+                                        batch_axes="dp")
+            h = x + o.transpose(0, 2, 1, 3).reshape(B, T, E) @ wo
+            h = h + jax.nn.gelu(h @ w1) @ w2
+            return jnp.mean((h - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return tuple(p - 0.1 * g for p, g in zip(params, grads)), loss
+
+    def make_args(variant):
+        # fresh params per call: donate_argnums=(0,) consumes them
+        rng = np.random.default_rng(29 + variant)
+
+        def w(*shape):
+            return jnp.asarray(rng.standard_normal(shape) * 0.1,
+                               jnp.float32)
+
+        params = (w(E, E), w(E, E), w(E, E), w(E, E),
+                  w(E, 2 * E), w(2 * E, E))
+        x = jnp.asarray(rng.standard_normal((B, T, E)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((B, T, E)), jnp.float32)
+        return (params, x, y)
+
+    return audit.AuditSpec(fn=train_step, make_args=make_args,
+                           jit_kwargs={"donate_argnums": (0,)})
+
+
 def _register_audit_entrypoints():
     from ..core import audit
     audit.register_entrypoint("resnet_train_step", _audit_resnet_train_spec,
                               tags=("train", "bench"))
     audit.register_entrypoint("gpt_train_step", _audit_gpt_train_spec,
                               tags=("train", "bench"))
+    audit.register_entrypoint("gpt_ring_flash_train_step",
+                              _audit_gpt_ring_flash_spec,
+                              tags=("train", "bench", "distributed"))
 
 
 _register_audit_entrypoints()

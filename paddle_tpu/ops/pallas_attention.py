@@ -306,28 +306,51 @@ def _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
     )(qb, kb, vb)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _fa_core(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
-    out, _ = _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret,
-                              true_kv)
-    return out
+def _tuned_bwd_blocks(s_pad, kv_pad, head_dim, dtype, causal, bq, bk):
+    """(block_q, block_k) for the backward kernels: the tuner's
+    ``flash_bwd`` winner when one exists AND divides the padded grid
+    (the backward pallas_calls floor-divide exactly like the forward),
+    else the forward blocks the residuals were produced with."""
+    try:
+        from ..tuner import get_flash_blocks
+        tuned = get_flash_blocks(s_pad, kv_pad, head_dim, dtype, causal,
+                                 bwd=True)
+    except Exception:
+        tuned = None
+    if tuned is not None:
+        tbq, tbk = int(tuned[0]), int(tuned[1])
+        if (tbq > 0 and tbk > 0 and s_pad % tbq == 0
+                and kv_pad % tbk == 0 and tbq % 16 == 0 and tbk % 16 == 0):
+            return tbq, tbk
+    return bq, bk
 
 
-def _fa_core_fwd(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
-    out, lse = _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret,
-                                true_kv)
-    return out, (qb, kb, vb, out, lse)
+def _fa_bwd_with_lse(qb, kb, vb, do, out, lse, causal, sc, bq, bk,
+                     interpret, true_kv, delta=None, grad_dtypes=None):
+    """Backward kernel calls (FlashAttention recomputation schedule):
+    given the saved residuals — ``out`` and the ``[bh, 1, S]`` f32
+    logsumexp rows from :func:`_fa_fwd_with_lse` — recompute P block-wise
+    as ``exp(s·scale − lse)`` and emit (dQ, dK, dV) with f32 accumulators.
 
-
-def _fa_core_bwd(causal, sc, bq, bk, interpret, true_kv, res, do):
+    ``delta`` is the rowsum(dO∘O) softmax-jacobian correction
+    ``[bh, 1, S]``; computed here from ``out`` when not supplied (ring
+    callers precompute it once per rank because it is chunk-independent,
+    and pass ``out=None``). ``grad_dtypes`` overrides the emitted grad
+    dtypes (default: the operand dtypes) — the ring backward requests f32
+    so per-chunk grads accumulate without intermediate rounding."""
     import jax.experimental.pallas as pl
 
-    qb, kb, vb, out, lse = res
     bh, s_pad, d = qb.shape
     kv_pad = kb.shape[1]
-    # delta = rowsum(dO * O) — the softmax-jacobian correction term
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)[:, None, :]                      # [bh, 1, s_pad]
+    if s_pad % bq or kv_pad % bk:
+        raise ValueError(
+            f"flash attention backward: block_q={bq} / block_k={bk} must "
+            f"divide the (padded) sequence lengths ({s_pad}, {kv_pad})")
+    if delta is None:
+        # delta = rowsum(dO * O) — the softmax-jacobian correction term
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)[:, None, :]                  # [bh, 1, s_pad]
+    dq_dt, dk_dt, dv_dt = grad_dtypes or (qb.dtype, kb.dtype, vb.dtype)
 
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk,
@@ -344,7 +367,7 @@ def _fa_core_bwd(causal, sc, bq, bk, interpret, true_kv, res, do):
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), qb.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), dq_dt),
         interpret=interpret,
     )(qb, kb, vb, do, lse, delta)
 
@@ -364,11 +387,35 @@ def _fa_core_bwd(causal, sc, bq, bk, interpret, true_kv, res, do):
         ],
         out_specs=[pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
                    pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bh, kv_pad, d), kb.dtype),
-                   jax.ShapeDtypeStruct((bh, kv_pad, d), vb.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, kv_pad, d), dk_dt),
+                   jax.ShapeDtypeStruct((bh, kv_pad, d), dv_dt)],
         interpret=interpret,
     )(qb, kb, vb, do, lse, delta)
     return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _fa_core(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
+    out, _ = _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret,
+                              true_kv)
+    return out
+
+
+def _fa_core_fwd(qb, kb, vb, causal, sc, bq, bk, interpret, true_kv):
+    out, lse = _fa_fwd_with_lse(qb, kb, vb, causal, sc, bq, bk, interpret,
+                                true_kv)
+    return out, (qb, kb, vb, out, lse)
+
+
+def _fa_core_bwd(causal, sc, bq, bk, interpret, true_kv, res, do):
+    qb, kb, vb, out, lse = res
+    bh, s_pad, d = qb.shape
+    # backward blocks may differ from the forward's (the lse/delta rows
+    # are full-length arrays; only grid divisibility ties them together)
+    bbq, bbk = _tuned_bwd_blocks(s_pad, kb.shape[1], d, qb.dtype, causal,
+                                 bq, bk)
+    return _fa_bwd_with_lse(qb, kb, vb, do, out, lse, causal, sc, bbq,
+                            bbk, interpret, true_kv)
 
 
 _fa_core.defvjp(_fa_core_fwd, _fa_core_bwd)

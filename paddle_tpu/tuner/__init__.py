@@ -76,12 +76,18 @@ def _ceil16(n: int) -> int:
 
 def flash_key(q_len: int, kv_len: int, head_dim: int, dtype: str,
               causal: bool, platform: Optional[str] = None,
-              ring: bool = False) -> str:
+              ring: bool = False, bwd: bool = False) -> str:
     """Key for the flash-attention family. Lengths are canonicalized to
     the 16-row sublane grid (4095 and 4096 share a winner); ``ring``
-    marks the divisor-constrained ring-flash chunk variant."""
+    marks the divisor-constrained ring-flash chunk variant; ``bwd``
+    selects the backward-kernel family (the dQ/dKV recomputation programs
+    have a different VMEM/compute balance than the forward, so they tune
+    separately)."""
     p = platform or _platform()
-    fam = "ring_flash" if ring else "flash_fwd"
+    if bwd:
+        fam = "ring_flash_bwd" if ring else "flash_bwd"
+    else:
+        fam = "ring_flash" if ring else "flash_fwd"
     try:                 # canonicalize: np.dtype / jnp scalar type / str
         import numpy as _np
         dtype = _np.dtype(dtype).name
@@ -118,12 +124,12 @@ def _resolve(key: str) -> Optional[Dict[str, Any]]:
 
 
 def get_flash_blocks(q_len: int, kv_len: int, head_dim: int, dtype: str,
-                     causal: bool, ring: bool = False
+                     causal: bool, ring: bool = False, bwd: bool = False
                      ) -> Optional[Tuple[int, int]]:
     """The tuned (block_q, block_k) for a flash-attention shape, or None
     when no winner is known (caller applies its heuristic default)."""
     cfg = _resolve(flash_key(q_len, kv_len, head_dim, dtype, causal,
-                             ring=ring))
+                             ring=ring, bwd=bwd))
     if not cfg:
         return None
     try:
@@ -173,14 +179,17 @@ def record_winner(key: str, config: Dict[str, Any],
 def autotune_flash(batch_heads: int, q_len: int, kv_len: int,
                    head_dim: int, dtype: str = "float32",
                    causal: bool = False, ring: bool = False,
-                   trials: int = 5, interpret: Optional[bool] = None,
+                   bwd: bool = False, trials: int = 5,
+                   interpret: Optional[bool] = None,
                    record: bool = True) -> Dict[str, Any]:
     """Search (block_q, block_k) for one flash-attention shape by timing
     the real kernel, and (by default) persist the winner.
 
     Returns ``{"block_q", "block_k", "us", "results"}``. Runs the actual
-    ``_fa_fwd_with_lse`` program — candidate pruning is VMEM-based, the
-    scoring is wall clock with median-of-``trials``.
+    ``_fa_fwd_with_lse`` program (or, with ``bwd=True``, the
+    ``_fa_bwd_with_lse`` recomputation program over residuals produced by
+    an untimed forward) — candidate pruning is VMEM-based, the scoring is
+    wall clock with median-of-``trials``.
     """
     import jax
     import jax.numpy as jnp
@@ -198,8 +207,7 @@ def autotune_flash(batch_heads: int, q_len: int, kv_len: int,
     vb = jax.random.normal(kq, (batch_heads, k16, head_dim), jdt)
     scale = 1.0 / float(head_dim) ** 0.5
 
-    def make_runner(cand):
-        bq, bk = cand
+    def _padded(bq, bk):
         if q16 % bq or k16 % bk:
             # pad to the candidate's grid exactly like flash_attention()
             qq = jnp.pad(qb, ((0, 0), (0, -(-q16 // bq) * bq - q16),
@@ -208,11 +216,24 @@ def autotune_flash(batch_heads: int, q_len: int, kv_len: int,
                               (0, 0)))
             vv = jnp.pad(vb, ((0, 0), (0, -(-k16 // bk) * bk - k16),
                               (0, 0)))
-        else:
-            qq, kk, vv = qb, kb, vb
-        fn = jax.jit(lambda a, b, c: fa._fa_fwd_with_lse(
-            a, b, c, causal, scale, bq, bk, interpret, kv_len)[0])
-        return lambda: fn(qq, kk, vv)
+            return qq, kk, vv
+        return qb, kb, vb
+
+    def make_runner(cand):
+        bq, bk = cand
+        qq, kk, vv = _padded(bq, bk)
+        if not bwd:
+            fn = jax.jit(lambda a, b, c: fa._fa_fwd_with_lse(
+                a, b, c, causal, scale, bq, bk, interpret, kv_len)[0])
+            return lambda: fn(qq, kk, vv)
+        # backward lane: residuals come from one untimed forward at the
+        # same grid; only the dQ/dKV recomputation programs are timed
+        out, lse = jax.jit(lambda a, b, c: fa._fa_fwd_with_lse(
+            a, b, c, causal, scale, bq, bk, interpret, kv_len))(qq, kk, vv)
+        do = jax.random.normal(kq, qq.shape, jdt)
+        fn = jax.jit(lambda a, b, c, g, o, l: fa._fa_bwd_with_lse(
+            a, b, c, g, o, l, causal, scale, bq, bk, interpret, kv_len))
+        return lambda: fn(qq, kk, vv, do, out, lse)
 
     best, best_t, results = runner.search(cands, make_runner,
                                           trials=trials)
@@ -225,7 +246,7 @@ def autotune_flash(batch_heads: int, q_len: int, kv_len: int,
     us = best_t * 1e6
     if record:
         record_winner(flash_key(q_len, kv_len, head_dim, dtype, causal,
-                                ring=ring), cfg, us=us)
+                                ring=ring, bwd=bwd), cfg, us=us)
     return dict(cfg, us=us, results=results)
 
 

@@ -47,6 +47,15 @@ BENCH_FLASH_SHAPES = [
 ]
 BENCH_NMS_KS = [128]
 
+#: backward-kernel block family (``--only flash-bwd``): the dQ/dKdV
+#: recomputation grids are tuned independently of the forward — same
+#: candidate space, different arithmetic intensity (5 matmuls vs 2)
+BENCH_FLASH_BWD_SHAPES = [
+    (4096, 4096, 64, "bfloat16", True, False),   # GPT-small S=4096, amp
+    (4096, 4096, 64, "float32", True, False),    # same, no autocast
+    (512, 512, 64, "bfloat16", False, True),     # ring chunk Tl=512
+]
+
 #: (nelems, wire_dtype) — gradient-size families for the compressed
 #: allreduce quantize stage (pow2-bucketed by compress_key, so one entry
 #: covers the whole bucket)
@@ -58,20 +67,26 @@ QUICK_FLASH_SHAPES = [
     (128, 128, 32, "float32", True, False),
     (64, 64, 32, "float32", False, True),
 ]
+QUICK_FLASH_BWD_SHAPES = [
+    (128, 128, 32, "float32", True, False),
+    (64, 64, 32, "float32", False, True),
+]
 QUICK_NMS_KS = [64]
 QUICK_COMPRESS_SIZES = [(1 << 16, "int8")]
 
 
-def tune_flash_lane(shapes, trials, batch_heads):
+def tune_flash_lane(shapes, trials, batch_heads, bwd=False):
     from paddle_tpu import tuner
 
     results = {}
     for q, kv, d, dtype, causal, ring in shapes:
-        key = tuner.flash_key(q, kv, d, dtype, causal, ring=ring)
+        key = tuner.flash_key(q, kv, d, dtype, causal, ring=ring, bwd=bwd)
         t0 = time.time()
         win = tuner.autotune_flash(batch_heads, q, kv, d, dtype=dtype,
-                                   causal=causal, ring=ring, trials=trials)
-        print(f"flash {key}: block_q={win['block_q']} "
+                                   causal=causal, ring=ring, bwd=bwd,
+                                   trials=trials)
+        print(f"flash{'-bwd' if bwd else ''} {key}: "
+              f"block_q={win['block_q']} "
               f"block_k={win['block_k']} ({win['us']:.0f}us, "
               f"{len(win['results'])} candidates, "
               f"{time.time() - t0:.1f}s search)")
@@ -169,7 +184,8 @@ def main(argv=None):
     ap.add_argument("--batch-heads", type=int, default=8,
                     help="leading batch*heads dim for flash search "
                          "arrays (default %(default)s)")
-    ap.add_argument("--only", choices=["flash", "nms", "compress"],
+    ap.add_argument("--only",
+                    choices=["flash", "flash-bwd", "nms", "compress"],
                     help="restrict to one kernel family")
     ap.add_argument("--emit-defaults", nargs="?", metavar="PATH",
                     const=os.path.join(REPO, "paddle_tpu", "tuner",
@@ -185,6 +201,8 @@ def main(argv=None):
     quick = args.quick or (not on_tpu and not args.full)
     interpret = not on_tpu
     flash_shapes = QUICK_FLASH_SHAPES if quick else BENCH_FLASH_SHAPES
+    flash_bwd_shapes = (QUICK_FLASH_BWD_SHAPES if quick
+                        else BENCH_FLASH_BWD_SHAPES)
     nms_ks = QUICK_NMS_KS if quick else BENCH_NMS_KS
     compress_sizes = (QUICK_COMPRESS_SIZES if quick
                       else BENCH_COMPRESS_SIZES)
@@ -198,6 +216,9 @@ def main(argv=None):
     if args.only in (None, "flash"):
         tuned.update(tune_flash_lane(flash_shapes, args.trials,
                                      args.batch_heads))
+    if args.only in (None, "flash-bwd"):
+        tuned.update(tune_flash_lane(flash_bwd_shapes, args.trials,
+                                     args.batch_heads, bwd=True))
     if args.only in (None, "nms"):
         tuned.update(tune_nms_lane(nms_ks, args.trials, interpret))
     if args.only in (None, "compress"):

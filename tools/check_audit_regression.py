@@ -43,7 +43,12 @@ ENTRYPOINTS = ("resnet_train_step", "gpt_train_step",
                # quantized hot paths (docs/quantization.md): the
                # compressed-gradient dp train step and the int8 serving
                # decode step — both must keep zero host transfers
-               "compressed_allreduce_train_step", "llm_int8_decode_step")
+               "compressed_allreduce_train_step", "llm_int8_decode_step",
+               # long-context dp×sp train path: grads through the
+               # ring-flash custom_vjp backward (sequence_parallel.py) —
+               # both ring walks must stay fused, zero-host-transfer
+               # device programs
+               "gpt_ring_flash_train_step")
 
 #: copy_fraction may drift this much absolutely before failing (XLA
 #: version skew moves copy counts a little; a real fusion break moves a
@@ -111,6 +116,15 @@ def run_bench_audit():
     """Trace just the bench entrypoints (forces CPU) and return the
     stats payload."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the ring-flash entrypoint shards over a dp×sp mesh: give the CPU
+    # gate the same 8 virtual devices the test suite uses (conftest.py)
+    # so its audited program is the multi-rank ring, not a 1×1 fallback.
+    # Only provision when the flag is absent — never override an
+    # operator's explicit device-count choice.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     if REPO not in sys.path:
         sys.path.insert(0, REPO)
     from tools.analyze.trace import run_audit
