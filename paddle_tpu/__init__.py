@@ -61,37 +61,25 @@ if not hasattr(_lax, "pcast"):
     _lax.pcast = lambda x, axes, to=None: x
 
 # Under a launcher/spawn (PADDLE_TRAINERS_NUM > 1) the distributed runtime
-# must come up before the first XLA-backend touch below. Inline (not via
-# paddle_tpu.distributed) because that package import already pulls in
-# backend-touching modules.
+# must come up before the first XLA-backend touch below. The retry loop
+# lives in distributed/env.py (bootstrap_pre_backend); importing the
+# paddle_tpu.distributed *package* this early would pull in
+# backend-touching modules, so load the env module standalone under its
+# canonical name — the package's later `from .env import ...` reuses this
+# sys.modules entry, keeping exactly one copy of the bootstrap.
 import os as _os
 if (int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
         and not _os.environ.get("_PADDLE_TPU_DIST_INITIALIZED")):
-    import time as _time
-    _eps = _os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
-    # retry with backoff: workers race the coordinator at job start and must
-    # wait for it rather than fail fast. Inline (not utils.resilience): no
-    # paddle_tpu subpackage may load before this pre-backend bootstrap.
-    _deadline = _time.monotonic() + float(
-        _os.environ.get("PADDLE_TPU_INIT_TIMEOUT", "300"))
-    _delay = 1.0
-    while True:
-        try:
-            _jax.distributed.initialize(
-                coordinator_address=(_eps[0] or None) if _eps else None,
-                num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
-                process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
-            break
-        except Exception as _e:
-            if _time.monotonic() >= _deadline:
-                raise RuntimeError(
-                    "jax.distributed.initialize did not come up within "
-                    "PADDLE_TPU_INIT_TIMEOUT="
-                    f"{_os.environ.get('PADDLE_TPU_INIT_TIMEOUT', '300')}s"
-                ) from _e
-            _time.sleep(min(_delay, max(0.0, _deadline - _time.monotonic())))
-            _delay = min(_delay * 2.0, 15.0)
-    _os.environ["_PADDLE_TPU_DIST_INITIALIZED"] = "1"
+    import importlib.util as _ilu
+    import sys as _sys
+    _spec = _ilu.spec_from_file_location(
+        "paddle_tpu.distributed.env",
+        _os.path.join(_os.path.dirname(__file__), "distributed", "env.py"))
+    _env_mod = _ilu.module_from_spec(_spec)
+    _sys.modules["paddle_tpu.distributed.env"] = _env_mod
+    _spec.loader.exec_module(_env_mod)
+    _env_mod.bootstrap_pre_backend()
+    del _spec, _env_mod, _ilu, _sys
 
 # float32 ops must be float32-accurate (the reference computes true fp32 unless
 # AMP is enabled). XLA's default runs f32 matmuls with bf16 passes on TPU;

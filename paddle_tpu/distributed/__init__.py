@@ -5,8 +5,14 @@ python/paddle/distributed/__init__.py): functional collectives, ParallelEnv /
 init_parallel_env, DataParallel, new_group, spawn, launch; plus the TPU-native
 mesh utilities that replace ring ids (see mesh.py docstring).
 """
+# `from . import env` (not only `from .env import ...`): when paddle_tpu's
+# pre-backend bootstrap loaded env.py standalone into sys.modules, this
+# also binds it as a package attribute so `paddle_tpu.distributed.env`
+# attribute access keeps working.
+from . import env  # noqa: F401
 from .env import (  # noqa: F401
-    ParallelEnv, init_parallel_env, is_initialized, device_count,
+    ParallelEnv, init_parallel_env, bootstrap_pre_backend, is_initialized,
+    device_count,
 )
 # group-aware rank/world-size (fall back to env for the global group)
 from .collective import get_rank, get_world_size  # noqa: F401
@@ -25,8 +31,12 @@ from .parallel import (  # noqa: F401
     DataParallel, sync_params_buffers, shard_batch, build_global_batch,
 )
 from .elastic import (  # noqa: F401
-    PreemptionGuard, PREEMPTION_EXIT_CODE, under_elastic_supervisor,
-    RestartBudget,
+    PreemptionGuard, PREEMPTION_EXIT_CODE, HOST_LOST_EXIT_CODE,
+    under_elastic_supervisor, RestartBudget,
+)
+from . import elastic_runtime  # noqa: F401
+from .elastic_runtime import (  # noqa: F401
+    StepWatchdog, HeartbeatPlane, CohortSupervisor,
 )
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,

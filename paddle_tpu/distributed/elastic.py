@@ -32,6 +32,14 @@ PREEMPTION_EXIT_CODE = 117
 #: state, so the supervisor tears the job down instead of respawning.
 DIVERGENCE_EXIT_CODE = 119
 
+#: Exit code the StepWatchdog (elastic_runtime.watchdog) uses when a guarded
+#: train step blows its deadline — the signature of a peer host dying
+#: mid-collective (the survivors don't crash, they stall forever inside the
+#: allreduce). The cohort supervisor treats it as "a peer is gone": it tears
+#: down ALL local workers, bumps the cohort generation, and re-forms the
+#: world, instead of respawning the one rank that happened to notice.
+HOST_LOST_EXIT_CODE = 121
+
 #: Env var the elastic supervisor sets in every child so training loops can
 #: auto-arm a PreemptionGuard without code changes.
 ELASTIC_ENV_VAR = "PADDLE_TPU_ELASTIC"

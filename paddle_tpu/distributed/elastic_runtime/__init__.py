@@ -1,0 +1,28 @@
+"""Multi-host elastic fault-tolerance control plane (docs/fault_tolerance.md,
+"Surviving host loss").
+
+Three layers turn peer death from an indefinite stall into bounded-time
+recovery:
+
+* :mod:`~paddle_tpu.distributed.elastic_runtime.heartbeat` — the
+  out-of-band health plane: per-host TCP beacons, missed-beat death
+  declaration, straggler z-scores, labeled ``/metricsz`` gauges.
+* :mod:`~paddle_tpu.distributed.elastic_runtime.watchdog` — the
+  in-process collective watchdog: a deadline thread around every guarded
+  train step that converts a hung collective into exit
+  :data:`~paddle_tpu.distributed.elastic.HOST_LOST_EXIT_CODE` (121).
+* :mod:`~paddle_tpu.distributed.elastic_runtime.cohort` — the supervisor:
+  on exit-121 or a declared death, tear down, bump the cohort generation,
+  re-form the world (spare host / shrink-to-fit), restore from the newest
+  committed multi-host checkpoint.
+"""
+from ..elastic import HOST_LOST_EXIT_CODE  # noqa: F401
+from .heartbeat import (  # noqa: F401
+    COHORT_GEN_VAR, HEARTBEAT_ADDR_VAR, BeaconSender, HeartbeatConfig,
+    HeartbeatCoordinator, HeartbeatPlane, cohort_generation,
+    maybe_auto_sender,
+)
+from .watchdog import (  # noqa: F401
+    STEP_DEADLINE_VAR, StepWatchdog, maybe_auto_watchdog,
+)
+from .cohort import CohortSupervisor  # noqa: F401

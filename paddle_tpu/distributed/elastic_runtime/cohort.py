@@ -1,0 +1,352 @@
+"""Cohort re-formation: the supervisor half of surviving host loss.
+
+:class:`~paddle_tpu.distributed.launch.ElasticSupervisor` (PR 1) respawns
+*individual* ranks — correct for a single-host job, wrong for a multi-host
+SPMD world: once any peer dies, every survivor's collectives are wedged and
+the ``jax.distributed`` runtime cannot admit a lone replacement into a
+half-dead world. Recovery is all-or-nothing: tear down every local worker,
+bump the cohort generation, and re-run ``jax.distributed.initialize`` for
+a *new* world.
+
+:class:`CohortSupervisor` is that extension (``launch --elastic`` builds it).
+On a cohort event — a child exiting
+:data:`~paddle_tpu.distributed.elastic.HOST_LOST_EXIT_CODE` (its watchdog
+caught a hung collective), any fatal child exit in a multi-rank world, or a
+heartbeat-declared host death — it:
+
+1. records a ``distributed.cohort_reform`` flight event (after the health
+   plane's own ``distributed.host_lost`` event, before any teardown),
+2. SIGTERM→SIGKILLs all surviving local workers,
+3. consumes ONE restart-budget unit for the whole re-formation (preemption
+   cascades are free, like single-rank preemption always was),
+4. computes the next world: a dead endpoint is replaced from
+   ``spare_endpoints`` when one is available, dropped when
+   ``shrink_on_loss`` is set or the endpoint is an unreachable remote,
+   kept when it is local (a respawnable process, not a lost machine),
+5. bumps the generation (``PADDLE_TPU_COHORT_GEN``), updates the PADDLE_*
+   env contract to the new world, and respawns every local rank.
+
+The respawned trainers re-run ``jax.distributed.initialize`` through the
+normal pre-backend bootstrap (env.py) and restore from the newest committed
+multi-host checkpoint via the PR 10 manifest; when the world shrank,
+``load_sharded``'s re-shard path reassembles the full arrays from all
+hosts' shard files and lays them out over the smaller mesh (dp degree is
+whatever the trainer recomputes from ``PADDLE_TRAINERS_NUM``).
+
+Exit-code taxonomy (docs/fault_tolerance.md): 0 done · 117 preemption
+(free) · 119 divergence (never restarted) · 121 host lost (cohort reform,
+budgeted) · other fatal (cohort reform in a multi-rank world, per-rank
+respawn in a single-rank one — the PR 1 semantics, unchanged).
+"""
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..elastic import (DIVERGENCE_EXIT_CODE, HOST_LOST_EXIT_CODE,
+                       PREEMPTION_EXIT_CODE)
+from ..launch import (ElasticSupervisor, _spawn_rank, _tail_log,
+                      terminate_local_procs)
+from .heartbeat import (COHORT_GEN_VAR, HEARTBEAT_ADDR_VAR,
+                        HeartbeatConfig, HeartbeatCoordinator)
+from .watchdog import STEP_DEADLINE_VAR
+
+
+class CohortSupervisor(ElasticSupervisor):
+    """Supervise a cohort of ranks as one unit (see module docstring)."""
+
+    def __init__(self, endpoints, script, script_args,
+                 step_deadline: Optional[float] = None,
+                 heartbeat: bool = False,
+                 heartbeat_port: int = 0,
+                 heartbeat_interval: Optional[float] = None,
+                 heartbeat_miss: Optional[int] = None,
+                 shrink_on_loss: bool = False,
+                 spare_endpoints: Sequence[str] = (),
+                 reform_on_crash: Optional[bool] = None,
+                 settle_s: float = 1.0,
+                 **kw):
+        super().__init__(endpoints, script, script_args, **kw)
+        self.generation = 0
+        self.world: List[str] = list(endpoints)
+        self.shrink_on_loss = bool(shrink_on_loss)
+        self.spares: List[str] = list(spare_endpoints)
+        # single-rank worlds keep PR 1's per-rank respawn; any multi-rank
+        # world must re-form as a unit (a lone respawn can't rejoin a
+        # wedged jax.distributed world)
+        self.reform_on_crash = (len(endpoints) > 1 if reform_on_crash is None
+                                else bool(reform_on_crash))
+        self.settle_s = float(settle_s)
+        self.reforms = 0
+        # the endpoints this supervisor is responsible for spawning: its
+        # node's slice of the initial world (ips decide locality after a
+        # shrink/replace reshuffles ranks)
+        base = self.node_rank * self.nproc_per_node
+        local = endpoints[base:base + self.nproc_per_node]
+        self._local_ips = {ep.rsplit(":", 1)[0] for ep in local}
+        self._procs: List = []
+        self._death_lock = threading.Lock()
+        self._remote_deaths: List[Dict] = []
+        self._coordinator: Optional[HeartbeatCoordinator] = None
+        self._hb_config = None
+        if heartbeat:
+            self._hb_config = HeartbeatConfig(
+                interval_s=heartbeat_interval, miss_threshold=heartbeat_miss)
+            self._hb_port = int(heartbeat_port)
+        if step_deadline and float(step_deadline) > 0:
+            self.extra_env[STEP_DEADLINE_VAR] = str(float(step_deadline))
+        self.extra_env.setdefault(COHORT_GEN_VAR, "0")
+        if self.log_dir:
+            # watchdog flight dumps should land next to the workerlogs
+            self.extra_env.setdefault("PADDLE_TPU_FLIGHT_DIR", self.log_dir)
+
+    # -- spawning -----------------------------------------------------------
+    def _local_rank_slots(self):
+        """(global_rank, local_rank) pairs this supervisor owns in the
+        *current* world — locality by endpoint ip, because a shrink or a
+        spare substitution renumbers global ranks."""
+        slots = []
+        for i, ep in enumerate(self.world):
+            if ep.rsplit(":", 1)[0] in self._local_ips:
+                slots.append((i, len(slots)))
+        return slots
+
+    def _spawn_cohort(self) -> List:
+        procs = []
+        for rank, local_rank in self._local_rank_slots():
+            n = self._restart_counts.get(rank, 0)
+            procs.append(_spawn_rank(
+                rank, local_rank, self.world, self.script, self.script_args,
+                self.log_dir, self.extra_env, restart_num=n))
+        self._procs = procs
+        return procs
+
+    # -- heartbeat-declared deaths ------------------------------------------
+    def _note_death(self, rank: int, info: Dict):
+        # coordinator-thread callback: queue only (the run loop owns all
+        # process/teardown state); the health plane already recorded the
+        # distributed.host_lost flight event before calling us
+        with self._death_lock:
+            self._remote_deaths.append(dict(info))
+
+    def _pop_remote_deaths(self) -> List[Dict]:
+        with self._death_lock:
+            out, self._remote_deaths = self._remote_deaths, []
+            return out
+
+    # -- the supervise loop -------------------------------------------------
+    def run(self) -> int:
+        if self._hb_config is not None:
+            self._coordinator = HeartbeatCoordinator(
+                port=self._hb_port, config=self._hb_config,
+                on_death=self._note_death)
+            self._coordinator.start()
+            self.extra_env[HEARTBEAT_ADDR_VAR] = self._coordinator.address
+        alive = self._spawn_cohort()
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, self.request_drain)
+        try:
+            while alive:
+                if self._drain:
+                    sys.stderr.write(
+                        f"cohort supervisor: draining {len(alive)} rank(s) "
+                        f"(grace {self.grace_period}s)\n")
+                    terminate_local_procs(alive, self.grace_period)
+                    return 1
+                self._sleep(self.poll_interval)
+                deaths = self._pop_remote_deaths()
+                if deaths:
+                    rc = self._reform(alive, fatals={}, declared=deaths)
+                    if rc is not None:
+                        return rc
+                    alive = self._procs
+                    continue
+                fatals: Dict[int, int] = {}
+                for p in list(alive):
+                    ret = p.poll()
+                    if ret is None:
+                        continue
+                    alive.remove(p)
+                    f = getattr(p, "_log_file", None)
+                    if f:
+                        f.close()
+                    if ret == 0:
+                        continue
+                    tail = _tail_log(p._log_path)
+                    if tail:
+                        sys.stderr.write(
+                            f"----- workerlog.{p._rank} (tail) -----\n"
+                            f"{tail}\n"
+                            f"----- end workerlog.{p._rank} -----\n")
+                    if ret == DIVERGENCE_EXIT_CODE:
+                        sys.stderr.write(
+                            f"rank {p._rank} halted on numerical divergence "
+                            f"(exit {ret}); not restarting — terminating "
+                            f"the job\n")
+                        terminate_local_procs(alive, self.grace_period)
+                        return ret
+                    if not self._cohort_event(ret):
+                        rc = self._respawn_single(alive, p, ret)
+                        if rc is not None:
+                            return rc
+                        continue
+                    fatals[p._rank] = ret
+                if fatals:
+                    # settle briefly so near-simultaneous peer exits (the
+                    # SIGKILLed host AND the 121 messengers) are all
+                    # attributed to this round before the shrink decision
+                    self._collect_fatals(alive, fatals)
+                    rc = self._reform(alive, fatals)
+                    if rc is not None:
+                        return rc
+                    alive = self._procs
+            return 0
+        finally:
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+            terminate_local_procs(alive, self.grace_period)
+            if self._coordinator is not None:
+                self._coordinator.stop()
+
+    def _cohort_event(self, ret: int) -> bool:
+        if ret == HOST_LOST_EXIT_CODE:
+            return True
+        return self.reform_on_crash
+
+    def _respawn_single(self, alive, p, ret) -> Optional[int]:
+        """PR 1 per-rank semantics for single-rank worlds: 117 free respawn,
+        crash respawn under budget. Returns an exit code to propagate or
+        None to continue supervising."""
+        if ret == PREEMPTION_EXIT_CODE:
+            sys.stderr.write(
+                f"rank {p._rank} drained after preemption (exit {ret}); "
+                f"restarting (free — budget "
+                f"{self.max_restarts - self.restarts_used} left)\n")
+            alive.append(self._respawn(p))
+            return None
+        if not self.budget.try_consume():
+            sys.stderr.write(
+                f"rank {p._rank} exited with code {ret}; restart budget "
+                f"({self.max_restarts}) exhausted — terminating the job\n")
+            terminate_local_procs(alive, self.grace_period)
+            return ret
+        pause = self.budget.pause()
+        sys.stderr.write(
+            f"rank {p._rank} exited with code {ret}; restarting in "
+            f"{pause:.2f}s ({self.restarts_used}/{self.max_restarts} "
+            f"restarts used)\n")
+        self._sleep(pause)
+        if not self._drain:
+            alive.append(self._respawn(p))
+        return None
+
+    def _collect_fatals(self, alive: List, fatals: Dict[int, int]):
+        """Poll survivors for up to ``settle_s`` more, folding any further
+        fatal exits into this round (the watchdog messengers and the
+        actually-dead rank race each other to the supervisor)."""
+        deadline = time.monotonic() + self.settle_s
+        while alive and time.monotonic() < deadline:
+            self._sleep(min(self.poll_interval, 0.05))
+            for p in list(alive):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                alive.remove(p)
+                f = getattr(p, "_log_file", None)
+                if f:
+                    f.close()
+                if ret != 0:
+                    fatals[p._rank] = ret
+
+    # -- re-formation -------------------------------------------------------
+    def _reform(self, alive: List, fatals: Dict[int, int],
+                declared: Sequence[Dict] = ()) -> Optional[int]:
+        """Tear down, recompute the world, respawn at generation+1.
+        Returns an exit code to propagate, or None when the new cohort is
+        up."""
+        from ...observability import flight as _flight
+        next_gen = self.generation + 1
+        # ranks whose HOST is gone: fatal exits other than the watchdog
+        # messengers (121) / preemption drains (117), plus every
+        # heartbeat-declared death
+        dead_ranks = sorted(
+            {r for r, c in fatals.items()
+             if c not in (HOST_LOST_EXIT_CODE, PREEMPTION_EXIT_CODE)}
+            | {int(d["rank"]) for d in declared})
+        free = (bool(fatals) and not declared
+                and set(fatals.values()) == {PREEMPTION_EXIT_CODE})
+        _flight.record_event(
+            "distributed.cohort_reform",
+            {"gen": self.generation, "next_gen": next_gen,
+             "fatals": {str(r): c for r, c in fatals.items()},
+             "declared_dead": dead_ranks, "free": free})
+        sys.stderr.write(
+            f"cohort supervisor: generation {self.generation} lost "
+            f"rank(s) {dead_ranks or sorted(fatals)} "
+            f"(exits {fatals}, heartbeat-declared "
+            f"{[d['rank'] for d in declared]}); tearing down "
+            f"{len(alive)} survivor(s) and re-forming\n")
+        terminate_local_procs(alive, self.grace_period)
+        del alive[:]
+        if not free and not self.budget.try_consume():
+            code = next((c for c in fatals.values()
+                         if c != PREEMPTION_EXIT_CODE), 1)
+            sys.stderr.write(
+                f"cohort supervisor: restart budget ({self.max_restarts}) "
+                f"exhausted — terminating the job (exit {code})\n")
+            return code
+
+        dead_eps = {self.world[r] for r in dead_ranks
+                    if 0 <= r < len(self.world)}
+        new_world: List[str] = []
+        for ep in self.world:
+            if ep not in dead_eps:
+                new_world.append(ep)
+            elif self.spares:
+                sub = self.spares.pop(0)
+                sys.stderr.write(
+                    f"cohort supervisor: replacing lost {ep} with spare "
+                    f"{sub}\n")
+                new_world.append(sub)
+            elif self.shrink_on_loss:
+                sys.stderr.write(
+                    f"cohort supervisor: dropping lost {ep} "
+                    f"(shrink-to-fit)\n")
+            elif ep.rsplit(":", 1)[0] in self._local_ips:
+                new_world.append(ep)  # local process, machine still here
+            else:
+                sys.stderr.write(
+                    f"cohort supervisor: dropping unreachable {ep} "
+                    f"(no spare available)\n")
+        if not new_world or not any(
+                ep.rsplit(":", 1)[0] in self._local_ips
+                for ep in new_world):
+            sys.stderr.write(
+                "cohort supervisor: no local ranks left after "
+                "re-formation — terminating\n")
+            return 1
+
+        self.generation = next_gen
+        self.world = new_world
+        self.endpoints = new_world  # keeps inherited _respawn coherent
+        self.extra_env[COHORT_GEN_VAR] = str(self.generation)
+        if self._coordinator is not None:
+            self._coordinator.set_generation(self.generation)
+        for rank, _lr in self._local_rank_slots():
+            self._restart_counts[rank] = self._restart_counts.get(rank, 0) + 1
+        pause = self.budget.pause() if not free else 0.0
+        if pause:
+            self._sleep(pause)
+        if self._drain:
+            return 1
+        self._spawn_cohort()
+        self.reforms += 1
+        sys.stderr.write(
+            f"cohort supervisor: generation {self.generation} up — world "
+            f"size {len(new_world)}, {len(self._procs)} local rank(s), "
+            f"budget {self.max_restarts - self.restarts_used} left\n")
+        return None

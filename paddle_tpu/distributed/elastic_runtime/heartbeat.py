@@ -1,0 +1,482 @@
+"""Heartbeat health plane: liveness + straggler side-channel over stdlib TCP.
+
+The SPMD data plane (XLA collectives) has no failure detector — when a peer
+host dies mid-allreduce the survivors stall, they don't crash. This module
+is the out-of-band control plane that notices: the coordinator (by
+convention the host of ``PADDLE_TRAINER_ENDPOINTS[0]``, i.e. the same host
+that runs the ``jax.distributed`` coordinator service) runs a
+:class:`HeartbeatCoordinator`, and every worker runs a :class:`BeaconSender`
+thread that POSTs one JSON beacon per interval carrying
+``(rank, cohort generation, step number, last step wall-time)``.
+
+Declarations the coordinator makes from the beacon stream:
+
+* **host death** — ``miss_threshold`` consecutive intervals without a
+  beacon. A ``distributed.host_lost`` flight event is recorded *before*
+  the ``on_death`` callback runs (the callback is what triggers cohort
+  teardown, and the acceptance contract is "every declared death produces
+  a flight event before any teardown").
+* **straggler** — a host whose reported step wall-time sits more than
+  ``straggler_z`` standard deviations above the cohort mean (computed over
+  the hosts' latest step times; needs ``straggler_min_peers`` reporting
+  hosts for the z-score to mean anything). Emits a ``distributed.straggler``
+  flight event on the rising edge and a labeled gauge either way.
+
+Per-host liveness/step/step-time/lag/straggler state is published as
+labeled gauges on the default :class:`~paddle_tpu.core.monitor.StatRegistry`
+so ``/metricsz`` (observability/metrics.py) renders one sample per rank.
+
+Partition tolerance is symmetric: the sender counts consecutive beacon
+*send* failures and declares the coordinator dead past the same threshold
+(``distributed.coordinator_lost`` flight event + ``on_coordinator_lost``
+callback) — a worker isolated from the control plane knows it, instead of
+training headless forever.
+
+Transport is one short-lived TCP connection per beacon (connect, one JSON
+line, read one JSON reply, close). At 1 Hz per host that is noise, and it
+keeps the protocol stateless: a half-open connection from a dead host can't
+wedge the accept loop. The reply carries the coordinator's current cohort
+view (``generation`` + declared-dead ranks) so workers learn verdicts
+without a second channel.
+
+Fault sites (``PADDLE_TPU_FAULT_SPEC``, docs/fault_tolerance.md):
+
+* ``heartbeat_partition:N:drop`` — the Nth beacon *latches* a simulated
+  network partition: that beacon and every later one is silently dropped
+  (real partitions don't heal after one packet), so the coordinator
+  declares this host dead after ``miss_threshold`` intervals.
+* ``slow_link:N:delay`` — the Nth beacon is delayed by
+  ``PADDLE_TPU_FAULT_SLOW_LINK_S`` (default 2.0) seconds before sending —
+  a transient, per-occurrence slow link.
+
+Threads hold ``_lock`` only around state mutation and never call out
+under it (PTA006); sockets are owned by the thread that created them.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ...utils.resilience import fault_injector
+
+#: env var the cohort supervisor sets in every child: "host:port" of the
+#: HeartbeatCoordinator; presence auto-starts a BeaconSender (see
+#: maybe_auto_sender).
+HEARTBEAT_ADDR_VAR = "PADDLE_TPU_HEARTBEAT_ADDR"
+
+#: env var carrying the cohort generation (bumped by the supervisor on every
+#: re-formation; generation 0 is the initial world).
+COHORT_GEN_VAR = "PADDLE_TPU_COHORT_GEN"
+
+SLOW_LINK_SECONDS = float(os.environ.get("PADDLE_TPU_FAULT_SLOW_LINK_S",
+                                         "2.0"))
+
+
+def cohort_generation() -> int:
+    """This process's cohort generation (0 outside a cohort supervisor)."""
+    try:
+        return int(os.environ.get(COHORT_GEN_VAR, "0"))
+    except ValueError:
+        return 0
+
+
+class HeartbeatConfig:
+    """Tuning knobs shared by both halves of the plane."""
+
+    def __init__(self, interval_s: Optional[float] = None,
+                 miss_threshold: Optional[int] = None,
+                 straggler_z: float = 3.0,
+                 straggler_min_peers: int = 3,
+                 connect_timeout_s: float = 2.0):
+        if interval_s is None:
+            interval_s = float(os.environ.get(
+                "PADDLE_TPU_HEARTBEAT_INTERVAL", "1.0"))
+        if miss_threshold is None:
+            miss_threshold = int(os.environ.get(
+                "PADDLE_TPU_HEARTBEAT_MISS", "3"))
+        self.interval_s = max(0.01, float(interval_s))
+        self.miss_threshold = max(1, int(miss_threshold))
+        self.straggler_z = float(straggler_z)
+        self.straggler_min_peers = max(2, int(straggler_min_peers))
+        self.connect_timeout_s = float(connect_timeout_s)
+
+    @property
+    def death_after_s(self) -> float:
+        return self.interval_s * self.miss_threshold
+
+
+class _Peer:
+    __slots__ = ("rank", "gen", "step", "step_s", "host", "pid",
+                 "last_seen", "straggler")
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.gen = 0
+        self.step = -1
+        self.step_s: Optional[float] = None
+        self.host = ""
+        self.pid = 0
+        self.last_seen = 0.0
+        self.straggler = False
+
+
+class HeartbeatCoordinator:
+    """Accept beacons, track per-host liveness, declare deaths/stragglers.
+
+    One daemon thread runs both the accept loop and the sweep (beacon rates
+    are ~1/s/host; a dedicated sweeper would be ceremony). ``on_death`` is
+    called once per declared rank, after the flight event and gauge flip.
+    """
+
+    def __init__(self, bind: str = "127.0.0.1", port: int = 0,
+                 config: Optional[HeartbeatConfig] = None,
+                 on_death: Optional[Callable[[int, Dict], None]] = None,
+                 registry=None, clock=time.monotonic):
+        self.config = config or HeartbeatConfig()
+        self._on_death = on_death
+        self._clock = clock
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._peers: Dict[int, _Peer] = {}
+        self._dead: Dict[int, Dict] = {}
+        self.generation = 0
+        self._stop = threading.Event()
+        self._srv = socket.create_server((bind, port))
+        self._srv.settimeout(min(0.2, self.config.interval_s / 2.0))
+        self.port = self._srv.getsockname()[1]
+        self.address = f"{bind}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registry plumbing (lazy: the default registry lives in core) -------
+    def _reg(self):
+        if self._registry is None:
+            from ...core import monitor as _monitor
+            self._registry = _monitor.default_registry()
+        return self._registry
+
+    def _gauge(self, name: str, rank: int, value: float):
+        self._reg().set_labeled(name, {"rank": str(rank)}, value)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._serve, name="heartbeat-coordinator",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def set_generation(self, gen: int):
+        """New cohort generation: prior declarations are stale (the dead
+        rank's endpoint was replaced or dropped), so the slate is wiped."""
+        with self._lock:
+            self.generation = int(gen)
+            self._peers.clear()
+            self._dead.clear()
+
+    # -- views --------------------------------------------------------------
+    def declared_dead(self) -> Dict[int, Dict]:
+        with self._lock:
+            return dict(self._dead)
+
+    def snapshot(self) -> Dict[int, Dict]:
+        """Per-rank view for /healthz-style introspection and tests."""
+        now = self._clock()
+        with self._lock:
+            return {r: {"rank": r, "gen": p.gen, "step": p.step,
+                        "step_s": p.step_s, "host": p.host, "pid": p.pid,
+                        "age_s": now - p.last_seen,
+                        "straggler": p.straggler,
+                        "dead": r in self._dead}
+                    for r, p in self._peers.items()}
+
+    # -- serve loop ---------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except socket.timeout:
+                pass
+            except OSError:
+                return  # socket closed under us: stop() won the race
+            else:
+                try:
+                    self._handle(conn)
+                finally:
+                    conn.close()
+            self._sweep()
+
+    def _handle(self, conn: socket.socket):
+        conn.settimeout(self.config.connect_timeout_s)
+        try:
+            raw = conn.makefile("rb").readline()
+            beacon = json.loads(raw.decode("utf-8"))
+        except (OSError, ValueError):
+            return  # torn beacon: the sender will retry next interval
+        if not isinstance(beacon, dict) or "rank" not in beacon:
+            return
+        rank = int(beacon["rank"])
+        now = self._clock()
+        with self._lock:
+            peer = self._peers.get(rank)
+            if peer is None:
+                peer = self._peers[rank] = _Peer(rank)
+            peer.gen = int(beacon.get("gen", 0))
+            peer.step = int(beacon.get("step", -1))
+            step_s = beacon.get("step_s")
+            peer.step_s = float(step_s) if step_s is not None else None
+            peer.host = str(beacon.get("host", ""))
+            peer.pid = int(beacon.get("pid", 0))
+            peer.last_seen = now
+            was_dead = self._dead.pop(rank, None)
+            gen = self.generation
+            dead = sorted(self._dead)
+        if was_dead is not None:
+            # a declared-dead rank beaconing again means the declaration
+            # was a partition, not a death — record the recovery
+            from ...observability import flight as _flight
+            _flight.record_event("distributed.host_recovered",
+                                 {"rank": rank, "gen": gen})
+        self._gauge("distributed.host_up", rank, 1.0)
+        self._gauge("distributed.host_step", rank, float(peer.step))
+        if peer.step_s is not None:
+            self._gauge("distributed.host_step_ms", rank,
+                        peer.step_s * 1000.0)
+        self._reg().add("distributed.heartbeats", 1)
+        try:
+            conn.sendall((json.dumps(
+                {"ok": True, "gen": gen, "dead": dead}) + "\n")
+                .encode("utf-8"))
+        except OSError:
+            pass  # sender vanished mid-reply; its own retry loop copes
+
+    def _sweep(self):
+        now = self._clock()
+        newly_dead = []
+        with self._lock:
+            alive = [p for r, p in self._peers.items() if r not in self._dead]
+            for p in alive:
+                if now - p.last_seen > self.config.death_after_s:
+                    info = {"rank": p.rank, "gen": p.gen, "step": p.step,
+                            "host": p.host, "pid": p.pid,
+                            "silent_s": now - p.last_seen}
+                    self._dead[p.rank] = info
+                    newly_dead.append(info)
+            alive = [p for p in alive if p.rank not in self._dead]
+            straggler_events, straggler_rows = \
+                self._update_stragglers_locked()
+            max_step = max((p.step for p in alive), default=-1)
+            lag_rows = [(p.rank, max_step - p.step) for p in alive
+                        if p.step >= 0]
+        for ev in straggler_events:
+            from ...observability import flight as _flight
+            _flight.record_event("distributed.straggler", ev)
+        for rank, flag in straggler_rows:
+            self._gauge("distributed.straggler", rank, 1.0 if flag else 0.0)
+        for rank, lag in lag_rows:
+            self._gauge("distributed.host_step_lag", rank, float(lag))
+        for info in newly_dead:
+            # contract: the flight event lands BEFORE any teardown the
+            # on_death callback may trigger
+            from ...observability import flight as _flight
+            _flight.record_event("distributed.host_lost", dict(info))
+            self._gauge("distributed.host_up", info["rank"], 0.0)
+            self._reg().add("distributed.deaths_declared", 1)
+            if self._on_death is not None:
+                self._on_death(info["rank"], info)
+
+    def _update_stragglers_locked(self):
+        """z-score each live host's latest step time against the cohort.
+        Caller holds ``_lock``; returns ``(rising_edge_events, rows)`` so
+        flight/gauge emission happens after the lock is dropped."""
+        live = [p for r, p in self._peers.items()  # noqa: PTA006 -- _locked suffix contract: sole caller (_sweep) holds _lock
+                if r not in self._dead and p.step_s is not None]  # noqa: PTA006 -- _locked suffix contract: sole caller (_sweep) holds _lock
+        events = []
+        if len(live) >= self.config.straggler_min_peers:
+            times = [p.step_s for p in live]
+            mean = sum(times) / len(times)
+            var = sum((t - mean) ** 2 for t in times) / len(times)
+            std = var ** 0.5
+            for p in live:
+                z = (p.step_s - mean) / std if std > 1e-12 else 0.0
+                is_straggler = z > self.config.straggler_z
+                if is_straggler and not p.straggler:
+                    events.append({"rank": p.rank, "step": p.step,
+                                   "step_s": p.step_s, "z": round(z, 3),
+                                   "cohort_mean_s": mean})
+                p.straggler = is_straggler
+        return events, [(p.rank, p.straggler) for p in live]
+
+
+class BeaconSender:
+    """Worker half: one daemon thread beaconing this host's liveness.
+
+    The train loop (StepWatchdog.disarm, TrainEpochRange, hapi callbacks)
+    calls :meth:`notify_step` with the latest completed step and its
+    wall-time; the beacon thread snapshots that under the lock. Zero work
+    on the step path beyond two float stores.
+    """
+
+    def __init__(self, address: str, rank: int, gen: Optional[int] = None,
+                 config: Optional[HeartbeatConfig] = None,
+                 on_coordinator_lost: Optional[Callable[[], None]] = None,
+                 clock=time.monotonic):
+        host, _, port = address.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.rank = int(rank)
+        self.gen = cohort_generation() if gen is None else int(gen)
+        self.config = config or HeartbeatConfig()
+        self._on_coordinator_lost = on_coordinator_lost
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._step = -1
+        self._step_s: Optional[float] = None
+        self._consec_fail = 0
+        self._coordinator_lost = False
+        self._partitioned = False
+        self.peer_dead: frozenset = frozenset()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def notify_step(self, step: int, step_s: Optional[float] = None):
+        with self._lock:
+            self._step = int(step)
+            if step_s is not None:
+                self._step_s = float(step_s)
+
+    @property
+    def coordinator_lost(self) -> bool:
+        with self._lock:
+            return self._coordinator_lost
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"heartbeat-sender-{self.rank}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- beacon loop --------------------------------------------------------
+    def _loop(self):
+        # first beacon immediately: the coordinator should see the host as
+        # alive before the first full interval elapses
+        while True:
+            self._beat()
+            if self._stop.wait(self.config.interval_s):
+                return
+
+    def _beat(self):
+        inj = fault_injector()
+        if inj.fire("heartbeat_partition") == "drop":
+            self._partitioned = True  # partitions latch; they don't heal
+        if self._partitioned:
+            return
+        if inj.fire("slow_link") == "delay":
+            time.sleep(min(SLOW_LINK_SECONDS, self.config.death_after_s))
+        with self._lock:
+            payload = {"rank": self.rank, "gen": self.gen,
+                       "step": self._step, "step_s": self._step_s,
+                       "host": socket.gethostname(), "pid": os.getpid()}
+        try:
+            with socket.create_connection(
+                    (self.host, self.port),
+                    timeout=self.config.connect_timeout_s) as conn:
+                conn.sendall((json.dumps(payload) + "\n").encode("utf-8"))
+                reply = json.loads(
+                    conn.makefile("rb").readline().decode("utf-8"))
+        except (OSError, ValueError):
+            self._on_send_failure()
+            return
+        with self._lock:
+            self._consec_fail = 0
+            if isinstance(reply, dict):
+                self.peer_dead = frozenset(reply.get("dead", ()))
+
+    def _on_send_failure(self):
+        with self._lock:
+            self._consec_fail += 1
+            crossed = (self._consec_fail >= self.config.miss_threshold
+                       and not self._coordinator_lost)
+            if crossed:
+                self._coordinator_lost = True
+            fails = self._consec_fail
+        if crossed:
+            # the symmetric half of partition tolerance: a worker cut off
+            # from the control plane knows it (and can choose to stop
+            # training into the void)
+            from ...observability import flight as _flight
+            _flight.record_event("distributed.coordinator_lost",
+                                 {"rank": self.rank, "gen": self.gen,
+                                  "consecutive_failures": fails})
+            if self._on_coordinator_lost is not None:
+                self._on_coordinator_lost()
+
+
+class HeartbeatPlane:
+    """Facade tying the two halves together (the name the docs use).
+
+    ``HeartbeatPlane.coordinator(...)`` / ``HeartbeatPlane.sender(...)``
+    construct the respective halves; :func:`maybe_auto_sender` is the
+    env-contract entry the training wiring uses.
+    """
+
+    coordinator = HeartbeatCoordinator
+    sender = BeaconSender
+
+
+_AUTO_SENDER: list = []
+
+
+def maybe_auto_sender() -> Optional[BeaconSender]:
+    """Process-wide BeaconSender when the cohort supervisor armed the env
+    contract (HEARTBEAT_ADDR_VAR), else None. Idempotent."""
+    if _AUTO_SENDER:
+        return _AUTO_SENDER[0]
+    addr = os.environ.get(HEARTBEAT_ADDR_VAR, "")
+    if not addr:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    sender = BeaconSender(addr, rank).start()
+    _AUTO_SENDER.append(sender)
+    return sender
+
+
+def _reset_auto_sender_for_tests():
+    while _AUTO_SENDER:
+        _AUTO_SENDER.pop().stop()

@@ -65,6 +65,28 @@ def _parse_args(argv=None):
                        "PADDLE_TPU_RESTART_BACKOFF", "1.0")),
                    help="initial restart backoff in seconds (doubles per "
                         "crash, +/-20%% jitter, capped at 30s)")
+    # cohort options (elastic_runtime; docs/fault_tolerance.md "Surviving
+    # host loss") — all ride on --elastic
+    p.add_argument("--step_deadline", type=float, default=0.0,
+                   help="guarded-step deadline in seconds: children arm a "
+                        "StepWatchdog that converts a hung collective into "
+                        "exit 121 (0 = off)")
+    p.add_argument("--heartbeat", action="store_true",
+                   help="run the HeartbeatCoordinator and arm per-host "
+                        "beacons (liveness, step lag, stragglers)")
+    p.add_argument("--heartbeat_port", type=int, default=0,
+                   help="coordinator port (0 = ephemeral)")
+    p.add_argument("--heartbeat_interval", type=float, default=None,
+                   help="beacon period in seconds (default "
+                        "PADDLE_TPU_HEARTBEAT_INTERVAL or 1.0)")
+    p.add_argument("--shrink_on_loss", action="store_true",
+                   help="re-form without the lost host instead of "
+                        "respawning it (dp degree recomputed from the "
+                        "smaller world)")
+    p.add_argument("--spare_ips", type=str, default="",
+                   help="comma-separated replacement host ips: a lost "
+                        "endpoint is substituted from this pool before "
+                        "any shrink")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -325,12 +347,28 @@ def launch(argv=None) -> int:
     endpoints = get_cluster(ips, args.nproc_per_node, args.start_port)
 
     if args.elastic:
-        sup = ElasticSupervisor(
+        # the cohort supervisor subsumes ElasticSupervisor: identical
+        # per-rank semantics for single-rank worlds, whole-cohort
+        # re-formation for multi-rank ones and for exit 121 (imported
+        # lazily — elastic_runtime pulls observability, which plain
+        # non-elastic launches never need)
+        from .elastic_runtime.cohort import CohortSupervisor
+        spares = []
+        for ip in args.spare_ips.split(","):
+            ip = ip.strip()
+            if ip:
+                spares.extend(f"{ip}:{args.start_port + i}"
+                              for i in range(args.nproc_per_node))
+        sup = CohortSupervisor(
             endpoints, args.training_script, args.training_script_args,
             log_dir=args.log_dir, max_restarts=args.max_restarts,
             grace_period=args.grace_period,
             restart_backoff=args.restart_backoff,
-            node_rank=args.node_rank, nproc_per_node=args.nproc_per_node)
+            node_rank=args.node_rank, nproc_per_node=args.nproc_per_node,
+            step_deadline=args.step_deadline, heartbeat=args.heartbeat,
+            heartbeat_port=args.heartbeat_port,
+            heartbeat_interval=args.heartbeat_interval,
+            shrink_on_loss=args.shrink_on_loss, spare_endpoints=spares)
         return sup.run()
 
     procs = start_local_trainers(

@@ -263,7 +263,8 @@ class FaultToleranceCallback(Callback):
     writer so no snapshot is lost at exit.
     """
 
-    def __init__(self, save_dir, guard=None, save_freq=1, async_save=False):
+    def __init__(self, save_dir, guard=None, save_freq=1, async_save=False,
+                 step_watchdog=None):
         super().__init__()
         self.save_dir = save_dir
         self.save_freq = max(1, int(save_freq))
@@ -271,6 +272,7 @@ class FaultToleranceCallback(Callback):
         self._epoch = 0
         self._async_save = bool(async_save)
         self._ckpt = None
+        self._watchdog = step_watchdog
 
     def _ensure_guard(self):
         if self._guard is None:
@@ -280,6 +282,12 @@ class FaultToleranceCallback(Callback):
 
     def on_train_begin(self, logs=None):
         self._ensure_guard()
+        # collective watchdog (elastic_runtime), auto-armed from the cohort
+        # supervisor's PADDLE_TPU_STEP_DEADLINE_S the way the guard is from
+        # PADDLE_TPU_ELASTIC: each train batch is a guarded step, so a peer
+        # death mid-collective becomes exit 121 within the deadline
+        from ..distributed.elastic_runtime.watchdog import maybe_auto_watchdog
+        self._watchdog = maybe_auto_watchdog(self._watchdog)
         if self._async_save and self._ckpt is None:
             from ..incubate.checkpoint.async_ckpt import (
                 AsyncCheckpointer, cleanup_stale_staging)
@@ -325,7 +333,13 @@ class FaultToleranceCallback(Callback):
             guard.exit_if_preempted(
                 save_fn=lambda: self._save("preempted", drain=True))
 
+    def on_train_batch_begin(self, step, logs=None):
+        if self._watchdog is not None:
+            self._watchdog.arm(step)
+
     def on_train_batch_end(self, step, logs=None):
+        if self._watchdog is not None:
+            self._watchdog.disarm()
         from ..utils.resilience import fault_injector
         fault_injector().fire("step")
         self._poll()
@@ -337,6 +351,8 @@ class FaultToleranceCallback(Callback):
         self._poll()
 
     def on_train_end(self, logs=None):
+        if self._watchdog is not None:
+            self._watchdog.disarm()
         if self._ckpt is not None:
             self._ckpt.wait()
 

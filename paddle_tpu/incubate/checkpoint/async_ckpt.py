@@ -368,9 +368,15 @@ def cleanup_stale_staging(root: str,
     definition uncommitted), and a parked ``*.old`` dir is *recovered* —
     renamed back into place when the crash landed inside the swap window
     (final missing: the parked dir is the only complete checkpoint left),
-    removed when the final was republished. ``held`` protects paths a
-    live writer still owns. Returns the removed paths. Startup-only by
-    contract (checkpoint GC must never race an in-flight stage)."""
+    removed when the final was republished. Also sweeps cooperative-commit
+    debris: orphaned ``.tmp_*`` *files* inside committed checkpoint dirs,
+    left by a host killed mid-write of its per-file stage (multi-host
+    commits have no dir-level staging to rename away — see
+    :func:`_write_cooperative`). Being a startup-only sweep, any such file
+    is by definition from a dead cohort generation, never live staging.
+    ``held`` protects paths a live writer still owns. Returns the removed
+    paths. Startup-only by contract (checkpoint GC must never race an
+    in-flight stage)."""
     removed: List[str] = []
     recovered = 0
     try:
@@ -399,10 +405,41 @@ def cleanup_stale_staging(root: str,
                 # new one — un-park it so the path stays restorable
                 os.replace(full, final)  # noqa: PTA002 -- startup-only swap recovery, never on the step path
                 recovered += 1
-    if removed:
-        _monitor.stat_add("ckpt.async.stale_staging_cleaned", len(removed))
+    staged_count = len(removed)
+    # cooperative-commit debris: ``.tmp_shards_<proc>.npz`` /
+    # ``.tmp_metadata_<proc>.json`` files a dead host left inside a shared
+    # checkpoint dir. Readers never see them (every walk keys on the
+    # shards_/metadata_ prefixes), but a re-formed cohort re-saving the
+    # same path must not inherit a dead peer's stale stage.
+    tmp_files = 0
+    candidates = [root] + [
+        os.path.join(root, n) for n in names
+        if not n.endswith(STAGING_SUFFIX) and not n.endswith(OLD_SUFFIX)]
+    for d in candidates:
+        if held and d in held:
+            continue
+        try:
+            inner = os.listdir(d)
+        except (OSError, NotADirectoryError):
+            continue
+        for fn in inner:
+            if not fn.startswith(".tmp_"):
+                continue
+            fp = os.path.join(d, fn)
+            if not os.path.isfile(fp):
+                continue
+            try:
+                os.unlink(fp)  # noqa: PTA002 -- startup-only orphan sweep, never on the step path
+            except OSError:
+                continue
+            removed.append(fp)
+            tmp_files += 1
+    if staged_count:
+        _monitor.stat_add("ckpt.async.stale_staging_cleaned", staged_count)
     if recovered:
         _monitor.stat_add("ckpt.async.parked_old_recovered", recovered)
+    if tmp_files:
+        _monitor.stat_add("ckpt.async.orphan_tmp_files_cleaned", tmp_files)
     return removed
 
 

@@ -74,7 +74,8 @@ class TrainEpochRange:
     def __init__(self, max_epoch_num: int, name: Optional[str] = None,
                  model=None, optimizer=None, checkpoint_path: Optional[str] = None,
                  save_checkpoint_inter: int = 1, async_save: bool = False,
-                 keep_last: int = 2, preemption_guard=None):
+                 keep_last: int = 2, preemption_guard=None,
+                 step_watchdog=None):
         self.max_epoch_num = int(max_epoch_num)
         self.name = name or _job_id()
         self._model = model
@@ -92,6 +93,12 @@ class TrainEpochRange:
         self._pending_unhealthy: Dict[int, Optional[str]] = {}
         from ...distributed.elastic import maybe_auto_guard
         self._guard = maybe_auto_guard(preemption_guard)
+        # collective watchdog (elastic_runtime): armed around each epoch
+        # body the same way the PreemptionGuard is auto-armed — the cohort
+        # supervisor sets PADDLE_TPU_STEP_DEADLINE_S in every child
+        from ...distributed.elastic_runtime.watchdog import (
+            maybe_auto_watchdog)
+        self._watchdog = maybe_auto_watchdog(step_watchdog)
         self.restored_epoch = -1
         self._last_saved = -1
         # debris from a writer killed mid-stage in a previous run; startup
@@ -212,9 +219,15 @@ class TrainEpochRange:
         # status.json is written only after the shard files exist, so a
         # crash mid-save leaves the previous checkpoint referenced; the
         # write itself is tmp+replace so a crash mid-write can't leave
-        # truncated JSON (matching the shard files' atomic pattern)
+        # truncated JSON (matching the shard files' atomic pattern). The
+        # staging name is per-process: on a *shared* checkpoint dir every
+        # host commits the same status (identical content, so concurrent
+        # replaces are benign), but a shared tmp name is not — the first
+        # host's replace consumes it and the others' replace raises
+        # FileNotFoundError mid-commit. ``.tmp_`` prefix so a host killed
+        # mid-write leaves debris the startup staging sweep removes.
         sp = self._status_path()
-        tmp = sp + ".tmp"
+        tmp = os.path.join(self._dir, f".tmp_status_{os.getpid()}.json")
         with open(tmp, "w") as f:
             json.dump({"epoch_no": epoch, "max_epoch_num": self.max_epoch_num},
                       f)
@@ -275,12 +288,21 @@ class TrainEpochRange:
                 # hard-kills the Nth iteration of this process, mid-epoch
                 # from the checkpoint's point of view
                 fault_injector().fire("epoch")
+                # the epoch body is the guarded step: a collective hung by
+                # a peer death becomes exit 121 within the deadline instead
+                # of stalling this loop forever
+                if self._watchdog is not None:
+                    self._watchdog.arm(epoch)
                 yield epoch
+                if self._watchdog is not None:
+                    self._watchdog.disarm()
                 if ((epoch + 1) % self._inter == 0
                         or epoch == self.max_epoch_num - 1):
                     self.save(epoch)
                 self._poll_preemption(epoch)
         finally:
+            if self._watchdog is not None:
+                self._watchdog.disarm()
             self.wait()  # don't exit with an uncommitted in-flight save
 
 

@@ -166,8 +166,10 @@ def _save_sharded_impl(state, path: str, overwrite: bool):
         json.dump(doc, f)
     os.replace(mtmp, os.path.join(path, f"metadata_{proc}.json"))
     if proc == 0:
-        with open(os.path.join(path, "scalars.json"), "w") as f:
+        stmp = os.path.join(path, f".tmp_scalars_{os.getpid()}.json")
+        with open(stmp, "w") as f:
             json.dump(scalars, f)
+        os.replace(stmp, os.path.join(path, "scalars.json"))
 
 
 def _corrupt_first_shard_file(path: str):
@@ -191,20 +193,40 @@ def verify_checkpoint(path: str):
     by a format-2 metadata file is missing or fails its sha256; format-1
     metadata (no checksums) only gets the existence check. Also fails when
     the directory has shard archives but no metadata at all (a save that
-    died between the two writes)."""
+    died between the two writes), and when any *individual* host's shard
+    archive lacks its ``metadata_<proc>.json`` — the multi-host commit
+    protocol writes the manifest last per host, so ``shards_3.npz`` without
+    ``metadata_3.json`` means host 3 (or the coordinator, mid-commit) died
+    inside the window; loading anyway would silently zero-fill every slice
+    host 3 owned."""
     if not os.path.isdir(path):
         raise CheckpointIntegrityError(f"{path} is not a directory")
     names = os.listdir(path)
-    meta_files = [n for n in names if n.startswith("metadata_")]
+    meta_files = [n for n in names if n.startswith("metadata_")
+                  and n.endswith(".json")]
     shard_files = [n for n in names if n.startswith("shards_")
                    and n.endswith(".npz")]
     if not meta_files:
         raise CheckpointIntegrityError(
             f"{path}: no metadata_*.json "
             f"({'shards present — torn save' if shard_files else 'empty'})")
+    meta_procs = {n[len("metadata_"):-len(".json")] for n in meta_files}
+    orphan_shards = sorted(
+        n for n in shard_files
+        if n[len("shards_"):-len(".npz")] not in meta_procs)
+    if orphan_shards:
+        raise CheckpointIntegrityError(
+            f"{path}: shard archive(s) without a committing manifest: "
+            f"{', '.join(orphan_shards)} (a host died between its shard "
+            f"write and its metadata commit — slices owned by that host "
+            f"would restore as zeros)")
     for fn in sorted(meta_files):
-        with open(os.path.join(path, fn)) as f:
-            m = json.load(f)
+        try:
+            with open(os.path.join(path, fn)) as f:
+                m = json.load(f)
+        except ValueError as e:
+            raise CheckpointIntegrityError(
+                f"{path}: {fn} is not parsable JSON ({e})") from e
         proc = fn[len("metadata_"):-len(".json")]
         expect = (m.get("checksums", {}) if m.get("format") in (2, 3)
                   else {f"shards_{proc}.npz": None})
@@ -231,14 +253,19 @@ def write_health_stamp(path: str, healthy: bool, step: Optional[int] = None,
                        reason: Optional[str] = None):
     """Write (or overwrite) the health-stamp sidecar on checkpoint dir
     ``path``. tmp+replace so a crash mid-write leaves the previous stamp,
-    never a torn one."""
+    never a torn one. The staging name is per-process: on a shared
+    checkpoint dir every dp rank sees the same divergence and stamps
+    concurrently — identical content, so racing replaces are benign, but a
+    shared tmp name is not (the first rename consumes it and the rest
+    raise). ``.tmp_`` prefix so debris from a host killed mid-write is
+    swept by ``cleanup_stale_staging``."""
     stamp = {"healthy": bool(healthy), "time": time.time()}
     if step is not None:
         stamp["step"] = int(step)
     if reason is not None:
         stamp["reason"] = str(reason)
     final = os.path.join(path, HEALTH_STAMP_FILE)
-    tmp = final + ".tmp"
+    tmp = os.path.join(path, f".tmp_health_{os.getpid()}.json")
     with open(tmp, "w") as f:
         json.dump(stamp, f)
     os.replace(tmp, final)

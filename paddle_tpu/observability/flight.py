@@ -12,10 +12,13 @@ dumped as JSONL so the post-mortem has the timeline that led to the exit:
   ``ChainedSignalHandler`` chain, or the engines' drain path
 
 Dump format (``flight_<ts>_<pid>.jsonl``): line 1 is a header
-``{"schema": "paddle-tpu-flight/1", "reason": ...}``; then one line per
+``{"schema": "paddle-tpu-flight/2", "reason": ...}``; then one line per
 recorded event (``{"kind": ...}``), then the last spans
 (``{"kind": "span", ...}``), and a final ``{"kind": "stats", ...}``
-registry snapshot.
+registry snapshot. Schema /2 adds ``process_index`` / ``process_count`` /
+``cohort_generation`` to the header so a multi-host post-mortem can be
+collated across per-host dumps and cohort re-formations
+(docs/fault_tolerance.md, "Surviving host loss").
 
 Dumping on crash paths is **opt-in** ("armed"): set ``PADDLE_TPU_FLIGHT=1``
 (or call ``arm()``; enabling tracing also arms) so ordinary test failures
@@ -34,12 +37,34 @@ from typing import Dict, List, Optional
 from ..core import monitor as _monitor
 from . import tracer as _tracer
 
-SCHEMA = "paddle-tpu-flight/1"
+SCHEMA = "paddle-tpu-flight/2"
 
 DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_FLIGHT_CAPACITY", "512"))
 
 #: how many of the newest spans a dump includes
 DUMP_SPAN_LIMIT = 256
+
+
+def _cohort_generation() -> int:
+    """Cohort generation stamped by the elastic supervisor (0 outside it)."""
+    try:
+        return int(os.environ.get("PADDLE_TPU_COHORT_GEN", "0"))
+    except ValueError:
+        return 0
+
+
+def _process_identity() -> Dict:
+    """``process_index``/``process_count`` for the dump header, read from
+    the PADDLE_* env contract (always set under the launcher) rather than
+    asked of jax — a crash-path writer must never trigger a backend
+    init/collective, least of all while a peer is already dead."""
+    try:
+        return {
+            "process_index": int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            "process_count": int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+        }
+    except ValueError:
+        return {"process_index": 0, "process_count": 1}
 
 
 class FlightRecorder:
@@ -83,7 +108,9 @@ class FlightRecorder:
                     "pid": os.getpid(),
                     "wall_s": time.time(),
                     "argv": list(sys.argv),
+                    "cohort_generation": _cohort_generation(),
                 }
+                header.update(_process_identity())
                 f.write(json.dumps(header, default=str) + "\n")
                 for ev in list(self._events):
                     f.write(json.dumps(ev, default=str) + "\n")

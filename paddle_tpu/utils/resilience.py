@@ -166,8 +166,19 @@ class FaultInjector:
     raises ENOSPC, ``slow_io`` stalls the boot). ``weight_swap`` fires
     once per replica inside a hot-swap roll: ``fail``/``disk_full`` force
     the swap's rollback path, ``slow_io`` stretches the swap window while
-    traffic is paused. See docs/fault_tolerance.md for the full site
-    catalog.
+    traffic is paused.
+
+    Host-loss sites (``distributed.elastic_runtime``): ``host_kill`` fires
+    at watchdog arm time, once per guarded step — ``crash`` there is the
+    canonical host-dies-mid-step. ``collective_hang`` fires right after
+    arming; ``hang`` sleeps ``PADDLE_TPU_FAULT_HANG_S`` seconds (default
+    3600) inside the armed window, the peer-death stall the watchdog must
+    convert to exit 121. ``heartbeat_partition`` fires per heartbeat
+    beat; ``drop`` latches the sender silent so the coordinator declares
+    the host dead while the process lives (the partition case).
+    ``slow_link`` delays one beat by ``PADDLE_TPU_FAULT_SLOW_LINK_S``
+    seconds (default 2.0) — a blip that must NOT trip the miss
+    threshold. See docs/fault_tolerance.md for the full site catalog.
 
     Counters are per-process: a restarted trainer starts counting from zero
     again, which is exactly what makes "crash once, then succeed" scenarios

@@ -384,6 +384,99 @@ class TestMultiHost:
         with pytest.raises(CheckpointIntegrityError):
             verify_checkpoint(p)
 
+    def test_coordinator_dies_between_host_commits(self, tmp_path,
+                                                   monkeypatch):
+        # The host-loss window: host 1 committed fully (shards + manifest),
+        # then the coordinator (proc 0) was SIGKILLed after publishing its
+        # shard archive but before its manifest landed. Every file present
+        # passes its own checksum — only the per-host commit-marker
+        # accounting can see that proc 0's slices would restore as zeros.
+        import jax
+        monkeypatch.setattr(ac, "_barrier", lambda: None)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(), p, step=3)
+
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        died = RuntimeError("SIGKILL between shard publish and manifest")
+
+        real_replace = os.replace
+
+        def dying_replace(src, dst):
+            if os.path.basename(dst).startswith("metadata_0"):
+                raise died
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(ac.os, "replace", dying_replace)
+        with pytest.raises(RuntimeError):
+            commit_checkpoint(_state(), p, step=3)
+        monkeypatch.undo()
+
+        names = set(os.listdir(p))
+        assert "shards_0.npz" in names and "metadata_0.json" not in names
+        with pytest.raises(CheckpointIntegrityError,
+                           match="without a committing manifest"):
+            verify_checkpoint(p)
+        # the restore walk treats it like any torn checkpoint: skipped,
+        # not zero-filled
+        assert newest_healthy_checkpoint(str(tmp_path)) is None
+
+    def test_partial_manifest_health_stamp_is_tolerated(self, tmp_path,
+                                                        monkeypatch):
+        # proc 0 (the only sidecar writer) died pre-marker: no health.json,
+        # no metadata_0.json. read_health_stamp must fall back to the
+        # surviving host's inline manifest health instead of raising.
+        import jax
+        monkeypatch.setattr(ac, "_barrier", lambda: None)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        p = str(tmp_path / "ck")
+        commit_checkpoint(_state(), p, healthy=False, step=9, reason="nan")
+        # coordinator debris: its shard landed, its manifest did not
+        with open(os.path.join(p, "shards_0.npz"), "wb") as f:
+            f.write(b"not a real archive")
+        assert not os.path.exists(os.path.join(p, "health.json"))
+        stamp = read_health_stamp(p)
+        assert stamp["healthy"] is False and stamp["reason"] == "nan"
+        # and a garbage manifest from the dead host must not break the
+        # health read either (it is skipped, not fatal)
+        with open(os.path.join(p, "metadata_0.json"), "w") as f:
+            f.write("{torn")
+        stamp = read_health_stamp(p)
+        assert stamp["healthy"] is False
+
+    def test_cleanup_sweeps_dead_cohorts_tmp_files(self, tmp_path,
+                                                   monkeypatch):
+        # A cohort member SIGKILLed mid-stage leaves .tmp_* FILES inside
+        # the shared checkpoint dir (per-file staging — there is no
+        # dir-level .tmp to rename away multi-host). The startup sweep
+        # must remove them without ever touching committed files, and
+        # readers must never mistake them for shards or manifests.
+        import jax
+        monkeypatch.setattr(ac, "_barrier", lambda: None)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        root = tmp_path / "job"
+        p = root / "ck"
+        commit_checkpoint(_state(), str(p), step=1)
+        debris = [p / ".tmp_shards_1.npz", p / ".tmp_metadata_1.json",
+                  root / ".tmp_shards_9.npz"]
+        for d in debris:
+            d.write_bytes(b"dead cohort stage")
+        removed = cleanup_stale_staging(str(root))
+        assert {str(d) for d in debris} <= set(removed)
+        for d in debris:
+            assert not d.exists()
+        # committed state untouched and loadable
+        verify_checkpoint(str(p))
+        out = load_sharded(str(p), return_tensor=False)
+        np.testing.assert_allclose(out["w"], np.arange(16.0))
+        # held dirs are protected from the file sweep
+        (p / ".tmp_shards_1.npz").write_bytes(b"live stage")
+        cleanup_stale_staging(str(root), held={str(p)})
+        assert (p / ".tmp_shards_1.npz").exists()
+
 
 class TestFaultActions:
     def test_new_actions_parse_and_fire_verbatim(self):

@@ -1,0 +1,600 @@
+"""Elastic-runtime unit tests (docs/fault_tolerance.md, "Surviving host
+loss"): heartbeat health plane, collective watchdog, cohort re-formation.
+
+Everything here is tier-1 fast: the heartbeat halves run in-process with
+millisecond intervals, the watchdog uses injectable ``on_timeout``/
+``exit_fn``, and the cohort supervisor drives throwaway *stdlib* child
+scripts (no paddle import per child) exactly like test_elastic_launch.py.
+The end-to-end chaos proof (real 2-process training job, kill + hang +
+bit-identical resume) lives in tests/test_elastic_cohort.py (slow lane).
+"""
+import os
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.core.monitor import StatRegistry, default_registry
+from paddle_tpu.distributed.elastic import (DIVERGENCE_EXIT_CODE,
+                                            HOST_LOST_EXIT_CODE,
+                                            PREEMPTION_EXIT_CODE)
+from paddle_tpu.distributed.elastic_runtime import (
+    COHORT_GEN_VAR, HEARTBEAT_ADDR_VAR, STEP_DEADLINE_VAR, BeaconSender,
+    CohortSupervisor, HeartbeatConfig, HeartbeatCoordinator, HeartbeatPlane,
+    StepWatchdog, cohort_generation, maybe_auto_sender, maybe_auto_watchdog)
+from paddle_tpu.distributed.elastic_runtime import heartbeat as hb_mod
+from paddle_tpu.distributed.elastic_runtime import watchdog as wd_mod
+from paddle_tpu.observability import flight
+from paddle_tpu.utils.resilience import (FAULT_CRASH_EXIT_CODE,
+                                         _reset_fault_injector_for_tests)
+
+FAST = dict(interval_s=0.03, miss_threshold=3)
+
+
+def _wait(pred, timeout_s=5.0, poll_s=0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll_s)
+    return pred()
+
+
+def _events_since(n, kind=None):
+    evs = flight.default_recorder().events()[n:]
+    if kind is None:
+        return evs
+    return [e for e in evs if e["kind"] == kind]
+
+
+@pytest.fixture
+def clean_faults(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC", raising=False)
+    _reset_fault_injector_for_tests()
+    yield monkeypatch
+    _reset_fault_injector_for_tests()
+
+
+class TestHeartbeatPlane:
+    def test_registration_snapshot_and_gauges(self):
+        reg = StatRegistry()
+        with HeartbeatCoordinator(config=HeartbeatConfig(**FAST),
+                                  registry=reg) as coord:
+            with BeaconSender(coord.address, rank=0,
+                              config=HeartbeatConfig(**FAST)) as sender:
+                sender.notify_step(7, 0.012)
+                assert _wait(lambda: coord.snapshot().get(0, {})
+                             .get("step") == 7)
+                snap = coord.snapshot()[0]
+                assert snap["pid"] == os.getpid()
+                assert snap["dead"] is False
+                assert snap["step_s"] == pytest.approx(0.012)
+        assert reg.labeled("distributed.host_up")[(("rank", "0"),)] == 1.0
+        assert reg.labeled("distributed.host_step")[(("rank", "0"),)] == 7.0
+        assert reg.get("distributed.heartbeats") >= 1
+
+    def test_death_declared_with_flight_event_before_callback(self):
+        reg = StatRegistry()
+        cfg = HeartbeatConfig(**FAST)
+        n0 = len(flight.default_recorder().events())
+        event_first = []
+
+        def on_death(rank, info):
+            # acceptance contract: the distributed.host_lost flight event
+            # must already be recorded when teardown (this callback) runs
+            event_first.append(
+                bool(_events_since(n0, "distributed.host_lost")))
+
+        with HeartbeatCoordinator(config=cfg, on_death=on_death,
+                                  registry=reg) as coord:
+            sender = BeaconSender(coord.address, rank=3, config=cfg).start()
+            assert _wait(lambda: 3 in coord.snapshot())
+            t0 = time.monotonic()
+            sender.stop()
+            assert _wait(lambda: 3 in coord.declared_dead())
+            detect = time.monotonic() - t0
+            assert detect < cfg.death_after_s + 10 * cfg.interval_s + 1.0
+            info = coord.declared_dead()[3]
+            assert info["rank"] == 3
+            assert info["silent_s"] > cfg.death_after_s
+        assert event_first == [True]
+        evs = _events_since(n0, "distributed.host_lost")
+        assert evs and evs[0]["rank"] == 3
+        assert reg.labeled("distributed.host_up")[(("rank", "3"),)] == 0.0
+        assert reg.get("distributed.deaths_declared") == 1
+
+    def test_recovery_after_false_declaration(self):
+        cfg = HeartbeatConfig(**FAST)
+        n0 = len(flight.default_recorder().events())
+        with HeartbeatCoordinator(config=cfg,
+                                  registry=StatRegistry()) as coord:
+            s1 = BeaconSender(coord.address, rank=1, config=cfg).start()
+            assert _wait(lambda: 1 in coord.snapshot())
+            s1.stop()
+            assert _wait(lambda: 1 in coord.declared_dead())
+            # the "dead" host beacons again: partition, not death
+            with BeaconSender(coord.address, rank=1, config=cfg):
+                assert _wait(lambda: 1 not in coord.declared_dead())
+        assert _events_since(n0, "distributed.host_recovered")
+
+    def test_peer_death_propagates_in_beacon_reply(self):
+        cfg = HeartbeatConfig(**FAST)
+        with HeartbeatCoordinator(config=cfg,
+                                  registry=StatRegistry()) as coord:
+            with BeaconSender(coord.address, rank=0, config=cfg) as survivor:
+                victim = BeaconSender(coord.address, rank=1,
+                                      config=cfg).start()
+                assert _wait(lambda: 1 in coord.snapshot())
+                victim.stop()
+                assert _wait(lambda: 1 in survivor.peer_dead)
+
+    def test_straggler_rising_edge_event_and_gauge(self):
+        reg = StatRegistry()
+        cfg = HeartbeatConfig(straggler_z=1.5, straggler_min_peers=4, **FAST)
+        n0 = len(flight.default_recorder().events())
+        with HeartbeatCoordinator(config=cfg, registry=reg) as coord:
+            senders = [BeaconSender(coord.address, rank=r,
+                                    config=cfg).start() for r in range(4)]
+            try:
+                for r, s in enumerate(senders):
+                    s.notify_step(10, 10.0 if r == 3 else 0.01)
+                assert _wait(lambda: reg.labeled("distributed.straggler")
+                             .get((("rank", "3"),)) == 1.0)
+                assert reg.labeled(
+                    "distributed.straggler")[(("rank", "0"),)] == 0.0
+                evs = _events_since(n0, "distributed.straggler")
+                assert evs and evs[0]["rank"] == 3 and evs[0]["z"] > 1.5
+                # rising edge only: staying slow emits no second event
+                time.sleep(4 * cfg.interval_s)
+                assert len(_events_since(
+                    n0, "distributed.straggler")) == len(evs)
+            finally:
+                for s in senders:
+                    s.stop()
+
+    def test_sender_declares_coordinator_lost(self):
+        cfg = HeartbeatConfig(interval_s=0.03, miss_threshold=2)
+        n0 = len(flight.default_recorder().events())
+        coord = HeartbeatCoordinator(config=cfg, registry=StatRegistry())
+        coord.start()
+        lost = []
+        sender = BeaconSender(coord.address, rank=0, config=cfg,
+                              on_coordinator_lost=lambda: lost.append(1))
+        sender.start()
+        try:
+            assert _wait(lambda: 0 in coord.snapshot())
+            coord.stop()  # the control plane vanishes, the worker survives
+            assert _wait(lambda: sender.coordinator_lost)
+            assert lost == [1]
+            evs = _events_since(n0, "distributed.coordinator_lost")
+            assert evs and evs[0]["consecutive_failures"] \
+                >= cfg.miss_threshold
+        finally:
+            sender.stop()
+            coord.stop()
+
+    def test_set_generation_wipes_declarations(self):
+        cfg = HeartbeatConfig(**FAST)
+        with HeartbeatCoordinator(config=cfg,
+                                  registry=StatRegistry()) as coord:
+            s = BeaconSender(coord.address, rank=2, config=cfg).start()
+            assert _wait(lambda: 2 in coord.snapshot())
+            s.stop()
+            assert _wait(lambda: 2 in coord.declared_dead())
+            coord.set_generation(1)
+            assert coord.declared_dead() == {}
+            assert coord.snapshot() == {}
+            assert coord.generation == 1
+
+    def test_metricsz_renders_labeled_heartbeat_gauges(self):
+        from paddle_tpu.observability.metrics import render_prometheus
+        cfg = HeartbeatConfig(**FAST)
+        with HeartbeatCoordinator(config=cfg) as coord:  # default registry
+            with BeaconSender(coord.address, rank=0, config=cfg):
+                assert _wait(lambda: 0 in coord.snapshot())
+        text = render_prometheus(default_registry())
+        assert 'host_up{rank="0"}' in text
+
+    def test_cohort_generation_env_parse(self, monkeypatch):
+        monkeypatch.delenv(COHORT_GEN_VAR, raising=False)
+        assert cohort_generation() == 0
+        monkeypatch.setenv(COHORT_GEN_VAR, "4")
+        assert cohort_generation() == 4
+        monkeypatch.setenv(COHORT_GEN_VAR, "junk")
+        assert cohort_generation() == 0
+
+    def test_facade_names_the_halves(self):
+        assert HeartbeatPlane.coordinator is HeartbeatCoordinator
+        assert HeartbeatPlane.sender is BeaconSender
+
+
+class TestHeartbeatFaultSites:
+    def test_heartbeat_partition_latches_until_declared(self, clean_faults):
+        clean_faults.setenv("PADDLE_TPU_FAULT_SPEC",
+                            "heartbeat_partition:3:drop")
+        _reset_fault_injector_for_tests()
+        cfg = HeartbeatConfig(**FAST)
+        n0 = len(flight.default_recorder().events())
+        with HeartbeatCoordinator(config=cfg,
+                                  registry=StatRegistry()) as coord:
+            with BeaconSender(coord.address, rank=0, config=cfg):
+                assert _wait(lambda: 0 in coord.snapshot())
+                # the 3rd beat latches the partition; the sender process is
+                # alive the whole time yet gets declared dead
+                assert _wait(lambda: 0 in coord.declared_dead())
+        assert _events_since(n0, "distributed.host_lost")
+
+    def test_slow_link_blip_is_not_a_death(self, clean_faults, monkeypatch):
+        clean_faults.setenv("PADDLE_TPU_FAULT_SPEC", "slow_link:2:delay")
+        _reset_fault_injector_for_tests()
+        monkeypatch.setattr(hb_mod, "SLOW_LINK_SECONDS", 0.05)
+        cfg = HeartbeatConfig(**FAST)  # death after 0.09s silence
+        deaths = []
+        with HeartbeatCoordinator(config=cfg, registry=StatRegistry(),
+                                  on_death=lambda r, i: deaths.append(r)) \
+                as coord:
+            with BeaconSender(coord.address, rank=0, config=cfg):
+                assert _wait(lambda: 0 in coord.snapshot())
+                time.sleep(0.05 + 3 * cfg.death_after_s)
+            assert deaths == []
+
+
+class TestStepWatchdog:
+    def test_rejects_non_positive_deadline(self):
+        with pytest.raises(ValueError, match="deadline must be positive"):
+            StepWatchdog(0.0)
+        with pytest.raises(ValueError, match="deadline must be positive"):
+            StepWatchdog(-1.0)
+
+    def test_disarm_returns_step_wall_time(self):
+        wd = StepWatchdog(60.0)
+        try:
+            assert wd.disarm() is None  # unarmed: a no-op, not an error
+            wd.arm(0)
+            assert wd.armed
+            time.sleep(0.02)
+            elapsed = wd.disarm()
+            assert elapsed >= 0.02
+            assert not wd.armed and not wd.fired
+        finally:
+            wd.stop()
+
+    def test_fires_on_timeout_with_flight_event(self):
+        fired = []
+        n0 = len(flight.default_recorder().events())
+        wd = StepWatchdog(0.05, on_timeout=lambda s, e: fired.append((s, e)))
+        try:
+            wd.arm(9)
+            assert _wait(lambda: wd.fired)
+            assert not wd.armed  # the hung step was consumed
+            step, elapsed = fired[0]
+            assert step == 9 and elapsed > 0.05
+            evs = _events_since(n0, "distributed.watchdog_fired")
+            assert evs and evs[0]["step"] == 9
+            assert evs[0]["deadline_s"] == pytest.approx(0.05)
+        finally:
+            wd.stop()
+
+    def test_exit_path_dumps_flight_record(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        codes = []
+        wd = StepWatchdog(0.05, exit_fn=codes.append)
+        try:
+            wd.arm(2)
+            assert _wait(lambda: codes)
+            assert codes == [HOST_LOST_EXIT_CODE]
+            dumps = [p for p in os.listdir(tmp_path)
+                     if p.startswith("flight_")]
+            assert dumps, "the terminal path must dump before exiting"
+        finally:
+            wd.stop()
+
+    def test_guard_context_manager_and_heartbeat_wiring(self):
+        seen = []
+
+        class FakeSender:
+            def notify_step(self, step, step_s):
+                seen.append((step, step_s))
+
+        wd = StepWatchdog(60.0, heartbeat=FakeSender())
+        try:
+            with wd.guard(5):
+                assert wd.armed
+            assert not wd.armed
+            assert seen and seen[0][0] == 5 and seen[0][1] >= 0.0
+        finally:
+            wd.stop()
+
+    def test_host_kill_site_hard_exits(self, clean_faults, monkeypatch):
+        clean_faults.setenv("PADDLE_TPU_FAULT_SPEC", "host_kill:2:crash")
+        _reset_fault_injector_for_tests()
+        exits = []
+        monkeypatch.setattr(os, "_exit", lambda code: exits.append(code))
+        wd = StepWatchdog(60.0)
+        try:
+            wd.arm(0)
+            wd.disarm()
+            assert exits == []
+            wd.arm(1)  # the 2nd guarded step is where the host "dies"
+            assert exits == [FAULT_CRASH_EXIT_CODE]
+        finally:
+            wd.stop()
+
+    def test_collective_hang_site_is_caught_by_deadline(self, clean_faults,
+                                                        monkeypatch):
+        clean_faults.setenv("PADDLE_TPU_FAULT_SPEC", "collective_hang:1:hang")
+        _reset_fault_injector_for_tests()
+        monkeypatch.setattr(wd_mod, "HANG_SECONDS", 0.3)
+        fired = []
+        wd = StepWatchdog(0.08, on_timeout=lambda s, e: fired.append(s))
+        try:
+            t0 = time.monotonic()
+            wd.arm(0)  # blocks inside the armed window for HANG_SECONDS
+            hung = time.monotonic() - t0
+            assert hung >= 0.3
+            assert _wait(lambda: fired == [0])
+        finally:
+            wd.stop()
+
+
+class TestAutoWiring:
+    @pytest.fixture(autouse=True)
+    def _clean(self, monkeypatch):
+        monkeypatch.delenv(STEP_DEADLINE_VAR, raising=False)
+        monkeypatch.delenv(HEARTBEAT_ADDR_VAR, raising=False)
+        wd_mod._reset_auto_watchdog_for_tests()
+        hb_mod._reset_auto_sender_for_tests()
+        yield monkeypatch
+        wd_mod._reset_auto_watchdog_for_tests()
+        hb_mod._reset_auto_sender_for_tests()
+
+    def test_no_env_no_watchdog(self):
+        assert maybe_auto_watchdog() is None
+        assert maybe_auto_sender() is None
+
+    def test_explicit_instance_wins(self):
+        wd = StepWatchdog(5.0)
+        try:
+            assert maybe_auto_watchdog(wd) is wd
+        finally:
+            wd.stop()
+
+    def test_env_contract_arms_singleton(self, _clean):
+        _clean.setenv(STEP_DEADLINE_VAR, "2.5")
+        wd = maybe_auto_watchdog()
+        assert wd is not None and wd.deadline_s == 2.5
+        assert maybe_auto_watchdog() is wd  # idempotent
+
+    def test_bad_or_zero_deadline_means_off(self, _clean):
+        _clean.setenv(STEP_DEADLINE_VAR, "0")
+        assert maybe_auto_watchdog() is None
+        _clean.setenv(STEP_DEADLINE_VAR, "nope")
+        assert maybe_auto_watchdog() is None
+
+    def test_heartbeat_addr_arms_sender_with_rank(self, _clean):
+        cfg = HeartbeatConfig(**FAST)
+        with HeartbeatCoordinator(config=cfg,
+                                  registry=StatRegistry()) as coord:
+            _clean.setenv(HEARTBEAT_ADDR_VAR, coord.address)
+            _clean.setenv("PADDLE_TRAINER_ID", "1")
+            _clean.setenv(STEP_DEADLINE_VAR, "3.0")
+            sender = maybe_auto_sender()
+            assert sender is not None and sender.rank == 1
+            # the auto watchdog picks up the auto sender so step times
+            # flow to the straggler detector with zero explicit wiring
+            wd = maybe_auto_watchdog()
+            assert wd.heartbeat is sender
+            assert _wait(lambda: 1 in coord.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# Cohort supervisor: stdlib child scripts, in-process run loop.
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def _cohort(script, endpoints=("127.0.0.1:7101", "127.0.0.1:7102"), **kw):
+    kw.setdefault("max_restarts", 3)
+    kw.setdefault("grace_period", 3.0)
+    kw.setdefault("restart_backoff", 0.02)
+    kw.setdefault("settle_s", 0.3)
+    sup = CohortSupervisor(list(endpoints), script, [], **kw)
+    sup.poll_interval = 0.05
+    return sup
+
+
+class TestCohortSupervisor:
+    def test_exit_121_reforms_whole_cohort(self, tmp_path, capsys):
+        n0 = len(flight.default_recorder().events())
+        script = _write(tmp_path, "child.py", f"""
+            import os, sys, time
+            gen = os.environ["{COHORT_GEN_VAR}"]
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            if gen == "0":
+                if rank == "0":
+                    sys.exit({HOST_LOST_EXIT_CODE})  # watchdog messenger
+                time.sleep(60)  # the survivor, wedged in a collective
+            open(os.path.join({str(tmp_path)!r}, f"done_{{rank}}_{{gen}}"),
+                 "w").write(os.environ["PADDLE_TRAINERS_NUM"])
+            sys.exit(0)
+        """)
+        sup = _cohort(script)
+        rc = sup.run()
+        assert rc == 0
+        assert sup.generation == 1 and sup.reforms == 1
+        assert sup.restarts_used == 1  # one budget unit for the reform
+        for rank in (0, 1):
+            p = tmp_path / f"done_{rank}_1"
+            assert p.exists() and p.read_text() == "2"
+        evs = _events_since(n0, "distributed.cohort_reform")
+        assert evs and evs[0]["next_gen"] == 1
+        assert "re-forming" in capsys.readouterr().err
+
+    def test_fatal_crash_in_multirank_world_reforms(self, tmp_path):
+        script = _write(tmp_path, "child.py", f"""
+            import os, sys, time
+            if os.environ["{COHORT_GEN_VAR}"] == "0":
+                sys.exit(9) if os.environ["PADDLE_TRAINER_ID"] == "1" \\
+                    else time.sleep(60)
+            open(os.path.join({str(tmp_path)!r},
+                 "gen1_" + os.environ["PADDLE_TRAINER_ID"]), "w").write("x")
+            sys.exit(0)
+        """)
+        sup = _cohort(script)
+        assert sup.run() == 0
+        # a lone respawn can't rejoin a wedged world: the default for a
+        # multi-rank cohort is whole-cohort re-formation, not PR 1's
+        # per-rank restart
+        assert sup.generation == 1
+        assert (tmp_path / "gen1_0").exists()
+        assert (tmp_path / "gen1_1").exists()
+
+    def test_spare_host_substitutes_for_the_dead_one(self, tmp_path, capsys):
+        script = _write(tmp_path, "child.py", f"""
+            import os, sys, time
+            if os.environ["{COHORT_GEN_VAR}"] == "0":
+                sys.exit(9) if os.environ["PADDLE_TRAINER_ID"] == "1" \\
+                    else time.sleep(60)
+            ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+            open(os.path.join({str(tmp_path)!r},
+                 "ep_" + os.environ["PADDLE_TRAINER_ID"]), "w").write(ep)
+            sys.exit(0)
+        """)
+        sup = _cohort(script, spare_endpoints=["127.0.0.1:7190"])
+        assert sup.run() == 0
+        assert sup.world == ["127.0.0.1:7101", "127.0.0.1:7190"]
+        assert (tmp_path / "ep_1").read_text() == "127.0.0.1:7190"
+        assert sup.spares == []  # consumed
+        assert "replacing lost" in capsys.readouterr().err
+
+    def test_shrink_on_loss_recomputes_world(self, tmp_path, capsys):
+        script = _write(tmp_path, "child.py", f"""
+            import os, sys, time
+            if os.environ["{COHORT_GEN_VAR}"] == "0":
+                sys.exit(9) if os.environ["PADDLE_TRAINER_ID"] == "1" \\
+                    else time.sleep(60)
+            open(os.path.join({str(tmp_path)!r}, "shrunk"), "w").write(
+                os.environ["PADDLE_TRAINERS_NUM"] + ":" +
+                os.environ["PADDLE_TRAINER_ENDPOINTS"])
+            sys.exit(0)
+        """)
+        sup = _cohort(script, shrink_on_loss=True)
+        assert sup.run() == 0
+        assert sup.world == ["127.0.0.1:7101"]
+        # the respawned trainer sees the SMALLER world through the normal
+        # PADDLE_* contract — dp degree is whatever it recomputes from it
+        assert (tmp_path / "shrunk").read_text() == "1:127.0.0.1:7101"
+        assert "shrink-to-fit" in capsys.readouterr().err
+
+    def test_heartbeat_declared_death_triggers_reform(self, tmp_path):
+        script = _write(tmp_path, "child.py", f"""
+            import os, sys, time
+            if os.environ["{COHORT_GEN_VAR}"] == "0":
+                time.sleep(60)  # alive but silent: the health plane decides
+            open(os.path.join({str(tmp_path)!r},
+                 "hb_" + os.environ["PADDLE_TRAINER_ID"]), "w").write("x")
+            sys.exit(0)
+        """)
+        sup = _cohort(script)
+        # queue the verdict the coordinator thread would deliver; the run
+        # loop must tear down BOTH sleeping ranks and re-form
+        sup._note_death(1, {"rank": 1, "gen": 0, "step": 4,
+                            "host": "h1", "pid": 0, "silent_s": 0.2})
+        assert sup.run() == 0
+        assert sup.generation == 1
+        assert (tmp_path / "hb_0").exists() and (tmp_path / "hb_1").exists()
+
+    def test_preemption_cascade_is_free(self, tmp_path):
+        script = _write(tmp_path, "child.py", f"""
+            import os, sys
+            if os.environ["{COHORT_GEN_VAR}"] == "0":
+                sys.exit({PREEMPTION_EXIT_CODE})
+            sys.exit(0)
+        """)
+        sup = _cohort(script, max_restarts=0)  # only a free reform can pass
+        assert sup.run() == 0
+        assert sup.generation == 1
+        assert sup.restarts_used == 0
+
+    def test_budget_exhaustion_propagates_exit_code(self, tmp_path, capsys):
+        script = _write(tmp_path, "child.py", """
+            import sys
+            sys.exit(9)
+        """)
+        sup = _cohort(script, max_restarts=1)
+        assert sup.run() == 9
+        assert sup.restarts_used == 1
+        assert "budget (1) exhausted" in capsys.readouterr().err
+
+    def test_divergence_is_never_reformed(self, tmp_path):
+        script = _write(tmp_path, "child.py", f"""
+            import os, sys, time
+            sys.exit({DIVERGENCE_EXIT_CODE}) \\
+                if os.environ["PADDLE_TRAINER_ID"] == "0" \\
+                else time.sleep(60)
+        """)
+        sup = _cohort(script)
+        assert sup.run() == DIVERGENCE_EXIT_CODE
+        assert sup.generation == 0 and sup.reforms == 0
+
+
+class TestInitRetryDedupe:
+    """Satellite: ONE initialize-retry implementation (env.py) serves both
+    the pre-backend import hook and init_parallel_env."""
+
+    def test_retry_logs_attempts_and_honors_timeout(self, monkeypatch,
+                                                    caplog):
+        import logging
+
+        import jax
+
+        from paddle_tpu.distributed import env as env_mod
+        calls = []
+
+        def refuse(**kw):
+            calls.append(kw)
+            raise RuntimeError("coordinator not up")
+
+        monkeypatch.setattr(jax.distributed, "initialize", refuse)
+        monkeypatch.setenv("PADDLE_TPU_INIT_TIMEOUT", "0.25")
+        with caplog.at_level(logging.WARNING,
+                             logger="paddle_tpu.distributed.env"):
+            with pytest.raises(RuntimeError,
+                               match=r"PADDLE_TPU_INIT_TIMEOUT=0\.25"):
+                env_mod._initialize_distributed_with_retry(
+                    "127.0.0.1:12999", 2, 0)
+        assert len(calls) >= 2  # it retried instead of failing fast
+        assert calls[0]["coordinator_address"] == "127.0.0.1:12999"
+        retry_lines = [r for r in caplog.records
+                       if "retrying" in r.getMessage()]
+        assert retry_lines
+        assert "127.0.0.1:12999" in retry_lines[0].getMessage()
+
+    def test_bootstrap_pre_backend_is_solo_noop(self, monkeypatch):
+        from paddle_tpu.distributed import env as env_mod
+        monkeypatch.delenv("PADDLE_TRAINERS_NUM", raising=False)
+        monkeypatch.delenv("_PADDLE_TPU_DIST_INITIALIZED", raising=False)
+        env_mod.bootstrap_pre_backend()  # must not touch jax.distributed
+        assert "_PADDLE_TPU_DIST_INITIALIZED" not in os.environ
+
+
+class TestFlightHeaderIdentity:
+    """Satellite: flight dumps carry process identity + cohort generation
+    (schema paddle-tpu-flight/2) so post-mortems from a dead cohort are
+    attributable without guessing."""
+
+    def test_header_fields(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        monkeypatch.setenv(COHORT_GEN_VAR, "3")
+        import json
+        path = flight.dump("unit_header_probe", directory=str(tmp_path))
+        header = json.loads(open(path).read().splitlines()[0])
+        assert header["schema"] == "paddle-tpu-flight/2"
+        assert header["process_index"] == 1
+        assert header["process_count"] == 2
+        assert header["cohort_generation"] == 3

@@ -79,6 +79,14 @@ def main():
                          "unless drops == 0, the fleet scaled up, the "
                          "roll was recompile-free, and SLO recovery "
                          "fits the bench_fleet_baseline.json budget")
+    ap.add_argument("--bench-elastic", action="store_true",
+                    help="opt-in gate: run tools/bench_elastic.py --check "
+                         "(host-loss kill matrix: watchdog hang, "
+                         "heartbeat silence/partition, slow link) and "
+                         "fail unless every loss is detected inside its "
+                         "latency budget, transient blips stay "
+                         "undeclared, and watchdog overhead is <=2% "
+                         "(bench_elastic_baseline.json)")
     ap.add_argument("--bench-quant", action="store_true",
                     help="opt-in gate: run tools/bench_quant.py --check "
                          "and fail unless int8 allreduce wire bytes are "
@@ -191,6 +199,20 @@ def main():
             [sys.executable, "-m", "tools.bench_fleet", "--check"],
             cwd=REPO, env=env)
         print(f"bench fleet: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+
+    if args.bench_elastic:
+        # Opt-in: the host-loss kill matrix on the CPU backend, gated on
+        # the detection-latency budgets (derived from the configured
+        # deadlines, not the machine), the no-false-positive bar, and the
+        # <=2% watchdog step overhead contract.
+        t0 = time.time()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_elastic", "--check"],
+            cwd=REPO, env=env)
+        print(f"bench elastic: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
