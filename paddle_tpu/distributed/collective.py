@@ -310,7 +310,8 @@ def _block_dequantize_int8(q, scale):
 
 
 def _raw_compressed_allreduce(x, axes: Tuple[str, ...], wire_dtype="int8",
-                              block: Optional[int] = None, mean=False):
+                              block: Optional[int] = None, mean=False,
+                              mesh=None):
     """The in-trace compressed allreduce (shard_map body).
 
     Phase 1 (reduce-scatter): block-quantize the local value, all_to_all
@@ -328,7 +329,10 @@ def _raw_compressed_allreduce(x, axes: Tuple[str, ...], wire_dtype="int8",
         raise NotImplementedError(
             "compressed allreduce needs a single mesh-axis group (dp)")
     axis = axes[0]
-    n = _mesh.mesh_axis_size(axes)
+    # size from the explicit mesh when given: inside a hand-built
+    # shard_map there may be no ambient global mesh, and the n==1
+    # fallback would silently turn the sync into an identity
+    n = _mesh.mesh_axis_size(axes, mesh)
     orig_dtype = x.dtype
     if n == 1:
         return x
@@ -387,14 +391,18 @@ def dense_allreduce_wire_bytes(nelems: int, world: int,
 
 
 def compressed_grad_sync(grads, axis: str = "dp", wire_dtype: str = "int8",
-                         block: Optional[int] = None, mean: bool = True):
+                         block: Optional[int] = None, mean: bool = True,
+                         mesh=None):
     """Compressed gradient mean over a mesh axis, for hand-written
     shard_map train steps (the DataParallel SPMD path inserts the dense
     psum implicitly via sharding; an explicit step opts into compression
-    by calling this on its gradient pytree instead of ``lax.pmean``)."""
+    by calling this on its gradient pytree instead of ``lax.pmean``).
+    Pass ``mesh`` when the enclosing shard_map's mesh is not the ambient
+    global one (``set_mesh``) — axis sizing falls back to the global
+    mesh otherwise."""
     return jax.tree_util.tree_map(
         lambda g: _raw_compressed_allreduce(g, (axis,), wire_dtype,
-                                            block, mean), grads)
+                                            block, mean, mesh=mesh), grads)
 
 
 # -- public functional API ----------------------------------------------------
@@ -793,7 +801,8 @@ def build_compressed_train_step(mesh, axis: str = "dp",
         gw = x.T @ err * (2.0 / n_local)
         gb = jnp.mean(err, axis=0) * 2.0
         gw, gb = compressed_grad_sync((gw, gb), axis=axis,
-                                      wire_dtype=wire_dtype, block=block)
+                                      wire_dtype=wire_dtype, block=block,
+                                      mesh=mesh)
         loss = lax.pmean(jnp.mean(err * err), axis)
         return w - lr * gw, b - lr * gb, loss
 

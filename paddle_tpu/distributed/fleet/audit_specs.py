@@ -1,0 +1,127 @@
+"""Auditable-entrypoint registrations for the fleet's mesh programs.
+
+The trace tier (PTA009/PTA010/PTA012) only sees programs that register
+here. ``bench_audit`` covers the dp×sp ring-flash path and
+``distributed.collective`` covers compressed allreduce; this module adds
+the two remaining mesh topologies ROADMAP item 3 composes — the pipeline
+("pp" ppermute chain + boundary psum/pmean) and the MoE expert mesh
+("ep" all_to_all dispatch/combine pair) — so the collective-schedule
+audit gates all four. Shapes are tiny and the meshes adapt to however
+many (virtual CPU) devices the audit process has, down to a 1-device
+fallback.
+"""
+from __future__ import annotations
+
+
+def _audit_pipeline_spec():
+    """GPipe train step over a ("pp",) mesh: S stacked residual blocks,
+    one per stage, microbatches rotating through the ppermute chain with
+    a log-softmax loss on the exiting microbatch (head_takes_input, as
+    the grads-parity test drives it). The schedule PTA012 should see:
+    per-tick ppermute shifts under the scan plus the boundary
+    psum/pmean — all rank-uniform."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ...core import audit
+    from . import pipeline_engine as PE
+
+    devices = np.array(jax.devices())  # noqa: PTA002 -- host-side device-list layout at audit registration, not a step path
+    S = devices.size
+    mesh = jax.sharding.Mesh(devices.reshape(S), ("pp",))
+    M, mb, seq, d, V = 2 * S, 2, 6, 16, 32
+
+    def embed_fn(p, ids):
+        return p["tok"][ids]
+
+    def block_fn(p, h):
+        return h + jnp.tanh(h @ p["w"])
+
+    def head_fn(p, h, labels):
+        lo = jax.nn.log_softmax(h @ p["wo"])
+        return -jnp.mean(jnp.take_along_axis(lo, labels[..., None],
+                                             axis=-1))
+
+    def train_step(params, xs):
+        def loss_fn(ps):
+            emb, blocks, head = ps
+            losses = PE.gpipe_blocks(embed_fn, block_fn, head_fn, emb,
+                                     blocks, head, xs, mesh=mesh,
+                                     head_takes_input=True)
+            return jnp.mean(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params,
+                                     grads)
+        return new, loss
+
+    def make_args(variant):
+        # fresh params per call: donate_argnums=(0,) consumes them
+        rng = np.random.default_rng(31 + variant)
+        emb = {"tok": jnp.asarray(rng.standard_normal((V, d)) * 0.1,
+                                  jnp.float32)}
+        blocks = {"w": jnp.asarray(rng.standard_normal((S, d, d)) * 0.1,
+                                   jnp.float32)}
+        head = {"wo": jnp.asarray(rng.standard_normal((d, V)) * 0.1,
+                                  jnp.float32)}
+        xs = jnp.asarray(rng.integers(0, V, (M, mb, seq)), jnp.int32)
+        return ((emb, blocks, head), xs)
+
+    return audit.AuditSpec(fn=train_step, make_args=make_args,
+                           jit_kwargs={"donate_argnums": (0,)})
+
+
+def _audit_moe_spec():
+    """MoE FFN train step over an ("ep",) expert mesh: top-1 dispatch
+    all_to_all, per-expert FFN, combine all_to_all, aux-loss pmean. The
+    two all_to_alls are the transpose-consistency pair PTA012 checks;
+    wire bytes scale with capacity so the collective_bytes gate catches
+    capacity-factor regressions."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ...core import audit
+    from .moe import moe_ffn
+
+    devices = np.array(jax.devices())  # noqa: PTA002 -- host-side device-list layout at audit registration, not a step path
+    ep = devices.size
+    mesh = jax.sharding.Mesh(devices.reshape(ep), ("ep",))
+    B, T, D, F = 2 * ep, 4, 16, 32
+    E = 2 * ep                         # experts per rank = 2
+
+    def train_step(params, x, y):
+        def loss_fn(ps):
+            wg, w1, w2 = ps
+            out, aux = moe_ffn(x, wg, w1, w2, mesh=mesh, axis="ep",
+                               capacity_factor=2.0)
+            return jnp.mean((out - y) ** 2) + 0.01 * aux
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new = tuple(p - 0.1 * g for p, g in zip(params, grads))
+        return new, loss
+
+    def make_args(variant):
+        rng = np.random.default_rng(37 + variant)
+
+        def w(*shape):
+            return jnp.asarray(rng.standard_normal(shape) * 0.1,
+                               jnp.float32)
+
+        params = (w(D, E), w(E, D, F), w(E, F, D))
+        x = jnp.asarray(rng.standard_normal((B, T, D)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((B, T, D)), jnp.float32)
+        return (params, x, y)
+
+    return audit.AuditSpec(fn=train_step, make_args=make_args,
+                           jit_kwargs={"donate_argnums": (0,)})
+
+
+def _register_audit_entrypoints():
+    from ...core import audit
+    audit.register_entrypoint("pipeline_train_step", _audit_pipeline_spec,
+                              tags=("train", "bench", "distributed"))
+    audit.register_entrypoint("moe_train_step", _audit_moe_spec,
+                              tags=("train", "bench", "distributed"))
+
+
+_register_audit_entrypoints()

@@ -84,13 +84,13 @@ def moe_ffn(x, gate_w, expert_w1, expert_w2, mesh=None, axis: str = "ep",
     expert dim over ``axis``. Returns ([B, T, D], aux_loss).
     """
     m = mesh or _mesh.ensure_mesh()
-    ep = int(m.shape[axis])
+    ep = int(m.shape[axis])  # noqa: PTA001 -- mesh axis size is a static host int, never a tracer
     B, T, D = x.shape
     E = expert_w1.shape[0]
     if E % ep != 0:
         raise ValueError(f"{E} experts not divisible by ep={ep}")
     n_local = (B // ep) * T
-    capacity = max(1, int(math.ceil(n_local * capacity_factor / E)))
+    capacity = max(1, int(math.ceil(n_local * capacity_factor / E)))  # noqa: PTA001 -- static shapes × config float, concrete at trace time
 
     def per_rank(xb, wg, w1, w2):
         Bl = xb.shape[0]

@@ -250,7 +250,7 @@ def gpipe_blocks(embed_fn, block_fn, head_fn, embed_params,
         block_one = jax.tree_util.tree_map(
             lambda a: a[0], stacked_block_params)
         # signatures are LOCAL (per-device) shapes: dp shards dim 1
-        bs = int(m.shape[batch_axis]) if batch_axis else 1
+        bs = int(m.shape[batch_axis]) if batch_axis else 1  # noqa: PTA001 -- mesh axis size is a static host int, never a tracer
         x_aval = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(
                 (a.shape[1] // bs,) + tuple(a.shape[2:]), a.dtype), xs)
@@ -332,10 +332,10 @@ def gpipe_stages(stage_fns, stage_params, xs, mesh=None, axis="pp",
     Returns [M, *out.shape] from the last stage. Differentiable.
     """
     m = mesh or _mesh.ensure_mesh()
-    S = int(m.shape[axis])
+    S = int(m.shape[axis])  # noqa: PTA001 -- mesh axis size is a static host int, never a tracer
     if len(stage_fns) != S:
         raise ValueError(f"{len(stage_fns)} stage fns for {axis}={S} mesh")
-    M = int(jax.tree_util.tree_leaves(xs)[0].shape[0])
+    M = int(jax.tree_util.tree_leaves(xs)[0].shape[0])  # noqa: PTA001 -- array shape is concrete at trace time
 
     if carry_sig is not None and out_sig is not None:
         carry_aval, out_aval = carry_sig, out_sig

@@ -1,0 +1,85 @@
+"""Seeded true positives for PTA011 (SPMD divergence lint) and PTA012
+(collective-schedule audit). Every function here is a deliberate bug —
+tests/test_spmd_lint.py asserts the analyzer catches each one and that
+clean_* functions stay clean. Never import this module from real code.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec  # noqa: F401 - axis decls
+
+
+def rank_gated_psum(grads):
+    # BUG: rank 0 issues a psum its peers never reach -> deadlock
+    if jax.process_index() == 0:
+        grads = lax.psum(grads, "dp")
+    return grads
+
+
+def env_rank_gated_allreduce(x):
+    # BUG: env-derived rank gates a collective wrapper
+    trainer = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if trainer == 0:
+        from paddle_tpu.distributed.collective import all_reduce
+        x = all_reduce(x)
+    return x
+
+
+def swallowed_collective(x):
+    # BUG: one rank's psum failure is swallowed while peers still wait
+    try:
+        x = lax.psum(x, "dp")
+    except Exception:
+        pass
+    return x
+
+
+def make_mesh_with_axes():
+    devices = jax.devices()
+    return Mesh(jax.numpy.array(devices), ("dp", "sp"))
+
+
+def axis_typo_psum(x):
+    # BUG: axis "pd" is declared nowhere (mesh above declares dp/sp)
+    return lax.psum(x, "pd")
+
+
+def host_len_loop_gather(chunks):
+    # BUG: trip count derives from this host's rank -> ranks run
+    # different numbers of collective rounds
+    steps = jax.process_index() + 2
+    out = []
+    for _ in range(steps):
+        out.append(lax.all_gather(chunks, "dp"))
+    return out
+
+
+def clean_uniform_psum(x):
+    # OK: every rank runs the same schedule; divergence is in data only
+    rank = lax.axis_index("dp")
+    masked = jnp.where(rank == 0, x, jnp.zeros_like(x))
+    return lax.psum(masked, "dp")
+
+
+def clean_rank_gated_logging(loss):
+    # OK: rank gate guards host-side I/O, not a collective
+    if jax.process_index() == 0:
+        print("loss:", loss)
+    return loss
+
+
+def make_ring_mesh():
+    import numpy as np
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    return Mesh(devs, ("r",))
+
+
+def broken_ring_body(x):
+    # BUG (PTA012): on a 4-wide axis this perm never involves rank 3 as
+    # a source and never delivers to rank 0's slot consistently — the
+    # ring is open and rank 3 blocks forever
+    return lax.ppermute(x, "r", perm=[(0, 1), (1, 2), (2, 0)])

@@ -65,7 +65,8 @@ class TestSummarize:
         s = gate.summarize(p)["gpt_train_step"]
         assert s == {"host_transfers": 3, "large_consts": 1,
                      "donatable_inputs": 4, "retraces": 2,
-                     "fingerprint_unstable": 1, "copy_fraction": 0.02}
+                     "fingerprint_unstable": 1, "copy_fraction": 0.02,
+                     "collective_bytes": 0, "collective_issues": 0}
 
     def test_error_entrypoint_carried(self):
         p = _clean_payload()

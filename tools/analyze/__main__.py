@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import traceback
 
@@ -42,8 +43,15 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="only", metavar="PTA###[,PTA###]",
                     help="run only these rules (repeatable or "
                          "comma-separated). The slow trace tier "
-                         "(PTA009/PTA010, compiles code) ONLY runs when "
-                         "selected here.")
+                         "(PTA009/PTA010/PTA012, compiles code) ONLY "
+                         "runs when selected here.")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="BASE",
+                    help="analyze only .py files changed vs BASE "
+                         "(git diff --name-only BASE, plus untracked "
+                         "files; default BASE: HEAD) that fall under the "
+                         "given paths — the fast pre-commit lane. No "
+                         "changed files is a clean exit.")
     ap.add_argument("--skip", action="append", default=[],
                     metavar="PTA###[,PTA###]", help="disable these rules "
                     "(repeatable or comma-separated)")
@@ -67,9 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "error-severity findings do)")
     ap.add_argument("--trace-report", default=None, metavar="FILE",
                     help="write the trace tier's per-entrypoint audit "
-                         "stats (trace counts, transfers, fusion stats) "
-                         "to FILE as json — requires selecting PTA009 "
-                         "and/or PTA010 via --only")
+                         "stats (trace counts, transfers, fusion stats, "
+                         "collective schedules) to FILE as json — "
+                         "requires selecting PTA009/PTA010/PTA012 via "
+                         "--only")
     ap.add_argument("--list-rules", action="store_true")
     return ap
 
@@ -98,6 +107,62 @@ def select_rules(args) -> list:
     return [r for r in rules if r.code not in skip]
 
 
+def _changed_paths(root: str, base: str, scope: list) -> list:
+    """Changed-vs-``base`` plus untracked .py files that fall under the
+    requested analysis paths (the --changed-only pre-commit lane)."""
+    def _git(*argv):
+        res = subprocess.run(["git", *argv], cwd=root,
+                             capture_output=True, text=True)
+        if res.returncode != 0:
+            raise SystemExit(f"--changed-only: git {' '.join(argv)} "
+                             f"failed: {res.stderr.strip()}")
+        return [ln.strip() for ln in res.stdout.splitlines() if ln.strip()]
+
+    changed = _git("diff", "--name-only", base)
+    changed += _git("ls-files", "--others", "--exclude-standard")
+    prefixes = []
+    for p in scope:
+        rel = os.path.relpath(os.path.abspath(p), root) \
+            if os.path.isabs(p) else p
+        prefixes.append(rel.rstrip("/"))
+    scoped = []
+    for rel in dict.fromkeys(changed):
+        if not rel.endswith(".py"):
+            continue
+        if not os.path.exists(os.path.join(root, rel)):
+            continue  # deleted by the change
+        if not any(rel == p or rel.startswith(p + "/") or p == "."
+                   for p in prefixes):
+            continue
+        scoped.append(rel)
+    return scoped
+
+
+def _salvage_output(args, root, rules, tb: str) -> None:
+    """Exit-2 path: never leave a stale payload file behind. Overwrite
+    the requested --output with a valid empty-results document carrying
+    the internal error (SARIF: as a tool-execution notification)."""
+    if not args.output or args.format not in ("sarif", "json"):
+        return
+    try:
+        if args.format == "sarif":
+            from .sarif import to_sarif
+            payload = to_sarif([], rules, set(), error=tb)
+        else:
+            payload = {"version": 1, "root": root, "error": tb,
+                       "rules": [r.code for r in rules],
+                       "counts": {}, "findings": []}
+        out_path = (args.output if os.path.isabs(args.output)
+                    else os.path.join(root, args.output))
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+        print(f"internal error recorded in {args.format} output "
+              f"{os.path.relpath(out_path, root)}", file=sys.stderr)
+    except Exception:
+        traceback.print_exc()
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
@@ -107,8 +172,25 @@ def main(argv=None) -> int:
         return 0
 
     root = os.path.abspath(args.root) if args.root else _repo_root()
-    paths = args.paths or ["paddle_tpu"]
     rules = select_rules(args)
+    try:
+        return _run(args, root, rules)
+    except SystemExit:
+        raise
+    except Exception:
+        traceback.print_exc()
+        _salvage_output(args, root, rules, traceback.format_exc())
+        return 2
+
+
+def _run(args, root: str, rules: list) -> int:
+    paths = args.paths or ["paddle_tpu"]
+    if args.changed_only is not None:
+        paths = _changed_paths(root, args.changed_only, paths)
+        if not paths:
+            print("--changed-only: no changed .py files under the "
+                  "analyzed paths; clean")
+            return 0
 
     baseline_arg = args.baseline or DEFAULT_BASELINE
     baseline_path = (None if baseline_arg.lower() == "none"

@@ -56,6 +56,36 @@ TRACE_WRAPPERS = {
 #: constructors whose ``target=`` argument runs on its own thread/process
 THREAD_CTORS = {"Thread", "Process"}
 
+#: jax.lax collective vocabulary: the last dotted component of a call
+#: that IS a cross-rank collective wherever it appears (no op in the
+#: repo shares these names, so bare-name matching is safe)
+LAX_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter", "pbroadcast",
+}
+
+#: public wrappers in distributed/collective.py whose *call* is a
+#: collective even though the lax primitive hides behind the dynamic
+#: ``_run(op, tensor, raw_fn)`` dispatch the precise edge walk cannot
+#: follow. Seeded by file+name, propagated to callers by the collective
+#: walk.
+COLLECTIVE_WRAPPER_FILE = "distributed/collective.py"
+COLLECTIVE_WRAPPER_NAMES = {
+    "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
+    "reduce_scatter", "alltoall", "alltoall_single", "send", "recv",
+    "isend", "irecv", "p2p_exchange", "barrier", "wait",
+    "compressed_allreduce", "compressed_grad_sync",
+}
+
+#: wrapper names distinctive enough to match without resolution even
+#: through an external attribute base (``dist.all_reduce`` where ``dist``
+#: is outside the analyzed paths). Short generic names (send, reduce,
+#: wait...) stay out: they collide with tensor ops and futures.
+COLLECTIVE_UNAMBIGUOUS_NAMES = {
+    "all_reduce", "alltoall", "alltoall_single", "reduce_scatter",
+    "p2p_exchange", "compressed_allreduce", "compressed_grad_sync",
+}
+
 #: Optional/Union wrappers unwrapped during annotation inference
 _UNION_WRAPPERS = {"Optional", "Union"}
 
@@ -64,7 +94,8 @@ class FuncInfo:
     __slots__ = ("file", "node", "name", "qualname", "is_method", "cls",
                  "root_via", "reachable_from",
                  "thread_root_via", "thread_reachable_from",
-                 "signal_root_via", "signal_reachable_from")
+                 "signal_root_via", "signal_reachable_from",
+                 "collective_via")
 
     def __init__(self, file: SourceFile, node, qualname: str,
                  is_method: bool, cls: Optional["ClassInfo"] = None):
@@ -80,6 +111,10 @@ class FuncInfo:
         self.thread_reachable_from: Optional[str] = None
         self.signal_root_via: Optional[str] = None
         self.signal_reachable_from: Optional[str] = None
+        #: why this function issues a collective (directly or through a
+        #: precise-edge callee chain); None = provably collective-free
+        #: as far as the precise walk can see
+        self.collective_via: Optional[str] = None
 
 
 class ClassInfo:
@@ -128,6 +163,14 @@ class CallGraph:
         self.signal_roots: List[FuncInfo] = []
         self._env_cache: Dict[int, Dict[str, List[ClassInfo]]] = {}
         self._edge_cache: Dict[Tuple[int, bool], List[FuncInfo]] = {}
+        #: id(FuncInfo) -> (mesh axis-name tuple or None, wrap-site str)
+        #: for functions handed to shard_map; axes are None when the mesh
+        #: expression could not be resolved to a literal declaration
+        self.shard_map_axes: Dict[int, Tuple[Optional[tuple], str]] = {}
+        #: axis names declared anywhere in the project: Mesh(...) /
+        #: make_mesh/build_mesh axis tuples or dict keys, PartitionSpec
+        #: literals, and string defaults of axis/axis_name parameters
+        self.declared_axes: set = set()
 
     # -- reachability views ---------------------------------------------------
     def reachable(self) -> List[FuncInfo]:
@@ -490,6 +533,29 @@ class CallGraph:
                 return list(cands)
         return []
 
+    # -- collective walk ------------------------------------------------------
+    def collective_issuers(self) -> List[FuncInfo]:
+        return [f for f in self.functions if f.collective_via is not None]
+
+    def collective_call_via(self, fi: Optional[FuncInfo],
+                            call: ast.Call) -> Optional[str]:
+        """Why this call site issues a collective, or None.
+
+        Recognizes the direct lax vocabulary and the unambiguous wrapper
+        names by name alone; everything else goes through the precise
+        call resolution so a name collision can never invent a deadlock
+        (same asymmetry as the thread/signal walks)."""
+        d = dotted_name(call.func)
+        last = d.rpartition(".")[2]
+        if last in LAX_COLLECTIVES or last in COLLECTIVE_UNAMBIGUOUS_NAMES:
+            return f"`{d}`"
+        if fi is None:
+            return None
+        for tgt in self.callee_targets(fi, call, precise_only=True):
+            if tgt.collective_via is not None:
+                return f"`{tgt.qualname}` → {tgt.collective_via}"
+        return None
+
 
 # -- AST walking helpers ------------------------------------------------------
 
@@ -685,6 +751,173 @@ def _mark_concurrency_roots(graph: CallGraph, sf: SourceFile):
                     f"{ci.qualname}.run (Thread subclass)")
 
 
+def _axis_literals(node) -> List[str]:
+    """String axis names in an expression: "dp", ("dp", "sp"), ["dp"]."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            out.extend(_axis_literals(e))
+        return out
+    return []
+
+
+#: constructors/factories whose arguments declare mesh axis names
+_MESH_CTORS = {"Mesh", "AbstractMesh", "make_mesh", "build_mesh",
+               "ensure_mesh"}
+
+
+def _mesh_call_axes(call: ast.Call) -> List[str]:
+    """Axis names declared by a Mesh(...)-style call: the axis-names
+    tuple (2nd positional or axis_names=) or a {"pp": 4} shape dict."""
+    out: List[str] = []
+    cand = list(call.args[1:2])
+    for kw in call.keywords:
+        if kw.arg in ("axis_names", "axis_name"):
+            cand.append(kw.value)
+    for a in list(call.args[:1]) + [kw.value for kw in call.keywords
+                                    if kw.arg in (None, "shape", "axes")]:
+        if isinstance(a, ast.Dict):
+            for k in a.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out.append(k.value)
+    for c in cand:
+        out.extend(_axis_literals(c))
+    return out
+
+
+def _resolve_mesh_axes(graph: CallGraph, sf: SourceFile, expr,
+                       ctx: Optional[FuncInfo]) -> Optional[tuple]:
+    """Literal axis names of a ``mesh=`` argument, or None when the mesh
+    flows in from somewhere the symbol tables cannot see (a parameter, a
+    runtime registry)."""
+    if isinstance(expr, ast.Call):
+        last = dotted_name(expr.func).rpartition(".")[2]
+        if last in _MESH_CTORS:
+            axes = _mesh_call_axes(expr)
+            return tuple(axes) if axes else None
+        return None
+    if isinstance(expr, ast.Name):
+        # nearest literal assignment: the enclosing function first, then
+        # module level of the same file
+        scopes = []
+        if ctx is not None and not isinstance(ctx.node, ast.Lambda):
+            scopes.append(_walk_own(ctx.node))
+        scopes.append(ast.iter_child_nodes(sf.tree))
+        for scope in scopes:
+            for node in scope:
+                if (isinstance(node, ast.Assign)
+                        and any(isinstance(t, ast.Name)
+                                and t.id == expr.id
+                                for t in node.targets)):
+                    got = _resolve_mesh_axes(graph, sf, node.value, ctx)
+                    if got is not None:
+                        return got
+    return None
+
+
+def _collect_axis_declarations(graph: CallGraph, sf: SourceFile):
+    """Project-wide declared-axis set: mesh constructions, PartitionSpec
+    literals, and axis-parameter string defaults. The axis-hygiene check
+    only trusts this set when a collective's enclosing shard_map mesh
+    cannot be resolved precisely."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            last = dotted_name(node.func).rpartition(".")[2]
+            if last in _MESH_CTORS:
+                graph.declared_axes.update(_mesh_call_axes(node))
+            elif last in ("P", "PartitionSpec", "NamedSharding"):
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    graph.declared_axes.update(_axis_literals(a))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = a.posonlyargs + a.args + a.kwonlyargs
+            defaults = ([None] * (len(a.posonlyargs + a.args)
+                                  - len(a.defaults)) + list(a.defaults)
+                        + list(a.kw_defaults))
+            for arg, dflt in zip(params, defaults):
+                if dflt is not None and arg.arg in (
+                        "axis", "axis_name", "batch_axis", "batch_axes"):
+                    graph.declared_axes.update(_axis_literals(dflt))
+
+
+def _collect_shard_map_wraps(graph: CallGraph, sf: SourceFile):
+    """Record the mesh axes of every function handed to shard_map, so
+    the axis-hygiene check can validate literal axis names inside the
+    wrapped body against the enclosing mesh declaration."""
+    fis = [fi for fi in graph.functions
+           if fi.file is sf and not isinstance(fi.node, ast.Lambda)]
+    sites = [(call, fi) for fi in fis
+             for call in _own_body_calls(fi.node)]
+    sites.extend(
+        (call, None) for call, ctx in _iter_calls_with_context(graph, sf)
+        if ctx is None)
+    for call, ctx in sites:
+        if dotted_name(call.func).rpartition(".")[2] != "shard_map":
+            continue
+        fn_expr = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg in ("f", "fun"):
+                fn_expr = kw.value
+        if fn_expr is None:
+            continue
+        mesh_expr = call.args[1] if len(call.args) > 1 else None
+        for kw in call.keywords:
+            if kw.arg == "mesh":
+                mesh_expr = kw.value
+        axes = (None if mesh_expr is None
+                else _resolve_mesh_axes(graph, sf, mesh_expr, ctx))
+        via = f"shard_map at {sf.relpath}:{call.lineno}"
+        for fi in graph.resolve_func_ref(sf, fn_expr, ctx):
+            prev = graph.shard_map_axes.get(id(fi))
+            # several wrap sites: keep resolved axes over unresolved,
+            # drop to None when two sites resolve to different meshes
+            if prev is None or (prev[0] is None and axes is not None):
+                graph.shard_map_axes[id(fi)] = (axes, via)
+            elif prev[0] is not None and axes is not None \
+                    and set(axes) != set(prev[0]):
+                graph.shard_map_axes[id(fi)] = (None, via)
+
+
+def _mark_collective_seeds(graph: CallGraph):
+    for fi in graph.functions:
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        if (not fi.is_method
+                and fi.file.relpath.endswith(COLLECTIVE_WRAPPER_FILE)
+                and fi.name in COLLECTIVE_WRAPPER_NAMES):
+            fi.collective_via = (f"collective wrapper "
+                                 f"{fi.file.relpath}:{fi.node.lineno}")
+            continue
+        for call in _own_body_calls(fi.node):
+            d = dotted_name(call.func)
+            if d.rpartition(".")[2] in LAX_COLLECTIVES:
+                fi.collective_via = (f"calls `{d}` at "
+                                     f"{fi.file.relpath}:{call.lineno}")
+                break
+
+
+def _collective_walk(graph: CallGraph):
+    """Reverse BFS from the seeds over precise edges: mark every function
+    from which a collective call is reachable. Precise-only, like the
+    thread/signal walks — a deadlock finding must never be invented
+    through a name collision."""
+    callers: Dict[int, List[FuncInfo]] = {}
+    for fi in graph.functions:
+        for callee in graph.edges(fi, precise_only=True):
+            callers.setdefault(id(callee), []).append(fi)
+    queue = [fi for fi in graph.functions
+             if fi.collective_via is not None]
+    while queue:
+        callee = queue.pop(0)
+        for caller in callers.get(id(callee), []):
+            if caller.collective_via is None:
+                caller.collective_via = (f"calls `{callee.qualname}` → "
+                                         f"{callee.collective_via}")
+                queue.append(caller)
+
+
 def _bfs(graph: CallGraph, roots: List[FuncInfo], mark_attr: str,
          precise_only: bool):
     queue = []
@@ -715,6 +948,8 @@ def build(project: Project) -> CallGraph:
         if sf.tree is not None:
             _mark_jit_roots(graph, sf)
             _mark_concurrency_roots(graph, sf)
+            _collect_axis_declarations(graph, sf)
+            _collect_shard_map_wraps(graph, sf)
 
     # jit walk keeps the name-based over-approximation (never miss a
     # tracer leak); thread/signal walks are precise (never invent a race)
@@ -723,4 +958,9 @@ def build(project: Project) -> CallGraph:
          "thread_reachable_from", precise_only=True)
     _bfs(graph, graph.signal_roots, "signal_reachable_from",
          precise_only=True)
+    # collective walk (PTA011): seed direct lax calls + the
+    # distributed/collective.py wrappers, then propagate to callers over
+    # the same precise edges the thread walk trusts
+    _mark_collective_seeds(graph)
+    _collective_walk(graph)
     return graph

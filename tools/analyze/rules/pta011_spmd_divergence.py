@@ -1,0 +1,363 @@
+"""PTA011: SPMD collective divergence lint.
+
+A multi-host SPMD program deadlocks the moment one rank issues a
+collective its peers never reach: everyone else parks in the ring/
+all-reduce and the job hangs until the elastic watchdog (PR 16) kills it
+at runtime — minutes into a run instead of seconds at analysis time.
+This rule walks the collective call graph (``callgraph.py``'s collective
+walk: the ``lax.psum``/``ppermute``/... vocabulary, the
+``distributed/collective.py`` wrappers, and every function they are
+reachable from over precise edges) and flags the four static shapes of
+that bug:
+
+- **rank-gated collective** (error): a collective reachable only under
+  rank-/process-dependent control flow — ``if jax.process_index() ==
+  0:``, ``if dist.get_rank() == 0:``, or a test over an env-derived rank
+  variable (``PADDLE_TRAINER_ID``/``RANK``). The gated ranks issue the
+  collective; the rest never join it.
+- **swallowed collective** (error): a collective inside a ``try:`` whose
+  ``except`` continues execution. One rank catches (an OOM, a
+  preemption), returns, and its peers hang in the collective forever —
+  the except must re-raise so the whole cohort fails together.
+- **axis-name hygiene** (error): a literal axis name passed to a
+  collective that is not declared by the enclosing ``shard_map``'s mesh
+  (resolved through the symbol tables) nor anywhere in the project — a
+  typo that surfaces as an unbound-axis trace error at best, a
+  wrong-axis reduction at worst.
+- **per-host loop trip count** (error): a collective inside a loop whose
+  iteration count derives from a rank/per-host value — ranks run
+  different numbers of collective rounds and the first extra round
+  deadlocks.
+
+Traced-value rank reads (``lax.axis_index``) are deliberately NOT rank
+sources here: a python ``if`` over a tracer fails at trace time on its
+own, and the ``jnp.where(rank == ..., ...)``/``lax.switch`` idioms the
+fleet code uses keep every rank inside every collective (uniform
+schedule, divergent *data* — exactly right). Deliberately rank-gated
+collectives (a sanctioned drain barrier) take a
+``# noqa: PTA011 -- reason``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .base import Rule
+from ..core import (Finding, Project, _binding_target_names, dotted_name,
+                    mentions_any_name)
+
+#: host-side calls whose result is this process's rank (last dotted
+#: component). ``axis_index`` is excluded on purpose — it is a traced
+#: value, not a host value (see module docstring).
+RANK_CALL_TAILS = {"process_index", "get_rank", "local_rank", "node_rank",
+                   "get_group_rank", "get_rank_from_stage"}
+
+#: substrings of environment-variable names that hold a per-host rank
+RANK_ENV_MARKERS = ("RANK", "TRAINER_ID")
+
+
+def _env_key_is_rank(key: Optional[str]) -> bool:
+    return bool(key) and any(m in key.upper() for m in RANK_ENV_MARKERS)
+
+
+def _rank_source(node: ast.AST) -> Optional[str]:
+    """A description of the host-rank read inside ``node``, or None."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            d = dotted_name(n.func)
+            last = d.rpartition(".")[2]
+            if last in RANK_CALL_TAILS:
+                return f"`{d}()`"
+            if last in ("get", "getenv") and n.args:
+                a0 = n.args[0]
+                if (isinstance(a0, ast.Constant)
+                        and isinstance(a0.value, str)
+                        and _env_key_is_rank(a0.value)):
+                    return f"env `{a0.value}`"
+        elif isinstance(n, ast.Subscript):
+            base = dotted_name(n.value)
+            if base.endswith("environ"):
+                sl = n.slice
+                if (isinstance(sl, ast.Constant)
+                        and isinstance(sl.value, str)
+                        and _env_key_is_rank(sl.value)):
+                    return f"env `{sl.value}`"
+    return None
+
+
+def _rank_tainted_names(func_node: ast.AST) -> dict:
+    """name -> provenance, for locals transitively bound from a host-rank
+    read. Fixpoint over simple bindings (same walker the tracer-taint
+    analysis uses), seeded by rank-source expressions."""
+    from ..core import walk_own_body
+    bindings: List[Tuple[list, ast.AST]] = []
+    for node in walk_own_body(func_node):
+        if isinstance(node, ast.Assign):
+            # `rank, world = process_index(), process_count()`: bind
+            # element-wise so `world` does not inherit rank taint
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Tuple)
+                    and isinstance(node.value, ast.Tuple)
+                    and len(node.targets[0].elts) == len(node.value.elts)):
+                for t, v in zip(node.targets[0].elts, node.value.elts):
+                    bindings.append((list(_binding_target_names(t)), v))
+                continue
+            names = [n for t in node.targets
+                     for n in _binding_target_names(t)]
+            bindings.append((names, node.value))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            bindings.append(
+                (list(_binding_target_names(node.target)), node.value))
+        elif isinstance(node, ast.AugAssign):
+            bindings.append(
+                (list(_binding_target_names(node.target)), node.value))
+        elif isinstance(node, ast.NamedExpr):
+            bindings.append(
+                (list(_binding_target_names(node.target)), node.value))
+    tainted: dict = {}
+    for _ in range(len(bindings) + 1):
+        grew = False
+        for names, rhs in bindings:
+            if all(n in tainted for n in names):
+                continue
+            src = _rank_source(rhs)
+            if src is None and mentions_any_name(rhs, set(tainted)):
+                hit = next((n.id for n in ast.walk(rhs)
+                            if isinstance(n, ast.Name)
+                            and n.id in tainted), None)
+                src = tainted.get(hit)
+            if src is not None:
+                for n in names:
+                    tainted.setdefault(n, src)
+                grew = True
+        if not grew:
+            break
+    return tainted
+
+
+def _handler_continues(handler: ast.ExceptHandler) -> bool:
+    """True when the except body can fall through (no unconditional
+    re-raise as its last statement)."""
+    body = handler.body
+    return not (body and isinstance(body[-1], ast.Raise))
+
+
+def _swallowing_handler(node: ast.Try) -> Optional[ast.ExceptHandler]:
+    for h in node.handlers:
+        if _handler_continues(h):
+            return h
+    return None
+
+
+def _iter_guarded_calls(stmts, guards):
+    """Yield (call, guards-at-call) walking a statement list, tracking the
+    enclosing rank-gated / swallowing-try / rank-loop contexts. Stops at
+    nested function/class defs (they are analyzed as their own units)."""
+    for st in stmts:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        if isinstance(st, ast.If):
+            g = st._pta_rank_guard if hasattr(st, "_pta_rank_guard") \
+                else None
+            inner = guards + ([g] if g else [])
+            yield from _iter_guarded_calls(st.body, inner)
+            yield from _iter_guarded_calls(st.orelse, inner)
+            continue
+        if isinstance(st, ast.While):
+            g = getattr(st, "_pta_rank_guard", None)
+            inner = guards + ([g] if g else [])
+            yield from _iter_guarded_calls(st.body, inner)
+            yield from _iter_guarded_calls(st.orelse, guards)
+            continue
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            g = getattr(st, "_pta_rank_guard", None)
+            inner = guards + ([g] if g else [])
+            yield from _iter_guarded_calls(st.body, inner)
+            yield from _iter_guarded_calls(st.orelse, guards)
+            continue
+        if isinstance(st, ast.Try):
+            h = _swallowing_handler(st)
+            g = (("swallow", st, h) if h is not None else None)
+            inner = guards + ([g] if g else [])
+            yield from _iter_guarded_calls(st.body, inner)
+            for handler in st.handlers:
+                yield from _iter_guarded_calls(handler.body, guards)
+            yield from _iter_guarded_calls(st.orelse, inner)
+            yield from _iter_guarded_calls(st.finalbody, guards)
+            continue
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            yield from _iter_guarded_calls(st.body, guards)
+            for item in st.items:
+                for n in ast.walk(item.context_expr):
+                    if isinstance(n, ast.Call):
+                        yield n, guards
+            continue
+        # plain statement: every call in it runs under the current
+        # guards. Prune def/lambda subtrees — their bodies do not
+        # execute at this statement.
+        stack = [st]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                yield n, guards
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _collective_axis_args(call: ast.Call) -> List[str]:
+    """Literal axis-name strings this collective call names. Positional
+    convention: lax collectives take the axis as the 2nd argument."""
+    out: List[str] = []
+    cand = list(call.args[1:2])
+    for kw in call.keywords:
+        if kw.arg in ("axis", "axis_name"):
+            cand.append(kw.value)
+    for c in cand:
+        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+            out.append(c.value)
+        elif isinstance(c, (ast.Tuple, ast.List)):
+            out.extend(e.value for e in c.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str))
+    return out
+
+
+class SpmdDivergenceRule(Rule):
+    code = "PTA011"
+    name = "spmd-divergence"
+    description = ("collectives under rank-dependent control flow, "
+                   "inside exception-swallowing try blocks, with "
+                   "undeclared axis names, or in per-host-length loops "
+                   "— each one a multi-host deadlock or wrong-axis "
+                   "reduction")
+    severity = "error"
+
+    def finalize(self, project: Project) -> List[Finding]:
+        graph = project.callgraph
+        findings: List[Finding] = []
+        for fi in graph.functions:
+            if isinstance(fi.node, (ast.Lambda,)):
+                continue
+            findings.extend(self._check_function(graph, fi))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    # -- per-function analysis ------------------------------------------------
+    def _check_function(self, graph, fi) -> List[Finding]:
+        sf = fi.file
+        node = fi.node
+        rank_names = _rank_tainted_names(node)
+
+        def rank_reason(test) -> Optional[str]:
+            src = _rank_source(test)
+            if src is not None:
+                return src
+            hit = next((n.id for n in ast.walk(test)
+                        if isinstance(n, ast.Name) and n.id in rank_names),
+                       None)
+            if hit is not None:
+                return f"`{hit}` (from {rank_names[hit]})"
+            return None
+
+        # annotate control statements with their guard kind before the
+        # guarded walk reads them. Always overwrite/clear: ast.walk also
+        # touches nested defs, and those are re-annotated (with their own
+        # taint sets) when their FuncInfo is processed later.
+        for st in ast.walk(node):
+            if isinstance(st, (ast.If, ast.While)):
+                r = rank_reason(st.test)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                r = rank_reason(st.iter)
+            else:
+                continue
+            if r:
+                kind = "loop" if isinstance(st, (ast.For, ast.AsyncFor)) \
+                    else "rank"
+                st._pta_rank_guard = (kind, st, r)
+            elif hasattr(st, "_pta_rank_guard"):
+                del st._pta_rank_guard
+
+        out: List[Finding] = []
+        seen = set()
+        for call, guards in _iter_guarded_calls(node.body, []):
+            via = graph.collective_call_via(fi, call)
+            if via is None:
+                continue
+            self._check_axes(graph, fi, call, via, out)
+            if not guards or id(call) in seen:
+                continue
+            seen.add(id(call))
+            kind, gnode, detail = guards[-1]
+            if kind == "rank":
+                stmt = ("if" if isinstance(gnode, ast.If) else "while")
+                out.append(sf.finding(
+                    self.code, call,
+                    f"collective {via} is reachable only under rank-"
+                    f"dependent control flow (`{stmt}` at line "
+                    f"{gnode.lineno} tests {detail}) — ranks that skip "
+                    f"the branch never join the collective and the job "
+                    f"deadlocks; issue it unconditionally and mask with "
+                    f"`jnp.where` instead",
+                    anchor=f"spmd:rank-gated:{fi.qualname}:"
+                           f"{sf.line_text(call.lineno)}"))
+            elif kind == "loop":
+                out.append(sf.finding(
+                    self.code, call,
+                    f"collective {via} runs inside a loop whose trip "
+                    f"count derives from a per-host value ({detail}, "
+                    f"line {gnode.lineno}) — ranks run different "
+                    f"numbers of collective rounds and the first extra "
+                    f"round deadlocks; make the trip count a global "
+                    f"constant",
+                    anchor=f"spmd:host-loop:{fi.qualname}:"
+                           f"{sf.line_text(call.lineno)}"))
+            elif kind == "swallow":
+                handler = guards[-1][2]
+                htype = (dotted_name(handler.type)
+                         if handler.type is not None else "bare")
+                out.append(sf.finding(
+                    self.code, call,
+                    f"collective {via} sits in a `try:` whose `except "
+                    f"{htype}` (line {handler.lineno}) continues "
+                    f"execution — one rank swallows the failure and "
+                    f"returns while its peers hang in the collective; "
+                    f"re-raise so the whole cohort fails together",
+                    anchor=f"spmd:swallowed:{fi.qualname}:"
+                           f"{sf.line_text(call.lineno)}"))
+        return out
+
+    def _check_axes(self, graph, fi, call: ast.Call, via: str,
+                    out: List[Finding]) -> None:
+        # only direct collective calls carry an axis argument we can read
+        d = dotted_name(call.func)
+        from ..callgraph import LAX_COLLECTIVES
+        if d.rpartition(".")[2] not in LAX_COLLECTIVES:
+            return
+        axes = _collective_axis_args(call)
+        if not axes:
+            return
+        wrap = graph.shard_map_axes.get(id(fi))
+        declared, where = None, ""
+        if wrap is not None and wrap[0] is not None:
+            declared = set(wrap[0])
+            where = f"the enclosing {wrap[1]} (mesh axes {wrap[0]})"
+        elif graph.declared_axes:
+            declared = set(graph.declared_axes)
+            where = "any mesh/PartitionSpec declaration in the project"
+        if declared is None:
+            return
+        for ax in axes:
+            if ax not in declared:
+                out.append(fi.file.finding(
+                    self.code, call,
+                    f"collective `{d}` names axis '{ax}', which is not "
+                    f"declared by {where} — a typo here is an unbound-"
+                    f"axis trace error at best, a wrong-axis reduction "
+                    f"at worst",
+                    anchor=f"spmd:axis:{fi.qualname}:{ax}"))
+
+
+RULE = SpmdDivergenceRule()

@@ -1,0 +1,122 @@
+"""PTA012: trace-level collective-schedule audit.
+
+For every registered auditable entrypoint, extract the ordered per-rank
+collective schedule from the captured jaxpr — (primitive, axis names,
+operand shape/dtype, ppermute permutation, all_to_all split/concat dims)
+— and verify the SPMD invariants a multi-host mesh depends on:
+
+- **rank-divergent cond** (error): a ``cond``/``switch`` whose branches
+  carry different collective schedules. Branch selection can differ per
+  rank at runtime, so one rank issues a collective its peers never join
+  and the mesh deadlocks — the compiled-program analogue of what PTA011
+  flags in source.
+- **broken permutation** (error): a ppermute perm with duplicate or
+  out-of-range endpoints, or one covering only a strict subset of the
+  axis — the uncovered rank never participates while its peers cycle,
+  which hangs the ring.
+- **all_to_all pairing** (warning): consecutive all_to_alls on the same
+  axis whose split/concat dims are not transposes of each other — the
+  return trip does not undo the dispatch and tokens land scrambled
+  (MoE dispatch/combine is the canonical pair).
+
+The schedule also records estimated **wire bytes** per step (operand
+bytes × enclosing scan trip counts), surfaced in the trace report as
+``collective_bytes`` so ``check_audit_regression.py`` can gate comm
+regressions the same way it gates copy fraction.
+
+Findings anchor at the ``register_entrypoint`` site with stable
+``trace:<name>:<check>`` fingerprints, so they baseline and noqa like any
+AST finding. This tier compiles code: it only runs when selected
+explicitly (``--only PTA012``).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .base import Rule
+from ..core import Finding, Project
+
+
+class CollectiveScheduleRule(Rule):
+    code = "PTA012"
+    name = "collective-schedule"
+    tier = "trace"
+    description = ("trace-level collective-schedule audit of registered "
+                   "entrypoints: rank-divergent cond branches, broken "
+                   "ppermute permutations, mismatched all_to_all pairs, "
+                   "wire-byte accounting (runs only via --only)")
+    severity = "error"
+
+    def finalize(self, project: Project) -> List[Finding]:
+        from ..trace import get_report
+        report = get_report()
+        findings: List[Finding] = []
+        if report.error:
+            findings.append(Finding(
+                self.code, "tools/analyze/trace/__init__.py", 1, 0,
+                f"trace audit could not run (jax/paddle_tpu import "
+                f"failed): {report.error.strip().splitlines()[-1]}",
+                anchor="trace:runner:unavailable", severity="error"))
+            return findings
+        for name, st in sorted(report.entrypoint_stats.items()):
+            loc = (st.path or "tools/analyze/trace/__init__.py",
+                   st.line or 1)
+            if st.error:
+                # PTA009 already reports the build failure; a second
+                # finding here would double-count the same breakage
+                continue
+            for issue in st.collective_issues:
+                kind = issue.get("kind", "?")
+                if kind == "rank-divergent-cond":
+                    scheds = issue.get("branch_schedules", [])
+                    desc = " vs ".join(
+                        "[" + ", ".join(s) + "]" for s in scheds) or "?"
+                    findings.append(Finding(
+                        self.code, loc[0], loc[1], 0,
+                        f"entrypoint `{name}`: cond/switch branches carry "
+                        f"different collective schedules ({desc}) — branch "
+                        f"selection can differ per rank, so some ranks "
+                        f"issue collectives their peers never join "
+                        f"(deadlock); hoist the collectives out of the "
+                        f"branches and select on data instead",
+                        anchor=f"trace:{name}:rank-divergent-cond",
+                        severity="error"))
+                elif kind == "broken-permutation":
+                    axis = issue.get("axis", "?")
+                    size = issue.get("axis_size")
+                    covered = issue.get("covered_ranks", [])
+                    cls = issue.get("classification", "invalid")
+                    findings.append(Finding(
+                        self.code, loc[0], loc[1], 0,
+                        f"entrypoint `{name}`: ppermute over axis "
+                        f"`{axis}` (size {size}) has a {cls} permutation "
+                        f"{issue.get('perm')} — ranks {covered} "
+                        f"participate but the axis has "
+                        f"{size if size is not None else '?'} ranks; the "
+                        f"uncovered rank blocks forever while its peers "
+                        f"cycle",
+                        anchor=f"trace:{name}:broken-perm:{axis}",
+                        severity="error"))
+                elif kind == "alltoall-pairing":
+                    axis = issue.get("axis", "?")
+                    findings.append(Finding(
+                        self.code, loc[0], loc[1], 0,
+                        f"entrypoint `{name}`: paired all_to_alls on axis "
+                        f"`{axis}` have non-transposed split/concat dims "
+                        f"({issue.get('first')} then "
+                        f"{issue.get('second')}) — the return trip does "
+                        f"not undo the dispatch, so tokens land on the "
+                        f"wrong expert/rank",
+                        anchor=f"trace:{name}:alltoall-pairing:{axis}",
+                        severity="warning"))
+                else:
+                    findings.append(Finding(
+                        self.code, loc[0], loc[1], 0,
+                        f"entrypoint `{name}`: collective-schedule issue "
+                        f"`{kind}`: {issue}",
+                        anchor=f"trace:{name}:{kind}",
+                        severity="warning"))
+        return findings
+
+
+RULE = CollectiveScheduleRule()

@@ -9,7 +9,7 @@ only the findings the current change introduced.
 """
 from __future__ import annotations
 
-from typing import List, Set
+from typing import List, Optional, Set
 
 from .core import Finding
 
@@ -20,7 +20,12 @@ SARIF_VERSION = "2.1.0"
 _LEVELS = {"error": "error", "warning": "warning"}
 
 
-def to_sarif(findings: List[Finding], rules, new_ids: Set[int]) -> dict:
+def to_sarif(findings: List[Finding], rules, new_ids: Set[int],
+             error: Optional[str] = None) -> dict:
+    """``error`` marks the run as failed: the SARIF stays valid (possibly
+    partial results) and the internal error travels as a tool-execution
+    notification instead of poisoning the file — consumers never see a
+    stale or truncated ``analysis.sarif``."""
     rule_index = {r.code: i for i, r in enumerate(rules)}
     results = []
     for f in findings:
@@ -42,6 +47,12 @@ def to_sarif(findings: List[Finding], rules, new_ids: Set[int]) -> dict:
         if f.rule in rule_index:
             res["ruleIndex"] = rule_index[f.rule]
         results.append(res)
+    invocation: dict = {"executionSuccessful": error is None}
+    if error is not None:
+        invocation["toolExecutionNotifications"] = [{
+            "level": "error",
+            "message": {"text": error},
+        }]
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,
@@ -56,6 +67,7 @@ def to_sarif(findings: List[Finding], rules, new_ids: Set[int]) -> dict:
                         "level": _LEVELS.get(r.severity, "error")},
                 } for r in rules],
             }},
+            "invocations": [invocation],
             "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
             "results": results,
         }],

@@ -42,6 +42,11 @@ class EntrypointStats:
     large_consts: List[Dict[str, Any]] = field(default_factory=list)
     donation: Optional[Dict[str, Any]] = None  # set when check applies
     hlo: Dict[str, int] = field(default_factory=dict)
+    # collective-schedule audit (PTA012): ordered per-rank schedule,
+    # total wire bytes per step, and any invariant violations
+    collectives: List[Dict[str, Any]] = field(default_factory=list)
+    collective_bytes: int = 0
+    collective_issues: List[Dict[str, Any]] = field(default_factory=list)
 
     def payload(self) -> Dict[str, Any]:
         return {
@@ -52,6 +57,9 @@ class EntrypointStats:
             "transfers": self.transfers,
             "large_consts": self.large_consts,
             "donation": self.donation, "hlo": self.hlo,
+            "collectives": self.collectives,
+            "collective_bytes": self.collective_bytes,
+            "collective_issues": self.collective_issues,
         }
 
 
@@ -148,6 +156,9 @@ def audit_spec(name: str, spec, tags: Tuple[str, ...] = (),
         closed = jax.make_jaxpr(spec.fn, **mj_kwargs)(*spec.make_args(0))
         st.transfers = passes.scan_transfers(closed)
         st.large_consts = passes.scan_large_consts(closed)
+        st.collectives, st.collective_issues = \
+            passes.collective_schedule(closed)
+        st.collective_bytes = sum(e["bytes"] for e in st.collectives)
         if "train" in st.tags and "donate_argnums" not in spec.jit_kwargs:
             st.donation = passes.donation_opportunities(closed)
 

@@ -140,6 +140,224 @@ def donation_opportunities(jaxpr: Any) -> Dict[str, Any]:
             "total_inputs": len(open_j.invars)}
 
 
+#: cross-rank collective primitives (jaxpr names). ``pmean`` lowers to
+#: psum+div before the jaxpr, so psum covers it; ``psum2`` is what
+#: shard_map's replication-rule rewrite turns psum into. ``pbroadcast``/
+#: pvary are replication type-casts, not communication.
+COLLECTIVE_PRIMITIVES = {
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+}
+
+#: rewrite aliases -> the primitive the schedule should report
+_PRIMITIVE_ALIASES = {"psum2": "psum"}
+
+
+def _collective_axes(params: Dict[str, Any]) -> List[str]:
+    for key in ("axis_name", "axes"):
+        v = params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, (list, tuple)):
+            return [str(a) for a in v if isinstance(a, str)]
+        if isinstance(v, str):
+            return [v]
+    return []
+
+
+def _aval_bytes(aval: Any) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n * int(getattr(getattr(aval, "dtype", None), "itemsize", 4))
+
+
+def _classify_perm(perm, axis_size) -> str:
+    """ring (single cycle covering the axis) | shift (open chain over all
+    ranks) | empty | partial (some rank never participates) | invalid
+    (duplicate/out-of-range endpoints) | unknown (axis size unresolved)."""
+    pairs = [(int(s), int(d)) for s, d in perm]
+    if not pairs:
+        return "empty"
+    srcs = [s for s, _ in pairs]
+    dsts = [d for _, d in pairs]
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        return "invalid"
+    if axis_size is None:
+        return "unknown"
+    ranks = set(range(axis_size))
+    if any(s not in ranks for s in srcs) or any(d not in ranks
+                                                for d in dsts):
+        return "invalid"
+    covered = set(srcs) | set(dsts)
+    if covered != ranks:
+        return "partial"
+    if set(srcs) == ranks and set(dsts) == ranks:
+        # walk the cycle from rank 0: a single cycle visits all ranks
+        nxt = dict(pairs)
+        seen, cur = set(), 0
+        while cur not in seen:
+            seen.add(cur)
+            cur = nxt[cur]
+        return "ring" if len(seen) == axis_size else "multi-cycle"
+    if len(pairs) == axis_size - 1:
+        return "shift"
+    return "other"
+
+
+def _sig(entry: Dict[str, Any]):
+    """Rank-invariance signature of one schedule entry: what must match
+    across cond branches for every rank to run the same collective."""
+    return (entry["primitive"], tuple(entry["axes"]),
+            tuple(entry["shape"]), entry["dtype"],
+            tuple(map(tuple, entry["perm"] or ())))
+
+
+def collective_schedule(jaxpr: Any) -> Tuple[List[Dict[str, Any]],
+                                             List[Dict[str, Any]]]:
+    """Extract the ordered per-rank collective schedule of a traced
+    program and verify its SPMD invariants.
+
+    Returns ``(schedule, issues)``. Each schedule entry records
+    (primitive, axis names, operand shape/dtype, ppermute permutation,
+    all_to_all split/concat dims, estimated wire bytes). Wire bytes are
+    operand bytes entering the collective × the static trip count of
+    enclosing scans — a regression counter for the audit gate, not an
+    exact wire model.
+
+    Issues found (each a dict with ``kind`` + message fields):
+
+    - ``rank-divergent-cond``: a ``cond``/``switch`` whose branches carry
+      different collective schedules — branch selection can differ per
+      rank at runtime, so some ranks issue collectives peers never join.
+    - ``broken-permutation``: a ppermute whose perm has duplicate or
+      out-of-range endpoints, or covers only a strict subset of the axis
+      (a broken ring: the uncovered rank never participates while its
+      peers cycle).
+    - ``alltoall-pairing``: consecutive all_to_alls on one axis whose
+      split/concat dims are not transposes of each other — the return
+      trip does not undo the dispatch and tokens land scrambled.
+    """
+    schedule: List[Dict[str, Any]] = []
+    issues: List[Dict[str, Any]] = []
+
+    def walk(j: Any, axis_sizes: Dict[str, int], mult: int,
+             out: List[Dict[str, Any]]) -> None:
+        for eqn in _open(j).eqns:
+            name = eqn.primitive.name
+            params = eqn.params
+            if name == "shard_map":
+                mesh = params.get("mesh")
+                sizes = dict(axis_sizes)
+                shape = getattr(mesh, "shape", None)
+                if shape:
+                    try:
+                        sizes.update({str(k): int(v)
+                                      for k, v in dict(shape).items()})
+                    except (TypeError, ValueError):
+                        pass
+                for sub in _sub_jaxprs(params.get("jaxpr")):
+                    walk(sub, sizes, mult, out)
+                continue
+            if name == "scan":
+                trip = int(params.get("length", 1) or 1)
+                for sub in _sub_jaxprs(params.get("jaxpr")):
+                    walk(sub, axis_sizes, mult * trip, out)
+                continue
+            if name in ("cond", "switch"):
+                branches = params.get("branches", ())
+                sub_scheds: List[List[Dict[str, Any]]] = []
+                for b in _sub_jaxprs(branches):
+                    s: List[Dict[str, Any]] = []
+                    walk(b, axis_sizes, mult, s)
+                    sub_scheds.append(s)
+                sigs = {tuple(_sig(e) for e in s) for s in sub_scheds}
+                if len(sigs) > 1:
+                    issues.append({
+                        "kind": "rank-divergent-cond",
+                        "branch_schedules": [
+                            [e["primitive"] for e in s]
+                            for s in sub_scheds],
+                    })
+                if sub_scheds:
+                    # account the heaviest branch so wire bytes bound
+                    # the true cost whichever branch a rank takes
+                    heaviest = max(
+                        sub_scheds,
+                        key=lambda s: sum(e["bytes"] for e in s))
+                    for e in heaviest:
+                        e = dict(e)
+                        e["context"] = "cond"
+                        out.append(e)
+                continue
+            if name in COLLECTIVE_PRIMITIVES:
+                avals = [v.aval for v in eqn.invars
+                         if hasattr(v, "aval")
+                         and getattr(v.aval, "shape", None) is not None]
+                first = avals[0] if avals else None
+                axes = _collective_axes(params)
+                perm = params.get("perm")
+                entry = {
+                    "primitive": _PRIMITIVE_ALIASES.get(name, name),
+                    "axes": axes,
+                    "shape": list(getattr(first, "shape", ())),
+                    "dtype": str(getattr(first, "dtype", "?")),
+                    "perm": ([[int(s), int(d)] for s, d in perm]
+                             if perm is not None else None),
+                    "split_axis": params.get("split_axis"),
+                    "concat_axis": params.get("concat_axis"),
+                    "trip_count": mult,
+                    "bytes": mult * sum(_aval_bytes(a) for a in avals),
+                    "context": "top",
+                }
+                if name == "ppermute" and perm is not None:
+                    size = None
+                    for ax in axes:
+                        if ax in axis_sizes:
+                            size = axis_sizes[ax]
+                            break
+                    kind = _classify_perm(perm, size)
+                    entry["perm_kind"] = kind
+                    if kind in ("invalid", "partial"):
+                        covered = sorted({int(r) for p in perm
+                                          for r in p})
+                        issues.append({
+                            "kind": "broken-permutation",
+                            "axis": axes[0] if axes else "?",
+                            "axis_size": size,
+                            "perm": entry["perm"],
+                            "classification": kind,
+                            "covered_ranks": covered,
+                        })
+                out.append(entry)
+                continue
+            for v in params.values():
+                for sub in _sub_jaxprs(v):
+                    walk(sub, axis_sizes, mult, out)
+
+    walk(jaxpr, {}, 1, schedule)
+
+    # paired all_to_alls (dispatch/return) must transpose their
+    # split/concat dims; a lone all_to_all (compressed allreduce's
+    # scatter phase) has no pairing to check
+    by_axis: Dict[str, List[Dict[str, Any]]] = {}
+    for e in schedule:
+        if e["primitive"] == "all_to_all":
+            by_axis.setdefault(
+                ",".join(e["axes"]), []).append(e)
+    for axis, group in by_axis.items():
+        for a, b in zip(group[0::2], group[1::2]):
+            if (b["split_axis"], b["concat_axis"]) != \
+                    (a["concat_axis"], a["split_axis"]):
+                issues.append({
+                    "kind": "alltoall-pairing",
+                    "axis": axis,
+                    "first": [a["split_axis"], a["concat_axis"]],
+                    "second": [b["split_axis"], b["concat_axis"]],
+                })
+    return schedule, issues
+
+
 # one HLO instruction: `[ROOT] %name = type opcode(...)`
 _HLO_INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*\S+\s+([a-z][\w\-]*)\(")

@@ -48,12 +48,22 @@ ENTRYPOINTS = ("resnet_train_step", "gpt_train_step",
                # ring-flash custom_vjp backward (sequence_parallel.py) —
                # both ring walks must stay fused, zero-host-transfer
                # device programs
-               "gpt_ring_flash_train_step")
+               "gpt_ring_flash_train_step",
+               # mesh topologies the collective_bytes gate covers
+               # (fleet/audit_specs.py): the pp ppermute chain and the
+               # ep all_to_all dispatch/combine pair
+               "pipeline_train_step", "moe_train_step")
 
 #: copy_fraction may drift this much absolutely before failing (XLA
 #: version skew moves copy counts a little; a real fusion break moves a
 #: lot — the hapi conv path regression that motivated PTA009 tripled it)
 COPY_FRACTION_SLACK = 0.05
+
+#: collective_bytes may grow this much relatively before failing (shape
+#: tweaks in the audit specs move it a little; a comm regression — a
+#: lost donation of the capacity factor, an extra ring round, an
+#: accidental full-replica gather — moves it a lot)
+COLLECTIVE_BYTES_SLACK = 0.05
 
 
 def summarize(payload):
@@ -76,6 +86,8 @@ def summarize(payload):
             "fingerprint_unstable":
                 0 if st.get("fingerprint_stable", True) else 1,
             "copy_fraction": round(int(hlo.get("copies", 0)) / instrs, 4),
+            "collective_bytes": int(st.get("collective_bytes", 0)),
+            "collective_issues": len(st.get("collective_issues") or []),
         }
     return out
 
@@ -96,7 +108,8 @@ def compare(baseline, current):
                             f"--write-baseline")
             continue
         for key in ("host_transfers", "large_consts", "donatable_inputs",
-                    "retraces", "fingerprint_unstable"):
+                    "retraces", "fingerprint_unstable",
+                    "collective_issues"):
             if cur.get(key, 0) > base.get(key, 0):
                 problems.append(
                     f"{name}: {key} regressed "
@@ -109,6 +122,15 @@ def compare(baseline, current):
                 f"{cur.get('copy_fraction', 0.0):.4f} "
                 f"(allowed <= {allowed:.4f}) — a fusion broke on the "
                 f"step path")
+        base_bytes = int(base.get("collective_bytes", 0))
+        cur_bytes = int(cur.get("collective_bytes", 0))
+        if cur_bytes > base_bytes * (1.0 + COLLECTIVE_BYTES_SLACK):
+            problems.append(
+                f"{name}: collective_bytes regressed "
+                f"{base_bytes} -> {cur_bytes} (allowed <= "
+                f"{int(base_bytes * (1.0 + COLLECTIVE_BYTES_SLACK))}) — "
+                f"the step is putting more traffic on the wire per "
+                f"iteration")
     return problems
 
 

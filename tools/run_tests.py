@@ -117,7 +117,7 @@ def main():
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         code = subprocess.call(
             [sys.executable, "-m", "tools.analyze", "--strict",
-             "--only", "PTA009,PTA010",
+             "--only", "PTA009,PTA010,PTA012",
              "--trace-report", args.trace_audit_output, "paddle_tpu"],
             cwd=REPO, env=env)
         print(f"trace audit: exit {code} ({time.time() - t0:.0f}s)")
