@@ -98,6 +98,7 @@ def load_default_entrypoints() -> Dict[str, AuditEntrypoint]:
     from ..serving import engine as _engine            # noqa: F401
     from ..serving.llm import decode as _decode        # noqa: F401
     from ..serving.llm import spec as _spec            # noqa: F401
+    from ..serving.llm.paged import decode as _paged_decode  # noqa: F401
     from ..models import bench_audit as _bench_audit   # noqa: F401
     from ..distributed import collective as _coll      # noqa: F401
     from ..distributed.fleet import audit_specs as _fleet_specs  # noqa: F401

@@ -1,0 +1,188 @@
+"""Paged decode attention as a Pallas TPU kernel (vLLM/PagedAttention).
+
+One query per sequence attends over K/V rows scattered across a paged
+arena: logical row ``t`` of sequence ``s`` lives at physical page
+``block_tables[s, t // page_size]``, in-page offset ``t % page_size``
+(see ``serving/llm/paged/pool.py``). Rather than gathering the pages
+into a contiguous ``[S, max_seq, H, D]`` tensor in HBM first (the
+reference lane, ``paged_gather_rows``), this kernel walks the block
+table *inside* the grid: the page id rides the scalar-prefetch channel
+into each K/V BlockSpec index map, so the pipeline DMAs exactly the
+pages the sequence owns, one per grid step, with the online-softmax
+running statistics (m, l, acc) carried across the page axis in VMEM
+scratch — the flash-attention recurrence over a gathered key axis.
+
+Grid: ``(S, H // block_h, pages_per_seq)`` — the page axis is innermost,
+so on TPU (sequential grid) the scratch accumulators persist across one
+sequence-head-block's page walk and reset via ``@pl.when(p == 0)``.
+
+Masking: query at position ``positions[s]`` attends rows ``j <=
+positions[s]`` (the just-written token sees itself and the whole valid
+prefix — same semantics as ``kvcache.valid_mask``). Pages past the
+length (including trash-page junk) zero out in the running softmax.
+
+Off-TPU the wrapper runs in interpret mode — the same numerics, so CPU
+tests cover the kernel's math; interpret-mode output matches the gather
+lane to float tolerance (NOT bitwise: the blocked online-softmax sums in
+a different order — the bitwise-parity contract belongs to the gather
+lane).
+
+Tuner family ``paged_attn`` (``paddle_tpu.tuner.paged_key``): the one
+knob is ``block_h``, how many heads share a grid step's DMA and compute
+block. ``default_winners.json`` carries committed entries; unknown
+shapes fall back to a dividing heuristic.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["paged_attention"]
+
+_NEG_INF = -1e30
+
+
+def _sanitize_block_h(block_h, num_heads: int) -> int:
+    """Largest divisor of ``num_heads`` that is <= the requested block
+    (the grid needs H % block_h == 0)."""
+    b = max(1, min(int(block_h), num_heads))  # noqa: PTA001 -- block_h is a python config int (tuner winner / heuristic), never a traced value
+    while num_heads % b:
+        b -= 1
+    return b
+
+
+def _tuned_block_h(num_heads, head_dim, page_size, dtype):
+    """The ``paged_attn`` family's tuned block_h, or None when untuned
+    (tuner import kept lazy + failure-proof, like the flash families)."""
+    try:
+        from ..tuner import get_paged_attn_config
+        cfg = get_paged_attn_config(num_heads, head_dim, page_size, dtype)
+    except Exception:
+        return None
+    if not cfg:
+        return None
+    try:
+        b = int(cfg.get("block_h", 0))
+    except (TypeError, ValueError):
+        return None
+    return b if b > 0 else None
+
+
+def _paged_attn_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, scale, page_size,
+                       pages_per_seq, block_h):
+    import jax.experimental.pallas as pl
+
+    s = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [bh, D]
+    kt = jnp.transpose(k_ref[0].astype(jnp.float32),
+                       (1, 0, 2))                     # [bh, page, D]
+    vt = jnp.transpose(v_ref[0].astype(jnp.float32),
+                       (1, 0, 2))
+    # scores: head-batched q·k over the page rows -> [bh, page]
+    s_blk = lax.dot_general(q[:, None, :], kt,
+                            (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)[:, 0, :]
+    j = p * page_size + lax.broadcasted_iota(jnp.int32,
+                                             (block_h, page_size), 1)
+    valid = j <= len_ref[s]
+    s_blk = jnp.where(valid, s_blk, _NEG_INF)
+    m_prev = m_ref[:, 0]                              # [bh]
+    l_prev = l_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=1))
+    alpha = jnp.exp(m_prev - m_new)
+    pexp = jnp.where(valid, jnp.exp(s_blk - m_new[:, None]), 0.0)
+    l_new = l_prev * alpha + jnp.sum(pexp, axis=1)
+    acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                    + lax.dot_general(pexp, vt,
+                                      (((1,), (1,)), ((0,), (0,))),
+                                      preferred_element_type=jnp.float32))
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
+                    ).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_arena, v_arena, block_tables, positions,
+                    scale=None, block_h=None, interpret=None):
+    """Single-token decode attention through a paged KV arena.
+
+    ``q``: ``[S, H, D]`` (one query per sequence, already projected);
+    ``k_arena``/``v_arena``: ``[num_pages + 1, page_size, H, D]``
+    single-layer arena views (dense — int8 arenas take the gather lane,
+    which dequantizes in-graph); ``block_tables``: ``[S, pages_per_seq]``
+    int32; ``positions``: ``[S]`` int32 — query ``s`` attends logical
+    rows ``j <= positions[s]``. Returns ``[S, H, D]`` in ``q.dtype``.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if isinstance(k_arena, dict) or isinstance(v_arena, dict):
+        raise ValueError(
+            "paged_attention kernel reads dense arenas only — the int8 "
+            "lane uses the gather implementation (dequantize in-graph)")
+    s_n, num_heads, head_dim = q.shape
+    page_size = k_arena.shape[1]
+    pages_per_seq = block_tables.shape[1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if scale is None:
+        scale = 1.0 / np.sqrt(head_dim)
+    if block_h is None:
+        block_h = _tuned_block_h(num_heads, head_dim, page_size, q.dtype)
+    if block_h is None:
+        # heuristic: 8 heads per step keeps the f32 sublane tile full on
+        # TPU; off-TPU any divisor is fine
+        block_h = 8 if not interpret else num_heads
+    block_h = _sanitize_block_h(block_h, num_heads)
+
+    kernel = functools.partial(
+        _paged_attn_kernel, scale=scale, page_size=page_size,
+        pages_per_seq=pages_per_seq, block_h=block_h)
+    bt_flat = block_tables.reshape(-1).astype(jnp.int32)
+
+    def _q_map(s, h, p, bt_ref, len_ref):
+        return (s, h, 0)
+
+    def _kv_map(s, h, p, bt_ref, len_ref):
+        # the block-table walk: physical page id -> arena block index
+        return (bt_ref[s * pages_per_seq + p], 0, h, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_n, num_heads // block_h, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, block_h, head_dim), _q_map),
+            pl.BlockSpec((1, page_size, block_h, head_dim), _kv_map),
+            pl.BlockSpec((1, page_size, block_h, head_dim), _kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, block_h, head_dim), _q_map),
+        scratch_shapes=[
+            pltpu.VMEM((block_h, head_dim), jnp.float32),   # acc
+            pltpu.VMEM((block_h, 128), jnp.float32),        # running max
+            pltpu.VMEM((block_h, 128), jnp.float32),        # running sum
+        ])
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_n, num_heads, head_dim),
+                                       q.dtype),
+        interpret=interpret,
+    )(bt_flat, positions.astype(jnp.int32), q, k_arena, v_arena)

@@ -194,9 +194,15 @@ class StaticKVCache:
     def free(self, slot: int):
         """Return a slot to the pool. Stale K/V rows stay in the buffers —
         they are masked by the length vector and overwritten by the next
-        occupant's prefill, so no device work is needed."""
-        if slot not in self._active:
-            raise ValueError(f"slot {slot} is not active")
+        occupant's prefill, so no device work is needed.
+
+        Raises on an out-of-range slot and on a slot that is not active
+        — a silent double-free would re-append the slot and hand it to
+        two sequences at once (interleaved K/V corruption). The
+        regression test pins both guards."""
+        if not (0 <= slot < self.num_slots) or slot not in self._active:
+            raise ValueError(
+                f"slot {slot} is not active (double free?)")
         self._active.discard(slot)
         self._free.append(slot)
         self._free.sort()

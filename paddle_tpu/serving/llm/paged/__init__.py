@@ -1,0 +1,44 @@
+"""Paged KV cache: block-table memory manager, paged attention, COW
+prefix sharing (docs/serving.md "Paged KV cache").
+
+The vLLM/PagedAttention design grafted under the static-slot LLM stack:
+``pool`` owns the page arena + refcounted free list, ``decode``/``spec``
+mirror the slot decode programs with the block table threaded through,
+``prefix`` shares prefix pages by refcount (COW on divergence), and
+``batcher`` admits on pages-at-current-lengths. Select with
+``LLMEngineConfig(kv_layout="paged")``.
+"""
+from .batcher import PagedBatcher
+from .decode import (GPTPagedDecoder, build_paged_decode_step,
+                     build_paged_prefill_fn, build_paged_tail_prefill_fn,
+                     get_paged_decode_step, get_paged_prefill_fn,
+                     get_paged_tail_prefill_fn)
+from .pool import (PagedKVCache, PagePool, PagesExhausted,
+                   paged_gather_rows, paged_write_prompt_rows,
+                   paged_write_rows, pages_for_tokens)
+from .prefix import PagedPrefixEntry, PagedPrefixStore
+from .spec import (GPTPagedSpecDecoder, build_paged_spec_decode_step,
+                   get_paged_spec_decode_step)
+
+__all__ = [
+    "PagePool",
+    "PagedKVCache",
+    "PagesExhausted",
+    "pages_for_tokens",
+    "paged_write_rows",
+    "paged_write_prompt_rows",
+    "paged_gather_rows",
+    "build_paged_decode_step",
+    "build_paged_prefill_fn",
+    "build_paged_tail_prefill_fn",
+    "get_paged_decode_step",
+    "get_paged_prefill_fn",
+    "get_paged_tail_prefill_fn",
+    "GPTPagedDecoder",
+    "build_paged_spec_decode_step",
+    "get_paged_spec_decode_step",
+    "GPTPagedSpecDecoder",
+    "PagedPrefixEntry",
+    "PagedPrefixStore",
+    "PagedBatcher",
+]

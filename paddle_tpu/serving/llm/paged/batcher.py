@@ -1,0 +1,350 @@
+"""PagedBatcher: admission on pages-at-current-lengths, not max_seq slots.
+
+The slot batcher admits whenever a slot is free, because a slot IS the
+worst case: ``max_seq`` rows, reserved up front. With paged KV the
+resource is the page pool, and the question changes from "is a slot
+free" to "are there enough pages for THIS prompt at ITS length, plus
+headroom for the sequences already running". This subclass keeps the
+whole tick loop (the compiled-step dispatch, token delivery, finish and
+deadline logic are inherited unchanged) and replaces the memory policy:
+
+- **admit** maps exactly the pages the prompt needs now. If the pool
+  (or slot table) can't take it, the request parks in a pending deque
+  — admission is no longer slot-gated, so ``free_slots`` reports 0
+  while anything is pending, and ``active`` counts pending so the
+  worker keeps ticking (each tick frees pages, which is what pending
+  requests are waiting for). A request that can't fit even with the
+  pool EMPTY of other users fails outright instead of deadlocking.
+
+- **per-tick capacity**: before each tick, one page-table pass maps the
+  next write position (``+k+1`` under speculation) for every active
+  slot. When the pool runs dry mid-stream, unpinned prefix entries are
+  dropped first, then the YOUNGEST request is evicted (least progress
+  lost) — pages reclaimed mid-stream, the slot-path analogue being
+  deadline eviction.
+
+- **prefix sharing is zero-copy**: a :class:`PagedPrefixStore` hit
+  adopts full shared pages by table splice (``bytes_shared``), and when
+  the entry extends past the last full page boundary the one partial
+  page is COW-split (``adopt_copied_page``: the only bytes a hit ever
+  copies, counted in ``bytes_copied`` — page-aligned hits copy ZERO).
+  On a miss, the freshly prefilled sequence's own page-aligned head is
+  claimed by the store by refcount, again copying nothing.
+
+Gauges: ``<stat_prefix>.pages_free`` and ``.pages_cow_splits`` publish
+the pool state at every admission and tick (the /metricsz view of the
+admission math in docs/serving.md).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...request import RequestTooLarge
+from ..decode import pack_sampling
+from ..scheduler import ContinuousBatcher, GenerationRequest
+from .decode import GPTPagedDecoder
+from .pool import PagedKVCache, PagesExhausted, pages_for_tokens
+from .prefix import PagedPrefixStore
+
+
+class PagedBatcher(ContinuousBatcher):
+    """Page-pool admission + COW prefix sharing over the inherited tick
+    loop. Single-threaded like the base: only the engine worker calls
+    in."""
+
+    def __init__(self, decoder: GPTPagedDecoder, config, registry,
+                 clock=None, prefix_store=None, spec_decoder=None):
+        if not isinstance(decoder, GPTPagedDecoder):
+            raise TypeError("PagedBatcher needs a GPTPagedDecoder "
+                            "(kv_layout='paged')")
+        if prefix_store is not None:
+            raise NotImplementedError(
+                "paged engines share prefix pages inside their own arena "
+                "— an external (host) PrefixStore cannot be attached; "
+                "set prefix_cache=True and let the batcher build its "
+                "PagedPrefixStore")
+        kw = {} if clock is None else {"clock": clock}
+        super().__init__(decoder, config, registry, prefix_store=None,
+                         spec_decoder=spec_decoder, **kw)
+        self.kv: PagedKVCache
+        self._pending = collections.deque()
+        if config.prefix_cache:
+            self.prefix_store = PagedPrefixStore(
+                self.kv, registry=registry,
+                stat_prefix=f"{config.stat_prefix}.prefix")
+        self._stat_set("pages_free", self.kv.pool.free_pages)
+        self._stat_set("pages_cow_splits", 0)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def active(self) -> int:
+        # pending requests count: the worker must keep ticking (ticks
+        # free pages) and drain must not exit while any wait for pages
+        return len(self._reqs) + len(self._pending)
+
+    @property
+    def free_slots(self) -> int:
+        # stop pulling from the queue while requests already wait for
+        # pages — queue order is admission order
+        if self._pending:
+            return 0
+        return self.kv.free_slots
+
+    def _publish_pages(self):
+        self._stat_set("pages_free", self.kv.pool.free_pages)
+        self._stat_set("pages_cow_splits", self.kv.cow_splits)
+
+    # -- admission -----------------------------------------------------------
+    def admit(self, req: GenerationRequest):
+        self._drain_pending()
+        if self._pending or not self._try_admit(req):
+            self._park_or_fail(req)
+        self._publish_pages()
+
+    def _drain_pending(self):
+        while self._pending:
+            head = self._pending[0]
+            if head.expired:
+                self._pending.popleft()
+                head.fail_expired()
+                continue
+            if not self._try_admit(head):
+                if not self._reqs:
+                    # nothing running -> no pages will ever free up;
+                    # _try_admit already drained the prefix store, so
+                    # this request simply does not fit the pool
+                    self._pending.popleft()
+                    self._fail_oversize(head)
+                    continue
+                break
+            self._pending.popleft()
+
+    def _park_or_fail(self, req: GenerationRequest):
+        if not self._reqs:
+            self._fail_oversize(req)
+            return
+        self._pending.append(req)
+        self._stat_set("pages_pending_requests", len(self._pending))
+
+    def _fail_oversize(self, req: GenerationRequest):
+        need = pages_for_tokens(req.prompt_len, self.kv.page_size)
+        req.fail(RequestTooLarge(
+            f"prompt of {req.prompt_len} tokens needs {need} pages but "
+            f"the pool holds {self.kv.pool.num_pages} "
+            f"({self.kv.pool.free_pages} free, none reclaimable)"))
+        self._stat_add("rejected_pool_exhausted", 1)
+
+    def _try_admit(self, req: GenerationRequest) -> bool:
+        """Admit ``req`` if a slot AND enough pages are available at its
+        actual length; True on success. No partial state on False: the
+        page math runs before any allocation."""
+        if self.kv.free_slots < 1:
+            return False
+        page = self.kv.page_size
+        sig = self.decoder.prefix_sig(self.kv)
+        entry, reuse_n = None, 0
+        if self.prefix_store is not None:
+            entry, reuse_n = self.prefix_store.lookup(
+                req.prompt, req.prompt_len - 1, sig)
+            # the PADDED tail bucket must fit behind the reused head
+            # (same shrink rule as the slot path)
+            while reuse_n > 0 and reuse_n + self.config.bucket_for(
+                    req.prompt_len - reuse_n) > self.config.max_seq:
+                reuse_n -= page
+            if entry is not None and reuse_n <= 0:
+                self.prefix_store.unpin(entry)
+                entry, reuse_n = None, 0
+        # COW extension: when the entry's pages run past the last FULL
+        # page boundary we may reuse (store hits are page-aligned, the
+        # reusable-token cap prompt_len-1 usually is not), the one
+        # partial page is copied and the divergent tail overwrites the
+        # private copy — rows [reuse_n, ext_n) come along for free.
+        cow_src = None
+        ext_n = min(entry.n_tokens, req.prompt_len - 1) if entry else 0
+        if (entry is not None and reuse_n < ext_n
+                and ext_n - reuse_n < page
+                and ext_n + self.config.bucket_for(
+                    req.prompt_len - ext_n) <= self.config.max_seq
+                and np.array_equal(entry.tokens[reuse_n:ext_n],
+                                   req.prompt[reuse_n:ext_n])):
+            cow_src = entry.page_ids[reuse_n // page]
+        else:
+            ext_n = reuse_n
+        shared_pages = reuse_n // page
+        total_pages = pages_for_tokens(req.prompt_len, page)
+        need_alloc = total_pages - shared_pages     # COW page included
+        # headroom: one lookahead page per running sequence, so an
+        # admission cannot immediately force a mid-stream eviction at
+        # the next tick's capacity pass
+        reserve = len(self._reqs)
+        shortfall = need_alloc + reserve - self.kv.pool.free_pages
+        if shortfall > 0 and self.prefix_store is not None:
+            shortfall -= self.prefix_store.evict_unpinned(shortfall)
+        if shortfall > 0:
+            if entry is not None:
+                self.prefix_store.unpin(entry)
+            return False
+        self._admit_paged(req, entry, reuse_n, ext_n, cow_src)
+        return True
+
+    def _admit_paged(self, req: GenerationRequest, entry, reuse_n: int,
+                     ext_n: int, cow_src: Optional[int]):
+        """The committed admission: slot + page mapping + prefill +
+        first-token delivery (the paged ``_admit_inner``)."""
+        t0 = self._clock()
+        page = self.kv.page_size
+        slot = self.kv.alloc()
+        req.weights_version = self.weights_version
+        self._reqs[slot] = req
+        self._slot_samp[slot] = req.sampling
+        self._samp_vecs = pack_sampling(self._slot_samp)
+        samp1 = pack_sampling([req.sampling])
+        slot_arr = jnp.asarray([slot], jnp.int32)
+        if reuse_n > 0:
+            for pid in entry.page_ids[:reuse_n // page]:
+                self.kv.adopt_shared_page(slot, pid)
+            self.prefix_store.note_shared(
+                (reuse_n // page) * self.kv.page_nbytes())
+        if cow_src is not None:
+            self.kv.adopt_copied_page(slot, cow_src)
+            self.prefix_store.note_copied(self.kv.page_nbytes())
+            self._stat_add("prefix.cow_splits", 1)
+        self.kv.ensure_pages(slot, req.prompt_len)
+        if entry is not None:
+            req._prefix_entry = entry       # stays pinned until release
+            tail = req.prompt[ext_n:]
+            lt = self.config.bucket_for(int(tail.size))
+            padded = np.zeros((1, lt), np.int32)
+            padded[0, :tail.size] = tail
+            nxt, self._finished = self.decoder.tail_prefill(
+                self.kv, self._params, jnp.asarray(padded),
+                jnp.asarray([int(tail.size)], jnp.int32),
+                jnp.asarray([ext_n], jnp.int32), slot_arr,
+                self._finished, samp1, self._next_key())
+            self._stat_add("prefix.reused_tokens", ext_n)
+        else:
+            lp = self.config.bucket_for(req.prompt_len)
+            padded = np.zeros((1, lp), np.int32)
+            padded[0, :req.prompt_len] = req.prompt
+            nxt, self._finished = self.decoder.prefill(
+                self.kv, self._params, jnp.asarray(padded),
+                jnp.asarray([req.prompt_len], jnp.int32), slot_arr,
+                self._finished, samp1, self._next_key())
+            if self.prefix_store is not None:
+                # miss: claim the page-aligned head BY REFERENCE — the
+                # store retains the sequence's own pages, nothing moves
+                n = (req.prompt_len // page) * page
+                if n >= page:
+                    ins = self.prefix_store.insert(
+                        req.prompt[:n],
+                        self.kv.slot_page_ids(slot)[:n // page],
+                        self.decoder.prefix_sig(self.kv))
+                    if ins is not None:
+                        req._prefix_entry = ins
+        if self.spec is not None:
+            lp = self.config.bucket_for(req.prompt_len)
+            dpad = np.zeros((1, lp), np.int32)
+            dpad[0, :req.prompt_len] = req.prompt
+            self.spec.draft_prefill(
+                self.kv_draft, self._draft_params, jnp.asarray(dpad),
+                jnp.asarray([req.prompt_len], jnp.int32), slot_arr,
+                self.kv.lengths, self._finished, samp1, self._next_key())
+        self._last = self._last.at[jnp.asarray([slot])].set(nxt)
+        tok = int(np.asarray(jax.device_get(nxt))[0])  # noqa: PTA002 -- one [1]-token fetch per admission; first-token delivery (TTFT) needs the value on host
+        now = self._clock()
+        self._stat_observe("prefill_ms", (now - t0) * 1000.0)
+        self._stat_observe("ttft_ms", (now - req.t_enqueue) * 1000.0)
+        self._stat_add("prefills", 1)
+        req._emit(tok)
+        req._t_last = now
+        self._stat_add("tokens_generated", 1)
+        self._maybe_finish(slot, req, tok)
+
+    # -- per-tick capacity ---------------------------------------------------
+    def tick(self) -> int:
+        self._drain_pending()
+        self._stat_set("pages_pending_requests", len(self._pending))
+        if not self._reqs:
+            self._publish_pages()
+            return 0
+        self._ensure_decode_capacity()
+        if not self._reqs:              # capacity pass may evict
+            self._publish_pages()
+            return 0
+        n = super().tick()
+        self._publish_pages()
+        return n
+
+    def _ensure_decode_capacity(self):
+        """Map the next write position for every active slot before the
+        tick — ``+1`` token plain, ``+k+1`` speculative (the verify step
+        lands k+1 candidate rows). Pool dry: drop unpinned prefix
+        entries, then evict the youngest request; a lone un-mappable
+        sequence finishes with reason 'length' (nothing left to
+        reclaim)."""
+        horizon = (self.spec.k + 1) if self.spec is not None else 1
+        for slot in sorted(self._reqs):
+            req = self._reqs.get(slot)
+            if req is None:
+                continue
+            pos = req.prompt_len + len(req.tokens) - 1
+            need_tok = min(pos + horizon, self.config.max_seq)
+            while True:
+                try:
+                    self.kv.ensure_pages(slot, need_tok)
+                    break
+                except PagesExhausted:
+                    short = (pages_for_tokens(need_tok, self.kv.page_size)
+                             - self.kv.mapped_pages(slot)
+                             - self.kv.pool.free_pages)
+                    if self.prefix_store is not None and \
+                            self.prefix_store.evict_unpinned(
+                                max(1, short)) > 0:
+                        continue
+                    victim = self._youngest_other(slot)
+                    if victim is None:
+                        # this is the only sequence and the pool cannot
+                        # grow it — finish at current length rather
+                        # than deadlock
+                        self._stat_add("pages_truncations", 1)
+                        self._release(slot, req, "length")
+                        break
+                    self._evict_for_pages(victim)
+
+    def _youngest_other(self, slot: int) -> Optional[int]:
+        others = [(s, r) for s, r in self._reqs.items() if s != slot]
+        if not others:
+            return None
+        return max(others, key=lambda sr: sr[1].t_enqueue)[0]
+
+    def _evict_for_pages(self, slot: int):
+        req = self._reqs.pop(slot)
+        self.kv.free(slot)
+        self._unpin_prefix(req)
+        req.fail(PagesExhausted(
+            f"request {req.req_id} evicted after {len(req.tokens)} "
+            f"tokens: page pool exhausted and it was the youngest "
+            f"sequence"))
+        self._stat_add("pages_evicted_midstream", 1)
+        self._stat_add("evicted_midstream", 1)
+
+    # -- exits ---------------------------------------------------------------
+    def abort_all(self, exc_factory):
+        super().abort_all(exc_factory)
+        while self._pending:
+            req = self._pending.popleft()
+            req.fail(exc_factory(req))
+        self._publish_pages()
+
+    # -- mfu -----------------------------------------------------------------
+    def _measure_decode_flops(self):
+        # the XLA cost probe compiles the SLOT decode program, which the
+        # paged engine never runs; skip rather than mis-measure
+        self._decode_flops = 0.0
+        self._peak_flops = 1.0

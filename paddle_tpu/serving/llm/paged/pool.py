@@ -1,0 +1,422 @@
+"""PagePool + block tables: the paged KV memory substrate.
+
+StaticKVCache gives every sequence a full ``[layers, max_seq, H, D]``
+slot row for its whole lifetime — a 64-token chat in a 32k-max-seq fleet
+wastes 99.8% of its reservation. This module rebuilds the substrate on
+the vLLM/PagedAttention design: K and V live in ONE preallocated arena
+of fixed-size token *pages*,
+
+    arena[k|v] : [num_pages + 1, num_layers, page_size, H, D]
+
+and each sequence owns a *block table* — a ``[pages_per_seq]`` int32
+device row mapping logical page index -> physical arena page. Logical
+row ``t`` of a sequence lives at ``arena[bt[t // page_size], :,
+t % page_size]``. Pages are ref-counted on the host (a page shared by a
+cached prefix and two live sequences has refcount 3), so prefix reuse is
+a block-table splice (zero copied bytes) and divergence is a single-page
+copy-on-write, not a whole-prefix copy.
+
+The LAST physical page (index ``num_pages``) is the **trash page**: the
+block tables of freed/unused slots point at it, and right-padded prefill
+junk rows are routed to it, so every compiled program can write
+unconditionally on uniform shapes (the LazyTensor one-program
+discipline) while unmapped logical rows never corrupt live pages.
+Whatever lands in the trash page is garbage by construction and every
+read of it is masked by the per-slot length vector.
+
+Host bookkeeping (free list, refcounts) mirrors StaticKVCache's slot
+lifecycle: device arrays are only ever *replaced* by functional step
+outputs; ``alloc``/``release`` never touch the device beyond the O(1)
+block-table entry updates, which are jitted scalar scatters.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..kvcache import (SlotsExhausted, is_quantized_kv, kv_nbytes,
+                       quantize_kv_rows)
+
+
+class PagesExhausted(RuntimeError):
+    """The pool cannot satisfy an allocation (callers should gate on
+    :attr:`PagePool.free_pages` / evict before hitting this)."""
+
+
+class PagePool:
+    """Host-side free list + per-page refcounts over the physical pages.
+
+    A page is *free* when its refcount is 0. ``alloc`` hands out the
+    lowest free index (deterministic tests) at refcount 1; ``retain``
+    adds a sharer; ``release`` drops one reference and returns the page
+    to the free list when the count hits zero. Releasing a free page
+    raises — the page-level double-free guard the leak tests pin.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 1:
+            raise ValueError(f"need num_pages >= 1, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._refs = np.zeros(self.num_pages, np.int64)
+        self._free: List[int] = list(range(self.num_pages))
+        heapq.heapify(self._free)
+        #: lifetime counters — the leak invariant is
+        #: ``total_allocs + total_retains == total_releases`` once every
+        #: sequence/prefix-entry is gone (pages_in_use == 0)
+        self.total_allocs = 0
+        self.total_retains = 0
+        self.total_releases = 0
+        self.peak_in_use = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def refcount(self, pid: int) -> int:
+        return int(self._refs[pid])
+
+    def alloc_many(self, n: int) -> List[int]:
+        """Claim ``n`` fresh pages (refcount 1 each), atomically: either
+        all ``n`` allocate or none do and :class:`PagesExhausted` is
+        raised — a partial allocation would leak on the error path."""
+        if n < 0:
+            raise ValueError(f"alloc_many({n})")
+        if n > len(self._free):
+            raise PagesExhausted(
+                f"need {n} pages, only {len(self._free)} of "
+                f"{self.num_pages} free")
+        out = [heapq.heappop(self._free) for _ in range(n)]
+        for pid in out:
+            self._refs[pid] = 1
+        self.total_allocs += n
+        self.peak_in_use = max(self.peak_in_use, self.pages_in_use)
+        return out
+
+    def alloc(self) -> int:
+        return self.alloc_many(1)[0]
+
+    def retain(self, pid: int):
+        """Add a reference to an already-live page (prefix sharing)."""
+        if not (0 <= pid < self.num_pages):
+            raise ValueError(f"retain of non-pool page {pid}")
+        if self._refs[pid] <= 0:
+            raise ValueError(f"retain of free page {pid}")
+        self._refs[pid] += 1
+        self.total_retains += 1
+
+    def release(self, pid: int) -> bool:
+        """Drop one reference; returns True when the page went back to
+        the free list. Raises on over-release (page double-free)."""
+        if not (0 <= pid < self.num_pages):
+            raise ValueError(f"release of non-pool page {pid}")
+        if self._refs[pid] <= 0:
+            raise ValueError(
+                f"page {pid} double-free: released with refcount 0")
+        self._refs[pid] -= 1
+        self.total_releases += 1
+        if self._refs[pid] == 0:
+            heapq.heappush(self._free, pid)
+            return True
+        return False
+
+    def reset(self):
+        self._refs[:] = 0
+        self._free = list(range(self.num_pages))
+        heapq.heapify(self._free)
+
+    def __repr__(self):
+        return (f"PagePool(pages={self.num_pages}, "
+                f"in_use={self.pages_in_use}, "
+                f"allocs={self.total_allocs}, "
+                f"releases={self.total_releases})")
+
+
+# -- jitted block-table / arena maintenance ops ------------------------------
+# Scalar-indexed so ONE trace serves every (slot, idx, pid) triple; an
+# eager `.at[3, 2].set(7)` would bake the constants in and compile a
+# fresh executable per distinct index pair.
+
+@jax.jit
+def _bt_set_entry(bt, slot, idx, pid):
+    return bt.at[slot, idx].set(pid)
+
+
+@jax.jit
+def _bt_reset_row(bt, slot, fill):
+    return bt.at[slot].set(fill)
+
+
+@jax.jit
+def _arena_copy_page(buf, dst, src):
+    """Copy physical page ``src`` -> ``dst`` (both arenas' leaves): the
+    copy-on-write split. One traced program per arena shape."""
+    def _cp(x):
+        row = jax.lax.dynamic_index_in_dim(x, src, axis=0, keepdims=True)
+        return jax.lax.dynamic_update_slice_in_dim(x, row, dst, axis=0)
+    return jax.tree_util.tree_map(_cp, buf)
+
+
+# -- functional writers / readers (used inside jitted programs) --------------
+
+def paged_write_rows(buf, rows, pids, ppos):
+    """Write one K or V row per entry into a single layer's arena view.
+
+    ``buf``: ``[P+1, page, H, D]`` (or the quantized dict view);
+    ``rows``: ``[N, H, D]``; ``pids``/``ppos``: ``[N]`` int32 physical
+    page + in-page offset. Rows routed to the trash page may collide —
+    they are junk by construction. One scatter per leaf."""
+    if is_quantized_kv(buf):
+        qs = quantize_kv_rows(rows)            # q [N, H, D], s [N]
+        return {"q": buf["q"].at[pids, ppos].set(qs["q"]),
+                "s": buf["s"].at[pids, ppos].set(qs["s"])}
+    return buf.at[pids, ppos].set(rows)
+
+
+def paged_write_prompt_rows(buf, rows, pids, ppos):
+    """Write ``N`` tokens' rows across ALL layers at once into a whole
+    arena. ``buf``: ``[P+1, L, page, H, D]`` (or dict); ``rows``:
+    ``[N, L, H, D]`` — token ``n``'s layer-``l`` row lands at
+    ``buf[pids[n], l, ppos[n]]``. One scatter per leaf covers the whole
+    prompt x layers block (the no-per-layer-host-loop invariant)."""
+    num_layers = rows.shape[1]
+    li = jnp.arange(num_layers, dtype=jnp.int32)[None, :]      # [1, L]
+    pi = pids[:, None]                                         # [N, 1]
+    oi = ppos[:, None]
+    if is_quantized_kv(buf):
+        qs = quantize_kv_rows(rows)            # q [N, L, H, D], s [N, L]
+        return {"q": buf["q"].at[pi, li, oi].set(qs["q"]),
+                "s": buf["s"].at[pi, li, oi].set(qs["s"])}
+    return buf.at[pi, li, oi].set(rows)
+
+
+def paged_gather_rows(buf, block_tables):
+    """Reconstruct contiguous logical rows from a single layer's arena
+    view: ``[P+1, page, H, D]`` gathered through ``[S, PP]`` block
+    tables -> ``[S, PP*page, H, D]`` — shape-identical to a slot
+    buffer's layer view, which is what makes the gather attention lane
+    bitwise-equal to the slot path."""
+    if is_quantized_kv(buf):
+        q = buf["q"][block_tables]             # [S, PP, page, H, D]
+        s = buf["s"][block_tables]             # [S, PP, page]
+        sh = q.shape
+        return {"q": q.reshape(sh[0], sh[1] * sh[2], sh[3], sh[4]),
+                "s": s.reshape(sh[0], sh[1] * sh[2])}
+    g = buf[block_tables]
+    sh = g.shape
+    return g.reshape(sh[0], sh[1] * sh[2], sh[3], sh[4])
+
+
+def pages_for_tokens(n_tokens: int, page_size: int) -> int:
+    """ceil(n_tokens / page_size) — the admission math helper."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+class PagedKVCache:
+    """Paged per-slot KV storage: one shared page arena + per-slot block
+    tables + the same device ``lengths`` vector StaticKVCache threads.
+
+    ``k``/``v``: ``[num_pages + 1, num_layers, page_size, H, D]`` device
+    arenas (index ``num_pages`` is the trash page). ``block_tables``:
+    ``[num_slots, pages_per_seq]`` int32 device array (unmapped entries
+    point at the trash page). The host tracks which physical pages each
+    slot holds references on (``_slot_pages``); ``free`` releases them
+    back to the :class:`PagePool`.
+    """
+
+    def __init__(self, num_slots: int, num_layers: int, max_seq: int,
+                 num_heads: int, head_dim: int, dtype="float32",
+                 kv_dtype: Optional[str] = None, page_size: int = 16,
+                 num_pages: Optional[int] = None):
+        if num_slots < 1 or max_seq < 2:
+            raise ValueError(
+                f"need num_slots >= 1 and max_seq >= 2, got "
+                f"{num_slots}/{max_seq}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (dense) or 'int8', got "
+                f"{kv_dtype!r}")
+        if page_size < 1 or max_seq % page_size:
+            raise ValueError(
+                f"page_size {page_size} must divide max_seq {max_seq} — "
+                f"equal logical rows are what make paged decode "
+                f"bitwise-comparable to the slot path")
+        self.num_slots = int(num_slots)
+        self.num_layers = int(num_layers)
+        self.max_seq = int(max_seq)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.page_size = int(page_size)
+        self.pages_per_seq = self.max_seq // self.page_size
+        if num_pages is None:
+            # worst case: every slot fully grown — byte parity with the
+            # static cache; real deployments size this far smaller
+            num_pages = self.num_slots * self.pages_per_seq
+        if num_pages < self.pages_per_seq:
+            raise ValueError(
+                f"num_pages {num_pages} cannot hold even one full "
+                f"sequence ({self.pages_per_seq} pages)")
+        self.num_pages = int(num_pages)
+        self.trash = self.num_pages            # physical junk-sink page
+        self.dtype = jnp.dtype(dtype)
+        self.kv_dtype = kv_dtype
+        self.quantized = kv_dtype == "int8"
+        shape = (self.num_pages + 1, self.num_layers, self.page_size,
+                 self.num_heads, self.head_dim)
+        if self.quantized:
+            def _zero_buf():
+                return {"q": jnp.zeros(shape, jnp.int8),
+                        "s": jnp.zeros(shape[:3], jnp.float32)}
+        else:
+            def _zero_buf():
+                return jnp.zeros(shape, self.dtype)
+        self.k = _zero_buf()
+        self.v = _zero_buf()
+        self.block_tables = jnp.full(
+            (self.num_slots, self.pages_per_seq), self.trash, jnp.int32)
+        self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
+        self.pool = PagePool(self.num_pages)
+        self._slot_pages: List[List[int]] = [[] for _ in
+                                             range(self.num_slots)]
+        self._free: List[int] = list(range(self.num_slots))
+        self._active: set = set()
+        #: copy-on-write splits performed (admission divergence)
+        self.cow_splits = 0
+
+    # -- slot lifecycle (host side) ------------------------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._active))
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise SlotsExhausted(
+                f"all {self.num_slots} KV slots are in use")
+        slot = self._free.pop(0)
+        self._active.add(slot)
+        return slot
+
+    def free(self, slot: int):
+        """Return a slot AND its page references to the pools. Raises on
+        a slot double-free — handing one slot (and its pages) to two
+        sequences is the corruption StaticKVCache.free guards against."""
+        if not (0 <= slot < self.num_slots) or slot not in self._active:
+            raise ValueError(
+                f"slot {slot} is not active (double free?)")
+        self._active.discard(slot)
+        for pid in self._slot_pages[slot]:
+            self.pool.release(pid)
+        self._slot_pages[slot] = []
+        self.block_tables = _bt_reset_row(self.block_tables, slot,
+                                          self.trash)
+        self._free.append(slot)
+        self._free.sort()
+
+    def reset(self):
+        """Free every slot, every page reference, and zero the lengths
+        (arenas are left as is — lengths + trash routing gate validity).
+        For warmup and engine restarts."""
+        for slot in list(self._active):
+            self.free(slot)
+        self._free = list(range(self.num_slots))
+        self._active.clear()
+        self._slot_pages = [[] for _ in range(self.num_slots)]
+        self.pool.reset()
+        self.block_tables = jnp.full(
+            (self.num_slots, self.pages_per_seq), self.trash, jnp.int32)
+        self.lengths = jnp.zeros((self.num_slots,), jnp.int32)
+
+    # -- page mapping (host decides, device block table records) -------------
+    def mapped_pages(self, slot: int) -> int:
+        return len(self._slot_pages[slot])
+
+    def mapped_tokens(self, slot: int) -> int:
+        return len(self._slot_pages[slot]) * self.page_size
+
+    def slot_page_ids(self, slot: int) -> Tuple[int, ...]:
+        return tuple(self._slot_pages[slot])
+
+    def _map_page(self, slot: int, pid: int):
+        idx = len(self._slot_pages[slot])
+        if idx >= self.pages_per_seq:
+            raise ValueError(
+                f"slot {slot} already maps {idx} pages (max_seq reached)")
+        self._slot_pages[slot].append(pid)
+        self.block_tables = _bt_set_entry(self.block_tables, slot, idx,
+                                          pid)
+
+    def ensure_pages(self, slot: int, n_tokens: int) -> int:
+        """Map fresh pages so logical rows ``[0, n_tokens)`` are backed;
+        returns how many pages were newly allocated. Atomic: raises
+        :class:`PagesExhausted` without mapping anything when the pool
+        cannot cover the need (callers evict and retry)."""
+        need = pages_for_tokens(n_tokens, self.page_size)
+        have = len(self._slot_pages[slot])
+        if need <= have:
+            return 0
+        fresh = self.pool.alloc_many(need - have)
+        for pid in fresh:
+            self._map_page(slot, pid)
+        return len(fresh)
+
+    def adopt_shared_page(self, slot: int, pid: int):
+        """Splice an already-live page (a prefix-store page) into the
+        slot's block table at the next logical index: refcount +1, zero
+        bytes copied."""
+        self.pool.retain(pid)
+        self._map_page(slot, pid)
+
+    def adopt_copied_page(self, slot: int, src_pid: int) -> int:
+        """Copy-on-write split: allocate a private page, device-copy the
+        shared page's rows into it, and map it. The new occupant can now
+        write its divergent tail rows without touching sharers."""
+        pid = self.pool.alloc()
+        dst = jnp.asarray(pid, jnp.int32)
+        src = jnp.asarray(src_pid, jnp.int32)
+        self.k = _arena_copy_page(self.k, dst, src)
+        self.v = _arena_copy_page(self.v, dst, src)
+        self._map_page(slot, pid)
+        self.cow_splits += 1
+        return pid
+
+    # -- functional state threading ------------------------------------------
+    def swap(self, k, v, lengths):
+        """Install the arrays returned by a jitted prefill/decode call.
+        Shape-checked: a shape change would mean a recompile upstream."""
+        def _shapes(buf):
+            return [leaf.shape for leaf in jax.tree_util.tree_leaves(buf)]
+        assert _shapes(k) == _shapes(self.k) \
+            and _shapes(v) == _shapes(self.v), (_shapes(k), _shapes(self.k))
+        self.k, self.v, self.lengths = k, v, lengths
+
+    def kv_bytes(self) -> int:
+        """Device bytes held by the K+V arenas (trash page included)."""
+        return kv_nbytes(self.k) + kv_nbytes(self.v)
+
+    def page_nbytes(self) -> int:
+        """Device bytes of ONE physical page across both arenas and all
+        layers — the unit the bytes_shared/bytes_copied counters count."""
+        return self.kv_bytes() // (self.num_pages + 1)
+
+    def host_lengths(self) -> np.ndarray:
+        """One deliberate device->host fetch of the per-slot lengths
+        (tests and ``/statsz`` only, never the per-tick path)."""
+        return np.asarray(jax.device_get(self.lengths))  # noqa: PTA002 -- deliberate observability fetch (tests, /statsz); the tick loop never calls this
+
+    def __repr__(self):
+        return (f"PagedKVCache(slots={self.num_slots}, "
+                f"layers={self.num_layers}, max_seq={self.max_seq}, "
+                f"page={self.page_size}, pages={self.num_pages}, "
+                f"in_use={self.pool.pages_in_use}, "
+                f"active={len(self._active)})")

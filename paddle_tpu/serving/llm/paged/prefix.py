@@ -1,0 +1,292 @@
+"""PagedPrefixStore: zero-copy prefix sharing through the page arena.
+
+The slot-path :class:`~paddle_tpu.serving.llm.prefix.PrefixStore` keeps
+prefix K/V on HOST and bulk-copies it into a fresh slot on every hit —
+correct, but a hit still costs a device copy proportional to the prefix.
+With paged KV the rows never need to move: a cached prefix is just a
+list of PAGE IDS into the live arena. A hit pins those pages into the
+new sequence's block table (``PagedKVCache.adopt_shared_page`` — one
+refcount bump and one int32 table write per page, zero K/V bytes
+copied), and the store itself holds one pool reference per page so the
+rows survive as long as the entry does, even after every sharing
+sequence has finished.
+
+Copy-on-write: shared pages are IMMUTABLE by convention — a sequence
+never writes into a page whose pool refcount it does not exclusively
+own. The batcher enforces this at admission: full shared pages are
+adopted in place, and the first page the sequence will WRITE into (the
+partial page covering ``reuse_n .. prompt_len``, or the page right at
+the divergence point) is materialized via
+``PagedKVCache.adopt_copied_page`` — a one-page arena copy, the COW
+split. ``bytes_shared`` / ``bytes_copied`` counters make the zero-copy
+claim observable on ``/metricsz`` (the acceptance test asserts
+``bytes_copied == 0`` for page-aligned hits).
+
+Hashing reuses ``prefix.chain_hashes`` with ``block = page_size``, so
+equal chain values identify equal token prefixes at page granularity,
+verified byte-for-byte on lookup. Eviction is LRU by last hit under a
+PAGE budget; evicting an entry releases its pool references (pages
+whose last reference drops return to the free list — a sequence still
+sharing them keeps them alive through its own references).
+
+Thread safety: same discipline as the host store — every mutable
+structure guarded by ``self._lock``. Pool refcount mutations happen
+inside the store lock; the pool itself is only ever touched from the
+engine worker thread and admission path, which the batcher already
+serializes.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ....core import monitor as _mon
+from ..prefix import ShapeSig, chain_hashes
+from .pool import PagedKVCache
+
+
+class PagedPrefixEntry:
+    """One cached page-aligned prefix: the token prefix plus the page
+    ids holding its K/V rows in the arena. The payload is a *claim* on
+    live arena pages (the store holds one pool ref per page), not a
+    copy."""
+
+    __slots__ = ("key", "tokens", "page_ids", "n_tokens", "sig")
+
+    def __init__(self, key: bytes, tokens: np.ndarray,
+                 page_ids: Tuple[int, ...], sig: ShapeSig):
+        self.key = key
+        self.tokens = tokens
+        self.page_ids = tuple(int(p) for p in page_ids)
+        self.n_tokens = int(tokens.size)
+        self.sig = sig
+
+    def __repr__(self):
+        return (f"PagedPrefixEntry(n_tokens={self.n_tokens}, "
+                f"pages={len(self.page_ids)})")
+
+
+class PagedPrefixStore:
+    """Ref-counted, page-budgeted store of shared prefix pages."""
+
+    def __init__(self, kv: PagedKVCache,
+                 capacity_pages: Optional[int] = None,
+                 registry: Optional[_mon.StatRegistry] = None,
+                 stat_prefix: str = "serving.llm.prefix"):
+        self.kv = kv
+        self.page_size = kv.page_size
+        # default budget: a quarter of the pool may sit in cached
+        # prefixes — enough to keep hot system prompts resident without
+        # starving admission
+        self.capacity_pages = (max(1, kv.pool.num_pages // 4)
+                               if capacity_pages is None
+                               else int(capacity_pages))
+        self._registry = registry if registry is not None \
+            else _mon.default_registry()
+        self._prefix = stat_prefix
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, PagedPrefixEntry] = {}
+        self._index: Dict[bytes, bytes] = {}           # chain point -> key
+        self._refs: Dict[bytes, int] = {}
+        self._last_hit: Dict[bytes, int] = {}
+        self._tick = 0
+        self._pages = 0
+        self._bytes_shared = 0
+        self._bytes_copied = 0
+        self._hits = 0
+        self._misses = 0
+        self._stat_set("pages", 0)
+        self._stat_set("entries", 0)
+
+    # -- stats ---------------------------------------------------------------
+    def _stat_add(self, name, v):
+        self._registry.add(f"{self._prefix}.{name}", v)
+
+    def _stat_set(self, name, v):
+        self._registry.set(f"{self._prefix}.{name}", v)
+
+    def note_shared(self, nbytes: int):
+        """Record a zero-copy adoption: ``nbytes`` of prefix K/V reused
+        by table splice instead of being recomputed or copied."""
+        with self._lock:
+            self._bytes_shared += int(nbytes)
+        self._stat_add("bytes_shared", int(nbytes))
+
+    def note_copied(self, nbytes: int):
+        """Record bytes actually copied on a hit (COW splits of partial
+        pages) — the counter the zero-copy acceptance test pins at 0
+        for page-aligned prefixes."""
+        with self._lock:
+            self._bytes_copied += int(nbytes)
+        self._stat_add("bytes_copied", int(nbytes))
+
+    @property
+    def pages_used(self) -> int:
+        with self._lock:
+            return self._pages
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "pages": self._pages,
+                "capacity_pages": self.capacity_pages,
+                "page_size": self.page_size,
+                "pinned": sum(1 for n in self._refs.values() if n > 0),
+                "bytes_shared": self._bytes_shared,
+                "bytes_copied": self._bytes_copied,
+                "hits": self._hits,
+                "misses": self._misses,
+            }
+
+    # -- pin / unpin ---------------------------------------------------------
+    def unpin(self, entry: PagedPrefixEntry):
+        with self._lock:
+            if entry.key in self._refs:
+                self._refs[entry.key] = max(0, self._refs[entry.key] - 1)
+
+    # -- lookup / insert -----------------------------------------------------
+    def lookup(self, tokens, max_tokens: int,
+               sig: ShapeSig) -> Tuple[Optional[PagedPrefixEntry], int]:
+        """Longest cached prefix of ``tokens`` reusable at most
+        ``max_tokens`` tokens (a page multiple). A hit comes back
+        *pinned*; the caller adopts ``entry.page_ids[: n //
+        page_size]`` into its block table and unpins when the request
+        leaves the engine."""
+        toks = np.asarray(tokens, dtype=np.int32).reshape(-1)  # noqa: PTA002 -- admission-time view of the caller's host-side prompt
+        np_max = min(int(max_tokens), toks.size) // self.page_size
+        if np_max < 1:
+            with self._lock:
+                self._misses += 1
+            self._stat_add("misses", 1)
+            return None, 0
+        hashes = chain_hashes(toks, self.page_size)[:np_max]
+        with self._lock:
+            for i in range(len(hashes) - 1, -1, -1):
+                key = self._index.get(hashes[i])
+                if key is None:
+                    continue
+                entry = self._entries.get(key)
+                n = (i + 1) * self.page_size
+                if entry is None or entry.sig != sig \
+                        or entry.n_tokens < n \
+                        or not np.array_equal(entry.tokens[:n], toks[:n]):
+                    continue
+                self._tick += 1
+                self._last_hit[key] = self._tick
+                self._refs[key] = self._refs.get(key, 0) + 1
+                self._hits += 1
+                self._stat_add("hits", 1)
+                self._stat_add("hit_tokens", n)
+                return entry, n
+            self._misses += 1
+        self._stat_add("misses", 1)
+        return None, 0
+
+    def insert(self, tokens,
+               page_ids, sig: ShapeSig) -> Optional[PagedPrefixEntry]:
+        """Claim the pages holding a freshly prefilled prompt's
+        page-aligned prefix. ``page_ids``: the sequence's OWN pages
+        covering ``tokens[: len(page_ids) * page_size]`` — the store
+        retains each (so they outlive the sequence), copying nothing.
+        Returns the entry *pinned*; dedups against an existing entry
+        for the same chain (in which case no new refs are taken). May
+        evict LRU unpinned entries past the page budget."""
+        toks = np.asarray(tokens, dtype=np.int32).reshape(-1)  # noqa: PTA002 -- admission-time view of the caller's host-side prompt
+        page_ids = tuple(int(p) for p in page_ids)
+        n = len(page_ids) * self.page_size
+        if n < self.page_size or toks.size < n:
+            return None
+        toks = toks[:n]
+        hashes = chain_hashes(toks, self.page_size)
+        key = hashes[-1]
+        with self._lock:
+            existing_key = self._index.get(key)
+            if existing_key is not None:
+                existing = self._entries.get(existing_key)
+                if existing is not None and existing.sig == sig \
+                        and existing.n_tokens >= n \
+                        and np.array_equal(existing.tokens[:n], toks):
+                    self._tick += 1
+                    self._last_hit[existing.key] = self._tick
+                    self._refs[existing.key] = \
+                        self._refs.get(existing.key, 0) + 1
+                    return existing
+            for pid in page_ids:
+                self.kv.pool.retain(pid)
+            entry = PagedPrefixEntry(key, toks, page_ids, sig)
+            self._entries[key] = entry
+            self._pages += len(page_ids)
+            self._tick += 1
+            self._last_hit[key] = self._tick
+            self._refs[key] = 1
+            for h in hashes:
+                self._index[h] = key
+            if self._pages > self.capacity_pages:
+                recency = dict(self._last_hit)
+                victims = sorted(
+                    (vk for vk, e in self._entries.items()
+                     if self._refs.get(vk, 0) == 0),
+                    key=lambda vk: recency.get(vk, 0))
+                for vk in victims:
+                    if self._pages <= self.capacity_pages:
+                        break
+                    self._evict_locked(vk)
+            self._stat_add("inserts", 1)
+            self._stat_set("pages", self._pages)
+            self._stat_set("entries", len(self._entries))
+            return entry
+
+    def _evict_locked(self, key: bytes):
+        victim = self._entries.pop(key)  # noqa: PTA006 -- _locked suffix contract: all callers hold self._lock
+        self._pages -= len(victim.page_ids)  # noqa: PTA006 -- _locked suffix contract: all callers hold self._lock
+        self._refs.pop(key, None)  # noqa: PTA006 -- _locked suffix contract: all callers hold self._lock
+        self._last_hit.pop(key, None)  # noqa: PTA006 -- _locked suffix contract: all callers hold self._lock
+        stale = [h for h, k2 in self._index.items() if k2 == key]  # noqa: PTA006 -- _locked suffix contract: all callers hold self._lock
+        for h in stale:
+            del self._index[h]  # noqa: PTA006 -- _locked suffix contract: all callers hold self._lock
+        for pid in victim.page_ids:
+            self.kv.pool.release(pid)
+        self._stat_add("evictions", 1)
+
+    def evict_unpinned(self, need_pages: int) -> int:
+        """Drop LRU unpinned entries until ``need_pages`` pool pages
+        were released (or no victims remain). The batcher's admission
+        fallback when the pool runs dry. Returns pages released."""
+        released = 0
+        with self._lock:
+            recency = dict(self._last_hit)
+            victims = sorted(
+                (vk for vk in self._entries
+                 if self._refs.get(vk, 0) == 0),
+                key=lambda vk: recency.get(vk, 0))
+            for vk in victims:
+                if released >= need_pages:
+                    break
+                released += len(self._entries[vk].page_ids)
+                self._evict_locked(vk)
+            self._stat_set("pages", self._pages)
+            self._stat_set("entries", len(self._entries))
+        return released
+
+    def clear(self):
+        """Drop every entry (pinned or not), releasing all page refs —
+        engine-teardown path, pairs with ``PagedKVCache.reset`` leak
+        accounting in tests."""
+        with self._lock:
+            for key in list(self._entries):
+                self._evict_locked(key)
+            self._stat_set("pages", self._pages)
+            self._stat_set("entries", len(self._entries))
+
+    def __repr__(self):
+        with self._lock:
+            return (f"PagedPrefixStore(entries={len(self._entries)}, "
+                    f"pages={self._pages}/{self.capacity_pages}, "
+                    f"page={self.page_size})")

@@ -105,6 +105,12 @@ class PrefixStore:
         self._last_hit: Dict[bytes, int] = {}
         self._tick = 0                                  # recency clock
         self._bytes = 0
+        # copied-vs-shared accounting: the host store COPIES every reused
+        # byte into the hitting slot (bytes_copied), the paged store
+        # shares pages by refcount (bytes_shared) — both surface the same
+        # two counters so /metricsz can prove the zero-copy claim
+        self._bytes_copied = 0
+        self._bytes_shared = 0
         self._stat_set("bytes", 0)
         self._stat_set("entries", 0)
 
@@ -114,6 +120,20 @@ class PrefixStore:
 
     def _stat_set(self, name, v):
         self._registry.set(f"{self._prefix}.{name}", v)
+
+    def note_copied(self, nbytes: int):
+        """Record reused-prefix bytes that were COPIED into a slot (the
+        host store's bulk insert path)."""
+        with self._lock:
+            self._bytes_copied += int(nbytes)
+        self._stat_add("bytes_copied", int(nbytes))
+
+    def note_shared(self, nbytes: int):
+        """Record reused-prefix bytes shared WITHOUT a copy (always 0
+        for the host store; the paged store's table-splice path)."""
+        with self._lock:
+            self._bytes_shared += int(nbytes)
+        self._stat_add("bytes_shared", int(nbytes))
 
     @property
     def bytes_used(self) -> int:
@@ -132,6 +152,8 @@ class PrefixStore:
                 "capacity_bytes": self.capacity_bytes,
                 "block_tokens": self.block_tokens,
                 "pinned": sum(1 for n in self._refs.values() if n > 0),
+                "bytes_copied": self._bytes_copied,
+                "bytes_shared": self._bytes_shared,
             }
 
     # -- pin / unpin ---------------------------------------------------------

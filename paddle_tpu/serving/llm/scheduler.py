@@ -178,6 +178,10 @@ class LLMEngineConfig:
                  role: str = "mixed",
                  weight_dtype: str = "float32",
                  kv_dtype: str = "float32",
+                 kv_layout: str = "slot",
+                 page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 paged_attn_impl: str = "auto",
                  stat_prefix: str = "serving.llm"):
         self.num_slots = int(num_slots)
         self.max_seq = int(max_seq)
@@ -235,6 +239,32 @@ class LLMEngineConfig:
                 "in place. Set kv_dtype='float32' or spec_k=0.")
         self.weight_dtype = weight_dtype
         self.kv_dtype = kv_dtype
+        # paged KV substrate (docs/serving.md "Paged KV cache"): fixed
+        # page_size-token pages in one arena, admission on pages at
+        # current lengths instead of worst-case max_seq slots
+        if kv_layout not in ("slot", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}")
+        if paged_attn_impl not in ("auto", "gather", "kernel"):
+            raise ValueError(
+                f"paged_attn_impl must be 'auto', 'gather' or 'kernel', "
+                f"got {paged_attn_impl!r}")
+        self.kv_layout = kv_layout
+        self.page_size = int(page_size)
+        self.num_pages = None if num_pages is None else int(num_pages)
+        self.paged_attn_impl = paged_attn_impl
+        if kv_layout == "paged":
+            if self.page_size < 1 or self.max_seq % self.page_size:
+                raise ValueError(
+                    f"page_size {self.page_size} must divide "
+                    f"max_seq {self.max_seq} (the gather lane's bitwise "
+                    f"parity relies on whole-page rows)")
+            if self.num_pages is not None and self.num_pages < \
+                    self.max_seq // self.page_size:
+                raise ValueError(
+                    f"num_pages {self.num_pages} cannot hold even one "
+                    f"max_seq sequence "
+                    f"({self.max_seq // self.page_size} pages)")
         self.stat_prefix = stat_prefix
 
     @property
@@ -364,6 +394,9 @@ class ContinuousBatcher:
             # hit: bulk-copy the cached head, prefill only the tail bucket
             self.decoder.insert_prefix(
                 self.kv, entry.k[:, :reuse_n], entry.v[:, :reuse_n], slot)
+            self.prefix_store.note_copied(
+                int(entry.k[:, :reuse_n].nbytes
+                    + entry.v[:, :reuse_n].nbytes))
             req._prefix_entry = entry       # stays pinned until release
             tail = req.prompt[reuse_n:]
             lt = self.config.bucket_for(int(tail.size))
@@ -684,39 +717,77 @@ class LLMEngine(DrainableEngineBase):
         # LLM engine shares executables and counters with Predictors and
         # batch engines instead of holding a private per-engine cache.
         self._cache = cache if cache is not None else default_cache()
-        self._decoder = GPTStaticDecoder(
-            model, max_top_k=self._config.max_top_k, exec_cache=self._cache,
-            mesh=mesh, slot_axis=slot_axis,
-            weight_dtype=self._config.weight_dtype,
-            kv_dtype=self._config.kv_dtype)
-        # prefix reuse: an explicit store (the disaggregated fleet shares
-        # ONE across replicas for the prefill->decode KV handoff) enables
-        # it even when the config flag is off
-        self._prefix_store = prefix_store
-        if prefix_store is not None and self._config.kv_dtype == "int8":
-            raise ValueError(
-                "a shared PrefixStore requires a dense KV cache "
-                "(kv_dtype='float32'): prefix export/insert moves raw "
-                "f32 rows between engines")
-        if self._prefix_store is None and self._config.prefix_cache:
-            self._prefix_store = PrefixStore(
-                capacity_bytes=int(
-                    self._config.prefix_capacity_mb * (1 << 20)),
-                block_tokens=self._config.prefix_block,
-                registry=self._registry,
-                stat_prefix=f"{self._config.stat_prefix}.prefix")
-        spec_decoder = None
-        if self._config.spec_k > 0:
-            if draft_model is None:
+        if self._config.kv_layout == "paged":
+            # lazy import: paged/batcher imports this module's classes
+            from .paged import (GPTPagedDecoder, GPTPagedSpecDecoder,
+                                PagedBatcher)
+            if mesh is not None:
+                raise NotImplementedError(
+                    "kv_layout='paged' over a slot-sharded mesh is not "
+                    "supported yet — use kv_layout='slot' with a mesh")
+            if prefix_store is not None:
+                raise NotImplementedError(
+                    "paged engines share prefix pages inside their own "
+                    "arena; an external PrefixStore cannot be attached "
+                    "— set prefix_cache=True instead")
+            self._decoder = GPTPagedDecoder(
+                model, max_top_k=self._config.max_top_k,
+                exec_cache=self._cache,
+                weight_dtype=self._config.weight_dtype,
+                kv_dtype=self._config.kv_dtype,
+                page_size=self._config.page_size,
+                num_pages=self._config.num_pages,
+                attn_impl=self._config.paged_attn_impl)
+            spec_decoder = None
+            if self._config.spec_k > 0:
+                if draft_model is None:
+                    raise ValueError(
+                        "spec_k > 0 requires a draft_model (the small "
+                        "GPT that proposes candidate tokens)")
+                spec_decoder = GPTPagedSpecDecoder(
+                    self._decoder, draft_model, k=self._config.spec_k,
+                    exec_cache=self._cache)
+            self._batcher = PagedBatcher(
+                self._decoder, self._config, self._registry,
+                spec_decoder=spec_decoder)
+            # the batcher builds its PagedPrefixStore (it needs the live
+            # arena); surface it on the engine like the host store
+            self._prefix_store = self._batcher.prefix_store
+        else:
+            self._decoder = GPTStaticDecoder(
+                model, max_top_k=self._config.max_top_k,
+                exec_cache=self._cache,
+                mesh=mesh, slot_axis=slot_axis,
+                weight_dtype=self._config.weight_dtype,
+                kv_dtype=self._config.kv_dtype)
+            # prefix reuse: an explicit store (the disaggregated fleet
+            # shares ONE across replicas for the prefill->decode KV
+            # handoff) enables it even when the config flag is off
+            self._prefix_store = prefix_store
+            if prefix_store is not None and self._config.kv_dtype == "int8":
                 raise ValueError(
-                    "spec_k > 0 requires a draft_model (the small GPT "
-                    "that proposes candidate tokens)")
-            spec_decoder = GPTSpecDecoder(
-                self._decoder, draft_model, k=self._config.spec_k,
-                exec_cache=self._cache)
-        self._batcher = ContinuousBatcher(
-            self._decoder, self._config, self._registry,
-            prefix_store=self._prefix_store, spec_decoder=spec_decoder)
+                    "a shared PrefixStore requires a dense KV cache "
+                    "(kv_dtype='float32'): prefix export/insert moves raw "
+                    "f32 rows between engines")
+            if self._prefix_store is None and self._config.prefix_cache:
+                self._prefix_store = PrefixStore(
+                    capacity_bytes=int(
+                        self._config.prefix_capacity_mb * (1 << 20)),
+                    block_tokens=self._config.prefix_block,
+                    registry=self._registry,
+                    stat_prefix=f"{self._config.stat_prefix}.prefix")
+            spec_decoder = None
+            if self._config.spec_k > 0:
+                if draft_model is None:
+                    raise ValueError(
+                        "spec_k > 0 requires a draft_model (the small GPT "
+                        "that proposes candidate tokens)")
+                spec_decoder = GPTSpecDecoder(
+                    self._decoder, draft_model, k=self._config.spec_k,
+                    exec_cache=self._cache)
+            self._batcher = ContinuousBatcher(
+                self._decoder, self._config, self._registry,
+                prefix_store=self._prefix_store, spec_decoder=spec_decoder)
         self._queue = BatchQueue(max_size=self._config.max_queue)
         if self._config.warmup:
             self._batcher.warmup()
@@ -898,6 +969,12 @@ class LLMEngine(DrainableEngineBase):
             "spec_k": self._config.spec_k,
             "prefix_store": (self._prefix_store.stats()
                              if self._prefix_store is not None else None),
+            "kv_layout": self._config.kv_layout,
+            "pages": ({"total": self._batcher.kv.pool.num_pages,
+                       "free": self._batcher.kv.pool.free_pages,
+                       "cow_splits": self._batcher.kv.cow_splits,
+                       "pending": len(self._batcher._pending)}
+                      if self._config.kv_layout == "paged" else None),
         }
 
     # -- worker --------------------------------------------------------------

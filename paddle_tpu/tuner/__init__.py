@@ -29,16 +29,20 @@ import threading
 from typing import Any, Dict, Optional, Tuple
 
 from . import runner, space, store
-from .space import compress_block_candidates, flash_candidates, nms_candidates
+from .space import (compress_block_candidates, flash_candidates,
+                    nms_candidates, paged_attn_candidates)
 from .store import CACHE_VERSION, WinnerStore, cache_dir, store_for
 
 __all__ = [
     "CACHE_VERSION", "WinnerStore", "cache_dir", "store_for",
-    "flash_key", "nms_key", "compress_key",
+    "flash_key", "nms_key", "compress_key", "paged_key",
     "get_flash_blocks", "get_nms_config", "get_compress_block",
+    "get_paged_attn_config",
     "record_winner", "autotune_flash", "autotune_compress",
+    "autotune_paged_attn",
     "tune_on_miss_enabled",
     "flash_candidates", "nms_candidates", "compress_block_candidates",
+    "paged_attn_candidates",
     "clear_memo",
 ]
 
@@ -97,6 +101,22 @@ def flash_key(q_len: int, kv_len: int, head_dim: int, dtype: str,
             f"|k{_ceil16(kv_len)}|c{int(bool(causal))}")
 
 
+def paged_key(num_heads: int, head_dim: int, page_size: int, dtype: str,
+              platform: Optional[str] = None) -> str:
+    """Key for the paged decode-attention family
+    (``ops/paged_attention.py``). The query is always one token per
+    sequence, so the shape family is (heads, head_dim, page_size) — the
+    sequence count only scales the grid, not the per-step block."""
+    p = platform or _platform()
+    try:                 # canonicalize: np.dtype / jnp scalar type / str
+        import numpy as _np
+        dtype = _np.dtype(dtype).name
+    except TypeError:
+        dtype = str(dtype)
+    return (f"paged_attn|{p}|{dtype}|h{int(num_heads)}|d{int(head_dim)}"  # noqa: PTA001 -- heads/head_dim/page_size are python shape ints at trace time
+            f"|p{int(page_size)}")  # noqa: PTA001 -- see above
+
+
 def nms_key(k: int, platform: Optional[str] = None) -> str:
     return f"nms|{platform or _platform()}|k{int(k)}"
 
@@ -147,6 +167,14 @@ def get_spec_verify_blocks(k: int, kv_len: int, head_dim: int,
     16-multiple families `flash_key` uses), so verify reuses the flash
     winner memo instead of growing a new family."""
     return get_flash_blocks(k + 1, kv_len, head_dim, dtype, causal=True)
+
+
+def get_paged_attn_config(num_heads: int, head_dim: int, page_size: int,
+                          dtype: str) -> Optional[Dict[str, Any]]:
+    """The tuned config (``{"block_h": ...}``) for a paged
+    decode-attention shape, or None when no winner is known (the kernel
+    applies its dividing heuristic)."""
+    return _resolve(paged_key(num_heads, head_dim, page_size, dtype))
 
 
 def get_nms_config(k: int) -> Optional[Dict[str, Any]]:
@@ -247,6 +275,58 @@ def autotune_flash(batch_heads: int, q_len: int, kv_len: int,
     if record:
         record_winner(flash_key(q_len, kv_len, head_dim, dtype, causal,
                                 ring=ring, bwd=bwd), cfg, us=us)
+    return dict(cfg, us=us, results=results)
+
+
+def autotune_paged_attn(num_seqs: int, num_heads: int, head_dim: int,
+                        page_size: int, pages_per_seq: int = 8,
+                        dtype: str = "float32", trials: int = 5,
+                        interpret: Optional[bool] = None,
+                        record: bool = True) -> Dict[str, Any]:
+    """Search ``block_h`` for one paged decode-attention shape by timing
+    the real kernel over a synthetic full arena (every sequence owns
+    ``pages_per_seq`` disjoint pages, positions at the last row — the
+    worst-case page walk), and (by default) persist the winner under
+    :func:`paged_key`."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops.paged_attention import paged_attention
+
+    if interpret is None:
+        interpret = _platform() != "tpu"
+    jdt = jnp.dtype(dtype)
+    num_pages = num_seqs * pages_per_seq
+    kq = jax.random.PRNGKey(0)
+    q = jax.random.normal(kq, (num_seqs, num_heads, head_dim), jdt)
+    k_arena = jax.random.normal(
+        kq, (num_pages + 1, page_size, num_heads, head_dim), jdt)
+    v_arena = jax.random.normal(
+        jax.random.PRNGKey(1), k_arena.shape, jdt)
+    bt = jnp.arange(num_pages, dtype=jnp.int32).reshape(
+        num_seqs, pages_per_seq)
+    positions = jnp.full((num_seqs,), pages_per_seq * page_size - 1,
+                         jnp.int32)
+    cands = paged_attn_candidates(num_heads, head_dim, page_size,
+                                  itemsize=jdt.itemsize)
+
+    def make_runner(cand):
+        bh = int(cand["block_h"])
+        fn = jax.jit(lambda qq, kk, vv, b, p: paged_attention(  # noqa: PTA008 -- per-candidate kernels differ (block_h baked in); tuner intentionally compiles each once
+            qq, kk, vv, b, p, block_h=bh, interpret=interpret))
+        return lambda: fn(q, k_arena, v_arena, bt, positions)
+
+    best, best_t, results = runner.search(cands, make_runner,
+                                          trials=trials)
+    if best is None:
+        raise RuntimeError(
+            f"autotune_paged_attn: no candidate built for shape "
+            f"(s={num_seqs}, h={num_heads}, d={head_dim}, "
+            f"page={page_size}, {dtype})")
+    cfg = {"block_h": int(best["block_h"])}
+    us = best_t * 1e6
+    if record:
+        record_winner(paged_key(num_heads, head_dim, page_size, dtype),
+                      cfg, us=us)
     return dict(cfg, us=us, results=results)
 
 

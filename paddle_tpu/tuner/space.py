@@ -80,6 +80,39 @@ def flash_candidates(q_len: int, kv_len: int, head_dim: int,
     return out
 
 
+#: head-block candidates for the paged decode-attention family
+#: (ops/paged_attention.py): how many heads share one grid step's page
+#: DMA and dot. Must divide num_heads (the grid is H // block_h).
+PAGED_BLOCK_H = (1, 2, 4, 8, 16, 32)
+
+
+def paged_attn_vmem_bytes(block_h: int, page_size: int, head_dim: int,
+                          itemsize: int = 4) -> int:
+    """VMEM-resident bytes for one paged-attention program instance: the
+    q/out head block, one K and one V page block, the f32 accumulator and
+    the (block_h, 128)-padded running max/sum scratch."""
+    q_blk = block_h * head_dim * itemsize
+    kv_blk = 2 * page_size * block_h * head_dim * itemsize
+    scores = block_h * page_size * 4
+    acc = block_h * head_dim * 4
+    stats = 2 * block_h * 128 * 4
+    out = block_h * head_dim * itemsize
+    return q_blk + kv_blk + scores + acc + stats + out
+
+
+def paged_attn_candidates(num_heads: int, head_dim: int, page_size: int,
+                          itemsize: int = 4) -> List[Dict[str, int]]:
+    """block_h candidates for a paged decode-attention shape: divisors of
+    ``num_heads`` only (the grid needs exact head tiling), VMEM pruned —
+    though at decode page sizes the footprint is tiny, so pruning only
+    bites on pathological page_size * head_dim products."""
+    out = [{"block_h": b} for b in PAGED_BLOCK_H
+           if b <= num_heads and num_heads % b == 0
+           and paged_attn_vmem_bytes(b, page_size, head_dim,
+                                     itemsize) <= VMEM_BUDGET]
+    return out or [{"block_h": 1}]
+
+
 #: candidate block sizes for the compressed-allreduce quantize stage.
 #: Smaller blocks track outliers better (tighter scales) but pay more
 #: scale-sidecar bytes; larger blocks amortize the sidecar but let one

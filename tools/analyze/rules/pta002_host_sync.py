@@ -37,6 +37,10 @@ HOT_PREFIXES = (
     # sanctioned fetches (per-tick token vector, admission-time first
     # token) carry noqa justifications.
     "paddle_tpu/serving/llm/",
+    # redundant with the parent prefix, listed so the paged-KV tick
+    # (block-table updates run every token) stays covered even if the
+    # parent entry is ever narrowed
+    "paddle_tpu/serving/llm/paged/",
     # replica router dispatch path: submit/_pick run per request and the
     # health sweep runs continuously; a host sync here stalls admission
     # for every replica at once

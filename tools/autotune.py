@@ -56,6 +56,14 @@ BENCH_FLASH_BWD_SHAPES = [
     (512, 512, 64, "bfloat16", False, True),     # ring chunk Tl=512
 ]
 
+#: (num_seqs, num_heads, head_dim, page_size, dtype) — paged decode
+#: attention (``ops/paged_attention.py``); the family key only uses
+#: (heads, head_dim, page, dtype), num_seqs just sizes the search grid
+BENCH_PAGED_SHAPES = [
+    (48, 12, 64, 16, "bfloat16"),   # GPT-small paged serving lane, amp
+    (48, 12, 64, 16, "float32"),    # same, no autocast
+]
+
 #: (nelems, wire_dtype) — gradient-size families for the compressed
 #: allreduce quantize stage (pow2-bucketed by compress_key, so one entry
 #: covers the whole bucket)
@@ -70,6 +78,9 @@ QUICK_FLASH_SHAPES = [
 QUICK_FLASH_BWD_SHAPES = [
     (128, 128, 32, "float32", True, False),
     (64, 64, 32, "float32", False, True),
+]
+QUICK_PAGED_SHAPES = [
+    (4, 4, 8, 8, "float32"),        # tiny CI model geometry
 ]
 QUICK_NMS_KS = [64]
 QUICK_COMPRESS_SIZES = [(1 << 16, "int8")]
@@ -133,6 +144,20 @@ def tune_nms_lane(ks, trials, interpret):
     return results
 
 
+def tune_paged_lane(shapes, trials):
+    from paddle_tpu import tuner
+
+    results = {}
+    for num_seqs, heads, d, page, dtype in shapes:
+        key = tuner.paged_key(heads, d, page, dtype)
+        win = tuner.autotune_paged_attn(num_seqs, heads, d, page,
+                                        dtype=dtype, trials=trials)
+        print(f"paged {key}: block_h={win['block_h']} "
+              f"({win['us']:.0f}us, {len(win['results'])} candidates)")
+        results[key] = {"block_h": win["block_h"]}
+    return results
+
+
 def tune_compress_lane(sizes, trials):
     from paddle_tpu import tuner
 
@@ -185,7 +210,8 @@ def main(argv=None):
                     help="leading batch*heads dim for flash search "
                          "arrays (default %(default)s)")
     ap.add_argument("--only",
-                    choices=["flash", "flash-bwd", "nms", "compress"],
+                    choices=["flash", "flash-bwd", "paged", "nms",
+                             "compress"],
                     help="restrict to one kernel family")
     ap.add_argument("--emit-defaults", nargs="?", metavar="PATH",
                     const=os.path.join(REPO, "paddle_tpu", "tuner",
@@ -203,6 +229,7 @@ def main(argv=None):
     flash_shapes = QUICK_FLASH_SHAPES if quick else BENCH_FLASH_SHAPES
     flash_bwd_shapes = (QUICK_FLASH_BWD_SHAPES if quick
                         else BENCH_FLASH_BWD_SHAPES)
+    paged_shapes = QUICK_PAGED_SHAPES if quick else BENCH_PAGED_SHAPES
     nms_ks = QUICK_NMS_KS if quick else BENCH_NMS_KS
     compress_sizes = (QUICK_COMPRESS_SIZES if quick
                       else BENCH_COMPRESS_SIZES)
@@ -219,6 +246,8 @@ def main(argv=None):
     if args.only in (None, "flash-bwd"):
         tuned.update(tune_flash_lane(flash_bwd_shapes, args.trials,
                                      args.batch_heads, bwd=True))
+    if args.only in (None, "paged"):
+        tuned.update(tune_paged_lane(paged_shapes, args.trials))
     if args.only in (None, "nms"):
         tuned.update(tune_nms_lane(nms_ks, args.trials, interpret))
     if args.only in (None, "compress"):

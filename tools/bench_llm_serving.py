@@ -42,9 +42,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from concurrent.futures import wait
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _synthetic_gpt(vocab, hidden, layers, heads, max_pos, seed=0):
@@ -198,6 +201,184 @@ def run_prefix_ab(model, args):
     return doc
 
 
+def _paged_trace_prompts(requests, vocab, max_seq, max_new, seed=0):
+    """Mixed realistic lengths: a clipped lognormal (chat traffic is a
+    short head with a long tail), far below ``max_seq`` on average —
+    the regime where worst-case slot reservation wastes almost the whole
+    KV arena and page-granular admission does not."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    lens = np.clip(rng.lognormal(3.2, 0.7, size=requests).astype(int),
+                   4, max_seq - max_new - 1)
+    return [rng.randint(0, vocab, size=int(n)).astype(np.int32)
+            for n in lens]
+
+
+def run_paged_burst(model, prompts, max_new, num_slots, max_seq,
+                    kv_layout, page_size=16, num_pages=None):
+    """Submit the whole trace at once and poll the scheduler's resident
+    set while the burst drains: ``peak_concurrent`` is how many sequences
+    the KV memory actually held simultaneously. Returns the generated
+    token lists too, so the caller can prove slot-vs-paged greedy decode
+    is bitwise identical on the same trace."""
+    import threading
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    kw = {}
+    if kv_layout == "paged":
+        kw = {"kv_layout": "paged", "page_size": page_size,
+              "num_pages": num_pages}
+    engine = LLMEngine(model, LLMEngineConfig(
+        num_slots=num_slots, max_seq=max_seq,
+        max_queue=max(1024, len(prompts)),
+        default_max_new_tokens=max_new, **kw),
+        registry=StatRegistry())
+    peak = [0]
+    stop = threading.Event()
+
+    def _poll():
+        while not stop.is_set():
+            peak[0] = max(peak[0], len(engine._batcher._reqs))
+            stop.wait(0.002)
+
+    poller = threading.Thread(target=_poll, daemon=True)
+    t0 = time.monotonic()
+    reqs = [engine.submit(p, max_new_tokens=max_new) for p in prompts]
+    poller.start()
+    wait([r.future for r in reqs], timeout=600)
+    stop.set()
+    poller.join(timeout=5)
+    wall = time.monotonic() - t0
+    tokens = [r.future.result()["tokens"] for r in reqs]
+    reg, pre = engine.registry, engine.config.stat_prefix
+    out = {
+        "kv_layout": kv_layout,
+        "num_slots": num_slots,
+        "requests": len(prompts),
+        "peak_concurrent": peak[0],
+        "wall_s": round(wall, 4),
+        "throughput_tok_s": round(
+            reg.get(f"{pre}.tokens_generated") / wall, 2),
+        "evicted_midstream": reg.get(f"{pre}.evicted_midstream"),
+    }
+    if kv_layout == "paged":
+        kv = engine._batcher.kv
+        out.update(page_size=page_size, num_pages=kv.pool.num_pages,
+                   kv_bytes=kv.kv_bytes(),
+                   peak_pages_in_use=kv.pool.peak_in_use,
+                   cow_splits=kv.cow_splits)
+    engine.drain()
+    return out, tokens
+
+
+def run_paged_prefix_phase(model, page_size, num_pages, num_slots,
+                           max_seq, max_new, vocab, requests=12, seed=1):
+    """Shared page-aligned system prompt through a paged engine with the
+    prefix store on: every hit must splice pages by refcount — zero
+    copied bytes, ``bytes_shared`` exactly hits * shared pages."""
+    import numpy as np
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+
+    rng = np.random.RandomState(seed)
+    shared_pages = 8
+    shared = rng.randint(0, vocab,
+                         size=shared_pages * page_size).astype(np.int32)
+    engine = LLMEngine(model, LLMEngineConfig(
+        num_slots=num_slots, max_seq=max_seq,
+        max_queue=max(1024, requests), default_max_new_tokens=max_new,
+        kv_layout="paged", page_size=page_size, num_pages=num_pages,
+        prefix_cache=True), registry=StatRegistry())
+    for _ in range(requests):
+        tail = rng.randint(0, vocab, size=7).astype(np.int32)
+        engine.generate(np.concatenate([shared, tail]),
+                        max_new_tokens=max_new)
+    ps = engine.prefix_store.stats()
+    page_nbytes = engine._batcher.kv.page_nbytes()
+    expect_shared = ps["hits"] * shared_pages * page_nbytes
+    out = {
+        "requests": requests,
+        "shared_tokens": int(shared.size),
+        "shared_pages": shared_pages,
+        "hits": ps["hits"],
+        "misses": ps["misses"],
+        "bytes_shared": ps["bytes_shared"],
+        "bytes_copied": ps["bytes_copied"],
+        "expected_bytes_shared": expect_shared,
+        "zero_copy": (ps["bytes_copied"] == 0 and ps["hits"] > 0
+                      and ps["bytes_shared"] == expect_shared),
+    }
+    engine.drain()
+    return out
+
+
+def run_paged_ab(model, args):
+    """The slot-vs-paged burst A/B at a byte-equal KV budget (the paged
+    arena carries one extra trash page), plus the zero-copy prefix
+    phase."""
+    prompts = _paged_trace_prompts(args.requests, args.vocab,
+                                   args.max_seq, args.max_new)
+    # byte parity: the paged arena holds exactly the slot path's rows
+    num_pages = args.num_slots * args.max_seq // args.page_size
+    slot, slot_toks = run_paged_burst(
+        model, prompts, args.max_new, args.num_slots, args.max_seq,
+        kv_layout="slot")
+    paged, paged_toks = run_paged_burst(
+        model, prompts, args.max_new, args.paged_slots, args.max_seq,
+        kv_layout="paged", page_size=args.page_size, num_pages=num_pages)
+    prefix = run_paged_prefix_phase(
+        model, args.page_size, num_pages, args.paged_slots, args.max_seq,
+        args.max_new, args.vocab)
+    ratio = round(paged["peak_concurrent"]
+                  / max(1, slot["peak_concurrent"]), 2)
+    match = slot_toks == paged_toks
+    doc = {
+        "bench": "llm-paged-trace",
+        "geometry": {
+            "vocab": args.vocab, "hidden": args.hidden,
+            "layers": args.layers, "heads": args.heads,
+            "max_seq": args.max_seq, "max_new": args.max_new,
+            "requests": args.requests, "page_size": args.page_size,
+            "slot_slots": args.num_slots,
+            "paged_slots": args.paged_slots,
+            "num_pages": num_pages,
+        },
+        "slot": slot,
+        "paged": paged,
+        "prefix": prefix,
+        "concurrency_ratio": ratio,
+        "greedy_bitwise_match": match,
+        "check": {
+            "concurrency_ratio_ge_5": ratio >= 5.0,
+            "greedy_bitwise_match": match,
+            "prefix_zero_copy": prefix["zero_copy"],
+        },
+    }
+    return doc
+
+
+def check_paged_doc(doc, baseline_path):
+    """Gate a --paged-trace doc against the committed baseline: same
+    geometry (so the ratio can't be gamed by shrinking the slot lane),
+    every in-doc invariant true, and the concurrency ratio no worse than
+    80% of the committed run (and never below the 5x acceptance bar)."""
+    problems = [f"{k} failed" for k, ok in doc["check"].items() if not ok]
+    try:
+        with open(baseline_path) as f:
+            base = json.load(f)
+    except (OSError, ValueError) as e:
+        return problems + [f"baseline unreadable at {baseline_path}: {e}"]
+    if base.get("geometry") != doc["geometry"]:
+        problems.append(f"geometry drifted from baseline: "
+                        f"{base.get('geometry')} != {doc['geometry']}")
+    floor = max(5.0, 0.8 * float(base.get("concurrency_ratio", 5.0)))
+    if doc["concurrency_ratio"] < floor:
+        problems.append(f"concurrency_ratio {doc['concurrency_ratio']} "
+                        f"< floor {floor:.2f}")
+    return problems
+
+
 def run_baseline(model, batch, prompt_len, new_tokens, vocab, seed=0):
     """Static-slot vs concat-grown decode through the SAME
     ``model.generate`` entry point: cold (includes tracing) and warm
@@ -263,10 +444,40 @@ def main(argv=None) -> int:
                     help="common-prefix length in tokens")
     ap.add_argument("--tail-len", type=int, default=8,
                     help="unique tail length behind the shared prefix")
+    ap.add_argument("--paged-trace", action="store_true",
+                    help="run the slot-vs-paged mixed-length burst A/B "
+                         "(byte-equal KV budget) plus the zero-copy "
+                         "prefix phase instead of the load sweep")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="paged-trace: tokens per KV page")
+    ap.add_argument("--paged-slots", type=int, default=48,
+                    help="paged-trace: sequence slots for the paged "
+                         "engine (its concurrency is page-bound, not "
+                         "slot-bound)")
+    ap.add_argument("--paged-baseline",
+                    default=os.path.join(REPO, "bench_llm_paged.json"),
+                    help="paged-trace: committed baseline the --check "
+                         "gate compares against")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="with --paged-trace: record this run as the "
+                         "committed baseline")
     ap.add_argument("--check", action="store_true",
                     help="with --prefix-trace: exit 1 unless hit_rate >= "
-                         "0.5 and reuse-on TTFT p50 beats reuse-off")
+                         "0.5 and reuse-on TTFT p50 beats reuse-off; "
+                         "with --paged-trace: gate the >=5x concurrency "
+                         "ratio, greedy bitwise parity and zero-copy "
+                         "prefix invariants against the committed "
+                         "bench_llm_paged.json")
     args = ap.parse_args(argv)
+
+    if args.paged_trace:
+        # a paged-vs-slot A/B needs room for the length spread: upgrade
+        # any knob left at its load-sweep default to the trace config
+        # (4 worst-case slots vs a byte-equal page pool)
+        for k, v in {"max_seq": 512, "num_slots": 4, "requests": 48,
+                     "max_new": 8}.items():
+            if getattr(args, k) == ap.get_default(k):
+                setattr(args, k, v)
 
     if args.prefix_trace:
         # the A/B needs prefill FLOPs to dominate jit dispatch overhead
@@ -293,6 +504,25 @@ def main(argv=None) -> int:
         if args.check and not all(doc["check"].values()):
             print(f"FAIL: {doc['check']}", file=sys.stderr)
             return 1
+        return 0
+
+    if args.paged_trace:
+        doc = run_paged_ab(model, args)
+        json.dump(doc, sys.stdout, indent=2)
+        print()
+        if args.write_baseline:
+            with open(args.paged_baseline, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+            print(f"baseline written to {args.paged_baseline}",
+                  file=sys.stderr)
+        if args.check:
+            problems = check_paged_doc(doc, args.paged_baseline)
+            if problems:
+                print("FAIL:", file=sys.stderr)
+                for p in problems:
+                    print(f"  - {p}", file=sys.stderr)
+                return 1
         return 0
     prompt_lens = [int(s) for s in args.prompt_lens.split(",") if s.strip()]
     loads = [float(x) for x in args.loads.split(",") if x.strip()]

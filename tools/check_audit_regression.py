@@ -40,6 +40,10 @@ BASELINE = os.path.join(REPO, "bench_audit_baseline.json")
 #: the bench step paths under the gate
 ENTRYPOINTS = ("resnet_train_step", "gpt_train_step",
                "llm_spec_decode_step",
+               # paged-KV serving decode (serving/llm/paged/): the block
+               # table rides the device step — must stay one host fetch
+               # per tick, zero retraces after warmup
+               "llm_paged_decode_step",
                # quantized hot paths (docs/quantization.md): the
                # compressed-gradient dp train step and the int8 serving
                # decode step — both must keep zero host transfers

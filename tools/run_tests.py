@@ -69,9 +69,11 @@ def main():
     ap.add_argument("--bench-llm", action="store_true",
                     help="opt-in gate: run tools/bench_llm_serving.py "
                          "--prefix-trace --check (80%% shared-prefix "
-                         "trace) and fail unless the prefix KV store hit "
-                         "rate is >=0.5 and reuse-on TTFT p50 beats "
-                         "reuse-off")
+                         "trace; prefix hit rate >=0.5, reuse-on TTFT "
+                         "p50 beats reuse-off) then --paged-trace "
+                         "--check (>=5x concurrency at byte-equal KV, "
+                         "greedy bitwise parity, zero-copy prefix vs "
+                         "bench_llm_paged.json)")
     ap.add_argument("--bench-fleet", action="store_true",
                     help="opt-in gate: run tools/bench_fleet.py --check "
                          "(traffic-replay chaos storm: kill + ENOSPC "
@@ -185,6 +187,18 @@ def main():
              "--prefix-trace", "--check"],
             cwd=REPO, env=env)
         print(f"bench llm: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+        # the paged-KV burst A/B: >=5x concurrent sequences at a
+        # byte-equal KV budget, greedy bitwise parity with the slot
+        # path, and zero-copy prefix sharing, gated against the
+        # committed bench_llm_paged.json
+        t0 = time.time()
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_llm_serving",
+             "--paged-trace", "--check"],
+            cwd=REPO, env=env)
+        print(f"bench llm paged: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
