@@ -47,6 +47,23 @@ def flash_vmem_bytes(block_q: int, block_k: int, kv_len: int,
     return q_blk + kv_res + scores + acc + out + stats
 
 
+def blockspec_vmem_bytes(block_shapes, itemsize: int = 4) -> int:
+    """Generic VMEM-resident bytes for a pallas_call's BlockSpec set: the
+    sum of every block's element count times ``itemsize``. The family
+    models above (:func:`flash_vmem_bytes`, :func:`paged_attn_vmem_bytes`)
+    know their kernels' scratch/accumulator terms; this is the
+    family-agnostic floor the static analyzer (PTA013) uses for arbitrary
+    pallas_call sites — if the declared blocks alone bust the budget, no
+    scratch accounting can save the kernel."""
+    total = 0
+    for shape in block_shapes:
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * itemsize
+    return total
+
+
 def flash_candidates(q_len: int, kv_len: int, head_dim: int,
                      itemsize: int = 4,
                      require_divides: bool = False

@@ -1,0 +1,121 @@
+"""Seeded true-positives for PTA013 (pallas-kernel-safety).
+
+Never import this module from real code: it exists so
+tests/test_pallas_lint.py can run the analyzer against a file with KNOWN
+kernel-safety violations and assert each is (a) detected, (b) killable
+by `# noqa: PTA013 -- reason`, and (c) killable by baseline. Mirrors the
+tests/fixtures/spmd_seeded.py discipline for PTA011.
+
+Four seeded classes (one per PTA013 finding class), then clean_*
+controls that must stay finding-free.
+"""
+
+
+def seeded_unguarded_grid(q, k, v, block_q):
+    """(a) grid floor-divides by a dynamic block with no divisibility
+    guard and no sanitize-helper provenance: a non-dividing block_q
+    silently drops the tail rows."""
+    import jax.experimental.pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        o_ref[...] = q_ref[...]
+
+    seq = q.shape[0]
+    return pl.pallas_call(
+        kernel,
+        grid=(seq // block_q,),  # PTA013(a): unguarded dynamic divisor
+        out_shape=q,
+        interpret=True,
+    )(q, k, v)
+
+
+def seeded_vmem_bust(x):
+    """(b) constant BlockSpec shapes whose combined f32 footprint
+    (blockspec_vmem_bytes) busts VMEM_BUDGET: 2 * (1, 8192, 512) blocks
+    = 32 MiB against the ~12.8 MiB budget."""
+    import jax.experimental.pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((1, 8192, 512), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, 8192, 512), lambda i: (i, 0, 0)),
+        out_shape=x,
+        interpret=True,
+    )(x)
+
+
+def seeded_bf16_acc_kernel(q_ref, k_ref, o_ref):
+    """(c) reduction accumulator declared below f32: online-softmax
+    statistics accumulated in bf16 lose the exactness contract."""
+    import jax.numpy as jnp
+
+    acc = jnp.zeros((128, 64), jnp.bfloat16)  # PTA013(c): bf16 accumulator
+    o_ref[...] = acc + q_ref[...] @ k_ref[...]
+
+
+def seeded_no_interpret(x):
+    """(d) pallas_call with no interpret= lane: unreachable off-TPU, so
+    CPU tier-1 can never cover its math."""
+    import jax.experimental.pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2
+
+    return pl.pallas_call(  # PTA013(d): no interpret kwarg
+        kernel,
+        out_shape=x,
+    )(x)
+
+
+# -- clean controls: the sanctioned idioms, must stay finding-free -----------
+
+
+def clean_guarded_grid(q, block_q):
+    """Explicit divisibility guard (the _fa_fwd_with_lse idiom): the mod
+    check + raise makes the floor-division exact by construction."""
+    import jax.experimental.pallas as pl
+
+    def kernel(q_ref, o_ref):
+        o_ref[...] = q_ref[...]
+
+    seq = q.shape[0]
+    if seq % block_q:
+        raise ValueError("block_q must divide the padded sequence")
+    return pl.pallas_call(
+        kernel,
+        grid=(seq // block_q,),
+        out_shape=q,
+        interpret=True,
+    )(q)
+
+
+def clean_sanitized_grid(q, block_q, _sanitize_block):
+    """Sanitize-helper provenance (the paged_attention _sanitize_block_h
+    idiom): the helper clamps the block to an exact divisor."""
+    import jax.experimental.pallas as pl
+
+    def kernel(q_ref, o_ref):
+        o_ref[...] = q_ref[...]
+
+    seq = q.shape[0]
+    block_q = _sanitize_block(block_q, seq)
+    return pl.pallas_call(
+        kernel,
+        grid=(seq // block_q,),
+        out_shape=q,
+        interpret=True,
+    )(q)
+
+
+def clean_f32_acc_kernel(q_ref, k_ref, o_ref):
+    """f32 accumulator plus an int32 mask: both legal — only sub-f32
+    FLOAT accumulators are findings."""
+    import jax.numpy as jnp
+
+    acc = jnp.zeros((128, 64), jnp.float32)
+    mask = jnp.zeros((128, 1), jnp.int32)
+    o_ref[...] = acc + q_ref[...] @ k_ref[...] + mask

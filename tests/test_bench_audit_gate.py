@@ -66,7 +66,8 @@ class TestSummarize:
         assert s == {"host_transfers": 3, "large_consts": 1,
                      "donatable_inputs": 4, "retraces": 2,
                      "fingerprint_unstable": 1, "copy_fraction": 0.02,
-                     "collective_bytes": 0, "collective_issues": 0}
+                     "collective_bytes": 0, "collective_issues": 0,
+                     "unfused_boundary_bytes": 0}
 
     def test_error_entrypoint_carried(self):
         p = _clean_payload()

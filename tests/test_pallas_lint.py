@@ -1,0 +1,556 @@
+"""Tier-1 tests for the kernel-safety/fusion-audit tier (PTA013 Pallas
+source lint + PTA014 HLO fusion-miss audit) and the satellites that
+shipped with it (winner VMEM fail-fast, --changed-only trace scoping,
+the fusion_audit.json artifact, the unfused_boundary_bytes gate).
+
+Layers:
+
+- seeded-fixture acceptance: every PTA013 finding class fires on
+  ``tests/fixtures/pallas_seeded.py`` and each is killable by noqa and
+  by a baseline entry; the real Pallas surface stays clean;
+- the committed-winner VMEM fail-fast (ISSUE satellite 1): every
+  ``default_winners.json`` entry passes its space.py model;
+- pure fusion-miss passes against hand-built HLO dumps (shape bytes,
+  boundary classification, ranking, the fully-fused negative);
+- PTA014 rule behaviour over synthetic reports (the PTA012 test seam);
+- gate + driver satellites: unfused_boundary_bytes regression fails
+  ``check_audit_regression``, --changed-only scopes the trace tier via
+  the audit registry's import closures, and --fusion-report emits the
+  standalone artifact from the memoized report.
+"""
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax.numpy as jnp                                 # noqa: E402
+
+from paddle_tpu.core.audit import AuditSpec             # noqa: E402
+from paddle_tpu.tuner import space                      # noqa: E402
+from tools.analyze import trace as trace_mod            # noqa: E402
+from tools.analyze.trace import (EntrypointStats,       # noqa: E402
+                                 TraceReport, audit_spec, passes)
+from tools.analyze.core import (Project, filter_noqa,   # noqa: E402
+                                baseline_payload, split_findings)
+from tools.analyze.rules import rules_by_code           # noqa: E402
+from tools.analyze.rules.pta013_pallas_safety import (  # noqa: E402
+    iter_winner_footprints, parse_winner_key)
+from tools.analyze.rules.pta014_fusion_miss import (    # noqa: E402
+    FUSION_MISS_BYTES_THRESHOLD)
+
+PTA005 = rules_by_code()["PTA005"]
+PTA013 = rules_by_code()["PTA013"]
+PTA014 = rules_by_code()["PTA014"]
+
+FIXTURE = os.path.join("tests", "fixtures", "pallas_seeded.py")
+
+
+def _driver(args):
+    return subprocess.run([sys.executable, "-m", "tools.analyze"] + args,
+                          cwd=REPO, capture_output=True, text=True)
+
+
+# -- PTA013 seeded-fixture acceptance ----------------------------------------
+
+def test_pallas_fixture_fires_every_pta013_class_and_nothing_else():
+    proc = _driver(["--baseline", "none", "--rule", "PTA013", "--json",
+                    FIXTURE])
+    assert proc.returncode == 1, proc.stdout
+    found = json.loads(proc.stdout)["findings"]
+    assert all(f["rule"] == "PTA013" for f in found)
+    assert len(found) == 4, [f["message"] for f in found]
+    blob = " | ".join(f["message"] for f in found)
+    # (a) unguarded grid division
+    assert "no divisibility guard" in blob
+    assert "`block_q`" in blob
+    # (b) VMEM-busting BlockSpecs (32 MiB vs the ~12.8 MiB budget)
+    assert "over the 13421772 byte budget" in blob
+    assert "32.0 MiB" in blob
+    # (c) bf16 accumulator
+    assert "allocated as bfloat16" in blob
+    # (d) missing interpret lane — a warning, the rest are errors
+    assert "without an `interpret=` keyword" in blob
+    sev = sorted(f["severity"] for f in found)
+    assert sev == ["error", "error", "error", "warning"]
+    # the clean_* controls (guard idiom, sanitize provenance, f32+int32
+    # accumulators) stay finding-free
+    lines = {f["line"] for f in found}
+    src = open(os.path.join(REPO, FIXTURE)).read().splitlines()
+    for i, text in enumerate(src, 1):
+        if "clean_" in text and "def " in text:
+            assert not any(i <= ln <= i + 20 for ln in lines), text
+
+
+def test_pta013_killable_by_noqa(tmp_path):
+    src = open(os.path.join(REPO, FIXTURE)).read()
+    patched = []
+    for line in src.splitlines():
+        if ("PTA013(a)" in line or "pl.pallas_call(" in line
+                or "jnp.bfloat16" in line):
+            line += "  # noqa: PTA013 -- seeded fixture, deliberate"
+        patched.append(line)
+    p = tmp_path / "pallas_noqa.py"
+    p.write_text("\n".join(patched) + "\n")
+    proc = _driver(["--baseline", "none", "--rule", "PTA013", "--json",
+                    str(p)])
+    assert proc.returncode == 0, proc.stdout
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["counts"]["suppressed"] == 4
+
+
+def test_pta013_killable_by_baseline(tmp_path):
+    bl = tmp_path / "baseline.json"
+    wrote = _driver(["--baseline", str(bl), "--write-baseline",
+                     "--rule", "PTA013", FIXTURE])
+    assert wrote.returncode == 0, wrote.stdout
+    proc = _driver(["--baseline", str(bl), "--rule", "PTA013", "--json",
+                    FIXTURE])
+    assert proc.returncode == 0, proc.stdout
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["new"] == 0
+    assert payload["counts"]["baselined"] == 4
+
+
+def test_pta013_clean_on_real_pallas_surface():
+    # the acceptance bar: the hand-written kernel families use the
+    # sanctioned idioms (mod-guard + raise, _sanitize_* provenance, f32
+    # accumulators, interpret lanes) and must stay finding-free
+    proc = _driver([
+        "--baseline", "none", "--rule", "PTA013", "--json",
+        "paddle_tpu/ops",
+        "paddle_tpu/distributed/fleet/sequence_parallel.py",
+        "paddle_tpu/tuner"])
+    assert proc.returncode == 0, proc.stdout
+    assert json.loads(proc.stdout)["findings"] == []
+
+
+# -- VMEM models + committed winners (ISSUE satellite 1) ----------------------
+
+def test_blockspec_vmem_bytes_model():
+    assert space.blockspec_vmem_bytes([(128, 64)]) == 128 * 64 * 4
+    assert space.blockspec_vmem_bytes(
+        [(128, 64), (64, 64)], itemsize=2) == (128 * 64 + 64 * 64) * 2
+    assert space.blockspec_vmem_bytes([]) == 0
+
+
+def test_every_committed_winner_fits_its_vmem_model():
+    # a stale hand-edited winner must fail fast here, not OOM Mosaic on
+    # a TPU — including the handcrafted flash_bwd/paged_attn entries
+    # that have never run on hardware
+    rows = list(iter_winner_footprints(REPO))
+    assert len(rows) >= 14, rows
+    fams = {fam for _, fam, _, _ in rows}
+    assert {"flash_fwd", "flash_bwd", "ring_flash", "ring_flash_bwd",
+            "paged_attn"} <= fams
+    for key, fam, bytes_, budget in rows:
+        assert bytes_ <= budget, \
+            f"{key} ({fam}): {bytes_} bytes over the {budget} VMEM budget"
+
+
+def test_winner_key_parsing():
+    p = parse_winner_key("flash_fwd|tpu|bfloat16|d64|q4096|k4096|c1")
+    assert p["family"] == "flash_fwd" and p["dtype"] == "bfloat16"
+    assert (p["d"], p["q"], p["k"]) == (64, 4096, 4096)
+    p = parse_winner_key("paged_attn|tpu|bfloat16|h12|d64|p16")
+    assert (p["h"], p["d"], p["p"]) == (12, 64, 16)
+    # families with no VMEM model are skipped, not silently mis-modeled
+    assert parse_winner_key("nms|cpu|k64") is None
+
+
+# -- fusion-miss passes (HLO text level) --------------------------------------
+
+HLO_DOC = """\
+HloModule jit_step, entry_computation_layout={(f32[128,512]{1,0})->f32[128,512]{1,0}}
+
+%fused_computation (param_0.1: f32[128,512]) -> f32[128,512] {
+  %param_0.1 = f32[128,512]{1,0} parameter(0)
+  ROOT %multiply.1 = f32[128,512]{1,0} multiply(%param_0.1, %param_0.1)
+}
+
+ENTRY %main.9 (Arg_0.1: f32[128,512]) -> f32[128,512] {
+  %Arg_0.1 = f32[128,512]{1,0} parameter(0)
+  %fusion = f32[128,512]{1,0} fusion(%Arg_0.1), kind=kLoop, calls=%fused_computation
+  %w = f32[512,512]{1,0} constant({...})
+  %dot.3 = f32[128,512]{1,0} dot(%fusion, %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %reduce.1 = f32[128]{0} reduce(%dot.3, %Arg_0.1), dimensions={1}, to_apply=%add_comp
+  ROOT %tanh.1 = f32[128,512]{1,0} tanh(%dot.3)
+}
+"""
+
+
+def test_shape_bytes_parses_dtypes_and_tuples():
+    assert passes._shape_bytes("f32[4,512]{1,0}") == 4 * 512 * 4
+    assert passes._shape_bytes("bf16[8]{0}") == 16
+    assert passes._shape_bytes("s8[3,3]") == 9
+    assert passes._shape_bytes("pred[16]") == 16
+    assert passes._shape_bytes("f32[]") == 4
+    assert passes._shape_bytes("(f32[8,4]{1,0}, s32[])") == 128 + 4
+
+
+def test_parse_hlo_module_structure():
+    mod = passes.parse_hlo_module(HLO_DOC)
+    assert mod["entry"] == "main.9"
+    entry = {i["name"]: i for i in mod["computations"]["main.9"]}
+    assert entry["dot.3"]["operands"] == ["fusion", "w"]
+    assert entry["fusion"]["calls"] == "fused_computation"
+    assert entry["tanh.1"]["bytes"] == 128 * 512 * 4
+    fused = mod["computations"]["fused_computation"]
+    assert [i["opcode"] for i in fused] == ["parameter", "multiply"]
+
+
+def test_fusion_miss_report_classifies_and_ranks_boundaries():
+    rep = passes.fusion_miss_report(HLO_DOC)
+    # fusion (elementwise), dot, reduce, tanh = 4 compute regions
+    assert rep["fusion_regions"] == 4
+    kinds = {(m["producer"], m["consumer"]): m["kind"]
+             for m in rep["top_fusion_misses"]}
+    # the kLoop elementwise fusion feeding the dot is the canonical miss
+    assert kinds[("fusion", "dot.3")] == "elementwise->dot"
+    assert kinds[("dot.3", "tanh.1")] == "dot->elementwise"
+    assert kinds[("dot.3", "reduce.1")] == "dot->elementwise"
+    # ranked by producer bytes, all three cross a 256 KiB boundary
+    bytes_ = [m["bytes"] for m in rep["top_fusion_misses"]]
+    assert bytes_ == sorted(bytes_, reverse=True)
+    assert rep["unfused_boundary_bytes"] == sum(bytes_) == 3 * 128 * 512 * 4
+
+
+def test_norm_to_dot_boundary_counts():
+    hlo = """\
+ENTRY %main (p: f32[64,256]) -> f32[64,64] {
+  %p = f32[64,256]{1,0} parameter(0)
+  %reduce.2 = f32[64,256]{1,0} reduce(%p, %p), dimensions={1}, to_apply=%add
+  %w2 = f32[256,64]{1,0} constant({...})
+  ROOT %dot.9 = f32[64,64]{1,0} dot(%reduce.2, %w2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+    rep = passes.fusion_miss_report(hlo)
+    (miss,) = rep["top_fusion_misses"]
+    assert miss["kind"] == "norm->dot"
+    assert miss["bytes"] == 64 * 256 * 4
+
+
+def test_fully_fused_program_reports_no_misses():
+    hlo = """\
+%fused_computation (p0: f32[32,32], p1: f32[32,32]) -> f32[32,32] {
+  %p0 = f32[32,32]{1,0} parameter(0)
+  %p1 = f32[32,32]{1,0} parameter(1)
+  %dot.1 = f32[32,32]{1,0} dot(%p0, %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %tanh.2 = f32[32,32]{1,0} tanh(%dot.1)
+}
+
+ENTRY %main (a: f32[32,32], b: f32[32,32]) -> f32[32,32] {
+  %a = f32[32,32]{1,0} parameter(0)
+  %b = f32[32,32]{1,0} parameter(1)
+  ROOT %fusion = f32[32,32]{1,0} fusion(%a, %b), kind=kOutput, calls=%fused_computation
+}
+"""
+    rep = passes.fusion_miss_report(hlo)
+    assert rep["fusion_regions"] == 1
+    assert rep["unfused_boundary_bytes"] == 0
+    assert rep["top_fusion_misses"] == []
+
+
+def test_audit_spec_records_fusion_fields():
+    def step(x, w):
+        h = jnp.tanh(x)
+        return jnp.maximum(h @ w, 0.0)
+
+    spec = AuditSpec(fn=step, make_args=lambda v: (
+        jnp.full((64, 64), float(v + 1)), jnp.full((64, 64), 0.5)))
+    st = audit_spec("fusion_probe", spec)
+    assert st.error == "", st.error
+    assert st.fusion_regions > 0
+    assert st.unfused_boundary_bytes >= 0
+    assert st.unfused_boundary_bytes >= sum(
+        m["bytes"] for m in st.top_fusion_misses)
+    for m in st.top_fusion_misses:
+        assert m["kind"] in ("elementwise->dot", "norm->dot",
+                             "dot->elementwise")
+        assert m["bytes"] > 0
+    # payload round-trips the new fields (the trace-report schema)
+    pl = st.payload()
+    assert pl["fusion_regions"] == st.fusion_regions
+    assert pl["unfused_boundary_bytes"] == st.unfused_boundary_bytes
+
+
+# -- PTA014 rule over reports -------------------------------------------------
+
+def _report_with(**overrides):
+    st = EntrypointStats(name="ep", tags=("train",),
+                         path=FIXTURE, line=14)
+    for k, v in overrides.items():
+        setattr(st, k, v)
+    return TraceReport(platform="cpu", entrypoint_stats={"ep": st})
+
+
+def _pta014_findings(report, monkeypatch):
+    monkeypatch.setattr(trace_mod, "_LAST", report)
+    return PTA014.finalize(None)
+
+
+def _misses(*sizes):
+    return [{"kind": "elementwise->dot", "producer": f"fusion.{i}",
+             "producer_op": "fusion", "consumer": f"dot.{i}",
+             "consumer_op": "dot", "bytes": b, "shape": "f32[...]"}
+            for i, b in enumerate(sizes)]
+
+
+def test_pta014_fires_over_threshold_with_ranked_misses(monkeypatch):
+    fs = _pta014_findings(_report_with(
+        fusion_regions=12,
+        unfused_boundary_bytes=FUSION_MISS_BYTES_THRESHOLD + 1,
+        top_fusion_misses=_misses(900000, 148577)), monkeypatch)
+    assert len(fs) == 1
+    assert fs[0].severity == "warning"
+    assert fs[0].anchor == "trace:ep:fusion-miss"
+    assert (fs[0].path, fs[0].line) == (FIXTURE, 14)
+    assert "fusion.0->dot.0" in fs[0].message
+    assert "--fusion-report" in fs[0].message
+
+
+def test_pta014_quiet_at_or_below_threshold(monkeypatch):
+    fs = _pta014_findings(_report_with(
+        unfused_boundary_bytes=FUSION_MISS_BYTES_THRESHOLD,
+        top_fusion_misses=_misses(FUSION_MISS_BYTES_THRESHOLD)),
+        monkeypatch)
+    assert fs == []
+
+
+def test_pta014_skips_errored_entrypoints_and_reports_runner_loss(
+        monkeypatch):
+    # a build failure is PTA009's finding; PTA014 must not double-report
+    fs = _pta014_findings(_report_with(
+        error="boom", unfused_boundary_bytes=10 << 20), monkeypatch)
+    assert fs == []
+    monkeypatch.setattr(trace_mod, "_LAST", TraceReport(
+        platform="unavailable", entrypoint_stats={}, error="ImportError"))
+    fs = PTA014.finalize(None)
+    assert len(fs) == 1
+    assert fs[0].severity == "error"
+    assert fs[0].anchor == "trace:runner:unavailable"
+
+
+def test_pta014_killable_by_baseline(monkeypatch):
+    fs = _pta014_findings(_report_with(
+        unfused_boundary_bytes=2 << 20,
+        top_fusion_misses=_misses(2 << 20)), monkeypatch)
+    baseline = baseline_payload(fs)["findings"]
+    new, baselined, expired = split_findings(fs, baseline)
+    assert new == [] and len(baselined) == 1 and expired == []
+
+
+def test_pta014_killable_by_noqa(tmp_path, monkeypatch):
+    reg = tmp_path / "reg.py"
+    reg.write_text("register_entrypoint('ep', f)"
+                   "  # noqa: PTA014 -- pre-megakernel state, item-1 WIP\n")
+    fs = _pta014_findings(_report_with(
+        unfused_boundary_bytes=2 << 20,
+        top_fusion_misses=_misses(2 << 20)), monkeypatch)
+    fs = [dataclasses.replace(f, path="reg.py", line=1) for f in fs]
+    project = Project(str(tmp_path), ["reg.py"])
+    kept, suppressed = filter_noqa(project, fs)
+    assert kept == [] and len(suppressed) == 1
+
+
+def test_committed_analyzer_baseline_covers_known_fusion_misses():
+    # gpt_train_step / resnet_train_step fire PTA014 today (the ROADMAP
+    # item-1 backlog); their findings must be baselined so the --strict
+    # --trace-audit lane stays green until the megakernel PR lands
+    with open(os.path.join(REPO, "tools", "analyze",
+                           "baseline.json")) as f:
+        entries = json.load(f)["findings"]
+    anchored = {e["message"] for e in entries.values()
+                if e["rule"] == "PTA014"}
+    assert any("gpt_train_step" in m for m in anchored)
+    assert any("resnet_train_step" in m for m in anchored)
+
+
+# -- unfused_boundary_bytes audit gate ----------------------------------------
+
+def _gate():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_audit_regression as gate
+    return gate
+
+
+def test_unfused_boundary_bytes_regression_fails_gate():
+    # the seeded regression of the acceptance criteria: an artificially
+    # de-fused entrypoint (boundary bytes up >5%) must fail the gate
+    gate = _gate()
+    name = "gpt_train_step"
+    counters = {"host_transfers": 0, "large_consts": 0,
+                "donatable_inputs": 0, "retraces": 0,
+                "fingerprint_unstable": 0, "copy_fraction": 0.0,
+                "collective_bytes": 0, "collective_issues": 0,
+                "unfused_boundary_bytes": 2_000_000}
+    base = {name: dict(counters)}
+    ok = {name: dict(counters, unfused_boundary_bytes=2_080_000)}
+    bad = {name: dict(counters, unfused_boundary_bytes=2_200_000)}
+    assert not any("unfused_boundary_bytes" in p
+                   for p in gate.compare(base, ok))
+    problems = gate.compare(base, bad)
+    assert any("unfused_boundary_bytes regressed 2000000 -> 2200000" in p
+               for p in problems)
+    assert any("PTA014" in p for p in problems)
+
+
+def test_gate_summarize_reads_fusion_fields():
+    gate = _gate()
+    payload = {"entrypoints": {
+        gate.ENTRYPOINTS[0]: {
+            "transfers": [], "large_consts": [], "donation": None,
+            "trace_count": 1, "fingerprint_stable": True,
+            "hlo": {"instructions": 10, "copies": 0},
+            "collectives": [], "collective_bytes": 0,
+            "collective_issues": [],
+            "unfused_boundary_bytes": 777}}}
+    cur = gate.summarize(payload)[gate.ENTRYPOINTS[0]]
+    assert cur["unfused_boundary_bytes"] == 777
+
+
+def test_committed_baseline_gates_gpt_fusion_bytes():
+    # the acceptance bar: gpt_train_step reports a non-empty fusion-miss
+    # list whose byte total the committed baseline now gates
+    with open(os.path.join(REPO, "bench_audit_baseline.json")) as f:
+        entries = json.load(f)["entrypoints"]
+    assert entries["gpt_train_step"]["unfused_boundary_bytes"] > 0
+    assert entries["resnet_train_step"]["unfused_boundary_bytes"] > 0
+
+
+# -- PTA005 noqa policing for the new tiers -----------------------------------
+
+def test_bare_pta013_noqa_policed_in_any_api_module(tmp_path):
+    mod = tmp_path / "paddle_tpu" / "newkernel.py"
+    mod.parent.mkdir()
+    mod.write_text(
+        "from __future__ import annotations\n"
+        "x = 1  # noqa: PTA013\n"
+        "y = 2  # noqa: PTA014 -- pre-megakernel state, tracked in item 1\n"
+        "z = 3  # noqa: PTA003\n")
+    project = Project(str(tmp_path), ["paddle_tpu"])
+    fs = PTA005.visit_file(project.files[0], project)
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "PTA013" in fs[0].message
+    assert fs[0].anchor.startswith("noqa-hygiene:PTA013:")
+    # the bare suppression cannot silence its own policing finding
+    kept, suppressed = filter_noqa(project, fs)
+    assert len(kept) == 1 and suppressed == []
+
+
+def test_bare_pta014_noqa_policed(tmp_path):
+    mod = tmp_path / "paddle_tpu" / "reg.py"
+    mod.parent.mkdir()
+    mod.write_text("from __future__ import annotations\n"
+                   "r = 0  # noqa: PTA014\n")
+    project = Project(str(tmp_path), ["paddle_tpu"])
+    fs = PTA005.visit_file(project.files[0], project)
+    assert len(fs) == 1
+    assert fs[0].anchor.startswith("noqa-hygiene:PTA014:")
+
+
+# -- --changed-only trace scoping (ISSUE satellite 2) -------------------------
+
+def test_changed_kernel_file_scopes_to_its_entrypoints():
+    names = trace_mod.scope_entrypoints(
+        REPO, ["paddle_tpu/ops/paged_attention.py"])
+    assert "llm_paged_decode_step" in names
+    assert "resnet_train_step" not in names
+    names = trace_mod.scope_entrypoints(
+        REPO, ["paddle_tpu/serving/engine.py"])
+    assert "serving_predict" in names
+    assert "llm_paged_decode_step" not in names
+
+
+def test_changed_unrelated_file_scopes_to_nothing():
+    assert trace_mod.scope_entrypoints(
+        REPO, ["paddle_tpu/vision/transforms.py"]) == []
+
+
+def test_changed_registry_file_scopes_to_everything():
+    names = trace_mod.scope_entrypoints(
+        REPO, ["paddle_tpu/core/audit.py"])
+    assert "resnet_train_step" in names and "serving_predict" in names
+    assert len(names) >= 9
+
+
+def test_set_audit_scope_empty_runs_zero_entrypoints():
+    try:
+        trace_mod.set_audit_scope([])
+        rep = trace_mod.run_audit()
+        assert rep.error == ""
+        assert rep.entrypoint_stats == {}
+    finally:
+        trace_mod.set_audit_scope(None)
+        trace_mod._reset_for_tests()
+
+
+# -- fusion_audit.json artifact (ISSUE satellite 6) ---------------------------
+
+def test_fusion_report_artifact_from_memoized_report(tmp_path, monkeypatch):
+    import tools.analyze.__main__ as main_mod
+    heavy = EntrypointStats(name="heavy", path=FIXTURE, line=1,
+                            fusion_regions=12,
+                            unfused_boundary_bytes=5_000_000,
+                            top_fusion_misses=_misses(5_000_000))
+    light = EntrypointStats(name="light", path=FIXTURE, line=2,
+                            fusion_regions=3,
+                            unfused_boundary_bytes=100)
+    broken = EntrypointStats(name="broken", error="boom")
+    monkeypatch.setattr(trace_mod, "_LAST", TraceReport(
+        platform="cpu", entrypoint_stats={
+            "heavy": heavy, "light": light, "broken": broken}))
+    out = tmp_path / "fusion_audit.json"
+    rc = main_mod.main(["--only", "PTA014", "--baseline", "none",
+                        "--fusion-report", str(out), FIXTURE])
+    assert rc == 0  # PTA014 findings are warnings; they gate only --strict
+    doc = json.loads(out.read_text())
+    assert doc["ranking"] == ["heavy", "light"]  # errored excluded
+    assert doc["entrypoints"]["heavy"]["unfused_boundary_bytes"] == 5_000_000
+    assert doc["entrypoints"]["heavy"]["top_fusion_misses"][0]["bytes"] \
+        == 5_000_000
+    assert "broken" not in doc["entrypoints"]
+
+
+@pytest.mark.slow
+def test_fusion_report_artifact_end_to_end(tmp_path):
+    # the full driver lane: trace one cheap entrypoint in a fresh
+    # process and emit the standalone artifact
+    out = tmp_path / "fusion_audit.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PTA_TRACE_ENTRYPOINTS="serving_predict")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--only", "PTA014",
+         "--baseline", "none", "--fusion-report", str(out), "paddle_tpu"],
+        cwd=REPO, capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["ranking"] == ["serving_predict"]
+    st = doc["entrypoints"]["serving_predict"]
+    assert st["fusion_regions"] > 0
+
+
+# -- docs / listing consistency (ISSUE satellite 3) ---------------------------
+
+def test_new_rules_listed_and_documented():
+    proc = _driver(["--list-rules"])
+    assert proc.returncode == 0
+    lines = {ln.split()[0]: ln for ln in proc.stdout.splitlines() if ln}
+    assert "PTA013" in lines and "PTA014" in lines
+    assert "[trace tier]" not in lines["PTA013"]   # AST tier: default run
+    assert "[trace tier]" in lines["PTA014"]
+    docs = open(os.path.join(REPO, "docs", "static_analysis.md")).read()
+    for code in ("PTA013", "PTA014"):
+        assert re.search(rf"^\| {code} \|", docs, re.M), code
+    # the worked-true-positive chapter exists
+    assert "Kernel safety & fusion audit" in docs

@@ -346,13 +346,13 @@ def test_driver_rule_selection(tmp_path):
 
 def test_all_rules_have_distinct_codes():
     codes = [r.code for r in ALL_RULES]
-    assert len(codes) == len(set(codes)) == 12
+    assert len(codes) == len(set(codes)) == 14
     assert codes == sorted(codes)
 
 
 def test_trace_tier_rules_are_not_in_the_default_selection():
-    """PTA009/PTA010/PTA012 compile registered entrypoints — they must
-    only run when named explicitly via --only/--rule."""
+    """PTA009/PTA010/PTA012/PTA014 compile registered entrypoints —
+    they must only run when named explicitly via --only/--rule."""
     import argparse
 
     from tools.analyze.__main__ import select_rules
@@ -363,11 +363,13 @@ def test_trace_tier_rules_are_not_in_the_default_selection():
     assert "PTA009" not in default_codes
     assert "PTA010" not in default_codes
     assert "PTA012" not in default_codes
+    assert "PTA014" not in default_codes
     assert "PTA011" in default_codes   # the SPMD lint is AST-tier
+    assert "PTA013" in default_codes   # the Pallas lint is AST-tier
     for r in ALL_RULES:
         assert r.tier in ("ast", "trace"), r.code
         assert (r.tier == "trace") == (r.code in ("PTA009", "PTA010",
-                                                  "PTA012"))
+                                                  "PTA012", "PTA014"))
 
     ns = argparse.Namespace(only=["PTA009,PTA010"], skip=["PTA010"])
     assert [r.code for r in select_rules(ns)] == ["PTA009"]

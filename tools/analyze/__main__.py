@@ -43,15 +43,17 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="only", metavar="PTA###[,PTA###]",
                     help="run only these rules (repeatable or "
                          "comma-separated). The slow trace tier "
-                         "(PTA009/PTA010/PTA012, compiles code) ONLY "
-                         "runs when selected here.")
+                         "(PTA009/PTA010/PTA012/PTA014, compiles code) "
+                         "ONLY runs when selected here.")
     ap.add_argument("--changed-only", nargs="?", const="HEAD",
                     default=None, metavar="BASE",
                     help="analyze only .py files changed vs BASE "
                          "(git diff --name-only BASE, plus untracked "
                          "files; default BASE: HEAD) that fall under the "
                          "given paths — the fast pre-commit lane. No "
-                         "changed files is a clean exit.")
+                         "changed files is a clean exit. Also scopes the "
+                         "trace tier: only entrypoints whose import "
+                         "closure touches a changed file are re-traced.")
     ap.add_argument("--skip", action="append", default=[],
                     metavar="PTA###[,PTA###]", help="disable these rules "
                     "(repeatable or comma-separated)")
@@ -77,8 +79,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the trace tier's per-entrypoint audit "
                          "stats (trace counts, transfers, fusion stats, "
                          "collective schedules) to FILE as json — "
-                         "requires selecting PTA009/PTA010/PTA012 via "
-                         "--only")
+                         "requires selecting PTA009/PTA010/PTA012/PTA014 "
+                         "via --only")
+    ap.add_argument("--fusion-report", nargs="?", const="fusion_audit.json",
+                    default=None, metavar="FILE",
+                    help="write the PTA014 ranked fusion-miss table to "
+                         "FILE as json (default FILE: fusion_audit.json, "
+                         "gitignored). Written automatically whenever "
+                         "PTA014 is selected, so `--only PTA014 --format "
+                         "json` emits the standalone artifact.")
     ap.add_argument("--list-rules", action="store_true")
     return ap
 
@@ -191,6 +200,19 @@ def _run(args, root: str, rules: list) -> int:
             print("--changed-only: no changed .py files under the "
                   "analyzed paths; clean")
             return 0
+        if any(r.tier == "trace" for r in rules):
+            # scope the trace tier too: only entrypoints whose static
+            # import closure touches a changed file get re-traced
+            from . import trace as trace_mod
+            try:
+                scope = trace_mod.scope_entrypoints(root, paths)
+            except Exception:
+                scope = None  # registry unimportable: run_audit records it
+            trace_mod.set_audit_scope(scope)
+            if scope is not None:
+                print(f"--changed-only: trace tier scoped to "
+                      f"{len(scope)} entrypoint(s)"
+                      + (f": {', '.join(scope)}" if scope else ""))
 
     baseline_arg = args.baseline or DEFAULT_BASELINE
     baseline_path = (None if baseline_arg.lower() == "none"
@@ -216,6 +238,40 @@ def _run(args, root: str, rules: list) -> int:
                 fh.write("\n")
             print(f"wrote trace audit ({len(report.entrypoint_stats)} "
                   f"entrypoint(s)) to {os.path.relpath(tr_path, root)}")
+
+    fusion_report = args.fusion_report
+    if fusion_report is None and any(r.code == "PTA014" for r in rules):
+        fusion_report = "fusion_audit.json"  # the standalone CI artifact
+    if fusion_report:
+        from .trace import last_report
+        report = last_report()
+        if report is None:
+            print("--fusion-report: no trace-tier rule ran (select "
+                  "PTA014 via --only)", file=sys.stderr)
+        else:
+            ranked = sorted(
+                (st for st in report.entrypoint_stats.values()
+                 if not st.error),
+                key=lambda s: -s.unfused_boundary_bytes)
+            fr_payload = {
+                "version": 1,
+                "platform": report.platform,
+                "ranking": [st.name for st in ranked],
+                "entrypoints": {
+                    st.name: {
+                        "fusion_regions": st.fusion_regions,
+                        "unfused_boundary_bytes":
+                            st.unfused_boundary_bytes,
+                        "top_fusion_misses": st.top_fusion_misses,
+                    } for st in ranked},
+            }
+            fr_path = (fusion_report if os.path.isabs(fusion_report)
+                       else os.path.join(root, fusion_report))
+            with open(fr_path, "w") as fh:
+                json.dump(fr_payload, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            print(f"wrote fusion-miss audit ({len(ranked)} "
+                  f"entrypoint(s)) to {os.path.relpath(fr_path, root)}")
 
     if args.write_baseline:
         if baseline_path is None:

@@ -13,11 +13,13 @@ from .pta009_trace_fusion import RULE as PTA009     # noqa: F401
 from .pta010_retrace_sentinel import RULE as PTA010  # noqa: F401
 from .pta011_spmd_divergence import RULE as PTA011  # noqa: F401
 from .pta012_collective_schedule import RULE as PTA012  # noqa: F401
+from .pta013_pallas_safety import RULE as PTA013     # noqa: F401
+from .pta014_fusion_miss import RULE as PTA014       # noqa: F401
 
-# PTA009/PTA010/PTA012 are tier="trace": they compile registered
+# PTA009/PTA010/PTA012/PTA014 are tier="trace": they compile registered
 # entrypoints and run only when selected via --only (__main__.select_rules)
 ALL_RULES = [PTA001, PTA002, PTA003, PTA004, PTA005, PTA006, PTA007,
-             PTA008, PTA009, PTA010, PTA011, PTA012]
+             PTA008, PTA009, PTA010, PTA011, PTA012, PTA013, PTA014]
 
 
 def rules_by_code():

@@ -67,8 +67,9 @@ class ApiHygieneRule(Rule):
     code = "PTA005"
     name = "api-hygiene"
     description = ("mutable default arguments, missing `from __future__ "
-                   "import annotations`, unjustified `# noqa: PTA002` and "
-                   "ungated span construction in hot-path modules")
+                   "import annotations`, unjustified `# noqa: PTA002` / "
+                   "`PTA013` / `PTA014` suppressions and ungated span "
+                   "construction in hot-path modules")
 
     def visit_file(self, sf: SourceFile, project: Project) -> List[Finding]:
         if API_PREFIX not in sf.relpath:
@@ -129,29 +130,44 @@ class ApiHygieneRule(Rule):
         """Every host-sync suppression in a hot-path module must say *why*
         the concrete value is semantically required: `# noqa: PTA002 --
         reason`. A bare `# noqa: PTA002` (or a codeless blanket `# noqa`)
-        silently sanctions a pipeline stall for the next reader."""
+        silently sanctions a pipeline stall for the next reader. The same
+        mandatory-reason policy covers the kernel-safety/fusion tiers
+        (PTA013/PTA014) in ANY analyzed module: suppressing a VMEM bust
+        or an unguarded grid without saying why hides a hardware-only
+        failure mode."""
         # local import: HOT_PREFIXES is owned by the host-sync rule
         from .pta002_host_sync import HOT_PREFIXES
-        if not sf.relpath.startswith(HOT_PREFIXES):
-            return []
+        hot = sf.relpath.startswith(HOT_PREFIXES)
         findings: List[Finding] = []
         for line, codes in sorted(sf.noqa.items()):
             if sf.noqa_justified.get(line):
                 continue
-            if _ALL_CODES in codes:
+            if hot and _ALL_CODES in codes:
                 findings.append(sf.finding(
                     self.code, line,
                     "blanket `# noqa` in a hot-path module — suppress the "
                     "specific rule with a justification: "
                     "`# noqa: PTA002 -- reason`",
                     anchor=f"noqa-hygiene:blanket:{sf.line_text(line)}"))
-            elif "PTA002" in codes:
+            elif hot and "PTA002" in codes:
                 findings.append(sf.finding(
                     self.code, line,
                     "`# noqa: PTA002` without a justification — hot-path "
                     "host syncs must document why a concrete value is "
                     "required: `# noqa: PTA002 -- reason`",
                     anchor=f"noqa-hygiene:PTA002:{sf.line_text(line)}"))
+            else:
+                for code in ("PTA013", "PTA014"):
+                    if code in codes:
+                        findings.append(sf.finding(
+                            self.code, line,
+                            f"`# noqa: {code}` without a justification — "
+                            f"kernel-safety/fusion suppressions hide "
+                            f"TPU-only failure modes and must document "
+                            f"why the pattern is safe: "
+                            f"`# noqa: {code} -- reason`",
+                            anchor=f"noqa-hygiene:{code}:"
+                                   f"{sf.line_text(line)}"))
         return findings
 
 

@@ -1,0 +1,84 @@
+"""PTA014: trace-level HLO fusion-miss audit.
+
+For every registered auditable entrypoint, walk the *optimized* HLO the
+way PTA009 does, segment the entry computation into fusion regions, and
+rank the unfused elementwise->dot / dot->elementwise / norm->dot
+boundaries by the HBM bytes crossing them
+(``trace/passes.py:fusion_miss_report``). Each surviving boundary is a
+round-trip through HBM that XLA's conservative producer/consumer fusion
+declined to merge — "Operator Fusion in XLA" (PAPERS.md) shows exactly
+these misses around matmuls are where GPT's single-digit MFU goes.
+
+An entrypoint whose total ``unfused_boundary_bytes`` exceeds
+:data:`FUSION_MISS_BYTES_THRESHOLD` gets a warning naming its heaviest
+boundaries — the ranked work order for the ROADMAP item-1 megakernel PR
+(ln+matmul, matmul+gelu+matmul, fused residual epilogues). Warnings
+rather than errors because a miss is a perf target, not a correctness
+bug; byte-level *regressions* are gated separately (±5%) by
+``tools/check_audit_regression.py``.
+
+Findings anchor at the ``register_entrypoint`` site with stable
+``trace:<name>:fusion-miss`` fingerprints, so they baseline and noqa
+like any AST finding. This tier compiles code: it only runs when
+selected explicitly (``--only PTA014``).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .base import Rule
+from ..core import Finding, Project
+
+#: an entrypoint whose unfused boundary traffic is under 1 MiB per step
+#: is not worth a megakernel; above it, the report names the targets
+FUSION_MISS_BYTES_THRESHOLD = 1 << 20
+
+
+class FusionMissRule(Rule):
+    code = "PTA014"
+    name = "fusion-miss"
+    tier = "trace"
+    description = ("trace-level HLO fusion-miss audit of registered "
+                   "entrypoints: unfused elementwise->dot / "
+                   "dot->elementwise / norm->dot boundaries ranked by "
+                   "HBM bytes crossed — the megakernel target list "
+                   "(runs only via --only)")
+    severity = "warning"
+
+    def finalize(self, project: Project) -> List[Finding]:
+        from ..trace import get_report
+        report = get_report()
+        findings: List[Finding] = []
+        if report.error:
+            findings.append(Finding(
+                self.code, "tools/analyze/trace/__init__.py", 1, 0,
+                f"trace audit could not run (jax/paddle_tpu import "
+                f"failed): {report.error.strip().splitlines()[-1]}",
+                anchor="trace:runner:unavailable", severity="error"))
+            return findings
+        for name, st in sorted(report.entrypoint_stats.items()):
+            if st.error:
+                # PTA009 already reports the build failure; a second
+                # finding here would double-count the same breakage
+                continue
+            if st.unfused_boundary_bytes <= FUSION_MISS_BYTES_THRESHOLD:
+                continue
+            top = ", ".join(
+                f"{m['kind']} {m['producer']}->{m['consumer']} "
+                f"({m['bytes']} B)"
+                for m in st.top_fusion_misses[:3]) or "?"
+            findings.append(Finding(
+                self.code,
+                st.path or "tools/analyze/trace/__init__.py",
+                st.line or 1, 0,
+                f"entrypoint `{name}`: {st.unfused_boundary_bytes} HBM "
+                f"bytes cross unfused dot boundaries per step across "
+                f"{st.fusion_regions} fusion regions; heaviest: {top} — "
+                f"each is a megakernel candidate (ROADMAP item 1), see "
+                f"--fusion-report for the full ranked table",
+                anchor=f"trace:{name}:fusion-miss",
+                severity="warning"))
+        return findings
+
+
+RULE = FusionMissRule()

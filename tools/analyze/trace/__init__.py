@@ -47,6 +47,11 @@ class EntrypointStats:
     collectives: List[Dict[str, Any]] = field(default_factory=list)
     collective_bytes: int = 0
     collective_issues: List[Dict[str, Any]] = field(default_factory=list)
+    # fusion-miss audit (PTA014): region count, HBM bytes crossing
+    # unfused elementwise/dot/norm boundaries, ranked worst offenders
+    fusion_regions: int = 0
+    unfused_boundary_bytes: int = 0
+    top_fusion_misses: List[Dict[str, Any]] = field(default_factory=list)
 
     def payload(self) -> Dict[str, Any]:
         return {
@@ -60,6 +65,9 @@ class EntrypointStats:
             "collectives": self.collectives,
             "collective_bytes": self.collective_bytes,
             "collective_issues": self.collective_issues,
+            "fusion_regions": self.fusion_regions,
+            "unfused_boundary_bytes": self.unfused_boundary_bytes,
+            "top_fusion_misses": self.top_fusion_misses,
         }
 
 
@@ -81,6 +89,20 @@ class TraceReport:
 
 
 _LAST: Optional[TraceReport] = None
+
+#: entrypoint scope installed by the driver (--changed-only): None = all,
+#: [] = none. Wins over PTA_TRACE_ENTRYPOINTS; an explicit run_audit
+#: names argument wins over both.
+_SCOPE: Optional[List[str]] = None
+
+
+def set_audit_scope(names: Optional[List[str]]) -> None:
+    """Restrict which entrypoints the memoized audit runs (the
+    --changed-only seam). Invalidates any memoized report so the scope
+    takes effect even after a prior full run."""
+    global _SCOPE, _LAST
+    _SCOPE = names
+    _LAST = None
 
 
 def last_report() -> Optional[TraceReport]:
@@ -130,6 +152,8 @@ def run_audit(names: Optional[List[str]] = None) -> TraceReport:
         return TraceReport(platform="unavailable", entrypoint_stats={},
                            error=traceback.format_exc(limit=3))
 
+    if names is None:
+        names = _SCOPE
     if names is None:
         env = os.environ.get("PTA_TRACE_ENTRYPOINTS", "")
         names = [n.strip() for n in env.split(",") if n.strip()] or None
@@ -189,12 +213,169 @@ def audit_spec(name: str, spec, tags: Tuple[str, ...] = (),
             st.fingerprint_stable = (st.fingerprints[0]
                                      == st.fingerprints[1])
 
-            # -- post-XLA census (fusion/copy stats) ------------------------
+            # -- post-XLA census (fusion/copy stats + fusion misses) --------
             compiled = fresh.lower(*spec.make_args(0)).compile()
-            st.hlo = passes.parse_hlo_stats(compiled.as_text())
+            hlo_text = compiled.as_text()
+            st.hlo = passes.parse_hlo_stats(hlo_text)
+            fus = passes.fusion_miss_report(hlo_text)
+            st.fusion_regions = fus["fusion_regions"]
+            st.unfused_boundary_bytes = fus["unfused_boundary_bytes"]
+            st.top_fusion_misses = fus["top_fusion_misses"]
     except Exception:
         st.error = traceback.format_exc(limit=3)
     return st
+
+
+def _resolve_module(root: str, dotted: str) -> Optional[str]:
+    """Root-relative path of a dotted module under ``root``, or None."""
+    base = dotted.replace(".", "/")
+    for cand in (base + ".py", base + "/__init__.py"):
+        if os.path.isfile(os.path.join(root, cand)):
+            return cand
+    return None
+
+
+def _resolve_reexport(root: str, init_relpath: str, name: str,
+                      depth: int = 0) -> List[str]:
+    """Resolve a name re-exported by a package ``__init__.py`` to the
+    submodule(s) that define it, chasing chained re-exports a few hops.
+    Keeps --changed-only scoping precise without traversing the whole
+    hub: ``from paddle_tpu.nn import Linear`` maps to nn/layers.py, not
+    to everything nn's __init__ imports."""
+    import ast
+
+    if depth > 4:
+        return []
+    try:
+        with open(os.path.join(root, init_relpath), "rb") as f:
+            tree = ast.parse(f.read().decode("utf-8", errors="replace"))
+    except (OSError, SyntaxError):
+        return []
+    pkg_parts = init_relpath.replace(os.sep, "/").split("/")[:-1]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        mod = _absolute_module(pkg_parts, node)
+        if not mod:
+            continue
+        for alias in node.names:
+            if (alias.asname or alias.name) != name or alias.name == "*":
+                continue
+            p = _resolve_module(root, f"{mod}.{alias.name}")
+            if p:
+                return [p]
+            p = _resolve_module(root, mod)
+            if p and p.endswith("__init__.py"):
+                return [p] + _resolve_reexport(root, p, alias.name,
+                                               depth + 1)
+            if p:
+                return [p]
+    return []
+
+
+def _absolute_module(pkg_parts: List[str], node) -> str:
+    """Absolute dotted module of an ImportFrom, resolving relative
+    levels against the importing file's package."""
+    if node.level:
+        # `from ..ops import x` in pkg/a/b.py: level 1 anchors at pkg/a,
+        # each extra level walks one package up
+        base_parts = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+        prefix = ".".join(base_parts)
+        return f"{prefix}.{node.module}" if node.module else prefix
+    return node.module or ""
+
+
+def _file_imports(root: str, relpath: str) -> List[str]:
+    """Root-relative paths this file statically imports (module- and
+    function-level), restricted to modules that live under ``root``.
+    Names pulled from package ``__init__.py`` hubs resolve through
+    :func:`_resolve_reexport` to their defining submodules."""
+    import ast
+
+    try:
+        with open(os.path.join(root, relpath), "rb") as f:
+            tree = ast.parse(f.read().decode("utf-8", errors="replace"))
+    except (OSError, SyntaxError):
+        return []
+    pkg_parts = relpath.replace(os.sep, "/").split("/")[:-1]
+    out: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                p = _resolve_module(root, alias.name)
+                if p:
+                    out.append(p)
+        elif isinstance(node, ast.ImportFrom):
+            mod = _absolute_module(pkg_parts, node)
+            for alias in node.names:
+                p = _resolve_module(root, f"{mod}.{alias.name}") \
+                    if mod else None
+                if p:
+                    out.append(p)
+                    continue
+                p = _resolve_module(root, mod) if mod else None
+                if not p:
+                    continue
+                out.append(p)
+                if p.endswith("__init__.py") and alias.name != "*":
+                    out.extend(_resolve_reexport(root, p, alias.name))
+    return [p for p in out if p]
+
+
+#: files that belong to every closure but whose own imports are NOT
+#: followed: the audit registry's load_default_entrypoints() imports all
+#: registration modules, so traversing through it would make every
+#: entrypoint's closure total and defeat the --changed-only scoping
+_CLOSURE_BARRIERS = ("paddle_tpu/core/audit.py",)
+
+
+def _is_barrier(relpath: str) -> bool:
+    """Files whose imports are not traversed: the audit registry and
+    package ``__init__.py`` hubs. Hubs stay closure *members* (editing
+    one re-traces its importers) but names pulled through them resolve
+    per-name via :func:`_resolve_reexport` instead of dragging in every
+    submodule the hub touches."""
+    return (relpath in _CLOSURE_BARRIERS
+            or relpath.endswith("__init__.py"))
+
+
+def _import_closure(root: str, relpath: str,
+                    cache: Dict[str, set]) -> set:
+    """Transitive static import closure of one file (memoized BFS)."""
+    if relpath in cache:
+        return cache[relpath]
+    closure = {relpath}
+    cache[relpath] = closure  # placed before BFS: cycles terminate
+    frontier = [relpath]
+    while frontier:
+        cur = frontier.pop()
+        if _is_barrier(cur) and cur != relpath:
+            continue
+        for dep in _file_imports(root, cur):
+            if dep not in closure:
+                closure.add(dep)
+                frontier.append(dep)
+    return closure
+
+
+def scope_entrypoints(root: str, changed_relpaths) -> List[str]:
+    """Registered entrypoint names whose static import closure touches
+    any changed file — the --changed-only trace scope. An entrypoint's
+    closure starts at its registration file (``ep.path``); an empty
+    result means no entrypoint is affected and the trace tier can skip
+    compiling entirely."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from paddle_tpu.core import audit as _audit
+    eps = _audit.load_default_entrypoints()
+    changed = {p.replace(os.sep, "/") for p in changed_relpaths}
+    cache: Dict[str, set] = {}
+    out = []
+    for name, ep in sorted(eps.items()):
+        if ep.path and _import_closure(root, ep.path, cache) & changed:
+            out.append(name)
+    return out
 
 
 def audit_entrypoint(name: str, ep) -> EntrypointStats:

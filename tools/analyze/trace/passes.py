@@ -362,6 +362,233 @@ def collective_schedule(jaxpr: Any) -> Tuple[List[Dict[str, Any]],
 _HLO_INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*\S+\s+([a-z][\w\-]*)\(")
 
+# full capture: name, result type (possibly a tuple type), opcode
+_HLO_FULL_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"((?:\([^)]*\)|\S+))\s+([a-z][\w\-]*)\(")
+
+# computation header: `[ENTRY ]%name (params) -> type {`
+_HLO_COMP_RE = re.compile(
+    r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{\s*$")
+
+# the computation an instruction calls into (fusion body, reduce apply)
+_HLO_CALLS_RE = re.compile(r"(?:calls|to_apply)=%?([\w.\-]+)")
+
+_DTYPE_OVERRIDE_BYTES = {"pred": 1}
+
+
+def _dtype_bytes(dtype: str) -> int:
+    """Bytes per element of an HLO dtype token (f32, bf16, s8, c64...).
+    The trailing bit count is authoritative; f8 variants (f8e4m3fn) and
+    pred are special-cased."""
+    if dtype in _DTYPE_OVERRIDE_BYTES:
+        return _DTYPE_OVERRIDE_BYTES[dtype]
+    if dtype.startswith("f8"):
+        return 1
+    m = re.search(r"(\d+)", dtype)
+    return max(1, int(m.group(1)) // 8) if m else 4
+
+
+_SHAPE_RE = re.compile(r"([a-z]+[a-z0-9]*)\[([\d,]*)\]")
+
+
+def _shape_bytes(type_str: str) -> int:
+    """Total bytes of an HLO result type string — `f32[4,512]{1,0}`,
+    scalar `s32[]`, or a tuple `(f32[8,4]{1,0}, f32[8]{0})` (summed)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _dtype_bytes(dtype)
+    return total
+
+
+def parse_hlo_module(text: str) -> Dict[str, Any]:
+    """Parse an HLO dump into its computations.
+
+    Returns ``{"entry": name_or_None, "computations": {name: [instr...]}}``
+    where each instr is ``{"name", "opcode", "type", "bytes", "operands",
+    "calls", "line"}`` — operands are the ``%``-referenced instruction
+    names in the first argument list, ``calls`` the fused/applied
+    computation name (or None). Text-level parsing on purpose: the audit
+    already works from ``compiled.as_text()`` and a parser keeps the pass
+    unit-testable on hand-built dumps."""
+    computations: Dict[str, List[Dict[str, Any]]] = {}
+    entry: Any = None
+    current: Any = None
+    for line in text.splitlines():
+        mc = _HLO_COMP_RE.match(line)
+        if mc and "=" not in line.split("(")[0]:
+            current = mc.group(2)
+            computations[current] = []
+            if mc.group(1):
+                entry = current
+            continue
+        if current is None:
+            continue
+        if line.strip() == "}":
+            current = None
+            continue
+        mi = _HLO_FULL_INSTR_RE.match(line)
+        if not mi:
+            continue
+        name, rtype, opcode = mi.groups()
+        # operand list: balanced-paren scan from the opcode's open paren
+        start = mi.end() - 1
+        depth, i = 0, start
+        for i in range(start, len(line)):
+            if line[i] == "(":
+                depth += 1
+            elif line[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        arglist = line[start + 1:i]
+        operands = re.findall(r"%([\w.\-]+)", arglist)
+        mcall = _HLO_CALLS_RE.search(line[i:])
+        computations[current].append({
+            "name": name, "opcode": opcode, "type": rtype,
+            "bytes": _shape_bytes(rtype), "operands": operands,
+            "calls": mcall.group(1) if mcall else None,
+            "line": line.strip(),
+        })
+    return {"entry": entry, "computations": computations}
+
+
+#: opcodes that are pure elementwise math — the producer/consumer halves
+#: XLA's loop fusion could absorb into a neighbouring dot
+_ELEMENTWISE_OPS = {
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "tanh", "negate", "abs", "power", "sqrt", "rsqrt", "cbrt", "sign",
+    "floor", "ceil", "round-nearest-afz", "round-nearest-even",
+    "convert", "select", "compare", "and", "or", "not", "xor",
+    "clamp", "logistic", "erf", "atan2", "remainder", "sine", "cosine",
+    "tan", "shift-left", "shift-right-logical", "shift-right-arithmetic",
+}
+
+_DOT_OPS = {"dot", "convolution"}
+
+#: reduction opcodes — the LayerNorm/softmax statistic half of a
+#: norm->dot chain
+_NORM_OPS = {"reduce", "reduce-window"}
+
+
+def _classify_instr(instr: Dict[str, Any],
+                    computations: Dict[str, List[Dict[str, Any]]],
+                    memo: Dict[str, str]) -> str:
+    """'dot' | 'norm' | 'elementwise' | 'other' for one instruction.
+    Fusions classify by their called computation's contents (a fusion
+    containing a dot is a dot region)."""
+    op = instr["opcode"]
+    if op in _DOT_OPS:
+        return "dot"
+    if op == "custom-call":
+        return ("dot" if re.search(r"(matmul|dot|conv)",
+                                   instr["line"], re.IGNORECASE)
+                else "other")
+    if op in _NORM_OPS:
+        return "norm"
+    if op in _ELEMENTWISE_OPS:
+        return "elementwise"
+    if op == "fusion" and instr["calls"]:
+        return _classify_computation(instr["calls"], computations, memo)
+    return "other"
+
+
+def _classify_computation(name: str,
+                          computations: Dict[str, List[Dict[str, Any]]],
+                          memo: Dict[str, str]) -> str:
+    if name in memo:
+        return memo[name]
+    memo[name] = "other"  # cycle guard
+    ops = {i["opcode"] for i in computations.get(name, ())}
+    called = [i["calls"] for i in computations.get(name, ())
+              if i["calls"]]
+    sub = {_classify_computation(c, computations, memo) for c in called}
+    if ops & _DOT_OPS or "dot" in sub:
+        cls = "dot"
+    elif ops & _NORM_OPS or "norm" in sub:
+        cls = "norm"
+    elif ops & _ELEMENTWISE_OPS or "elementwise" in sub:
+        cls = "elementwise"
+    else:
+        cls = "other"
+    memo[name] = cls
+    return cls
+
+
+#: producer-class -> consumer-class pairs that XLA's fusion pass could
+#: have merged; each surviving edge is HBM traffic a megakernel removes
+_MISS_KINDS = {
+    ("elementwise", "dot"): "elementwise->dot",
+    ("norm", "dot"): "norm->dot",
+    ("dot", "elementwise"): "dot->elementwise",
+    ("dot", "norm"): "dot->elementwise",
+}
+
+
+def fusion_miss_report(text: str, top_n: int = 10) -> Dict[str, Any]:
+    """Segment an optimized HLO dump into fusion regions and rank the
+    unfused elementwise->dot / dot->elementwise / norm->dot boundaries by
+    the HBM bytes crossing them.
+
+    Every def-use edge in the ENTRY computation between two compute
+    regions is a fusion boundary: the producer's result materializes in
+    HBM and is re-read by the consumer. Edges whose (producer class,
+    consumer class) pair XLA's producer/consumer loop fusion could have
+    merged (``_MISS_KINDS``) are misses; ``unfused_boundary_bytes`` sums
+    the producer result bytes over ALL misses and ``top_fusion_misses``
+    keeps the ``top_n`` heaviest — the ranked work order for hand-fused
+    Pallas megakernels (ROADMAP item 1).
+    """
+    mod = parse_hlo_module(text)
+    computations = mod["computations"]
+    entry_instrs = computations.get(mod["entry"], [])
+    memo: Dict[str, str] = {}
+    cls_of: Dict[str, str] = {}
+    instr_of: Dict[str, Dict[str, Any]] = {}
+    regions = 0
+    for instr in entry_instrs:
+        cls = _classify_instr(instr, computations, memo)
+        cls_of[instr["name"]] = cls
+        instr_of[instr["name"]] = instr
+        if instr["opcode"] == "fusion" or cls != "other":
+            regions += 1
+    misses: List[Dict[str, Any]] = []
+    seen_edges = set()
+    for instr in entry_instrs:
+        ccls = cls_of[instr["name"]]
+        for op_name in instr["operands"]:
+            pcls = cls_of.get(op_name)
+            if pcls is None:
+                continue
+            kind = _MISS_KINDS.get((pcls, ccls))
+            if kind is None:
+                continue
+            edge = (op_name, instr["name"])
+            if edge in seen_edges:
+                continue
+            seen_edges.add(edge)
+            producer = instr_of[op_name]
+            misses.append({
+                "kind": kind,
+                "producer": op_name,
+                "producer_op": producer["opcode"],
+                "consumer": instr["name"],
+                "consumer_op": instr["opcode"],
+                "bytes": producer["bytes"],
+                "shape": producer["type"],
+            })
+    misses.sort(key=lambda m: (-m["bytes"], m["producer"], m["consumer"]))
+    return {
+        "fusion_regions": regions,
+        "unfused_boundary_bytes": sum(m["bytes"] for m in misses),
+        "top_fusion_misses": misses[:top_n],
+    }
+
 
 def parse_hlo_stats(text: str) -> Dict[str, int]:
     """Opcode census of an HLO dump (``compiled.as_text()``): total

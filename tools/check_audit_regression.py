@@ -69,6 +69,12 @@ COPY_FRACTION_SLACK = 0.05
 #: accidental full-replica gather — moves it a lot)
 COLLECTIVE_BYTES_SLACK = 0.05
 
+#: unfused_boundary_bytes (PTA014) may grow this much relatively before
+#: failing: XLA version skew nudges fusion decisions a little; a real
+#: de-fusion — a new elementwise stage materializing before a matmul —
+#: adds a whole activation's worth of HBM traffic
+FUSION_BYTES_SLACK = 0.05
+
 
 def summarize(payload):
     """Reduce a stats_payload to the gated per-entrypoint counters."""
@@ -92,6 +98,8 @@ def summarize(payload):
             "copy_fraction": round(int(hlo.get("copies", 0)) / instrs, 4),
             "collective_bytes": int(st.get("collective_bytes", 0)),
             "collective_issues": len(st.get("collective_issues") or []),
+            "unfused_boundary_bytes":
+                int(st.get("unfused_boundary_bytes", 0)),
         }
     return out
 
@@ -135,6 +143,16 @@ def compare(baseline, current):
                 f"{int(base_bytes * (1.0 + COLLECTIVE_BYTES_SLACK))}) — "
                 f"the step is putting more traffic on the wire per "
                 f"iteration")
+        base_fus = int(base.get("unfused_boundary_bytes", 0))
+        cur_fus = int(cur.get("unfused_boundary_bytes", 0))
+        if cur_fus > base_fus * (1.0 + FUSION_BYTES_SLACK):
+            problems.append(
+                f"{name}: unfused_boundary_bytes regressed "
+                f"{base_fus} -> {cur_fus} (allowed <= "
+                f"{int(base_fus * (1.0 + FUSION_BYTES_SLACK))}) — a "
+                f"fusion boundary opened around a matmul; see "
+                f"`python -m tools.analyze --only PTA014` for the "
+                f"ranked misses")
     return problems
 
 

@@ -43,9 +43,10 @@ def main():
     ap.add_argument("--no-analyze", action="store_true",
                     help="skip the static-analysis gate")
     ap.add_argument("--trace-audit", action="store_true",
-                    help="also run the trace tier (PTA009/PTA010): "
-                         "compiles every registered entrypoint under "
-                         "JAX_PLATFORMS=cpu and writes the trace report")
+                    help="also run the trace tier (PTA009/PTA010/PTA012/"
+                         "PTA014): compiles every registered entrypoint "
+                         "under JAX_PLATFORMS=cpu and writes the trace "
+                         "report (plus the PTA014 fusion_audit.json)")
     ap.add_argument("--trace-audit-output", default="trace_audit.json",
                     help="where --trace-audit writes its report (default "
                          "%(default)s, which .gitignore covers; keep "
@@ -119,7 +120,7 @@ def main():
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         code = subprocess.call(
             [sys.executable, "-m", "tools.analyze", "--strict",
-             "--only", "PTA009,PTA010,PTA012",
+             "--only", "PTA009,PTA010,PTA012,PTA014",
              "--trace-report", args.trace_audit_output, "paddle_tpu"],
             cwd=REPO, env=env)
         print(f"trace audit: exit {code} ({time.time() - t0:.0f}s)")
