@@ -31,7 +31,8 @@ from .batcher import Batch, DynamicBatcher  # noqa: F401
 from .engine import Engine, EngineConfig  # noqa: F401
 from .request import (  # noqa: F401
     Deadline, DeadlineExceeded, EngineDraining, EngineKilled,
-    InferenceRequest, QueueFull, RequestTooLarge, ServingError)
+    InferenceRequest, QueueFull, RequestTooLarge, ServingError,
+    TokenStreamDivergence)
 from .sharding import ShardingSpec, ResolvedSharding  # noqa: F401
 from .replica import Replica  # noqa: F401
 from .router import (  # noqa: F401
@@ -43,7 +44,8 @@ __all__ = [
     "ExecutableCache", "default_cache", "signature_of", "BatchQueue",
     "DynamicBatcher", "Batch", "InferenceRequest", "Deadline",
     "DeadlineExceeded", "EngineDraining", "EngineKilled", "QueueFull",
-    "RequestTooLarge", "ServingError", "ShardingSpec", "ResolvedSharding",
+    "RequestTooLarge", "ServingError", "TokenStreamDivergence",
+    "ShardingSpec", "ResolvedSharding",
     "Replica", "Router", "RouterConfig", "NoHealthyReplicas",
     "llm_replica_factory", "predictor_replica_factory", "llm", "fleet",
 ]

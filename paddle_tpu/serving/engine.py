@@ -159,12 +159,14 @@ class DrainableEngineBase:
     def was_killed(self) -> bool:
         return self._killed.is_set()
 
-    def kill(self, reason: str = "killed") -> int:
+    def kill(self, reason: str = "killed") -> List[dict]:
         """Hard-kill (in-process SIGKILL analog): fail every queued request
         with :class:`EngineKilled` immediately — unlike drain, nothing is
         flushed — and flag the worker to abort in-flight work at its next
-        poll point. Returns the number of queued requests failed. Safe to
-        call from any thread; idempotent."""
+        poll point. Returns one snapshot record per failed request
+        (``{"req_id", "phase", "tokens"}``) so recovery paths can
+        enumerate what was in the engine. Safe to call from any thread;
+        idempotent."""
         self._kill_reason = str(reason)
         self._killed.set()
         self._draining.set()
@@ -204,7 +206,9 @@ class Engine(DrainableEngineBase):
         self._batcher = DynamicBatcher(
             self._queue, self._config.buckets,
             max_batch_delay=self._config.max_batch_delay)
-        self._inflight: set = set()
+        # admitted-but-unresolved futures, keyed to their request id so
+        # kill() can return an exact snapshot of what was in flight
+        self._inflight: dict = {}
         self._inflight_lock = threading.Lock()
         self._worker = threading.Thread(
             target=self._worker_loop, name="paddle-tpu-serving-worker",
@@ -293,7 +297,7 @@ class Engine(DrainableEngineBase):
             self._stat_add("rejected_queue_full", 1)
             raise
         with self._inflight_lock:
-            self._inflight.add(req.future)
+            self._inflight[req.future] = req.req_id
         req.future.add_done_callback(self._forget_future)
         self._stat_set("queue_depth", len(self._queue))
         return req.future
@@ -302,6 +306,18 @@ class Engine(DrainableEngineBase):
                     deadline: Optional[Union[Deadline, float]] = None):
         return [self.submit(inputs, deadline=deadline)
                 for inputs in requests]
+
+    def kill(self, reason: str = "killed") -> List[dict]:
+        """Hard-kill, returning records for queued requests (failed here)
+        AND the admitted-but-unresolved ones the worker will abort at its
+        next poll point (``phase: "inflight"``)."""
+        records = list(super().kill(reason))
+        seen = {r["req_id"] for r in records}
+        with self._inflight_lock:
+            records += [{"req_id": rid, "phase": "inflight", "tokens": 0}
+                        for rid in self._inflight.values()
+                        if rid not in seen]
+        return records
 
     def drain(self, timeout: Optional[float] = None) -> List:
         """Graceful drain: stop admission, flush every queued request, wait
@@ -339,7 +355,7 @@ class Engine(DrainableEngineBase):
     # -- worker -------------------------------------------------------------
     def _forget_future(self, fut):
         with self._inflight_lock:
-            self._inflight.discard(fut)
+            self._inflight.pop(fut, None)
 
     def _worker_loop(self):
         poll = max(0.01, self._config.max_batch_delay)

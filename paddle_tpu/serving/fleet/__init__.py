@@ -10,8 +10,13 @@ resurrection, engine admission pause, checkpoint health stamps):
   replica set with hysteresis + cooldown (docs/serving.md, "Fleet
   operations");
 * :class:`WeightSwapper` — rolls a committed, health-stamped checkpoint
-  across replicas one at a time with quiesce → swap → probe → readmit,
-  and automatic rollback on a failed probe;
+  across replicas one at a time with migrate-out → quiesce → swap →
+  probe → readmit, and automatic rollback on a failed probe;
+* :mod:`migrate` — zero-loss serving: :class:`FleetMigrator` moves
+  running sequences (paged KV pages included) between replicas for
+  park/swap, and replays :class:`SequenceJournal`-tracked sequences
+  onto survivors after a replica kill (docs/fault_tolerance.md,
+  "Zero-loss serving");
 * :mod:`replay` — record/synthesize request traces and replay them with
   arrival-time fidelity (the chaos-harness substrate of
   ``tools/bench_fleet.py``).
@@ -21,6 +26,8 @@ admission flags, loading checkpoints. None of it runs on the request hot
 path (PTA002 lints this package with hot-path strictness to keep it so).
 """
 from .autoscaler import SLO, Autoscaler, AutoscalerConfig  # noqa: F401
+from .migrate import (MANIFEST_VERSION, FleetMigrator,  # noqa: F401
+                      SequenceJournal, SequenceManifest)
 from .replay import (TraceRecorder, TraceReplayer,  # noqa: F401
                      load_trace, save_trace, synthesize_trace)
 from .swap import SwapError, WeightSwapper  # noqa: F401

@@ -15,7 +15,11 @@ bursty and a controller that reacts to single-tick spikes oscillates:
   uses.
 * **calm** (no breach) for ``calm_ticks`` consecutive ticks with more
   than ``slo.min_replicas`` active → scale DOWN by parking the
-  least-loaded active replica (graceful drain; in-flight work finishes).
+  least-loaded active replica. On fleets with live sequence migration
+  the park is immediate — in-flight sequences move to siblings with
+  their KV pages and keep streaming (docs/fault_tolerance.md,
+  "Zero-loss serving"); otherwise the park is a graceful drain and
+  in-flight work finishes in place.
 
 The controller never creates or destroys replicas — the Router owns
 ``max_replicas`` shells for its whole life and the autoscaler only moves

@@ -1,7 +1,10 @@
 """Live weight hot-swap: roll a committed checkpoint across the fleet.
 
 One replica at a time: pause (router stops dispatching, engine keeps its
-in-flight work), quiesce (every slot retires into the paused admission
+in-flight work), migrate-out (running sequences move — paged KV pages
+and all — onto siblings still serving the prior weights, so the quiesce
+below is instant; fleets without live migration skip this and drain the
+old way), quiesce (every slot retires into the paused admission
 gate), swap (``set_state_dict`` + param re-extract — the decode/prefill
 executables are keyed by spec and dtype, not parameter values, so the
 persistent cache serves them unchanged and the roll costs zero
@@ -121,6 +124,21 @@ class WeightSwapper:
                 if action == "slow_io":
                     time.sleep(float(os.environ.get(
                         "PADDLE_TPU_FAULT_SLOW_IO_S", "0.2")))
+                # zero-loss roll: instead of waiting for the quiesce to
+                # drain every in-flight sequence through this (possibly
+                # slow_io-widened) window, move them — KV pages and all —
+                # onto siblings still serving the prior weights. The
+                # swap's internal quiesce then completes instantly. Any
+                # sequence migration could not move (no migrator, engine
+                # without paged KV, no admissible sibling) simply rides
+                # out the quiesce as before — a latency cost, never a
+                # drop.
+                migrator = getattr(self.router, "migrator", None)
+                if migrator is not None and \
+                        getattr(engine, "supports_migration", False):
+                    mig = migrator.migrate_replica(replica, reason="swap")
+                    report.setdefault("migrated", {})[rid] = (
+                        mig["imported"] + mig["replayed"] + mig["requeued"])
                 version = engine.swap_weights(
                     weights, timeout=self._quiesce_timeout)
                 if action in ("fail", "disk_full"):

@@ -261,7 +261,9 @@ class PagedBatcher(ContinuousBatcher):
         self._stat_observe("prefill_ms", (now - t0) * 1000.0)
         self._stat_observe("ttft_ms", (now - req.t_enqueue) * 1000.0)
         self._stat_add("prefills", 1)
-        req._emit(tok)
+        if not req._emit(tok):
+            self._forget(slot, req)
+            return
         req._t_last = now
         self._stat_add("tokens_generated", 1)
         self._maybe_finish(slot, req, tok)
@@ -293,7 +295,7 @@ class PagedBatcher(ContinuousBatcher):
             req = self._reqs.get(slot)
             if req is None:
                 continue
-            pos = req.prompt_len + len(req.tokens) - 1
+            pos = req.seq_len - 1
             need_tok = min(pos + horizon, self.config.max_seq)
             while True:
                 try:
@@ -334,7 +336,121 @@ class PagedBatcher(ContinuousBatcher):
         self._stat_add("pages_evicted_midstream", 1)
         self._stat_add("evicted_midstream", 1)
 
+    # -- live sequence migration (docs/fault_tolerance.md) -------------------
+    #: the paged substrate can ship sequences as page payloads
+    supports_export = True
+
+    def export_all(self):
+        """Snapshot-and-detach every live sequence into host-side
+        manifests (worker thread, between ticks). A request still
+        mid-replay from an earlier resume ships payload-free — its
+        cache is not yet a faithful transcript, so the target replays
+        it instead of splicing. Pending (page-starved) requests ship
+        cold. On return the batcher holds none of them."""
+        from ...fleet.migrate import SequenceManifest
+        sig = self.decoder.prefix_sig(self.kv)
+        out = []
+        for slot in sorted(self._reqs):
+            req = self._reqs[slot]
+            if req._replay_pos is None:
+                n_cached = req.seq_len - 1   # last token not yet in cache
+                pids, k_pages, v_pages = self.decoder.export_sequence(
+                    self.kv, slot, n_cached)
+                man = SequenceManifest(
+                    req, req.prompt, req.tokens, req.sampling,
+                    weights_version=req.weights_version,
+                    n_cached_tokens=n_cached,
+                    page_size=self.kv.page_size, sig=sig,
+                    k_pages=k_pages, v_pages=v_pages)
+            else:
+                man = SequenceManifest.for_queued(req)
+            out.append(man)
+            del self._reqs[slot]
+            self.kv.free(slot)
+            self._unpin_prefix(req)
+        while self._pending:
+            out.append(SequenceManifest.for_queued(
+                self._pending.popleft()))
+        self._stat_set("pages_pending_requests", 0)
+        self._publish_pages()
+        return out
+
+    def import_manifest(self, man) -> bool:
+        """Splice a migrated sequence into a free slot and arm it for
+        the next tick (worker thread, between ticks). Page-aligned
+        prompt-prefix pages this engine already holds are adopted
+        zero-copy through the prefix store's chain hash; the rest are
+        allocated and filled from the shipped payload. Returns False
+        WITHOUT side effects when geometry differs or the slot table /
+        page pool cannot take it — the migrator falls back to replay."""
+        if man.sig != self.decoder.prefix_sig(self.kv) \
+                or man.page_size != self.kv.page_size:
+            return False
+        n_cached = man.n_cached_tokens
+        if not (0 < n_cached < self.config.max_seq) or not man.tokens:
+            return False
+        if self.kv.free_slots < 1:
+            return False
+        req = man.req
+        page = self.kv.page_size
+        total = pages_for_tokens(n_cached, page)
+        entry, reuse_n = None, 0
+        if self.prefix_store is not None:
+            entry, reuse_n = self.prefix_store.lookup(
+                req.prompt, min(req.prompt_len, n_cached), man.sig)
+            reuse_n = (reuse_n // page) * page   # whole pages only
+            if entry is not None and reuse_n <= 0:
+                self.prefix_store.unpin(entry)
+                entry, reuse_n = None, 0
+        shared = reuse_n // page
+        # same admission math as _try_admit: tail pages + one lookahead
+        # page per running sequence
+        shortfall = (total - shared) + len(self._reqs) \
+            - self.kv.pool.free_pages
+        if shortfall > 0 and self.prefix_store is not None:
+            shortfall -= self.prefix_store.evict_unpinned(shortfall)
+        if shortfall > 0:
+            if entry is not None:
+                self.prefix_store.unpin(entry)
+            return False
+        slot = self.kv.alloc()
+        try:
+            if shared:
+                for pid in entry.page_ids[:shared]:
+                    self.kv.adopt_shared_page(slot, pid)
+                self.prefix_store.note_shared(
+                    shared * self.kv.page_nbytes())
+            self.decoder.import_sequence(
+                self.kv, slot, n_cached, man.k_pages, man.v_pages,
+                shared_pages=shared)
+        except Exception:
+            self.kv.free(slot)
+            if entry is not None:
+                self.prefix_store.unpin(entry)
+            raise
+        req._prefix_entry = entry
+        req._t_last = None
+        self._reqs[slot] = req
+        self._slot_samp[slot] = req.sampling
+        self._samp_vecs = pack_sampling(self._slot_samp)
+        # arm the compiled step's per-slot state: the next tick feeds
+        # the last emitted token and writes its KV row at n_cached
+        self._finished = self._finished.at[slot].set(False)
+        self._last = self._last.at[slot].set(int(req.tokens[-1]))
+        self._stat_add("migrated_pages_shared", shared)
+        self._stat_add("migrated_pages_copied", total - shared)
+        self._publish_pages()
+        return True
+
     # -- exits ---------------------------------------------------------------
+    def evacuate(self):
+        out = super().evacuate()
+        while self._pending:
+            out.append(self._pending.popleft())
+        self._stat_set("pages_pending_requests", 0)
+        self._publish_pages()
+        return out
+
     def abort_all(self, exc_factory):
         super().abort_all(exc_factory)
         while self._pending:

@@ -41,7 +41,8 @@ from ..decode import (GPTDecodeSpec, GPTStaticDecoder, _AUDIT_SPEC,
 from ..kvcache import (dequantize_kv, is_quantized_kv, kv_layer_view,
                        kv_stack_layers, valid_mask)
 from .pool import (PagedKVCache, paged_gather_rows,
-                   paged_write_prompt_rows, paged_write_rows)
+                   paged_write_prompt_rows, paged_write_rows,
+                   pages_for_tokens)
 
 
 def _write_page_index(block_tables, positions, page_size):
@@ -456,6 +457,48 @@ class GPTPagedDecoder(GPTStaticDecoder):
             last_tokens, *samp_vecs, key)
         kv.swap(k, v, lengths)
         return nxt, finished
+
+    # -- live sequence migration (docs/fault_tolerance.md) -------------------
+    def export_sequence(self, kv: PagedKVCache, slot: int, n_tokens: int):
+        """Snapshot the device half of a live sequence: host copies of
+        the arena pages backing logical rows ``[0, n_tokens)``. Returns
+        ``(page_ids, k_pages, v_pages)`` — the payload the migrator
+        wraps into a :class:`~paddle_tpu.serving.fleet.migrate.
+        SequenceManifest`. The sampling/progress half (tokens, RNG
+        discipline, position) is host-derivable and assembled by the
+        batcher; only the KV rows need a device fetch. Runs between
+        decode ticks (engine worker), never inside one."""
+        n_pages = pages_for_tokens(n_tokens, self.page_size)
+        pids = kv.slot_page_ids(slot)[:n_pages]
+        if len(pids) < n_pages:
+            raise ValueError(
+                f"slot {slot} maps {len(pids)} pages but {n_pages} are "
+                f"needed for {n_tokens} cached tokens")
+        k_pages, v_pages = kv.read_pages(pids)
+        return pids, k_pages, v_pages
+
+    def import_sequence(self, kv: PagedKVCache, slot: int, n_tokens: int,
+                        k_pages, v_pages, shared_pages: int = 0):
+        """Splice an exported sequence into ``slot``: pages
+        ``[0, shared_pages)`` were already adopted zero-copy from this
+        engine's prefix store (the chain-hash path); the remaining tail
+        pages are allocated here and filled from the shipped payload.
+        Installs the resume position so the next decode tick writes the
+        exact next token."""
+        total = pages_for_tokens(n_tokens, self.page_size)
+        if not (0 <= shared_pages <= total):
+            raise ValueError(
+                f"shared_pages {shared_pages} out of range for "
+                f"{total} total pages")
+        kv.ensure_pages(slot, n_tokens)
+        pids = kv.slot_page_ids(slot)
+        tmap = jax.tree_util.tree_map
+        for i in range(shared_pages, total):
+            kv.write_page(pids[i],
+                          tmap(lambda x, i=i: x[i], k_pages),
+                          tmap(lambda x, i=i: x[i], v_pages))
+        kv.set_length(slot, n_tokens)
+        return total - shared_pages
 
 
 # -- trace-audit registration (tools/analyze/trace, PTA009/PTA012) -----------

@@ -164,6 +164,23 @@ def _arena_copy_page(buf, dst, src):
     return jax.tree_util.tree_map(_cp, buf)
 
 
+@jax.jit
+def _arena_write_page(buf, dst, page):
+    """Install one host-shipped physical page at index ``dst`` — the
+    import half of sequence migration. ``page`` carries a single page's
+    rows per leaf (``[L, page, H, D]``, or the quantized ``q``/``s``
+    pair); scalar-indexed so one traced program serves every dst."""
+    def _wr(x, p):
+        return jax.lax.dynamic_update_slice_in_dim(
+            x, p[None].astype(x.dtype), dst, axis=0)
+    return jax.tree_util.tree_map(_wr, buf, page)
+
+
+@jax.jit
+def _len_set(lengths, slot, n):
+    return lengths.at[slot].set(n)
+
+
 # -- functional writers / readers (used inside jitted programs) --------------
 
 def paged_write_rows(buf, rows, pids, ppos):
@@ -389,6 +406,43 @@ class PagedKVCache:
         self._map_page(slot, pid)
         self.cow_splits += 1
         return pid
+
+    # -- sequence migration (cold path: export / import) ---------------------
+    def read_pages(self, page_ids) -> Tuple[object, object]:
+        """Host copies of the K and V arena rows for ``page_ids`` — the
+        export half of sequence migration. One gather + one transfer per
+        arena leaf (``[n, L, page, H, D]`` stacked over the requested
+        pages, or the quantized ``q``/``s`` pair). Runs between decode
+        ticks on the engine worker, never inside one."""
+        idx = jnp.asarray([int(p) for p in page_ids], jnp.int32)
+
+        def _take(buf):
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(jnp.take(x, idx, axis=0))),  # noqa: PTA002 -- sequence-export page fetch: a deliberate once-per-migration transfer on the between-tick control path
+                buf)
+        return _take(self.k), _take(self.v)
+
+    def write_page(self, pid: int, k_page, v_page):
+        """Install one host-shipped page (a ``read_pages`` row) at
+        physical index ``pid`` — the import half of migration. The page
+        must already be owned by the caller (allocated/mapped); sharers
+        would observe the write."""
+        if self.pool.refcount(pid) != 1:
+            raise ValueError(
+                f"write_page({pid}): refcount "
+                f"{self.pool.refcount(pid)} != 1 — importing into a "
+                f"shared or free page would corrupt sharers")
+        dst = jnp.asarray(pid, jnp.int32)
+        self.k = _arena_write_page(self.k, dst, k_page)
+        self.v = _arena_write_page(self.v, dst, v_page)
+
+    def set_length(self, slot: int, n_tokens: int):
+        """Install a migrated sequence's resume position in the device
+        lengths vector (the next decode step's write coordinate)."""
+        if not (0 <= n_tokens <= self.max_seq):
+            raise ValueError(f"set_length({slot}, {n_tokens})")
+        self.lengths = _len_set(self.lengths, jnp.asarray(slot, jnp.int32),
+                                jnp.asarray(n_tokens, jnp.int32))
 
     # -- functional state threading ------------------------------------------
     def swap(self, k, v, lengths):

@@ -22,7 +22,9 @@ ticking until every in-flight sequence finishes and the queue is flushed
 """
 from __future__ import annotations
 
+import collections
 import itertools
+import os
 import queue as _pyqueue
 import threading
 import time
@@ -40,8 +42,10 @@ from ..buckets import pow2_buckets
 from ..cache import ExecutableCache, default_cache
 from ..engine import DrainableEngineBase
 from ..queue import BatchQueue
+from ...utils.resilience import fault_injector
 from ..request import (Deadline, DeadlineExceeded, EngineDraining,
-                       EngineKilled, RequestTooLarge)
+                       EngineKilled, RequestTooLarge,
+                       TokenStreamDivergence)
 from .decode import GPTStaticDecoder, SamplingParams, pack_sampling
 from .kvcache import StaticKVCache
 from .prefix import PrefixStore
@@ -65,7 +69,7 @@ class GenerationRequest:
     __slots__ = ("req_id", "prompt", "sampling", "deadline", "future",
                  "t_enqueue", "t_first_token", "tokens", "finish_reason",
                  "_stream_q", "_clock", "_prefix_entry", "_t_last",
-                 "weights_version")
+                 "weights_version", "_replay_pos", "_resume_offset")
 
     def __init__(self, prompt, sampling: SamplingParams,
                  deadline: Optional[Deadline] = None, stream: bool = False,
@@ -93,10 +97,26 @@ class GenerationRequest:
         # whole generation runs on that one generation (hot-swap waits
         # for slots to quiesce), so the result is bitwise old-or-new
         self.weights_version: Optional[int] = None
+        # resume-dedup guard (docs/fault_tolerance.md "Zero-loss
+        # serving"): after a migration/replay rebind, `_replay_pos`
+        # marks the next already-streamed token the engine must
+        # re-verify before any NEW token may flow; `_resume_offset`
+        # counts generated tokens folded into the rebuilt prompt so
+        # `seq_len` stays invariant across resumes.
+        self._replay_pos: Optional[int] = None
+        self._resume_offset = 0
 
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.size)
+
+    @property
+    def seq_len(self) -> int:
+        """Logical sequence length: ORIGINAL prompt + generated tokens.
+        Invariant under resume (a replayed request's ``prompt`` holds
+        already-generated tokens; ``_resume_offset`` backs them out), so
+        capacity and length-budget checks never double-count."""
+        return self.prompt_len - self._resume_offset + len(self.tokens)
 
     @property
     def expired(self) -> bool:
@@ -120,12 +140,62 @@ class GenerationRequest:
             f"generation request {self.req_id} exceeded its "
             f"{self.deadline.seconds}s deadline"))
 
-    def _emit(self, tok: int):
+    def begin_resume(self, n_resume: int) -> "GenerationRequest":
+        """Rebind this request for resumption on another engine with
+        ``n_resume`` generated tokens' worth of state restored (from a
+        migrated KV splice or a journal replay). The prompt is rebuilt
+        as ``original_prompt + tokens[:n_resume]`` so a plain prefill
+        reconstructs the cache, and the dedup guard arms: every
+        re-generated token in ``tokens[n_resume:]`` is VERIFIED against
+        what the client already received and swallowed — the stream
+        resumes at the exact next unseen token, or fails loudly with
+        :class:`TokenStreamDivergence`. Raises (gap direction) when
+        ``n_resume`` exceeds what the client has."""
+        n = int(n_resume)
+        if n < 0 or n > len(self.tokens):
+            raise TokenStreamDivergence(
+                f"request {self.req_id}: cannot resume at token {n}; "
+                f"the client has {len(self.tokens)} — the restored "
+                f"state is AHEAD of the stream and would emit a gap")
+        base = self.prompt[:self.prompt.size - self._resume_offset]
+        if n:
+            self.prompt = np.concatenate(
+                [base,
+                 np.asarray(self.tokens[:n], np.int32)])  # noqa: PTA002 -- self.tokens is a host-side list of emitted ints, not a device value
+        else:
+            self.prompt = base
+        self._resume_offset = n
+        self._replay_pos = n if n < len(self.tokens) else None
+        self._t_last = None
+        return self
+
+    def _emit(self, tok: int) -> bool:
+        """Deliver one engine-produced token. During a resume replay the
+        token is verified against the already-streamed transcript and
+        swallowed (never re-delivered); a mismatch fails the request
+        with :class:`TokenStreamDivergence` and returns False — the
+        caller must then forget the slot without finishing."""
+        if self._replay_pos is not None:
+            pos = self._replay_pos
+            if pos < len(self.tokens):
+                if tok != self.tokens[pos]:
+                    self.fail(TokenStreamDivergence(
+                        f"request {self.req_id}: resumed stream produced "
+                        f"token {tok} at position {pos} but the client "
+                        f"already received {self.tokens[pos]} — refusing "
+                        f"to corrupt the stream"))
+                    return False
+                self._replay_pos = pos + 1
+                if self._replay_pos >= len(self.tokens):
+                    self._replay_pos = None
+                return True
+            self._replay_pos = None
         if self.t_first_token is None:
             self.t_first_token = self._clock()
         self.tokens.append(tok)
         if self._stream_q is not None:
             self._stream_q.put(tok)
+        return True
 
     def _finish(self, reason: str):
         self.finish_reason = reason
@@ -445,7 +515,9 @@ class ContinuousBatcher:
         self._stat_observe("prefill_ms", (now - t0) * 1000.0)
         self._stat_observe("ttft_ms", (now - req.t_enqueue) * 1000.0)
         self._stat_add("prefills", 1)
-        req._emit(tok)
+        if not req._emit(tok):
+            self._forget(slot, req)
+            return
         req._t_last = now
         self._stat_add("tokens_generated", 1)
         self._maybe_finish(slot, req, tok)
@@ -473,7 +545,7 @@ class ContinuousBatcher:
         rows up to position + k."""
         k = self.spec.k
         for req in self._reqs.values():
-            pos = req.prompt_len + len(req.tokens) - 1
+            pos = req.seq_len - 1
             if pos + k + 1 > self.config.max_seq:
                 return False
         return True
@@ -530,13 +602,15 @@ class ContinuousBatcher:
         s = req.sampling
         for tok in toks:
             tok = int(tok)
-            req._emit(tok)
+            if not req._emit(tok):
+                self._forget(slot, req)
+                break
             emitted += 1
             if s.eos_token_id is not None and tok == int(s.eos_token_id):
                 self._release(slot, req, "stop")
                 break
             if len(req.tokens) >= s.max_new_tokens \
-                    or req.prompt_len + len(req.tokens) >= self.config.max_seq:
+                    or req.seq_len >= self.config.max_seq:
                 self._release(slot, req, "length")
                 break
         return emitted
@@ -569,7 +643,9 @@ class ContinuousBatcher:
                 self._evict(slot, req)
                 continue
             tok = int(toks[slot])
-            req._emit(tok)
+            if not req._emit(tok):
+                self._forget(slot, req)
+                continue
             if req._t_last is not None:
                 self._stat_observe("intertoken_ms",
                                    (now - req._t_last) * 1000.0)
@@ -600,7 +676,7 @@ class ContinuousBatcher:
             self._release(slot, req, "stop")
         elif len(req.tokens) >= s.max_new_tokens:
             self._release(slot, req, "length")
-        elif req.prompt_len + len(req.tokens) >= self.config.max_seq:
+        elif req.seq_len >= self.config.max_seq:
             self._release(slot, req, "length")
 
     def _unpin_prefix(self, req: GenerationRequest):
@@ -632,6 +708,28 @@ class ContinuousBatcher:
             f"{req.deadline.seconds}s deadline after "
             f"{len(req.tokens)} tokens"))
         self._stat_add("evicted_midstream", 1)
+
+    def _forget(self, slot: int, req: GenerationRequest):
+        """Reclaim a slot whose request already resolved (the dedup
+        guard failed it mid-replay): free resources, touch neither the
+        future nor the stream."""
+        del self._reqs[slot]
+        self.kv.free(slot)
+        self._unpin_prefix(req)
+        self._stat_add("stream_divergence", 1)
+
+    def evacuate(self) -> List[GenerationRequest]:
+        """Detach every in-flight request WITHOUT failing it — the
+        zero-loss half of a hard kill. Slots and prefix pins are
+        reclaimed; the futures stay pending for the router's recovery
+        replay (docs/fault_tolerance.md "Zero-loss serving")."""
+        out: List[GenerationRequest] = []
+        for slot, req in list(self._reqs.items()):
+            del self._reqs[slot]
+            self.kv.free(slot)
+            self._unpin_prefix(req)
+            out.append(req)
+        return out
 
     def abort_all(self, exc_factory):
         """Fail every in-flight sequence (forced shutdown, not drain)."""
@@ -789,6 +887,15 @@ class LLMEngine(DrainableEngineBase):
                 self._decoder, self._config, self._registry,
                 prefix_store=self._prefix_store, spec_decoder=spec_decoder)
         self._queue = BatchQueue(max_size=self._config.max_queue)
+        # between-tick control plane (docs/fault_tolerance.md "Zero-loss
+        # serving"): closures queued here run ON the worker thread at the
+        # top of its loop — never concurrent with a decode tick. The
+        # sequence export/import paths ride this so migration can touch
+        # batcher state without a lock on the hot path.
+        self._ctl: "collections.deque" = collections.deque()
+        #: crash-recovery journal; armed by :meth:`enable_recovery`
+        self.journal = None
+        self._evacuated: List[GenerationRequest] = []
         if self._config.warmup:
             self._batcher.warmup()
         self._worker = threading.Thread(
@@ -926,6 +1033,171 @@ class LLMEngine(DrainableEngineBase):
              "cache_misses_before": misses_before})
         return self._batcher.weights_version
 
+    # -- zero-loss serving: migration + crash recovery -----------------------
+    # (docs/fault_tolerance.md "Zero-loss serving")
+    def _run_on_worker(self, fn, timeout: float = 30.0):
+        """Run ``fn`` on the engine worker at the top of its next loop
+        iteration — i.e. BETWEEN decode ticks, never concurrent with
+        one. Blocks the caller until serviced; re-raises whatever ``fn``
+        raised. A worker that exits first fails the call with
+        :class:`EngineKilled` instead of hanging it."""
+        if self._stopped.is_set():
+            raise EngineKilled(
+                f"engine worker already stopped "
+                f"({self._kill_reason or 'drained'})")
+        box: Dict[str, object] = {}
+        ev = threading.Event()
+        self._ctl.append((fn, box, ev))
+        if not ev.wait(timeout):
+            raise TimeoutError(
+                f"engine worker did not service the control call within "
+                f"{timeout}s")
+        if "exc" in box:
+            raise box["exc"]
+        return box.get("ret")
+
+    @property
+    def supports_migration(self) -> bool:
+        """True when live sequences can be exported/imported as page
+        payloads — the paged KV substrate only (slot-layout engines
+        still get crash recovery via journal replay)."""
+        return bool(getattr(self._batcher, "supports_export", False))
+
+    def export_sequences(self, *, timeout: float = 30.0) -> List:
+        """Snapshot-and-detach every live sequence — plus the engine's
+        still-queued backlog, shipped cold — into host-side
+        :class:`~paddle_tpu.serving.fleet.migrate.SequenceManifest`
+        objects. The caller (migrator) should have paused admission
+        first. Runs on the worker between ticks; on return the engine
+        holds none of the exported requests and their futures are still
+        pending — ownership transfers to the caller."""
+        if not self.supports_migration:
+            raise NotImplementedError(
+                "sequence export requires the paged KV cache "
+                "(kv_layout='paged')")
+        action = fault_injector().fire("seq_export")
+        if action == "slow_io":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_SLOW_IO_S", "1.0")))
+        elif action is not None:
+            raise RuntimeError(f"injected seq_export fault: {action}")
+        from ..fleet.migrate import SequenceManifest
+
+        def _export():
+            mans = self._batcher.export_all()
+            if len(self._queue):
+                for req in self._queue.take_many(
+                        len(self._queue), timeout=0.0):
+                    mans.append(SequenceManifest.for_queued(req))
+            return mans
+        mans = self._run_on_worker(_export, timeout=timeout)
+        self._stat_add("migrated_out", len(mans))
+        self._stat_set("queue_depth", len(self._queue))
+        return mans
+
+    def import_sequence(self, manifest, *, timeout: float = 30.0) -> bool:
+        """Splice a migrated sequence into this engine and resume it at
+        the exact next token. Returns False when the engine cannot
+        adopt it (manifest/weights-version mismatch, pool pressure,
+        injected faults) — the migrator falls back to replay then."""
+        if self._killed.is_set() or self._draining.is_set() \
+                or self._stopped.is_set() or not self.supports_migration:
+            return False
+        from ..fleet.migrate import MANIFEST_VERSION
+        if manifest.version != MANIFEST_VERSION or manifest.cold:
+            return False
+        if manifest.weights_version != self.weights_version:
+            # KV computed under other weights must never continue under
+            # these — the hot-swap bitwise contract is old OR new
+            return False
+        action = fault_injector().fire("seq_import")
+        if action == "slow_io":
+            time.sleep(float(os.environ.get(
+                "PADDLE_TPU_FAULT_SLOW_IO_S", "1.0")))
+        elif action is not None:
+            return False
+        ok = bool(self._run_on_worker(
+            lambda: self._batcher.import_manifest(manifest),
+            timeout=timeout))
+        if ok:
+            self._stat_add("migrated_in", 1)
+        return ok
+
+    def resubmit(self, req: GenerationRequest) -> bool:
+        """Adopt a request that never started decoding on its donor (a
+        migrated admission-queue entry): nothing was streamed, so it
+        re-queues as if freshly submitted."""
+        if self._killed.is_set() or self._draining.is_set() \
+                or self._stopped.is_set():
+            return False
+        if req.tokens:       # defensive: partially-streamed → replay path
+            return self.resubmit_for_recovery(req, req.tokens)
+        self._queue.put(req, block=False)
+        self._stat_set("queue_depth", len(self._queue))
+        return True
+
+    def resubmit_for_recovery(self, req: GenerationRequest,
+                              resume_tokens) -> bool:
+        """Adopt an evacuated request from a dead sibling by REPLAY:
+        re-prefill ``original_prompt + resume_tokens`` (the journaled
+        transcript, possibly a few tokens stale) and let the dedup
+        guard verify-and-swallow the re-generated gap. Greedy streams
+        come out bitwise-identical to an uninterrupted run; a sampled
+        stream that diverges fails loudly instead of corrupting
+        output."""
+        if self._killed.is_set() or self._draining.is_set() \
+                or self._stopped.is_set():
+            return False
+        resume = [int(t) for t in resume_tokens]
+        n = min(len(resume), len(req.tokens))
+        if resume[:n] != req.tokens[:n]:
+            exc = TokenStreamDivergence(
+                f"request {req.req_id}: journaled transcript diverges "
+                f"from the client stream within the first {n} tokens")
+            req.fail(exc)
+            raise exc
+        # the rebuilt prompt must stay admissible; shrinking the resume
+        # point is always safe — the gap is re-generated and verified
+        cap = self._config.max_prompt_len \
+            - (req.prompt_len - req._resume_offset)
+        req.begin_resume(max(0, min(n, cap)))
+        self._queue.put(req, block=False)
+        self._stat_add("recovered", 1)
+        self._stat_set("queue_depth", len(self._queue))
+        return True
+
+    def enable_recovery(self, capacity: int = 1024):
+        """Arm crash recovery (idempotent): the worker notes the live
+        request set every tick into a :class:`~paddle_tpu.serving.
+        fleet.migrate.SequenceJournal` (flushed off-thread), and a
+        subsequent :meth:`kill` EVACUATES in-flight requests — futures
+        left pending — instead of failing them, so the router can
+        replay them onto survivors."""
+        if self.journal is None:
+            from ..fleet.migrate import SequenceJournal
+            self.journal = SequenceJournal(
+                capacity=capacity, registry=self._registry,
+                stat_prefix=f"{self._prefix}.journal")
+        return self.journal
+
+    def take_evacuated(self) -> List[GenerationRequest]:
+        """Hand over the requests the worker detached at kill time
+        (futures still pending). Ownership transfers to the caller —
+        anything not replayed or failed there would leak."""
+        out, self._evacuated = self._evacuated, []
+        return out
+
+    def kill(self, reason: str = "killed") -> List[dict]:
+        """Hard-kill, returning a snapshot record per affected request
+        (id, phase, tokens emitted): queued requests fail retryably;
+        in-flight generations are evacuated for replay when recovery is
+        armed, aborted with :class:`EngineKilled` otherwise."""
+        journaled = self.journal is not None
+        inflight = [{"req_id": r.req_id, "phase": "decode",
+                     "tokens": len(r.tokens), "evacuated": journaled}
+                    for r in list(self._batcher._reqs.values())]
+        return list(super().kill(reason)) + inflight
+
     def drain(self, timeout: Optional[float] = None) -> List:
         """Graceful drain: stop admission, finish every in-flight and
         queued sequence, stop the worker. Returns the requests that were
@@ -935,6 +1207,8 @@ class LLMEngine(DrainableEngineBase):
         self._stopped.wait(timeout)
         if self._signal_chain is not None:
             self._signal_chain.uninstall()
+        if self.journal is not None:
+            self.journal.close()
         self._stat_set("queue_depth", 0)
         return inflight
 
@@ -982,21 +1256,38 @@ class LLMEngine(DrainableEngineBase):
         cfg = self._config
         try:
             while True:
+                # between-tick control plane: migration export/import
+                # closures run here, on the worker, never mid-tick
+                while self._ctl:
+                    fn, box, ev = self._ctl.popleft()
+                    try:
+                        box["ret"] = fn()
+                    except BaseException as e:  # noqa: BLE001 -- boxed and re-raised on the calling thread
+                        box["exc"] = e
+                    finally:
+                        ev.set()
                 if self._killed.is_set():
-                    # hard-kill: abort in-flight sequences (queued requests
-                    # were failed by kill() itself) and exit quietly — this
-                    # is a commanded death, not a worker crash, so no
-                    # re-raise / no noisy daemon-thread traceback
+                    # hard-kill: queued requests were failed by kill()
+                    # itself. With recovery armed, in-flight sequences are
+                    # EVACUATED (futures pending, for the router's replay);
+                    # otherwise aborted as before. Either way this is a
+                    # commanded death, not a worker crash, so no re-raise /
+                    # no noisy daemon-thread traceback.
                     n = self._batcher.active
-                    self._batcher.abort_all(
-                        lambda req: EngineKilled(
-                            f"engine hard-killed ({self._kill_reason}) "
-                            f"with request {req.req_id} in flight after "
-                            f"{len(req.tokens)} tokens"))
+                    if self.journal is not None:
+                        self._evacuated.extend(self._batcher.evacuate())
+                    else:
+                        self._batcher.abort_all(
+                            lambda req: EngineKilled(
+                                f"engine hard-killed ({self._kill_reason}) "
+                                f"with request {req.req_id} in flight after "
+                                f"{len(req.tokens)} tokens"))
                     _flight.record_event(
                         "engine_killed",
                         {"engine": self._prefix,
-                         "reason": self._kill_reason, "aborted": n})
+                         "reason": self._kill_reason,
+                         "aborted": 0 if self.journal is not None else n,
+                         "evacuated": n if self.journal is not None else 0})
                     return
                 if self._guard is not None and self._guard.preempted \
                         and not self._draining.is_set():
@@ -1017,6 +1308,11 @@ class LLMEngine(DrainableEngineBase):
                 self._stat_set("slots_in_use", self._batcher.active)
                 if self._batcher.active:
                     self._batcher.tick()
+                    if self.journal is not None and self._batcher.active:
+                        # O(1) reference enqueue; the journal's flush
+                        # thread does the copying (async-dispatch
+                        # discipline: the tick never pays for durability)
+                        self.journal.note(self._batcher._reqs.values())
                 elif self._draining.is_set() and len(self._queue) == 0:
                     break
                 self._publish_cache_stats()
@@ -1033,6 +1329,13 @@ class LLMEngine(DrainableEngineBase):
                     f"flight: {e!r}"))
             raise
         finally:
+            # unblock any control-plane caller racing the worker's exit
+            while self._ctl:
+                fn, box, ev = self._ctl.popleft()
+                box["exc"] = EngineKilled(
+                    "engine worker exited before servicing the control "
+                    "call")
+                ev.set()
             if self._drain_signaled:
                 _flight.record_event("sigterm_drain",
                                      {"engine": self._prefix})

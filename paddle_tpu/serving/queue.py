@@ -59,22 +59,28 @@ class BatchQueue:
             self._not_empty.notify_all()
             self._not_full.notify_all()
 
-    def fail_all(self, exc_factory: Callable[[], BaseException]) -> int:
+    def fail_all(self, exc_factory: Callable[[], BaseException]) -> list:
         """Hard-kill path: close admission and fail every queued request
         with ``exc_factory()`` (drain lets takers consume the backlog;
-        a kill must not — the worker is already gone). Returns the number
-        of requests failed."""
+        a kill must not — the worker is already gone). Returns one
+        snapshot record per request actually failed — ``{"req_id",
+        "phase": "queued", "tokens"}`` — so recovery paths and tests can
+        enumerate exactly what was dropped instead of just counting it.
+        (``tokens`` is non-zero only for a replayed generation request
+        that was re-queued mid-recovery.)"""
         with self._lock:
             self._closed = True
             victims = list(self._dq)
             self._dq.clear()
             self._not_empty.notify_all()
             self._not_full.notify_all()
-        failed = 0
+        records = []
         for req in victims:
             if req.fail(exc_factory()):
-                failed += 1
-        return failed
+                records.append({
+                    "req_id": req.req_id, "phase": "queued",
+                    "tokens": len(getattr(req, "tokens", ()) or ())})
+        return records
 
     # -- producer side ------------------------------------------------------
     def put(self, req: InferenceRequest, block: bool = True,

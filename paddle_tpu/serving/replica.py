@@ -60,7 +60,8 @@ class Replica:
                  restart_budget=None,
                  unhealthy_queue_depth: Optional[int] = None,
                  health_source: Optional[Callable[[], bool]] = None,
-                 registry=None, clock=time.monotonic):
+                 registry=None, clock=time.monotonic,
+                 recovery_cb: Optional[Callable[["Replica"], None]] = None):
         self.replica_id = int(replica_id)
         #: StatRegistry the engine factory should hand its engine, so all
         #: replicas of one router publish into one scrape (per-replica
@@ -84,6 +85,14 @@ class Replica:
         self._unhealthy_reason: Optional[str] = None
         self._boot_checkpoint: Optional[str] = None
         self._paused = False
+        # zero-loss serving (docs/fault_tolerance.md): when set, every
+        # engine this replica boots gets its crash-recovery journal
+        # armed, and kill() invokes the callback so the router can
+        # replay evacuated sequences onto survivors
+        self._recovery_cb = recovery_cb
+        #: snapshot records from the last kill() (id, phase, tokens) —
+        #: what was in the engine at the moment it died
+        self.last_kill_records: list = []
         self._boot()
 
     # -- boot / resurrect ----------------------------------------------------
@@ -117,6 +126,11 @@ class Replica:
             self._boot_checkpoint = ckpt
             self._state = STARTING
         engine = self._factory(self)
+        if self._recovery_cb is not None \
+                and hasattr(engine, "enable_recovery"):
+            # re-armed on EVERY boot: a resurrected engine instance is a
+            # fresh object and must journal from its first tick
+            engine.enable_recovery()
         with self._lock:
             self._engine = engine
             self._state = HEALTHY
@@ -195,17 +209,28 @@ class Replica:
 
     def kill(self, reason: str = "killed") -> bool:
         """Hard-kill (the in-process SIGKILL analog): the replica goes
-        DEAD immediately and the engine aborts queued + in-flight work
-        with :class:`~paddle_tpu.serving.request.EngineKilled`. The
-        router's health sweep sees DEAD and schedules a budgeted
-        resurrection, exactly as for a drained-out replica."""
+        DEAD immediately. Queued work fails retryably with
+        :class:`~paddle_tpu.serving.request.EngineKilled`; in-flight
+        work is aborted — or, when the recovery callback is wired,
+        evacuated and handed to the router for replay onto survivors
+        (docs/fault_tolerance.md "Zero-loss serving"). The router's
+        health sweep sees DEAD and schedules a budgeted resurrection,
+        exactly as for a drained-out replica."""
         with self._lock:
             if self._state == DEAD:
                 return False
             self._state = DEAD
             engine = self._engine
         if engine is not None:
-            engine.kill(f"replica {self.replica_id}: {reason}")
+            self.last_kill_records = engine.kill(
+                f"replica {self.replica_id}: {reason}")
+            if self._recovery_cb is not None:
+                try:
+                    self._recovery_cb(self)
+                except Exception as e:  # noqa: BLE001 -- recovery is best-effort; the kill verdict stands either way
+                    warnings.warn(
+                        f"replica {self.replica_id} recovery callback "
+                        f"failed: {e!r}")
         return True
 
     @property

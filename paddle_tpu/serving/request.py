@@ -50,8 +50,24 @@ class RequestTooLarge(ServingError):
 
 class EngineKilled(ServingError):
     """The engine was hard-killed (the in-process analog of a replica
-    SIGKILL): queued and in-flight requests fail with this error instead
-    of draining. Retryable — the request never produced partial output."""
+    SIGKILL). Queued requests fail with this error — retryable, they
+    never produced partial output. In-flight generations on an engine
+    with recovery enabled are NOT failed: they are evacuated and
+    replayed onto surviving replicas (docs/fault_tolerance.md
+    "Zero-loss serving"); only when no survivor can adopt a sequence
+    does it fall back to this retryable failure."""
+
+
+class TokenStreamDivergence(ServingError):
+    """A resumed token stream disagreed with what the client already
+    received. Raised by the :class:`~paddle_tpu.serving.llm.scheduler.
+    GenerationRequest` resume-dedup guard when a migrated or replayed
+    sequence would emit a duplicate, a gap, or a different token at an
+    already-streamed position — the stream fails loudly instead of ever
+    corrupting client-visible output. Retryable (a fresh submission
+    regenerates from scratch); expected for sampled (non-greedy)
+    streams recovered via replay, whose RNG path cannot be replayed
+    bit-exactly across replicas."""
 
 
 class InferenceRequest:

@@ -81,7 +81,8 @@ class RouterConfig:
                  roles: Optional[Sequence[str]] = None,
                  prefill_threshold: int = 64,
                  handoff: bool = True,
-                 handoff_timeout: float = 30.0):
+                 handoff_timeout: float = 30.0,
+                 recovery: Optional[bool] = None):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
         if kind not in ("classifier", "llm"):
@@ -126,6 +127,12 @@ class RouterConfig:
         self.prefill_threshold = int(prefill_threshold)
         self.handoff = bool(handoff)
         self.handoff_timeout = float(handoff_timeout)
+        # zero-loss serving (docs/fault_tolerance.md): arm the sequence
+        # journal on every LLM engine boot and replay journaled sequences
+        # onto survivors after a kill. Default: on for LLM fleets (the
+        # only kind with sequences to lose), off for classifiers.
+        self.recovery = (kind == "llm") if recovery is None else \
+            bool(recovery)
 
 
 class Router:
@@ -159,6 +166,18 @@ class Router:
         self._parked: set = set()          # autoscaler-parked replica ids
         self._parked_lock = threading.Lock()
         self._trace_recorder = None        # replay.TraceRecorder hook
+        # zero-loss serving: LLM fleets get a FleetMigrator (sequence
+        # export/import for park + hot-swap) and, when recovery is on, a
+        # per-replica kill callback that replays journaled sequences onto
+        # survivors. Lazy import: fleet.migrate is control plane and the
+        # classifier path must not pay for it.
+        self.migrator = None
+        recovery_cb = None
+        if self._config.kind == "llm":
+            from .fleet.migrate import FleetMigrator
+            self.migrator = FleetMigrator(self, registry=self._registry)
+            if self._config.recovery:
+                recovery_cb = self._on_replica_killed
         self.replicas: List[Replica] = []
         for rid, sub in enumerate(self._split_devices(devices)):
             mesh = None
@@ -172,7 +191,8 @@ class Router:
                 checkpoint_root=self._config.checkpoint_root,
                 restart_budget=self.budget,
                 unhealthy_queue_depth=self._config.unhealthy_queue_depth,
-                health_source=src, registry=self._registry))
+                health_source=src, registry=self._registry,
+                recovery_cb=recovery_cb))
         self._health_thread = threading.Thread(
             target=self._health_loop, name="paddle-tpu-router-health",
             daemon=True)
@@ -323,24 +343,50 @@ class Router:
             return out
 
     # -- fleet control plane (autoscaler) ------------------------------------
+    def _on_replica_killed(self, replica: Replica) -> None:
+        """Replica kill callback (crash recovery): replay the victim's
+        journaled sequences onto survivors. Runs on its own daemon
+        thread — the callback fires from inside :meth:`Replica.kill`,
+        which may hold locks the recovery path (survivor queue puts,
+        worker control calls) must not wait behind."""
+        t = threading.Thread(
+            target=lambda: self.migrator.recover_replica(replica),
+            name=f"paddle-tpu-recover-{replica.replica_id}", daemon=True)
+        t.start()
+
     def parked_ids(self) -> List[int]:
         """Replica ids intentionally out of service (autoscale-down)."""
         with self._parked_lock:
             return sorted(self._parked)
 
     def park(self, replica_id: int) -> bool:
-        """Scale-down: drain ``replica_id`` out of service and exclude it
+        """Scale-down: take ``replica_id`` out of service and exclude it
         from health-loop resurrection until :meth:`unpark`. False when it
         is already parked. Parking is intentional capacity removal — it
-        does not count as degradation and costs no restart budget."""
+        does not count as degradation and costs no restart budget.
+
+        When the fleet supports live migration, parking does not wait
+        for in-flight sequences to finish: admission is paused, every
+        running sequence is exported onto the least-loaded siblings
+        (paged KV pages travel with it; clients keep streaming), and
+        the now-empty replica drains instantly. Sequences that could
+        not be moved (report ``remaining`` > 0) finish under the old
+        drain-and-wait behavior — migration never drops work."""
         r = self.replicas[replica_id]
         with self._parked_lock:
             if replica_id in self._parked:
                 return False
             self._parked.add(replica_id)
+        migrated = None
+        if self.migrator is not None and \
+                getattr(r.engine, "supports_migration", False):
+            r.pause()   # stop admission while sequences leave
+            migrated = self.migrator.migrate_replica(r, reason="park")
         r.begin_drain()
         self._registry.add(f"{self._prefix}.park_downs", 1)
-        _flight.record_event("replica_park", {"replica": replica_id})
+        _flight.record_event("replica_park", {
+            "replica": replica_id,
+            "migrated": 0 if migrated is None else migrated["exported"]})
         return True
 
     def unpark(self, replica_id: int, *, boot_timeout: float = 5.0) -> bool:

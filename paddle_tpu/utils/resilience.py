@@ -168,6 +168,20 @@ class FaultInjector:
     the swap's rollback path, ``slow_io`` stretches the swap window while
     traffic is paused.
 
+    Sequence-migration sites (``serving.llm.scheduler`` /
+    ``serving.fleet.migrate`` — docs/fault_tolerance.md, "Zero-loss
+    serving"): ``seq_export`` fires once per replica export (park, swap
+    migrate-out) — any failure action makes the export raise and the
+    caller falls back to the old drain-and-wait path, ``slow_io`` stalls
+    the export; ``seq_import`` fires once per sequence adoption on the
+    target — any failure action refuses the import and the migrator
+    tries the next sibling, then the re-prefill replay path; and
+    ``journal_write`` fires once per journal flush — ``drop`` keeps the
+    previous (stale) records so recovery must regenerate and verify the
+    gap, ``fail``/``disk_full`` count write errors, ``slow_io`` stalls
+    the flusher thread. None of these can drop a sequence: every
+    failure path degrades to replay, whose dedup guard arbitrates.
+
     Host-loss sites (``distributed.elastic_runtime``): ``host_kill`` fires
     at watchdog arm time, once per guarded step — ``crash`` there is the
     canonical host-dies-mid-step. ``collective_hang`` fires right after

@@ -1,0 +1,480 @@
+"""Zero-loss serving (docs/fault_tolerance.md "Zero-loss serving").
+
+Five invariant families:
+
+* **Dedup guard** — a resumed stream re-verifies every already-streamed
+  token before any new token may flow: replayed tokens are swallowed
+  (never re-delivered), a mismatch fails loudly with
+  ``TokenStreamDivergence``, and a resume point AHEAD of the client's
+  transcript raises (gap direction) instead of silently skipping.
+* **Kill records** — ``BatchQueue.fail_all`` and ``Engine.kill`` return
+  one snapshot record per affected request (id, phase, tokens emitted),
+  and an engine with recovery armed EVACUATES in-flight requests
+  (futures pending) instead of failing them.
+* **Export/import** — a live paged sequence round-trips through a
+  host-side ``SequenceManifest`` onto a sibling engine and the client's
+  single stream iterator completes bitwise-identical to an undisturbed
+  run; mismatched manifests (cold / wrong weights version / wrong model
+  signature) are refused, and the ``seq_export``/``seq_import`` fault
+  sites degrade exactly as documented.
+* **Journal** — bounded ring semantics, finished-request pruning, and
+  the ``journal_write:drop`` fault leaving STALE (but usable) records —
+  the state a real crash leaves behind.
+* **Fleet migration** — park and weight-roll move live streams to
+  siblings instead of waiting for drain, and a hard kill replays
+  journaled sequences onto survivors; in every case the client sees ONE
+  uninterrupted, bitwise-correct stream.
+
+Plus hygiene pins (PTA002 hot-prefix membership, PTA011-clean migration
+plane) and the slow end-to-end chaos storm (``bench_fleet --migrate``).
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.monitor import StatRegistry
+from paddle_tpu.incubate.checkpoint import commit_checkpoint
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving.fleet import (MANIFEST_VERSION, SequenceJournal,
+                                      SequenceManifest, WeightSwapper)
+from paddle_tpu.serving.llm import (GenerationRequest, LLMEngine,
+                                    LLMEngineConfig, SamplingParams)
+from paddle_tpu.serving.queue import BatchQueue
+from paddle_tpu.serving.request import EngineKilled, TokenStreamDivergence
+from paddle_tpu.serving.router import Router, RouterConfig, llm_replica_factory
+from paddle_tpu.utils import resilience
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VOCAB = 64
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6]
+N_NEW = 40
+
+
+def _tiny_model(seed=0):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _paged_cfg(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("max_queue", 64)
+    kw.setdefault("warmup", False)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 8)
+    kw.setdefault("default_max_new_tokens", N_NEW)
+    return LLMEngineConfig(**kw)
+
+
+def _req(prompt=PROMPT, stream=False, **kw):
+    return GenerationRequest(prompt, SamplingParams(**kw), stream=stream)
+
+
+def _wait_for(pred, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _ref_tokens(n=N_NEW):
+    """Greedy reference stream from an engine nothing happens to."""
+    with LLMEngine(_tiny_model(), _paged_cfg(),
+                   registry=StatRegistry()) as eng:
+        return eng.submit(PROMPT, max_new_tokens=n) \
+                  .result(timeout=120)["tokens"]
+
+
+@pytest.fixture
+def fault_spec(monkeypatch):
+    """Arm PADDLE_TPU_FAULT_SPEC for this test; disarm afterwards."""
+    def arm(spec):
+        monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", spec)
+        resilience._reset_fault_injector_for_tests()
+    yield arm
+    monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC", raising=False)
+    resilience._reset_fault_injector_for_tests()
+
+
+# -- resume-dedup guard -------------------------------------------------------
+
+class TestDedupGuard:
+    def test_replay_swallows_then_new_tokens_flow(self):
+        req = _req(stream=True)
+        for t in (7, 8, 9):
+            assert req._emit(t)
+        req.begin_resume(1)          # token 7 folded into the prompt
+        assert req.prompt_len == len(PROMPT) + 1
+        assert req.seq_len == len(PROMPT) + 3     # invariant under resume
+        assert req._emit(8) and req._emit(9)      # verified + swallowed
+        assert req.tokens == [7, 8, 9]            # nothing duplicated
+        assert req._emit(4)                       # first NEW token flows
+        assert req.tokens == [7, 8, 9, 4]
+
+    def test_divergent_replay_fails_loudly(self):
+        req = _req()
+        for t in (7, 8, 9):
+            req._emit(t)
+        req.begin_resume(0)
+        assert req._emit(7)
+        assert req._emit(5) is False              # 8 expected
+        with pytest.raises(TokenStreamDivergence):
+            req.result(timeout=5)
+        assert req.tokens == [7, 8, 9]            # transcript untouched
+
+    def test_resume_ahead_of_stream_raises_gap_direction(self):
+        req = _req()
+        req._emit(7)
+        with pytest.raises(TokenStreamDivergence):
+            req.begin_resume(2)       # state AHEAD of the client's stream
+        with pytest.raises(TokenStreamDivergence):
+            req.begin_resume(-1)
+
+    def test_second_resume_rebuilds_from_original_prompt(self):
+        req = _req()
+        for t in (7, 8):
+            req._emit(t)
+        req.begin_resume(2)
+        assert req.prompt_len == len(PROMPT) + 2
+        req.begin_resume(1)           # NOT prompt+2+1: base is original
+        assert req.prompt_len == len(PROMPT) + 1
+        assert req.seq_len == len(PROMPT) + 2
+
+
+# -- kill snapshot records ----------------------------------------------------
+
+class TestKillRecords:
+    def test_fail_all_returns_one_record_per_request(self):
+        q = BatchQueue(max_size=8)
+        reqs = [_req() for _ in range(3)]
+        for r in reqs:
+            q.put(r, block=False)
+        recs = q.fail_all(lambda: EngineKilled("gone"))
+        assert [r["phase"] for r in recs] == ["queued"] * 3
+        assert {r["req_id"] for r in recs} == {r.req_id for r in reqs}
+        assert all(r["tokens"] == 0 for r in recs)
+        for r in reqs:
+            with pytest.raises(EngineKilled):
+                r.result(timeout=5)
+
+    def test_engine_kill_snapshots_queued_and_decode_phases(self):
+        eng = LLMEngine(_tiny_model(), _paged_cfg(num_slots=1),
+                        registry=StatRegistry())
+        a = eng.submit(PROMPT, max_new_tokens=N_NEW, stream=True)
+        assert _wait_for(lambda: len(a.tokens) >= 1)
+        b = eng.submit(PROMPT, max_new_tokens=4)      # queued behind a
+        recs = eng.kill("test kill")
+        phases = {r["req_id"]: r for r in recs}
+        assert phases[b.req_id]["phase"] == "queued"
+        assert phases[a.req_id]["phase"] == "decode"
+        assert phases[a.req_id]["tokens"] >= 1
+        assert phases[a.req_id]["evacuated"] is False
+        for r in (a, b):
+            with pytest.raises(EngineKilled):
+                r.result(timeout=5)
+
+    def test_kill_with_recovery_evacuates_instead_of_failing(self):
+        eng = LLMEngine(_tiny_model(), _paged_cfg(),
+                        registry=StatRegistry())
+        eng.enable_recovery()
+        a = eng.submit(PROMPT, max_new_tokens=N_NEW, stream=True)
+        assert _wait_for(lambda: len(a.tokens) >= 1)
+        recs = eng.kill("test kill")
+        dec = [r for r in recs if r["phase"] == "decode"]
+        assert dec and all(r["evacuated"] for r in dec)
+        # the worker detaches the requests as it stops — wait for it
+        assert eng._stopped.wait(timeout=30)
+        evac = eng.take_evacuated()
+        assert [r.req_id for r in evac] == [a.req_id]
+        assert not a.future.done()    # pending: the router owns it now
+        assert eng.take_evacuated() == []   # ownership transfers once
+        a.fail(EngineKilled("test cleanup"))
+
+
+# -- sequence export / import -------------------------------------------------
+
+class TestExportImport:
+    def test_roundtrip_resumes_bitwise_on_sibling(self):
+        ref = _ref_tokens()
+        a = LLMEngine(_tiny_model(), _paged_cfg(), registry=StatRegistry())
+        breg = StatRegistry()
+        b = LLMEngine(_tiny_model(), _paged_cfg(), registry=breg)
+        try:
+            assert a.supports_migration and b.supports_migration
+            req = a.submit(PROMPT, max_new_tokens=N_NEW, stream=True)
+            assert _wait_for(lambda: len(req.tokens) >= 3)
+            a.pause_admission()
+            mans = a.export_sequences(timeout=30)
+            assert len(mans) == 1
+            man = mans[0]
+            assert man.version == MANIFEST_VERSION and not man.cold
+            assert man.n_cached_tokens == len(PROMPT) + len(man.tokens) - 1
+            assert b.import_sequence(man, timeout=30)
+            # the SAME iterator the client has been reading all along
+            assert list(req.iter_tokens(timeout=120)) == ref
+            assert req.finish_reason is not None
+            stats = breg.stats()
+            assert sum(v for k, v in stats.items()
+                       if k.endswith(".migrated_in")) == 1
+        finally:
+            a.drain(timeout=30)
+            b.drain(timeout=30)
+
+    def test_import_refuses_mismatched_manifests(self):
+        ref = _ref_tokens()
+        a = LLMEngine(_tiny_model(), _paged_cfg(), registry=StatRegistry())
+        b = LLMEngine(_tiny_model(), _paged_cfg(), registry=StatRegistry())
+        try:
+            req = a.submit(PROMPT, max_new_tokens=N_NEW, stream=True)
+            assert _wait_for(lambda: len(req.tokens) >= 3)
+            a.pause_admission()
+            man = a.export_sequences(timeout=30)[0]
+            cold = SequenceManifest.for_queued(_req())
+            assert b.import_sequence(cold) is False    # no device state
+            man.weights_version += 1                   # cross-version KV
+            assert b.import_sequence(man) is False
+            man.weights_version -= 1
+            sig = man.sig
+            man.sig = ("tampered",)                    # wrong model shape
+            assert b.import_sequence(man) is False
+            man.sig = sig
+            # the refusals were the only obstacle: restore and resume
+            assert b.import_sequence(man, timeout=30)
+            assert list(req.iter_tokens(timeout=120)) == ref
+        finally:
+            a.drain(timeout=30)
+            b.drain(timeout=30)
+
+    def test_export_and_import_fault_sites_degrade(self, fault_spec):
+        a = LLMEngine(_tiny_model(), _paged_cfg(), registry=StatRegistry())
+        b = LLMEngine(_tiny_model(), _paged_cfg(), registry=StatRegistry())
+        try:
+            req = a.submit(PROMPT, max_new_tokens=N_NEW, stream=True)
+            assert _wait_for(lambda: len(req.tokens) >= 3)
+            a.pause_admission()
+            fault_spec("seq_export:1:fail")
+            with pytest.raises(RuntimeError):
+                a.export_sequences(timeout=30)
+            mans = a.export_sequences(timeout=30)      # budget spent
+            assert len(mans) == 1
+            fault_spec("seq_import:1:fail")
+            assert b.import_sequence(mans[0]) is False  # never raises
+            assert b.import_sequence(mans[0], timeout=30)
+            assert list(req.iter_tokens(timeout=120))
+        finally:
+            a.drain(timeout=30)
+            b.drain(timeout=30)
+
+
+# -- sequence journal ---------------------------------------------------------
+
+class TestJournal:
+    def _mk(self, **kw):
+        kw.setdefault("capacity", 4)
+        kw.setdefault("flush_interval", 999.0)   # manual flushes only
+        kw.setdefault("registry", StatRegistry())
+        return SequenceJournal(**kw)
+
+    def test_ring_is_bounded_and_lookup_sees_newest(self):
+        j = self._mk()
+        try:
+            reqs = [_req() for _ in range(6)]
+            for r in reqs:
+                r._emit(5)
+            j.note(reqs)
+            j.flush_pending()
+            assert len(j) == 4                       # capacity, not 6
+            assert j.lookup(reqs[0].req_id) is None  # oldest evicted
+            rec = j.lookup(reqs[-1].req_id)
+            assert rec is not None and rec.tokens == [5]
+        finally:
+            j.close()
+
+    def test_finished_requests_are_pruned(self):
+        j = self._mk()
+        try:
+            r = _req()
+            r._emit(3)
+            j.note([r])
+            j.flush_pending()
+            assert j.lookup(r.req_id) is not None
+            r._finish("stop")
+            j.note([r])
+            j.flush_pending()
+            assert j.lookup(r.req_id) is None       # nothing to recover
+            assert j.snapshot() == []
+        finally:
+            j.close()
+
+    def test_dropped_write_leaves_stale_records(self, fault_spec):
+        j = self._mk()
+        try:
+            r = _req()
+            r._emit(3)
+            j.note([r])
+            j.flush_pending()
+            fault_spec("journal_write:1:drop")
+            r._emit(4)
+            j.note([r])
+            j.flush_pending()                        # lost write
+            assert j.lookup(r.req_id).tokens == [3]  # stale, still usable
+            j.note([r])
+            j.flush_pending()                        # budget spent
+            assert j.lookup(r.req_id).tokens == [3, 4]
+        finally:
+            j.close()
+
+    def test_failed_write_counts_errors(self, fault_spec):
+        j = self._mk()
+        try:
+            fault_spec("journal_write:1:fail")
+            r = _req()
+            r._emit(3)
+            j.note([r])
+            j.flush_pending()
+            assert j.write_errors == 1
+            assert j.lookup(r.req_id) is None
+        finally:
+            j.close()
+
+
+# -- fleet-level migration ----------------------------------------------------
+
+def _mk_paged_router(n=2, **rcfg):
+    rcfg.setdefault("health_interval", 0.05)
+    reg = StatRegistry()
+    router = Router(
+        llm_replica_factory(lambda r: _tiny_model(), _paged_cfg()),
+        RouterConfig(num_replicas=n, kind="llm", **rcfg),
+        registry=reg)
+    return router, reg
+
+
+class TestFleetMigration:
+    def test_park_migrates_live_stream_to_sibling(self):
+        ref = _ref_tokens()
+        router, reg = _mk_paged_router(2)
+        try:
+            assert router.migrator is not None     # armed for llm fleets
+            req = router.submit(PROMPT, max_new_tokens=N_NEW, stream=True)
+            assert _wait_for(lambda: len(req.tokens) >= 3)
+            donor = max(router.replicas, key=lambda r: r.outstanding)
+            assert router.park(donor.replica_id)
+            # the client's ONE iterator rides through the park untouched
+            assert list(req.iter_tokens(timeout=120)) == ref
+            stats = reg.stats()
+            assert stats.get("fleet.migrate.sequences_exported", 0) >= 1
+            adopted = (stats.get("fleet.migrate.sequences_imported", 0)
+                       + stats.get("fleet.migrate.sequences_replayed", 0))
+            assert adopted >= 1
+            assert stats.get("fleet.migrate.sequences_failed", 0) == 0
+        finally:
+            router.drain(timeout=60)
+
+    def test_kill_replays_journaled_stream_on_survivor(self):
+        ref = _ref_tokens()
+        router, reg = _mk_paged_router(2)
+        try:
+            req = router.submit(PROMPT, max_new_tokens=N_NEW, stream=True)
+            assert _wait_for(lambda: len(req.tokens) >= 3)
+            victim = max(router.replicas, key=lambda r: r.outstanding)
+            victim.kill("chaos: test kill")
+            # journal replay re-prefills on a survivor; the dedup guard
+            # swallows the already-streamed prefix — bitwise, no dups
+            assert list(req.iter_tokens(timeout=120)) == ref
+            assert _wait_for(lambda: reg.stats().get(
+                "fleet.migrate.sequences_recovered", 0) >= 1)
+            assert sum(v for k, v in reg.stats().items()
+                       if k.endswith(".stream_divergence")) == 0
+        finally:
+            router.drain(timeout=60)
+
+    def test_weight_roll_migrates_instead_of_draining(self, tmp_path):
+        ref = _ref_tokens()
+        router, reg = _mk_paged_router(2)
+        # sustained load (a one-shot stream finishes during checkpoint
+        # load / the first replica's probe): pumps keep streams in
+        # flight until the whole roll has completed, so migrate-out is
+        # guaranteed to find live sequences on each replica it pauses
+        stop = threading.Event()
+        done, rejected = [], []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    r = router.submit(PROMPT, max_new_tokens=N_NEW,
+                                      stream=True)
+                    done.append(list(r.iter_tokens(timeout=120)))
+                except Exception as e:  # retryable paused/draining windows
+                    rejected.append(repr(e))
+                    time.sleep(0.02)
+        pumps = [threading.Thread(target=pump, daemon=True)
+                 for _ in range(4)]
+        try:
+            ckpt = str(tmp_path / "ckpt-step1")
+            commit_checkpoint({"model": _tiny_model().state_dict()},
+                              ckpt, healthy=True, step=1)
+            swapper = WeightSwapper(router, reg, quiesce_timeout=60.0,
+                                    probe_timeout=60.0)
+            for t in pumps:
+                t.start()
+            assert _wait_for(lambda: sum(
+                r.outstanding for r in router.replicas) >= 2)
+            report = swapper.roll(ckpt)
+            stop.set()
+            for t in pumps:
+                t.join(timeout=150)
+            assert not report.get("aborted")
+            assert sorted(report["swapped"]) == [0, 1]
+            assert sum(report.get("migrated", {}).values()) >= 1
+            # identical weights either side of the roll: still bitwise
+            assert done and all(t == ref for t in done)
+            assert reg.stats().get(
+                "fleet.migrate.sequences_exported", 0) >= 1
+        finally:
+            stop.set()
+            router.drain(timeout=60)
+
+
+# -- hygiene pins -------------------------------------------------------------
+
+def test_migrate_module_is_pta002_hot():
+    from tools.analyze.rules.pta002_host_sync import HOT_PREFIXES
+    assert "paddle_tpu/serving/fleet/migrate.py" in HOT_PREFIXES
+    assert "paddle_tpu/serving/fleet/" in HOT_PREFIXES
+
+
+def test_pta011_clean_on_migration_plane():
+    # the export path must never gate a collective on replica rank —
+    # PTA011 over the whole migration plane stays finding-free
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--baseline", "none",
+         "--rule", "PTA011", "--json",
+         "paddle_tpu/serving/fleet", "paddle_tpu/serving/llm/paged"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- slow end-to-end ----------------------------------------------------------
+
+@pytest.mark.slow
+def test_zero_loss_storm_end_to_end():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.bench_fleet", "--migrate",
+         "--check", "--replicas", "2", "--streams", "12"],
+        cwd=REPO, capture_output=True, text=True, env=env, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

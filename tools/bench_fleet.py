@@ -5,6 +5,8 @@ proof, printed as one JSON document.
     python -m tools.bench_fleet                   # run the chaos storm
     python -m tools.bench_fleet --check           # CI gate (run_tests.py
                                                   #   --bench-fleet)
+    python -m tools.bench_fleet --migrate --check # zero-loss migration
+                                                  #   storm (see below)
     python -m tools.bench_fleet --write-baseline  # refresh the committed
                                                   #   bench_fleet_baseline.json
     python -m tools.bench_fleet --trace my.jsonl  # replay a recorded trace
@@ -37,6 +39,17 @@ the storm ends. Absolute latencies are machine-dependent and not gated;
 the *structural* counters (drops, scale-ups, rollbacks, recompiles) and
 the *relative* recovery budget are the invariants
 (``bench_fleet_baseline.json``).
+
+``--migrate`` runs the **zero-loss serving** storm instead
+(docs/fault_tolerance.md): a paged-KV fleet serving long greedy token
+streams takes a ``weight_swap:1:slow_io``-widened weight roll (every
+in-flight sequence migrates — KV pages and all — to a sibling instead
+of draining) and then a hard kill of the busiest replica with streams
+in flight (journal replay resumes them on survivors). The roll targets
+a checkpoint with IDENTICAL weights, so every client's assembled
+stream must be **bitwise equal** to a reference computed on an
+undisturbed standalone engine — zero drops, zero duplicated or missing
+tokens, zero divergence, zero recompiles across the roll.
 """
 from __future__ import annotations
 
@@ -54,6 +67,11 @@ BASELINE = os.path.join(REPO, "bench_fleet_baseline.json")
 #: replica_boot is the first scale-up boot (3 shells boot at router
 #: construction), and the 2nd weight_swap is mid-roll.
 FAULT_SPEC = "replica_boot:4:disk_full,weight_swap:2:slow_io"
+
+#: the migration storm's armed disaster: the FIRST replica swap of the
+#: roll gets its window stretched by slow_io — the exact window the old
+#: quiesce-drain path would have parked live streams in.
+FAULT_SPEC_MIGRATE = "weight_swap:1:slow_io"
 
 
 def _tiny_model():
@@ -325,6 +343,306 @@ def check(doc, baseline=None):
     return problems
 
 
+def run_migrate(args) -> dict:
+    """The zero-loss serving storm (``--migrate``): live streams ride
+    through a slow_io-widened weight roll (sequence migration) and a
+    hard replica kill (journal replay), and every assembled stream must
+    match an undisturbed reference engine bit for bit."""
+    from paddle_tpu.utils import resilience
+    if not args.no_faults:
+        os.environ["PADDLE_TPU_FAULT_SPEC"] = FAULT_SPEC_MIGRATE
+        os.environ.setdefault("PADDLE_TPU_FAULT_SLOW_IO_S", "0.3")
+        resilience._reset_fault_injector_for_tests()
+
+    import random
+    import tempfile
+    from paddle_tpu.core.monitor import StatRegistry
+    from paddle_tpu.incubate.checkpoint import commit_checkpoint
+    from paddle_tpu.serving.llm import LLMEngine, LLMEngineConfig
+    from paddle_tpu.serving.router import (Router, RouterConfig,
+                                           llm_replica_factory)
+    from paddle_tpu.serving.fleet import WeightSwapper
+
+    # ONE set of weights everywhere — fleet, roll target, and reference
+    # engine — so the bitwise gate is version-independent: a stream that
+    # migrates across the roll must still equal the reference.
+    state = _tiny_model().state_dict()
+
+    def make_model(_replica=None):
+        m = _tiny_model()
+        m.set_state_dict(state)
+        return m
+
+    # streams must be LONG relative to a decode tick, or they finish
+    # before the roll/kill can catch them mid-flight (a CPU tick on the
+    # tiny model is ~ms; 32 tokens keeps a stream alive for a window
+    # the chaos can actually hit)
+    n_new = args.stream_tokens
+
+    def _paged_cfg():
+        return LLMEngineConfig(
+            num_slots=args.slots, max_seq=64, max_queue=256,
+            kv_layout="paged", page_size=8, warmup=True,
+            default_max_new_tokens=n_new)
+
+    rng = random.Random(args.seed)
+    n_streams = args.streams
+    prompts = [[rng.randrange(1, 64) for _ in range(rng.randrange(4, 13))]
+               for _ in range(n_streams)]
+
+    # the ground truth: greedy streams from an engine nothing happens to
+    ref_eng = LLMEngine(make_model(), _paged_cfg(),
+                        registry=StatRegistry())
+    refs = [ref_eng.submit(p, max_new_tokens=n_new)
+            .result(timeout=args.request_timeout)["tokens"]
+            for p in prompts]
+    ref_eng.drain(timeout=60)
+
+    reg = StatRegistry()
+    router = Router(
+        llm_replica_factory(make_model, _paged_cfg()),
+        RouterConfig(num_replicas=args.replicas, kind="llm",
+                     health_interval=0.1, max_restarts=8,
+                     restart_backoff=0.2, restart_backoff_cap=1.0),
+        registry=reg)
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_migrate_")
+    ckpt = os.path.join(tmp, "ckpt-step1")
+    commit_checkpoint({"model": make_model().state_dict()}, ckpt,
+                      healthy=True, step=1)
+    swapper = WeightSwapper(router, reg, quiesce_timeout=60.0,
+                            probe_timeout=60.0)
+
+    counts = {"completed": 0, "dropped": 0, "mismatched": 0, "retries": 0}
+    counts_lock = threading.Lock()
+
+    def one_stream(p_i) -> None:
+        # production 503 handling: anything retryable (EngineKilled on a
+        # queued request, a draining/paused window, a divergence-failed
+        # sampled resume) restarts the request from scratch; migrated and
+        # replayed streams keep flowing through the SAME iterator.
+        for attempt in range(args.max_retries):
+            try:
+                req = router.submit(prompts[p_i],
+                                    max_new_tokens=n_new,
+                                    stream=True)
+                toks = list(req.iter_tokens(timeout=args.request_timeout))
+                with counts_lock:
+                    counts["completed"] += 1
+                    if toks != refs[p_i]:
+                        counts["mismatched"] += 1
+                return
+            except Exception:  # noqa: BLE001 -- the client's whole job is retrying retryable failures
+                with counts_lock:
+                    counts["retries"] += 1
+                time.sleep(0.05 * min(attempt + 1, 10))
+        with counts_lock:
+            counts["dropped"] += 1
+
+    def client(idx, stop):
+        # sustained load: tiny-model decode ticks are ~ms on CPU, so a
+        # one-shot stream is gone before any chaos can catch it — each
+        # client keeps streaming (cycling the prompt pool) until its
+        # wave's chaos event has fully played out
+        step = 0
+        while not stop.is_set():
+            one_stream((idx + step * n_streams) % len(prompts))
+            step += 1
+
+    def _serving(unpaused=True):
+        return [r for r in router.replicas
+                if r.state == "HEALTHY" and (not unpaused or not r.paused)]
+
+    def _wait_inflight(want, deadline):
+        """Block until some serving replica has >= want in-flight
+        sequences (returns it), or the deadline passes (returns the
+        busiest anyway — the storm must not hang on a quiet fleet)."""
+        while time.monotonic() < deadline:
+            live = _serving()
+            if live:
+                busiest = max(live, key=lambda r: r.outstanding)
+                if busiest.outstanding >= want:
+                    return busiest
+            time.sleep(0.02)
+        live = _serving()
+        return max(live, key=lambda r: r.outstanding) if live else None
+
+    roll_report: dict = {}
+    roll_recompiles = [0]
+    kill_info: dict = {"replica": None, "inflight_at_kill": 0}
+
+    def roller():
+        # the roll starts only once streams are genuinely in flight, so
+        # migrate-out has sequences to move through the slow_io window
+        _wait_inflight(2, time.monotonic() + 30.0)
+        before = _total_misses(router)
+        try:
+            roll_report.update(swapper.roll(ckpt))
+        except Exception as e:
+            roll_report.update({"error": repr(e), "aborted": True})
+        roll_recompiles[0] = _total_misses(router) - before
+
+    def saboteur():
+        # kill only once the victim carries >= kill_min_inflight live
+        # streams — the crash-recovery path must have real work to do
+        victim = _wait_inflight(args.kill_min_inflight,
+                                time.monotonic() + 30.0)
+        if victim is not None:
+            kill_info["inflight_at_kill"] = victim.outstanding
+            kill_info["min_inflight"] = args.kill_min_inflight
+            kill_info["replica"] = victim.replica_id
+            victim.kill("bench-fleet migration storm")
+
+    # two sustained waves, run back to back: wave 1 holds streams in
+    # flight for the whole weight roll (migrate-out through the slow_io
+    # window), wave 2 does the same for the kill so the victim is
+    # guaranteed to be carrying live sequences when it dies
+    t0 = time.monotonic()
+    stop_roll = threading.Event()
+    wave1 = [threading.Thread(target=client, args=(i, stop_roll),
+                              daemon=True, name=f"bench-migrate-w1-{i}")
+             for i in range(n_streams)]
+    for t in wave1:
+        t.start()
+        time.sleep(1.0 / args.rate)   # staggered arrivals
+    rol = threading.Thread(target=roller, daemon=True)
+    rol.start()
+    rol.join(timeout=240.0)
+    stop_roll.set()
+    for t in wave1:
+        t.join(timeout=args.request_timeout + 60.0)
+
+    stop_kill = threading.Event()
+    wave2 = [threading.Thread(target=client, args=(i, stop_kill),
+                              daemon=True, name=f"bench-migrate-w2-{i}")
+             for i in range(n_streams)]
+    for t in wave2:
+        t.start()                     # burst: pile up in-flight streams
+    sab = threading.Thread(target=saboteur, daemon=True)
+    sab.start()
+    sab.join(timeout=60.0)
+    time.sleep(2.0)  # let journal replay land the recovered streams
+    stop_kill.set()
+    for t in wave2:
+        t.join(timeout=args.request_timeout + 60.0)
+    wall = time.monotonic() - t0
+
+    stats = reg.stats()
+
+    def _sum_suffix(suffix):
+        return int(sum(v for k, v in stats.items()
+                       if k.endswith(suffix) and isinstance(v, (int, float))))
+
+    doc = {
+        "bench": "fleet-migrate",
+        "replicas": args.replicas,
+        "fault_spec": "" if args.no_faults else FAULT_SPEC_MIGRATE,
+        "streams": {
+            # sustained waves complete as many streams as the chaos
+            # windows allow; min_expected is the floor the check enforces
+            "min_expected": n_streams,
+            "completed": counts["completed"],
+            "dropped": counts["dropped"],
+            "mismatched": counts["mismatched"],
+            "retries": counts["retries"],
+            "wall_s": round(wall, 2),
+        },
+        "migrate": {
+            "exported": int(stats.get("fleet.migrate.sequences_exported", 0)),
+            "imported": int(stats.get("fleet.migrate.sequences_imported", 0)),
+            "recovered": int(stats.get("fleet.migrate.sequences_recovered", 0)),
+            "failed": int(stats.get("fleet.migrate.sequences_failed", 0)),
+            "export_failures": int(stats.get(
+                "fleet.migrate.export_failures", 0)),
+            "import_failures": int(stats.get(
+                "fleet.migrate.import_failures", 0)),
+            "replayed_on_engines": _sum_suffix(".recovered"),
+            "divergence": _sum_suffix(".stream_divergence"),
+            "latency_p95_ms": round(
+                reg.quantile("fleet.migrate.latency_ms", 0.95), 3),
+        },
+        "swap": {
+            "swapped": roll_report.get("swapped", []),
+            "migrated": roll_report.get("migrated", {}),
+            "rolled_back": roll_report.get("rolled_back"),
+            "aborted": roll_report.get("aborted", True),
+            "error": roll_report.get("error"),
+            "downtime_p95_ms": round(
+                reg.quantile("fleet.swap.downtime_ms", 0.95), 3),
+            "recompiles": roll_recompiles[0],
+        },
+        "kill": kill_info,
+        "end_state": {
+            "healthz": router.healthz()["status"],
+            "active_replicas": router.fleet_snapshot()["active_replicas"],
+        },
+    }
+    router.drain(timeout=60)
+    return doc
+
+
+def check_migrate(doc, baseline=None):
+    """Acceptance bars for the zero-loss storm: structural invariants
+    are absolute (bitwise streams, zero drops, recompile-free roll);
+    swap downtime is relative to the committed baseline — migration
+    must not be SLOWER than the quiesce-drain roll it replaces."""
+    problems = []
+    st, mig, swap = doc["streams"], doc["migrate"], doc["swap"]
+    if st["dropped"] != 0:
+        problems.append(f"dropped {st['dropped']} streams (zero-loss "
+                        f"serving promises zero drops)")
+    if st["completed"] < st["min_expected"]:
+        problems.append(f"completed only {st['completed']} streams "
+                        f"(< {st['min_expected']}) — the storm never "
+                        f"sustained real traffic")
+    if st["mismatched"] != 0:
+        problems.append(
+            f"{st['mismatched']} stream(s) differ from the reference — "
+            f"a duplicated, missing, or divergent token reached a client")
+    if mig["exported"] < 1:
+        problems.append("no sequence was ever exported — the roll never "
+                        "exercised migrate-out")
+    if mig["imported"] + mig["replayed_on_engines"] < 1:
+        problems.append("no sequence was adopted by a sibling (imported "
+                        "+ replayed == 0)")
+    if swap["aborted"]:
+        problems.append(f"the weight roll aborted: {swap['error']}")
+    if swap["rolled_back"] is not None:
+        problems.append(f"replica {swap['rolled_back']} rolled back "
+                        f"during the roll (probe failed)")
+    if swap["recompiles"] != 0:
+        problems.append(f"{swap['recompiles']} recompile(s) across the "
+                        f"migrating roll — sequence import must reuse "
+                        f"the spec-keyed executables")
+    if doc["fault_spec"]:
+        if doc["kill"]["replica"] is None:
+            problems.append("the chaos kill never fired")
+        else:
+            want = doc["kill"].get("min_inflight", 1)
+            if doc["kill"]["inflight_at_kill"] < want:
+                problems.append(
+                    f"the kill caught only "
+                    f"{doc['kill']['inflight_at_kill']} in-flight "
+                    f"streams (needed >= {want} for a real recovery "
+                    f"test)")
+            if mig["recovered"] < 1:
+                problems.append(
+                    "the kill fired but no sequence was journal-"
+                    "replayed onto a survivor (recovered == 0)")
+    if doc["end_state"]["healthz"] not in ("ok", "degraded"):
+        problems.append(f"end-state healthz is "
+                        f"{doc['end_state']['healthz']!r}")
+    if baseline:
+        bswap = baseline.get("swap", {})
+        base_dt = bswap.get("downtime_p95_ms", 0.0)
+        if base_dt and swap["downtime_p95_ms"] > 10 * base_dt:
+            problems.append(
+                f"swap downtime p95 {swap['downtime_p95_ms']:.1f}ms "
+                f"> 10x baseline {base_dt:.1f}ms — migration made the "
+                f"roll slower than the drain it replaced")
+    return problems
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", type=int, default=3)
@@ -349,6 +667,20 @@ def main(argv=None) -> int:
     ap.add_argument("--request-timeout", type=float, default=120.0)
     ap.add_argument("--workers", type=int, default=48)
     ap.add_argument("--converge-timeout", type=float, default=60.0)
+    ap.add_argument("--migrate", action="store_true",
+                    help="run the zero-loss serving storm instead: live "
+                         "stream migration through a weight roll + "
+                         "journal replay through a replica kill, gated "
+                         "bitwise against an undisturbed reference")
+    ap.add_argument("--streams", type=int, default=24,
+                    help="concurrent greedy token streams (--migrate)")
+    ap.add_argument("--stream-tokens", type=int, default=32,
+                    help="tokens per stream (--migrate); long enough "
+                         "that the roll and the kill catch streams "
+                         "mid-flight")
+    ap.add_argument("--kill-min-inflight", type=int, default=4,
+                    help="kill waits until the victim carries at least "
+                         "this many live streams (--migrate)")
     ap.add_argument("--no-faults", action="store_true",
                     help="storm without the injected disasters (latency "
                          "baseline of the harness itself)")
@@ -359,7 +691,7 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", default=BASELINE)
     args = ap.parse_args(argv)
 
-    doc = run_chaos(args)
+    doc = run_migrate(args) if args.migrate else run_chaos(args)
     json.dump(doc, sys.stdout, indent=2)
     print()
 
@@ -386,12 +718,16 @@ def main(argv=None) -> int:
         except (OSError, ValueError):
             print(f"bench fleet: no baseline at {args.baseline} "
                   f"(absolute budgets skipped)", file=sys.stderr)
-        problems = check(doc, baseline)
+        problems = (check_migrate(doc, baseline) if args.migrate
+                    else check(doc, baseline))
         if problems:
             for p in problems:
                 print(f"FAIL: {p}", file=sys.stderr)
             return 1
-        print("OK: zero drops, fleet scaled, roll clean, SLO recovered",
+        print("OK: " + ("zero-loss: streams bitwise, zero drops, "
+                        "migrating roll clean" if args.migrate else
+                        "zero drops, fleet scaled, roll clean, "
+                        "SLO recovered"),
               file=sys.stderr)
     return 0
 

@@ -81,7 +81,12 @@ def main():
                          "scale-up + mid-storm weight roll) and fail "
                          "unless drops == 0, the fleet scaled up, the "
                          "roll was recompile-free, and SLO recovery "
-                         "fits the bench_fleet_baseline.json budget")
+                         "fits the bench_fleet_baseline.json budget; "
+                         "then --migrate --check (zero-loss storm: live "
+                         "streams migrate through a slow_io-widened "
+                         "roll and replay through a replica kill, every "
+                         "stream bitwise-equal to an undisturbed "
+                         "reference, zero drops, recompile-free)")
     ap.add_argument("--bench-elastic", action="store_true",
                     help="opt-in gate: run tools/bench_elastic.py --check "
                          "(host-loss kill matrix: watchdog hang, "
@@ -214,6 +219,18 @@ def main():
             [sys.executable, "-m", "tools.bench_fleet", "--check"],
             cwd=REPO, env=env)
         print(f"bench fleet: exit {code} ({time.time() - t0:.0f}s)")
+        if code:
+            sys.exit(code)
+        # Second storm: zero-loss serving (separate subprocess — each
+        # storm arms its own PADDLE_TPU_FAULT_SPEC singleton). Gated on
+        # bitwise stream equality, zero drops, and a recompile-free
+        # migrating roll.
+        t0 = time.time()
+        code = subprocess.call(
+            [sys.executable, "-m", "tools.bench_fleet",
+             "--migrate", "--check"],
+            cwd=REPO, env=env)
+        print(f"bench fleet migrate: exit {code} ({time.time() - t0:.0f}s)")
         if code:
             sys.exit(code)
 
